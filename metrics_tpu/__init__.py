@@ -1,0 +1,19 @@
+"""metrics_tpu — a TPU-native (JAX/XLA) machine-learning metrics framework.
+
+Capability parity target: TorchMetrics v0.8.0dev (/root/reference). Exports
+grow as domains land; see SURVEY.md §2.8 for the full target inventory.
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+__version__ = "0.1.0"
+
+from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
+
+__all__ = [
+    "CompositionalMetric",
+    "Metric",
+]

@@ -1,0 +1,204 @@
+"""Distributed state synchronization — the TPU-native equivalent of the
+reference's ``torch.distributed`` backend.
+
+The reference (/root/reference/torchmetrics/utilities/distributed.py:96-145)
+implements ``gather_all_tensors`` as: barrier -> gather per-rank shapes ->
+pad to elementwise-max -> ``all_gather`` -> trim, over NCCL/Gloo process
+groups. Here the same contract is provided two ways, both XLA-native:
+
+* **Host-level** (`gather_all_arrays`): cross-process gather using a one-shot
+  pjit'ed ``all_gather`` over the global device mesh (ICI within a host/pod
+  slice, DCN across hosts via ``jax.distributed``). Uneven per-rank shapes
+  are handled with the same pad-to-max + trim contract, with the shape
+  exchange done host-side (it is outside any jit region, mirroring the
+  reference where the gather is likewise eager).
+* **In-jit** (`sync_in_mesh` / `reduce_state`): for metric state living
+  inside a pjit/shard_map region, reductions map directly onto XLA
+  collectives over a named mesh axis — ``psum``/``pmean``/``pmax``/``pmin``
+  for scalar-reduced states and ``all_gather(tiled=True)`` for concat
+  states. This is cheaper than gather-then-reduce (the reference's only
+  strategy) because the reduction rides the ICI all-reduce.
+
+``process_group`` in the reference maps to a *mesh axis name* (or a subset
+axis) here.
+"""
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def distributed_available() -> bool:
+    """True when more than one process participates (multi-host JAX)."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def world_size(group: Optional[Any] = None) -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def process_index() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Host-level gather (cross-process, outside jit)
+# ---------------------------------------------------------------------------
+
+def _process_allgather(x: Array) -> List[Array]:
+    """All-gather ``x`` across processes; returns a list of per-process arrays."""
+    if not distributed_available():
+        return [jnp.asarray(x)]
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(np.asarray(x), tiled=False)
+    return [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
+
+
+def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """Gather an array from all processes, supporting uneven dim sizes.
+
+    Contract parity with the reference ``gather_all_tensors``
+    (/root/reference/torchmetrics/utilities/distributed.py:96-145): returns a
+    list of arrays, one per process, each with its true (untrimmed) shape.
+    """
+    result = jnp.asarray(result)
+    if not distributed_available():
+        return [result]
+
+    if result.ndim == 0:
+        return _process_allgather(result)
+
+    # exchange shapes host-side, pad to elementwise max, gather, trim
+    local_shape = np.asarray(result.shape, dtype=np.int64)
+    all_shapes = _process_allgather(jnp.asarray(local_shape))
+    all_shapes = [np.asarray(s) for s in all_shapes]
+    max_shape = np.max(np.stack(all_shapes), axis=0)
+
+    if all((s == all_shapes[0]).all() for s in all_shapes):
+        return _process_allgather(result)
+
+    pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
+    padded = jnp.pad(result, pad_width)
+    gathered = _process_allgather(padded)
+    return [g[tuple(slice(0, int(d)) for d in shp)] for g, shp in zip(gathered, all_shapes)]
+
+
+# ---------------------------------------------------------------------------
+# In-jit collectives over a named mesh axis
+# ---------------------------------------------------------------------------
+
+def _axis_size(axis_name: str) -> int:
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:  # older jax
+        return jax.lax.psum(1, axis_name)
+
+
+def all_gather_replicated(x: Array, axis_name: str, tiled: bool = True) -> Array:
+    """All-gather whose output is *replicated* (VMA-clean) across the axis.
+
+    Implemented as a psum of the local shard scattered into its slot — the
+    same bytes over ICI as a ring all-gather, but the output is provably
+    identical on every device, so ``shard_map`` can emit it with
+    ``PartitionSpec()`` without ``check_vma=False``.
+    """
+    x = jnp.asarray(x)
+    n = _axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    work_dtype = jnp.int32 if x.dtype == jnp.bool_ else x.dtype
+    buf = jnp.zeros((n,) + x.shape, work_dtype).at[idx].set(x.astype(work_dtype))
+    out = jax.lax.psum(buf, axis_name)
+    if x.dtype == jnp.bool_:
+        out = out.astype(jnp.bool_)
+    if tiled:
+        out = out.reshape((n * x.shape[0],) + x.shape[1:]) if x.ndim >= 1 else out
+    return out
+
+
+def sync_in_mesh(
+    state: Dict[str, Union[Array, list]],
+    reductions: Dict[str, Union[str, Callable, None]],
+    axis_name: str,
+) -> Dict[str, Union[Array, list]]:
+    """Synchronize a metric-state pytree across a named mesh axis, inside jit.
+
+    ``"sum"/"mean"/"max"/"min"`` states use the matching XLA all-reduce;
+    ``"cat"`` (and list) states use a tiled ``all_gather``. Use inside
+    ``shard_map``/``pmap`` bodies where ``axis_name`` is bound.
+    """
+    out: Dict[str, Union[Array, list]] = {}
+    for name, value in state.items():
+        red = reductions.get(name)
+        if isinstance(value, list):
+            cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
+            out[name] = [all_gather_replicated(cat, axis_name, tiled=True)]
+            continue
+        if red is None:
+            # "gathered, not reduced" parity: stack per-rank values along a new dim 0
+            out[name] = all_gather_replicated(value, axis_name, tiled=False)
+        elif red == "sum":
+            out[name] = jax.lax.psum(value, axis_name)
+        elif red == "mean":
+            out[name] = jax.lax.pmean(value, axis_name)
+        elif red == "max":
+            out[name] = jax.lax.pmax(value, axis_name)
+        elif red == "min":
+            out[name] = jax.lax.pmin(value, axis_name)
+        elif red == "cat":
+            out[name] = all_gather_replicated(value, axis_name, tiled=True)
+        elif callable(red):
+            out[name] = red(all_gather_replicated(value, axis_name, tiled=False))
+        else:
+            raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scalar reduction helpers (parity with reference reduce/class_reduce)
+# ---------------------------------------------------------------------------
+
+def reduce(x: Array, reduction: str) -> Array:
+    """Reduce a tensor: 'elementwise_mean' | 'sum' | 'none'.
+
+    Parity with /root/reference/torchmetrics/utilities/distributed.py:21-40.
+    """
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction in ("none", None):
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Per-class fraction reduction: 'micro' | 'macro' | 'weighted' | 'none'.
+
+    Parity with /root/reference/torchmetrics/utilities/distributed.py:43-93.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+    fraction = jnp.where(jnp.isnan(fraction), 0.0, fraction) if class_reduction != "micro" else fraction
+
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights / jnp.sum(weights)))
+    if class_reduction in ("none", None):
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")

@@ -1,0 +1,140 @@
+"""Array helpers: dim-zero reducers, one-hot, top-k selection, collection mapping.
+
+Behavior parity with /root/reference/torchmetrics/utilities/data.py:24-253,
+re-expressed in JAX. The dim-zero reducers are the per-state reduction
+functions applied after a cross-process gather (``dist_reduce_fx``).
+"""
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+METRIC_EPS = 1e-6
+
+
+def dim_zero_cat(x: Union[Array, List[Array], Tuple[Array, ...]]) -> Array:
+    """Concatenation along dim 0; accepts a single array or a (possibly nested) list."""
+    if not isinstance(x, (list, tuple)):
+        return jnp.asarray(x)
+    x = [jnp.atleast_1d(jnp.asarray(el)) for el in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(jnp.asarray(x), axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(jnp.asarray(x), axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(jnp.asarray(x), axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(jnp.asarray(x), axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    return [item for sublist in x for item in sublist]
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert integer labels ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Parity with /root/reference/torchmetrics/utilities/data.py:70-101.
+    """
+    label_tensor = jnp.asarray(label_tensor)
+    if label_tensor.ndim == 2 and jnp.issubdtype(label_tensor.dtype, jnp.floating):
+        # already (N, C) probabilities/onehot
+        return label_tensor
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot appends class dim last -> move to position 1
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary int mask selecting the ``topk`` highest entries along ``dim``.
+
+    Parity with /root/reference/torchmetrics/utilities/data.py:104-132.
+    """
+    prob_tensor = jnp.asarray(prob_tensor)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    mask = jnp.sum(jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32), axis=-2)
+    mask = jnp.clip(mask, 0, 1)
+    return jnp.moveaxis(mask, -1, dim).astype(jnp.int32)
+
+
+def to_categorical(tensor: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/logits -> integer labels by argmax.
+
+    Parity with /root/reference/torchmetrics/utilities/data.py:135-155.
+    """
+    return jnp.argmax(jnp.asarray(tensor), axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` elements of a collection.
+
+    Parity with /root/reference/torchmetrics/utilities/data.py:179-226.
+    """
+    elem_type = type(data)
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return elem_type(
+            {k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()}
+        )
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return elem_type(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return elem_type([apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data])
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group positions by value; returns one index array per distinct group id.
+
+    Host-side parity helper (/root/reference/torchmetrics/utilities/data.py:229-253).
+    The on-device retrieval path uses sorted segment ops instead
+    (metrics_tpu/functional/retrieval/_segments.py).
+    """
+    indexes = np.asarray(indexes)
+    res: dict = {}
+    for i, val in enumerate(indexes):
+        val = val.item()
+        res.setdefault(val, []).append(i)
+    return [jnp.asarray(group, dtype=jnp.int32) for group in res.values()]
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-length bincount (jit-safe)."""
+    return jnp.bincount(jnp.asarray(x).reshape(-1), length=minlength)
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Recursively squeeze single-element arrays to 0-d.
+
+    Parity with /root/reference/torchmetrics/utilities/data.py:256-261.
+    """
+
+    def _sq(x: Array) -> Array:
+        return x.reshape(()) if x.size == 1 else x
+
+    return apply_to_collection(data, (jnp.ndarray,), _sq)

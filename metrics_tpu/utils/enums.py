@@ -1,0 +1,55 @@
+"""String-valued enums for metric configuration.
+
+Behavior parity with /root/reference/torchmetrics/utilities/enums.py:15-83
+(the case-deduction ``DataType`` and averaging enums), re-expressed for the
+TPU-native framework. All enums compare case-insensitively against strings.
+"""
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """String enum with a tolerant ``from_str`` constructor."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            return None
+
+    def __eq__(self, other: Union[str, Enum, None]) -> bool:  # type: ignore[override]
+        other = other.value if isinstance(other, Enum) else str(other)
+        return self.value.lower() == other.lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Classification input "case" deduced from shapes/dtypes.
+
+    Reference: /root/reference/torchmetrics/utilities/enums.py:35-45.
+    """
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Reduction over classes. Reference: utilities/enums.py:48-66."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multidim-multiclass extra-dim handling. Reference: utilities/enums.py:69-76."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

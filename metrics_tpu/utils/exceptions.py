@@ -1,0 +1,13 @@
+"""User-facing exceptions.
+
+Parity with the reference's ``TorchMetricsUserError``
+(/root/reference/torchmetrics/utilities/exceptions.py:17).
+"""
+
+
+class MetricsUserError(Exception):
+    """Error raised when user misuses the metric API (e.g. illegal sync ordering)."""
+
+
+class MetricsUserWarning(UserWarning):
+    """Warning category for metric API usage issues (e.g. memory-heavy list states)."""

@@ -1,0 +1,34 @@
+"""Optional-dependency availability gates.
+
+Parity with /root/reference/torchmetrics/utilities/imports.py:94-118: every
+optional third-party package used by a metric (or by a test oracle) gets a
+module-level boolean so import of the package never hard-fails.
+"""
+from importlib.util import find_spec
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_SCIPY_AVAILABLE = _package_available("scipy")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_NLTK_AVAILABLE = _package_available("nltk")
+_ROUGE_SCORE_AVAILABLE = _package_available("rouge_score")
+_SACREBLEU_AVAILABLE = _package_available("sacrebleu")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_JIWER_AVAILABLE = _package_available("jiwer")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")
+_TORCH_AVAILABLE = _package_available("torch")
+_TORCHVISION_AVAILABLE = _package_available("torchvision")
+_TORCH_FIDELITY_AVAILABLE = _package_available("torch_fidelity")
+_LPIPS_AVAILABLE = _package_available("lpips")
+_BERTSCORE_AVAILABLE = _package_available("bert_score")
+_REGEX_AVAILABLE = _package_available("regex")
+_FLAX_AVAILABLE = _package_available("flax")
+_ORBAX_AVAILABLE = _package_available("orbax.checkpoint")

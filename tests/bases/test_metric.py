@@ -1,0 +1,226 @@
+"""Single-process Metric lifecycle tests.
+
+Parity in spirit with /root/reference/tests/bases/test_metric.py (383 LoC):
+add_state validation, reset/cache semantics, forward double-update, compute
+caching, pickle, hashing, state_dict, pure-state API.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+class DummyMetric(Metric):
+    name = "Dummy"
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.array(0.0), dist_reduce_fx="sum")
+
+    def _update(self, x=None):
+        if x is not None:
+            self.x = self.x + jnp.asarray(x, dtype=jnp.float32)
+
+    def _compute(self):
+        return self.x
+
+
+class DummyListMetric(Metric):
+    name = "DummyList"
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def _update(self, x=None):
+        if x is not None:
+            self.x.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def _compute(self):
+        return self.x
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError):
+        m.add_state("bad", [jnp.array(1.0)], dist_reduce_fx="sum")
+    with pytest.raises(ValueError):
+        m.add_state("bad2", jnp.array(0.0), dist_reduce_fx="not_a_reduction")
+    m.add_state("ok", jnp.zeros(3), dist_reduce_fx="mean")
+    assert "ok" in m._defaults
+
+
+def test_update_and_compute():
+    m = DummyMetric()
+    m.update(1.0)
+    m.update(2.0)
+    assert np.allclose(m.compute(), 3.0)
+
+
+def test_compute_cached_until_update():
+    m = DummyMetric()
+    m.update(1.0)
+    assert np.allclose(m.compute(), 1.0)
+    # cached
+    m._computed_probe = m._computed
+    assert m._computed_probe is not None
+    m.update(1.0)
+    assert m._computed is None
+    assert np.allclose(m.compute(), 2.0)
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    m = DummyMetric()
+    b1 = m(1.0)
+    assert np.allclose(b1, 1.0)
+    b2 = m(2.0)
+    assert np.allclose(b2, 2.0)  # batch value, not accumulation
+    assert np.allclose(m.compute(), 3.0)  # global accumulation
+
+
+def test_reset():
+    m = DummyMetric()
+    m.update(5.0)
+    m.reset()
+    assert np.allclose(m.x, 0.0)
+    lm = DummyListMetric()
+    lm.update(jnp.ones(3))
+    lm.reset()
+    assert lm.x == []
+
+
+def test_reset_compute():
+    m = DummyMetric()
+    m.update(5.0)
+    assert np.allclose(m.compute(), 5.0)
+    m.reset()
+    m.update(2.0)
+    assert np.allclose(m.compute(), 2.0)
+
+
+def test_list_state_append_and_compute():
+    m = DummyListMetric()
+    m.update(jnp.array([1.0, 2.0]))
+    m.update(jnp.array([3.0]))
+    out = m.compute()
+    assert len(out) == 2
+
+
+def test_pickle_roundtrip():
+    m = DummyMetric()
+    m.update(3.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert np.allclose(m2.compute(), 3.0)
+
+
+def test_hash_differs_between_instances():
+    a, b = DummyMetric(), DummyMetric()
+    assert hash(a) != hash(b)
+
+
+def test_const_attr_immutable():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError):
+        m.higher_is_better = True
+    with pytest.raises(RuntimeError):
+        m.is_differentiable = True
+
+
+def test_state_dict_roundtrip():
+    m = DummyMetric()
+    m.update(4.0)
+    sd = m.state_dict()
+    assert np.allclose(sd["x"], 4.0)
+    m2 = DummyMetric()
+    m2.load_state_dict(sd)
+    m2._update_called = True
+    assert np.allclose(m2.compute(), 4.0)
+
+
+def test_state_dict_list_state():
+    m = DummyListMetric()
+    m.update(jnp.array([1.0, 2.0]))
+    sd = m.state_dict()
+    m2 = DummyListMetric()
+    m2.load_state_dict(sd)
+    m2._update_called = True
+    out = m2.compute()
+    assert np.allclose(out[0], [1.0, 2.0])
+
+
+def test_pure_state_api():
+    m = DummyMetric()
+    s = m.init_state()
+    s = m.update_state(s, 1.0)
+    s = m.update_state(s, 2.0)
+    assert np.allclose(m.compute_state(s), 3.0)
+    # metric instance untouched
+    assert np.allclose(m.x, 0.0)
+
+
+def test_pure_state_api_jit():
+    m = DummyMetric()
+    s = m.init_state()
+    step = jax.jit(m.update_state)
+    s = step(s, jnp.array(1.0))
+    s = step(s, jnp.array(2.0))
+    assert np.allclose(m.compute_state(s), 3.0)
+
+
+def test_merge_states():
+    m = DummyMetric()
+    a = m.update_state(m.init_state(), 1.0)
+    b = m.update_state(m.init_state(), 2.0)
+    merged = m.merge_states(a, b)
+    assert np.allclose(m.compute_state(merged), 3.0)
+
+
+def test_sync_without_distributed_is_noop():
+    m = DummyMetric()
+    m.update(1.0)
+    m.sync()
+    assert not m._is_synced
+    with pytest.raises(MetricsUserError):
+        m.unsync()
+
+
+def test_double_sync_raises():
+    m = DummyMetric()
+    m.update(1.0)
+    fake_gather = lambda x, group=None: [x, x]
+    m.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+    assert m._is_synced
+    assert np.allclose(m.x, 2.0)  # summed over fake world of 2
+    with pytest.raises(MetricsUserError):
+        m.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+    m.unsync()
+    assert np.allclose(m.x, 1.0)
+
+
+def test_forward_while_synced_raises():
+    m = DummyMetric()
+    m.update(1.0)
+    m.sync(dist_sync_fn=lambda x, group=None: [x, x], distributed_available=lambda: True)
+    with pytest.raises(MetricsUserError):
+        m(2.0)
+
+
+def test_set_dtype():
+    m = DummyMetric()
+    m.update(1.0)
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16
+
+
+def test_clone_independent():
+    m = DummyMetric()
+    m.update(1.0)
+    c = m.clone()
+    c.update(1.0)
+    assert np.allclose(m.compute(), 1.0)
+    assert np.allclose(c.compute(), 2.0)

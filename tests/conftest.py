@@ -1,0 +1,20 @@
+"""Test session configuration: force CPU with 8 virtual devices so mesh /
+collective tests run without TPU hardware (SURVEY.md §4 implication).
+
+A pytest plugin (jaxtyping) imports jax before this conftest runs, so the
+platform must be set via ``jax.config.update`` (still possible until the
+backend is first queried), and the XLA flag via the environment (read at
+backend initialization).
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.device_count() >= 8, f"expected >=8 virtual devices, got {jax.device_count()}"

@@ -1,0 +1,260 @@
+"""Universal metric test harness.
+
+Parity in spirit with the reference MetricTester
+(/root/reference/tests/helpers/testers.py:329-564): numerical parity vs a
+reference oracle (sklearn etc.) both per-batch and on the full accumulated
+dataset, const-attr immutability, compile check (jit replaces torchscript),
+pickle round-trip, hashability. The reference's 2-process Gloo pool is
+replaced by (a) a virtual-rank merge check via the pure state API and (b)
+real-collective tests over an 8-virtual-device CPU mesh in tests/bases.
+"""
+from functools import partial
+import pickle
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_PROCESSES = 2  # virtual ranks for merge-based ddp simulation
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def _assert_allclose(tpu_result: Any, sk_result: Any, atol: float = 1e-8) -> None:
+    if isinstance(tpu_result, dict):
+        assert isinstance(sk_result, dict), f"oracle returned {type(sk_result)}, metric returned dict"
+        for key in tpu_result:
+            np.testing.assert_allclose(
+                np.asarray(tpu_result[key]), np.asarray(sk_result[key]), atol=atol, rtol=1e-5, err_msg=f"key={key}"
+            )
+    elif isinstance(tpu_result, (list, tuple)) and not isinstance(sk_result, np.ndarray):
+        for t, s in zip(tpu_result, sk_result):
+            _assert_allclose(t, s, atol=atol)
+    else:
+        np.testing.assert_allclose(np.asarray(tpu_result), np.asarray(sk_result), atol=atol, rtol=1e-5)
+
+
+def _assert_array(tpu_result: Any) -> None:
+    if isinstance(tpu_result, dict):
+        for key in tpu_result:
+            assert isinstance(tpu_result[key], jnp.ndarray), f"{key} is not an array"
+    elif isinstance(tpu_result, (list, tuple)):
+        for el in tpu_result:
+            _assert_array(el)
+    else:
+        assert isinstance(tpu_result, jnp.ndarray), f"{tpu_result} is not an array"
+
+
+def _class_test(
+    preds: Any,
+    target: Any,
+    metric_class: type,
+    sk_metric: Callable,
+    metric_args: Optional[dict] = None,
+    check_batch: bool = True,
+    check_merge: bool = True,
+    check_jit: bool = True,
+    check_pickle: bool = True,
+    atol: float = 1e-8,
+    fragment_kwargs: bool = False,
+    **kwargs_update: Any,
+) -> None:
+    """Single-process lifecycle + virtual-rank merge parity test."""
+    metric_args = metric_args or {}
+    metric = metric_class(**metric_args)
+
+    # const attrs are immutable
+    for attr in ("is_differentiable", "higher_is_better"):
+        try:
+            setattr(metric, attr, True)
+            raise AssertionError(f"const attr {attr} was assignable")
+        except RuntimeError:
+            pass
+
+    num_batches = len(preds) if isinstance(preds, (list, tuple)) else preds.shape[0]
+    for i in range(num_batches):
+        batch_kwargs = {
+            k: (v[i] if isinstance(v, (list, tuple)) or (hasattr(v, "shape") and len(v) == num_batches) else v)
+            for k, v in kwargs_update.items()
+        }
+        batch_result = metric(preds[i], target[i], **batch_kwargs)
+
+        if check_pickle and i == 0:
+            clone = pickle.loads(pickle.dumps(metric))
+            assert type(clone) is type(metric)
+
+        if check_batch:
+            sk_batch_result = sk_metric(preds[i], target[i], **batch_kwargs)
+            _assert_allclose(batch_result, sk_batch_result, atol=atol)
+
+    # full-dataset accumulated value vs oracle on everything
+    result = metric.compute()
+    _assert_array(result)
+    total_kwargs = {
+        k: (np.concatenate([np.asarray(vv) for vv in v]) if isinstance(v, (list, tuple)) or (hasattr(v, "shape") and len(v) == num_batches) else v)
+        for k, v in kwargs_update.items()
+    }
+    if isinstance(preds, (list, tuple)):
+        all_preds = np.concatenate([np.asarray(p) for p in preds])
+        all_target = np.concatenate([np.asarray(t) for t in target])
+    else:
+        all_preds = np.asarray(preds).reshape(-1, *preds.shape[2:])
+        all_target = np.asarray(target).reshape(-1, *target.shape[2:])
+    sk_result = sk_metric(all_preds, all_target, **total_kwargs)
+    _assert_allclose(result, sk_result, atol=atol)
+
+    # hashability
+    assert isinstance(hash(metric), int)
+
+    # virtual-rank merge parity: split batches over NUM_PROCESSES "ranks",
+    # accumulate independently via the pure state API, merge, compute.
+    if check_merge and not kwargs_update:
+        states = []
+        for rank in range(NUM_PROCESSES):
+            m = metric_class(**metric_args)
+            state = m.init_state()
+            for i in range(rank, num_batches, NUM_PROCESSES):
+                state = m.update_state(state, preds[i], target[i])
+            states.append(state)
+        merged = metric.merge_states(states[0], states[1])
+        merged_result = metric.compute_state(merged)
+        _assert_allclose(merged_result, sk_result, atol=atol)
+
+    # jit-compilability of the pure update (replaces torchscript check)
+    if check_jit and not getattr(metric_class, "__jit_unsafe__", False) and not kwargs_update:
+        m = metric_class(**metric_args)
+        state = m.init_state()
+        jit_state = jax.jit(m.update_state)(state, jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        eager_state = m.update_state(state, jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        for k in eager_state:
+            ev, jv = eager_state[k], jit_state[k]
+            if isinstance(ev, list):
+                for e, j in zip(ev, jv):
+                    np.testing.assert_allclose(np.asarray(j), np.asarray(e), atol=1e-6, rtol=1e-5)
+            else:
+                np.testing.assert_allclose(np.asarray(jv), np.asarray(ev), atol=1e-6, rtol=1e-5)
+
+
+def _functional_test(
+    preds: Any,
+    target: Any,
+    metric_functional: Callable,
+    sk_metric: Callable,
+    metric_args: Optional[dict] = None,
+    atol: float = 1e-8,
+    **kwargs_update: Any,
+) -> None:
+    metric_args = metric_args or {}
+    metric = partial(metric_functional, **metric_args)
+    num_batches = len(preds) if isinstance(preds, (list, tuple)) else preds.shape[0]
+    for i in range(min(num_batches, 2)):
+        batch_kwargs = {
+            k: (v[i] if isinstance(v, (list, tuple)) or (hasattr(v, "shape") and len(v) == num_batches) else v)
+            for k, v in kwargs_update.items()
+        }
+        tpu_result = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]), **batch_kwargs)
+        sk_result = sk_metric(preds[i], target[i], **batch_kwargs)
+        _assert_allclose(tpu_result, sk_result, atol=atol)
+
+
+class MetricTester:
+    """Base class for all metric test classes."""
+
+    atol: float = 1e-8
+
+    def run_class_metric_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        sk_metric: Callable,
+        dist_sync_on_step: bool = False,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+        check_merge: bool = True,
+        check_jit: bool = True,
+        atol: Optional[float] = None,
+        **kwargs_update: Any,
+    ) -> None:
+        _class_test(
+            preds,
+            target,
+            metric_class,
+            sk_metric,
+            metric_args=metric_args,
+            check_batch=check_batch,
+            check_merge=check_merge,
+            check_jit=check_jit,
+            atol=self.atol if atol is None else atol,
+            **kwargs_update,
+        )
+
+    def run_functional_metric_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        atol: Optional[float] = None,
+        **kwargs_update: Any,
+    ) -> None:
+        _functional_test(
+            preds,
+            target,
+            metric_functional,
+            sk_metric,
+            metric_args=metric_args,
+            atol=self.atol if atol is None else atol,
+            **kwargs_update,
+        )
+
+    def run_precision_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        """bf16 analog of the reference fp16 test: update/compute must not crash."""
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        metric.set_dtype(jnp.bfloat16)
+        p = jnp.asarray(preds[0])
+        if jnp.issubdtype(p.dtype, jnp.floating):
+            p = p.astype(jnp.bfloat16)
+        metric.update(p, jnp.asarray(target[0]))
+        metric.compute()
+
+    def run_differentiability_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        """jax.grad analog of the reference autograd test."""
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        if metric.is_differentiable:
+            p = jnp.asarray(preds[0], dtype=jnp.float32)
+            t = jnp.asarray(target[0])
+
+            def scalar_fn(pp):
+                out = metric_functional(pp, t, **metric_args)
+                if isinstance(out, (tuple, list)):
+                    out = out[0]
+                return jnp.sum(jnp.asarray(out))
+
+            grad = jax.grad(scalar_fn)(p)
+            assert jnp.all(jnp.isfinite(grad)), "gradient contains non-finite values"
+
+
+class DummyMetric:
+    pass
