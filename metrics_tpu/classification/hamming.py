@@ -1,0 +1,44 @@
+"""Modular HammingDistance.
+
+Behavior parity with /root/reference/torchmetrics/classification/
+hamming.py:23-100.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.hamming import _hamming_distance_compute, _hamming_distance_update
+
+Array = jax.Array
+
+
+class HammingDistance(Metric):
+    """Computes the average Hamming distance (Hamming loss).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> preds = jnp.array([[0, 1], [0, 1]])
+        >>> hamming_distance = HammingDistance()
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.threshold = threshold
+
+    def _update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def _compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

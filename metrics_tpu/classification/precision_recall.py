@@ -1,0 +1,79 @@
+"""Modular Precision and Recall.
+
+Behavior parity with /root/reference/torchmetrics/classification/
+precision_recall.py:22-311.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.precision_recall import _precision_compute, _recall_compute
+
+Array = jax.Array
+
+
+class _PrecisionRecallBase(StatScores):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+
+
+class Precision(_PrecisionRecallBase):
+    """Computes precision: ``tp / (tp + fp)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> precision = Precision(average='macro', num_classes=3)
+        >>> precision(preds, target)
+        Array(0.16666667, dtype=float32)
+    """
+
+    def _compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _precision_compute(tp, fp, fn, self.average, self.mdmc_reduce)
+
+
+class Recall(_PrecisionRecallBase):
+    """Computes recall: ``tp / (tp + fn)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> recall = Recall(average='macro', num_classes=3)
+        >>> recall(preds, target)
+        Array(0.33333334, dtype=float32)
+    """
+
+    def _compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _recall_compute(tp, fp, fn, self.average, self.mdmc_reduce)

@@ -1,0 +1,108 @@
+"""Modular StatScores — the base of the classification metric family.
+
+Behavior parity with /root/reference/torchmetrics/classification/
+stat_scores.py:24-260: tp/fp/tn/fn accumulators of static shape (``[]`` for
+micro, ``[num_classes]`` for macro) with sum reduction, or list states when
+``reduce='samples'`` / ``mdmc_reduce='samplewise'``.
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_compute, _stat_scores_update
+
+Array = jax.Array
+
+
+class StatScores(Metric):
+    """Computes the number of true/false positives/negatives and support.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([1, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> stat_scores = StatScores(reduce='macro', num_classes=3)
+        >>> stat_scores(preds, target)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        default: Callable = list
+        reduce_fn: Optional[str] = "cat"
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            zeros_shape = [] if reduce == "micro" else [num_classes]
+            default = lambda: jnp.zeros(zeros_shape, dtype=jnp.int32)
+            reduce_fn = "sum"
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
+
+    def _update(self, preds: Array, target: Array) -> None:
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+        if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+        else:
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        """Concatenate list states if necessary. Reference stat_scores.py:221-227."""
+        tp = jnp.concatenate(self.tp) if isinstance(self.tp, list) else self.tp
+        fp = jnp.concatenate(self.fp) if isinstance(self.fp, list) else self.fp
+        tn = jnp.concatenate(self.tn) if isinstance(self.tn, list) else self.tn
+        fn = jnp.concatenate(self.fn) if isinstance(self.fn, list) else self.fn
+        return tp, fp, tn, fn
+
+    def _compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

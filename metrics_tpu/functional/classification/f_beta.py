@@ -1,0 +1,148 @@
+"""F-beta / F1 functional kernels.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+f_beta.py:24-229 (the micro path masks ignored classes before summing; the
+macro/none class removal is re-expressed as a jit-safe ignore mask).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.data import _safe_divide
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: str,
+    mdmc_average: Optional[str],
+) -> Array:
+    """Reference f_beta.py:30-108.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> tp, fp, tn, fn = _stat_scores_update(preds, target, reduce='micro', num_classes=3)
+        >>> _fbeta_compute(tp, fp, tn, fn, beta=0.5, ignore_index=None, average='micro', mdmc_average=None)
+        Array(0.33333334, dtype=float32)
+    """
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0
+        precision = _safe_divide(jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32), jnp.sum(jnp.where(mask, tp + fp, 0)))
+        recall = _safe_divide(jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32), jnp.sum(jnp.where(mask, tp + fn, 0)))
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), tp + fp)
+        recall = _safe_divide(tp.astype(jnp.float32), tp + fn)
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    # absent classes (no TPs, FPs, nor FNs) are meaningless for per-class scores
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = (tp | fn | fp) == 0
+        if ignore_index is not None:
+            meaningless = meaningless.at[ignore_index].set(True)
+        num = jnp.where(meaningless, -1.0, num)
+        denom = jnp.where(meaningless, -1.0, denom)
+    elif ignore_index is not None:
+        if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES) and mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+            num = num.at[..., ignore_index].set(-1.0)
+            denom = denom.at[..., ignore_index].set(-1.0)
+        elif average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+            num = num.at[ignore_index, ...].set(-1.0)
+            denom = denom.at[ignore_index, ...].set(-1.0)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        num = jnp.where(cond, 0.0, num)
+        denom = jnp.where(cond, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """One-shot F-beta. Reference f_beta.py:111-229.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> fbeta_score(preds, target, num_classes=3, beta=0.5)
+        Array(0.33333334, dtype=float32)
+    """
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1. Reference f_beta.py:232-344.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> f1_score(preds, target, num_classes=3)
+        Array(0.33333334, dtype=float32)
+    """
+    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

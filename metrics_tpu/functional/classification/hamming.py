@@ -1,0 +1,40 @@
+"""Hamming distance functional kernel.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+hamming.py:22-100.
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+Array = jax.Array
+
+
+def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5) -> Tuple[Array, int]:
+    """Reference hamming.py:22-41."""
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    """Reference hamming.py:44-59."""
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Average Hamming distance (a.k.a. Hamming loss). Reference hamming.py:62-100.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> preds = jnp.array([[0, 1], [0, 1]])
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

@@ -1,0 +1,235 @@
+"""True/false positive/negative counting — the spine of the classification stack.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+stat_scores.py (:64-286): canonical-format inputs are reduced by boolean
+masks + sums over the (sample, class, extra) axes depending on ``reduce`` /
+``mdmc_reduce``; ``_reduce_stat_scores`` implements the shared
+micro/macro/weighted/none/samples averaging used by every StatScores-derived
+metric. All functions are pure and jit-compatible (static shapes given
+``num_classes``).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    return jnp.concatenate([data[:, :idx], data[:, (idx + 1):]], axis=1)
+
+
+def _drop_negative_ignored_indices(
+    preds: Array, target: Array, ignore_index: int, mode: DataType
+) -> Tuple[Array, Array]:
+    """Remove positions whose target equals a negative ignore_index.
+
+    Reference stat_scores.py:28-61. Boolean-mask indexing is data-dependent,
+    so this path (negative ignore_index) is host-eager only.
+    """
+    if mode == DataType.MULTIDIM_MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating):
+        n_dims = preds.ndim
+        num_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 1, n_dims - 1).reshape(-1, num_classes)
+        target = target.reshape(-1)
+
+    if mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        keep = target != ignore_index
+        preds = preds[keep]
+        target = target[keep]
+
+    return preds, target
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn over canonical binary ``(N,C)`` / ``(N,C,X)`` inputs.
+
+    Reference stat_scores.py:64-110; output shapes per reduce mode match.
+    """
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+    else:  # samples
+        dim = 1
+
+    true_pred, false_pred = target == preds, target != preds
+    pos_pred, neg_pred = preds == 1, preds == 0
+
+    tp = jnp.sum(true_pred & pos_pred, axis=dim)
+    fp = jnp.sum(false_pred & pos_pred, axis=dim)
+    tn = jnp.sum(true_pred & neg_pred, axis=dim)
+    fn = jnp.sum(false_pred & neg_pred, axis=dim)
+
+    return (
+        tp.astype(jnp.int32),
+        fp.astype(jnp.int32),
+        tn.astype(jnp.int32),
+        fn.astype(jnp.int32),
+    )
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    mode: Optional[DataType] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Format inputs and count statistics. Reference stat_scores.py:113-196."""
+    _negative_index_dropped = False
+
+    if ignore_index is not None and ignore_index < 0 and mode is not None:
+        preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
+        _negative_index_dropped = True
+
+    preds, target, _ = _input_format_classification(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if ignore_index is not None and ignore_index >= preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro" and not _negative_index_dropped:
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Concatenate [tp, fp, tn, fn, support] on the last dim. Reference :199-230."""
+    stats = [
+        jnp.expand_dims(tp, -1),
+        jnp.expand_dims(fp, -1),
+        jnp.expand_dims(tn, -1),
+        jnp.expand_dims(fn, -1),
+        jnp.expand_dims(tp, -1) + jnp.expand_dims(fn, -1),  # support
+    ]
+    outputs = jnp.concatenate(stats, axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """Shared micro/macro/weighted/none/samples reduction. Reference :233-286."""
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    if weights is None:
+        weights = jnp.ones_like(denominator)
+    else:
+        weights = weights.astype(jnp.float32)
+
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    # sum(weights)==0 (e.g. only present class ignored with average='weighted')
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        scores = jnp.where(ignore_mask, jnp.nan, scores)
+    else:
+        scores = jnp.sum(scores)
+
+    return scores
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """One-shot tp/fp/tn/fn/support counts; shapes per reference :288-420.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([1, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> stat_scores(preds, target, reduce='macro', num_classes=3)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+    """
+    if reduce not in ("micro", "macro", "samples"):
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in (None, "samplewise", "global"):
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

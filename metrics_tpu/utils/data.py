@@ -123,6 +123,13 @@ def get_group_indexes(indexes: Array) -> List[Array]:
     return [jnp.asarray(group, dtype=jnp.int32) for group in res.values()]
 
 
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """Division that returns num/1 where denom == 0 (parity with reference
+    /root/reference/torchmetrics/functional/classification/f_beta.py:24-27)."""
+    denom = jnp.where(denom == 0, 1, denom)
+    return num / denom
+
+
 def _bincount(x: Array, minlength: int) -> Array:
     """Static-length bincount (jit-safe)."""
     return jnp.bincount(jnp.asarray(x).reshape(-1), length=minlength)

@@ -1,0 +1,104 @@
+"""Accuracy vs sklearn oracle. Parity in spirit with
+/root/reference/tests/classification/test_accuracy.py."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.functional import accuracy
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    preds, target = np.asarray(preds), np.asarray(target)
+    sk_preds, sk_target, mode = _input_format(preds, target)
+    if mode == "multilabel" and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+    elif mode == "mdmc" and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+    elif mode == "mdmc" and subset_accuracy:
+        return np.mean([np.array_equal(p, t) for p, t in zip(sk_preds, sk_target)])
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+def _input_format(preds, target):
+    """Mimic the canonical formatting for the oracle."""
+    if preds.ndim == target.ndim and np.issubdtype(preds.dtype, np.floating):
+        if preds.ndim == 1:  # binary prob
+            return (preds >= THRESHOLD).astype(int), target, "binary"
+        return (preds >= THRESHOLD).astype(int), target, "multilabel"  # multilabel prob
+    if preds.ndim == target.ndim + 1:  # multiclass prob
+        return np.argmax(preds, axis=1), target, "multiclass"
+    if preds.ndim == target.ndim and preds.ndim >= 2:
+        return preds, target, "mdmc"
+    return preds, target, "multiclass"
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, False),
+        (_input_multilabel.preds, _input_multilabel.target, False),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, False),
+        (_input_multiclass.preds, _input_multiclass.target, False),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, True),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, True),
+    ],
+)
+class TestAccuracy(MetricTester):
+    def test_accuracy_class(self, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+            atol=1e-6,
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+            atol=1e-6,
+        )
+
+
+def test_accuracy_topk():
+    """top_k accuracy on multiclass probabilities, reference docstring value."""
+    import jax.numpy as jnp
+
+    target = jnp.array([0, 1, 2])
+    preds = jnp.array([[0.1, 0.9, 0], [0.3, 0.1, 0.6], [0.2, 0.5, 0.3]])
+    acc = Accuracy(top_k=2)
+    np.testing.assert_allclose(acc(preds, target), 2 / 3, atol=1e-6)
+
+
+def test_accuracy_invalid_average():
+    with pytest.raises(ValueError):
+        Accuracy(average="invalid")
+
+
+def test_accuracy_mode_switch_raises():
+    import jax.numpy as jnp
+
+    acc = Accuracy()
+    acc.update(jnp.array([0, 1, 1]), jnp.array([0, 1, 0]))
+    with pytest.raises(ValueError):
+        acc.update(jnp.array([[0.1, 0.9], [0.8, 0.2]]).ravel()[:2].reshape(2), jnp.array([0, 1]))
+        acc.update(jnp.array([[0.1, 0.9, 0.0], [0.3, 0.1, 0.6]]), jnp.array([0, 1]))

@@ -1,0 +1,52 @@
+"""HammingDistance vs sklearn hamming_loss."""
+import numpy as np
+import pytest
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+
+from metrics_tpu.classification import HammingDistance
+from metrics_tpu.functional import hamming_distance
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_hamming(preds, target):
+    preds, target = np.asarray(preds), np.asarray(target)
+    if np.issubdtype(preds.dtype, np.floating):
+        preds = (preds >= THRESHOLD).astype(int)
+    return sk_hamming_loss(target.reshape(-1), preds.reshape(-1))
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary.preds, _input_binary.target),
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_multilabel.preds, _input_multilabel.target),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target),
+    ],
+)
+class TestHammingDistance(MetricTester):
+    atol = 1e-6
+
+    def test_hamming_class(self, preds, target):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=HammingDistance,
+            sk_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+    def test_hamming_fn(self, preds, target):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=hamming_distance,
+            sk_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )

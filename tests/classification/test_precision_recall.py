@@ -1,0 +1,157 @@
+"""Precision/Recall/FBeta/Specificity vs sklearn oracles."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import multilabel_confusion_matrix
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu.classification import F1Score, FBetaScore, Precision, Recall, Specificity
+from metrics_tpu.functional import f1_score, fbeta_score, precision, recall, specificity
+from tests.classification.inputs import _input_binary_prob, _input_multiclass, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _to_labels(preds, target):
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    elif np.issubdtype(preds.dtype, np.floating):
+        preds = (preds >= THRESHOLD).astype(int)
+    return preds, target
+
+
+def _sk_prec(preds, target, average="micro"):
+    preds, target = _to_labels(preds, target)
+    labels = np.arange(NUM_CLASSES) if average != "binary" else None
+    avg = None if average == "none" else average
+    res = sk_precision(target, preds, average=avg, labels=labels, zero_division=0)
+    return res
+
+
+def _sk_rec(preds, target, average="micro"):
+    preds, target = _to_labels(preds, target)
+    labels = np.arange(NUM_CLASSES) if average != "binary" else None
+    avg = None if average == "none" else average
+    return sk_recall(target, preds, average=avg, labels=labels, zero_division=0)
+
+
+def _sk_fbeta_fn(preds, target, average="micro", beta=1.0):
+    preds, target = _to_labels(preds, target)
+    labels = np.arange(NUM_CLASSES) if average != "binary" else None
+    avg = None if average == "none" else average
+    return sk_fbeta(target, preds, beta=beta, average=avg, labels=labels, zero_division=0)
+
+
+def _sk_specificity(preds, target, average="macro"):
+    preds, target = _to_labels(preds, target)
+    mcm = multilabel_confusion_matrix(target, preds, labels=np.arange(NUM_CLASSES))
+    tn, fp = mcm[:, 0, 0].astype(float), mcm[:, 0, 1].astype(float)
+    spec_per_class = np.divide(tn, tn + fp, out=np.zeros_like(tn), where=(tn + fp) > 0)
+    if average == "macro":
+        return spec_per_class.mean()
+    if average == "micro":
+        return tn.sum() / (tn.sum() + fp.sum())
+    return spec_per_class
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target),
+    ],
+)
+class TestPrecisionRecall(MetricTester):
+    atol = 1e-6
+
+    def test_precision(self, preds, target, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            sk_metric=partial(_sk_prec, average=average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+        )
+
+    def test_recall(self, preds, target, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            sk_metric=partial(_sk_rec, average=average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+        )
+
+    def test_fbeta(self, preds, target, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=FBetaScore,
+            sk_metric=partial(_sk_fbeta_fn, average=average, beta=0.5),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "beta": 0.5},
+        )
+
+    def test_f1(self, preds, target, average):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=F1Score,
+            sk_metric=partial(_sk_fbeta_fn, average=average, beta=1.0),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+        )
+
+    def test_precision_fn(self, preds, target, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=precision,
+            sk_metric=partial(_sk_prec, average=average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+        )
+
+    def test_recall_fn(self, preds, target, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=recall,
+            sk_metric=partial(_sk_rec, average=average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+        )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_specificity(average):
+    preds, target = _input_multiclass.preds, _input_multiclass.target
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        preds=preds,
+        target=target,
+        metric_class=Specificity,
+        sk_metric=partial(_sk_specificity, average=average),
+        metric_args={"average": average, "num_classes": NUM_CLASSES},
+        atol=1e-6,
+    )
+    tester.run_functional_metric_test(
+        preds,
+        target,
+        metric_functional=specificity,
+        sk_metric=partial(_sk_specificity, average=average),
+        metric_args={"average": average, "num_classes": NUM_CLASSES},
+        atol=1e-6,
+    )
+
+
+def test_none_average_per_class():
+    preds, target = _input_multiclass.preds, _input_multiclass.target
+    MetricTester().run_class_metric_test(
+        preds=preds,
+        target=target,
+        metric_class=Precision,
+        sk_metric=partial(_sk_prec, average="none"),
+        metric_args={"average": "none", "num_classes": NUM_CLASSES},
+        atol=1e-6,
+    )

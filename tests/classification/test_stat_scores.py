@@ -1,0 +1,68 @@
+"""StatScores vs sklearn multilabel_confusion_matrix oracle."""
+import numpy as np
+import pytest
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu.classification import StatScores
+from metrics_tpu.functional import stat_scores
+from tests.classification.inputs import _input_multiclass, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_stat_scores_macro(preds, target):
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    mcm = multilabel_confusion_matrix(target, preds, labels=np.arange(NUM_CLASSES))
+    tn, fp, fn, tp = mcm[:, 0, 0], mcm[:, 0, 1], mcm[:, 1, 0], mcm[:, 1, 1]
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+
+
+def _sk_stat_scores_micro(preds, target):
+    per_class = _sk_stat_scores_macro(preds, target)
+    return per_class.sum(axis=0)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target),
+    ],
+)
+class TestStatScores(MetricTester):
+    def test_stat_scores_macro(self, preds, target):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            sk_metric=_sk_stat_scores_macro,
+            metric_args={"reduce": "macro", "num_classes": NUM_CLASSES},
+        )
+
+    def test_stat_scores_micro(self, preds, target):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            sk_metric=_sk_stat_scores_micro,
+            metric_args={"reduce": "micro", "num_classes": NUM_CLASSES},
+        )
+
+    def test_stat_scores_fn(self, preds, target):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=stat_scores,
+            sk_metric=_sk_stat_scores_macro,
+            metric_args={"reduce": "macro", "num_classes": NUM_CLASSES},
+        )
+
+
+def test_stat_scores_invalid_args():
+    with pytest.raises(ValueError):
+        StatScores(reduce="invalid")
+    with pytest.raises(ValueError):
+        StatScores(reduce="macro")  # num_classes missing
+    with pytest.raises(ValueError):
+        StatScores(mdmc_reduce="invalid")
