@@ -1,0 +1,124 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md config 1→2 ladder): multiclass Accuracy update
+throughput on ImageNet-1k-shaped logits, jit-compiled on the available
+accelerator, compared against the reference TorchMetrics implementation
+running on torch-CPU (the reference publishes no numbers of its own —
+BASELINE.md — so the baseline is measured live from /root/reference).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 4096
+NUM_CLASSES = 1000
+WARMUP = 3
+ITERS = 20
+
+
+def _make_data():
+    rng = np.random.RandomState(42)
+    preds = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    target = rng.randint(0, NUM_CLASSES, size=(BATCH,)).astype(np.int64)
+    return preds, target
+
+
+def bench_tpu() -> float:
+    """Samples/sec through jitted Accuracy update+compute on device."""
+    import jax
+    import jax.numpy as jnp
+    from metrics_tpu.classification import Accuracy
+
+    preds_np, target_np = _make_data()
+    preds = jnp.asarray(preds_np)
+    target = jnp.asarray(target_np, dtype=jnp.int32)
+
+    metric = Accuracy(num_classes=NUM_CLASSES, average="micro", multiclass=True)
+    state = metric.init_state()
+
+    @jax.jit
+    def step(state, preds, target):
+        new_state = metric.update_state(state, preds, target)
+        return new_state, metric.compute_state(new_state)
+
+    state, value = step(state, preds, target)  # compile
+    jax.block_until_ready((state, value))
+    for _ in range(WARMUP):
+        state, value = step(state, preds, target)
+    jax.block_until_ready((state, value))
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, value = step(state, preds, target)
+    jax.block_until_ready((state, value))
+    dt = time.perf_counter() - t0
+    return BATCH * ITERS / dt
+
+
+def bench_reference() -> float:
+    """Samples/sec through the reference TorchMetrics Accuracy on torch-CPU."""
+    if "pkg_resources" not in sys.modules:
+        # modern setuptools dropped pkg_resources; the reference needs a stub
+        import types
+
+        stub = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        stub.DistributionNotFound = DistributionNotFound
+        stub.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = stub
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        import torch
+        from torchmetrics import Accuracy as TorchAccuracy
+
+        preds_np, target_np = _make_data()
+        preds = torch.from_numpy(preds_np)
+        target = torch.from_numpy(target_np)
+
+        metric = TorchAccuracy(num_classes=NUM_CLASSES, average="micro")
+        metric.update(preds, target)
+        metric.compute()
+        metric.reset()
+
+        t0 = time.perf_counter()
+        iters = max(ITERS // 4, 3)
+        for _ in range(iters):
+            metric.update(preds, target)
+            metric.compute()
+            metric._computed = None
+        dt = time.perf_counter() - t0
+        return BATCH * iters / dt
+    finally:
+        sys.path.pop(0)
+
+
+def main() -> None:
+    tpu_sps = bench_tpu()
+    try:
+        ref_sps = bench_reference()
+    except Exception:
+        ref_sps = None
+
+    print(
+        json.dumps(
+            {
+                "metric": "accuracy_update_throughput",
+                "value": round(tpu_sps, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(tpu_sps / ref_sps, 3) if ref_sps else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

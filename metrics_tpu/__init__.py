@@ -12,8 +12,26 @@ _logger.setLevel(logging.INFO)
 __version__ = "0.1.0"
 
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.classification import (  # noqa: E402
+    Accuracy,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 
 __all__ = [
+    "Accuracy",
     "CompositionalMetric",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
     "Metric",
+    "Precision",
+    "Recall",
+    "Specificity",
+    "StatScores",
 ]

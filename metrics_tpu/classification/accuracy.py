@@ -69,12 +69,8 @@ class Accuracy(StatScores):
             raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
 
         self.average = average
-        self.threshold = threshold
-        self.top_k = top_k
         self.subset_accuracy = subset_accuracy
         self.mode: Optional[DataType] = None
-        self.multiclass = multiclass
-        self.ignore_index = ignore_index
 
         if self.subset_accuracy:
             self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")

@@ -9,7 +9,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.functional.classification.stat_scores import (
+    _check_avg_arguments,
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
 from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 
 Array = jax.Array
@@ -59,16 +63,7 @@ def specificity(
         >>> specificity(preds, target, average='macro', num_classes=3)
         Array(0.6111111, dtype=float32)
     """
-    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
-    if average not in allowed_average:
-        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
-    allowed_mdmc_average = (None, "samplewise", "global")
-    if mdmc_average not in allowed_mdmc_average:
-        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
-    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
-        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
-    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
-        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+    _check_avg_arguments(average, mdmc_average, num_classes, ignore_index)
 
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(

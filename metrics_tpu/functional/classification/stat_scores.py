@@ -19,6 +19,22 @@ from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
 Array = jax.Array
 
 
+def _check_avg_arguments(
+    average: str, mdmc_average: Optional[str], num_classes: Optional[int], ignore_index: Optional[int]
+) -> None:
+    """Shared argument validation for the StatScores-derived metric family."""
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
 def _del_column(data: Array, idx: int) -> Array:
     return jnp.concatenate([data[:, :idx], data[:, (idx + 1):]], axis=1)
 
@@ -106,6 +122,13 @@ def _stat_scores_update(
         ignore_index=ignore_index,
     )
 
+    if ignore_index is not None and ignore_index < 0 and not _negative_index_dropped:
+        # torch fails loudly here via scatter index-out-of-bounds; JAX one_hot /
+        # .at[-1] would silently corrupt instead, so raise explicitly
+        raise ValueError(
+            f"A negative `ignore_index` {ignore_index} is only supported by metrics that infer the"
+            " input mode (e.g. Accuracy); use a non-negative class index here instead"
+        )
     if ignore_index is not None and ignore_index >= preds.shape[1]:
         raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
     if ignore_index is not None and preds.shape[1] == 1:

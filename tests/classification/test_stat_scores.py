@@ -66,3 +66,25 @@ def test_stat_scores_invalid_args():
         StatScores(reduce="macro")  # num_classes missing
     with pytest.raises(ValueError):
         StatScores(mdmc_reduce="invalid")
+
+
+@pytest.mark.parametrize("reduce", ["micro", "macro"])
+def test_negative_ignore_index_raises(reduce):
+    """Negative ignore_index must fail loudly in StatScores-family metrics
+    that don't infer the input mode (silent corruption guard); Accuracy's
+    mode-inferring drop path keeps supporting it."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.classification import Accuracy, Precision
+
+    preds = jnp.array([0, 1, 2, 1])
+    target = jnp.array([0, 1, 2, -1])
+    m = StatScores(reduce=reduce, num_classes=3, ignore_index=-1)
+    with pytest.raises(ValueError, match="negative"):
+        m.update(preds, target)
+    p = Precision(average="macro", num_classes=3, ignore_index=-1)
+    with pytest.raises(ValueError, match="negative"):
+        p.update(preds, target)
+
+    acc = Accuracy(num_classes=3, ignore_index=-1)
+    assert float(acc(preds, target)) == 1.0
