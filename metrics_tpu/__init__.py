@@ -22,16 +22,52 @@ from metrics_tpu.classification import (  # noqa: E402
     Specificity,
     StatScores,
 )
+from metrics_tpu.aggregation import (  # noqa: E402
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.regression import (  # noqa: E402
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
 
 __all__ = [
     "Accuracy",
+    "CatMetric",
     "CompositionalMetric",
+    "CosineSimilarity",
+    "ExplainedVariance",
     "F1Score",
     "FBetaScore",
     "HammingDistance",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MaxMetric",
+    "MeanMetric",
     "Metric",
+    "MinMetric",
+    "SumMetric",
+    "PearsonCorrCoef",
     "Precision",
+    "R2Score",
     "Recall",
+    "SpearmanCorrCoef",
     "Specificity",
     "StatScores",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
 ]

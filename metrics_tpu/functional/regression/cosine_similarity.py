@@ -1,0 +1,46 @@
+"""Cosine similarity.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/
+cosine_similarity.py:22-102.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    if reduction == "sum":
+        return jnp.sum(similarity)
+    if reduction == "mean":
+        return jnp.mean(similarity)
+    if reduction in ("none", None):
+        return similarity
+    raise ValueError(f"Expected reduction to be one of ['sum', 'mean', 'none', None] but got {reduction}")
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Computes cosine similarity between rows of preds and target.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.]])
+        >>> preds = jnp.array([[1., 2., 3., 4.], [-1., -2., -3., -4.]])
+        >>> cosine_similarity(preds, target, 'none')
+        Array([ 1., -1.], dtype=float32)
+    """
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,79 @@
+"""Explained variance.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/
+explained_variance.py:22-140, with the boolean-indexed assignments
+re-expressed as ``jnp.where`` selects (identical numerics, jit-safe).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    n_obs = preds.shape[0]
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    safe_denominator = jnp.where(valid_score, denominator, 1.0)
+    output_scores = jnp.ones_like(diff_avg)
+    output_scores = jnp.where(valid_score, 1.0 - numerator / safe_denominator, output_scores)
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`,"
+        f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+    )
+
+
+def explained_variance(
+    preds: Array,
+    target: Array,
+    multioutput: str = "uniform_average",
+) -> Union[Array, Tuple[Array, ...]]:
+    """Computes explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2., 7.])
+        >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+        >>> explained_variance(preds, target)
+        Array(0.9572649, dtype=float32)
+    """
+    n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target, multioutput
+    )

@@ -1,0 +1,37 @@
+"""Mean squared log error.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/log_mse.py.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    diff = jnp.log1p(preds) - jnp.log1p(target)
+    sum_squared_log_error = jnp.sum(diff * diff)
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Array) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Computes mean squared log error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1., 2., 3.])
+        >>> y = jnp.array([0., 1., 2., 2.])
+        >>> mean_squared_log_error(x, y)
+        Array(0.02068..., dtype=float32)
+    """
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,36 @@
+"""Mean absolute error.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/mae.py.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Array) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Computes mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1., 2., 3.])
+        >>> y = jnp.array([0., 1., 2., 1.])
+        >>> mean_absolute_error(x, y)
+        Array(0.5, dtype=float32)
+    """
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

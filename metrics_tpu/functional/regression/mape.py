@@ -1,0 +1,41 @@
+"""Mean absolute percentage error.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/mape.py
+(epsilon = 1.17e-06, taken from sklearn's implementation).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array,
+    target: Array,
+    epsilon: float = 1.17e-06,
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Computes mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1., 10., 1e6])
+        >>> preds = jnp.array([0.9, 15., 1.2e6])
+        >>> mean_absolute_percentage_error(preds, target)
+        Array(0.26666668, dtype=float32)
+    """
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

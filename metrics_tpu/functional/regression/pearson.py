@@ -1,0 +1,105 @@
+"""Pearson correlation coefficient — streaming moment accumulators.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/
+pearson.py:22-80. The streaming (mean, var, cov) update is the psum-merge
+template for all moment metrics (SURVEY.md §7 stage 7).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Streaming update of the six moment accumulators."""
+    _check_same_shape(preds, target)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+
+    n_obs = preds.size
+    mx_new = (n_prior * mean_x + jnp.mean(preds) * n_obs) / (n_prior + n_obs)
+    my_new = (n_prior * mean_y + jnp.mean(target) * n_obs) / (n_prior + n_obs)
+    n_new = n_prior + n_obs
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x))
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y))
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y))
+
+    return mx_new, my_new, var_x, var_y, corr_xy, n_new
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = jnp.squeeze(corr_xy / jnp.sqrt(var_x * var_y))
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array]:
+    """Merge per-device moment accumulators with the parallel (Chan et al.)
+    variance/covariance formula.
+
+    Role parity with reference pearson.py:23-53, but NOT formula parity: the
+    reference snapshot's merge scales its variance and covariance terms
+    inconsistently (vars as (n-1)-weighted averages of raw sums, cov as an
+    n-weighted one), which biases the merged coefficient (fixed upstream in
+    later torchmetrics releases). Here the states stay what `_update`
+    accumulates — raw centered sums — and merge exactly:
+
+        S = S1 + S2 + n1*n2/(n1+n2) * (m1 - m2)^2           (variance sums)
+        C = C1 + C2 + n1*n2/(n1+n2) * (mx1-mx2)*(my1-my2)   (covariance sum)
+
+    so the merged compute matches the single-pass result to rounding. The
+    leading dim is the (static) device count; the fold is trace-friendly.
+    """
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+        w = (n1 * n2) / nb
+        var_x = vx1 + vx2 + w * (mx1 - mx2) ** 2
+        var_y = vy1 + vy2 + w * (my1 - my2) ** 2
+        corr_xy = cxy1 + cxy2 + w * (mx1 - mx2) * (my1 - my2)
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return vx1, vy1, cxy1, n1
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Computes the Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2., 7.])
+        >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+        >>> pearson_corrcoef(preds, target)
+        Array(0.98491, dtype=float32)
+    """
+    zero = jnp.asarray(0.0, dtype=jnp.result_type(preds.dtype, jnp.float32))
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zero, zero, zero, zero, zero, jnp.asarray(0.0)
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

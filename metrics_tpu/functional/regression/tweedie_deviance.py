@@ -1,0 +1,82 @@
+"""Tweedie deviance score.
+
+Behavior parity with /root/reference/torchmetrics/functional/regression/
+tweedie_deviance.py:27-170. The power-dependent domain validations are
+value-dependent, so they run only on concrete (non-traced) arrays; under jit
+the deviance math itself is branch-free per (static) power.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlogy as _xlogy
+
+from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
+
+Array = jax.Array
+
+
+def _validate_domain(preds: Array, targets: Array, power: float) -> None:
+    if not _is_concrete(preds, targets):
+        return
+    if power == 1:
+        if bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0)):
+            raise ValueError(
+                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+            )
+    elif power == 2:
+        if bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0)):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+    elif power < 0:
+        if bool(jnp.any(preds <= 0)):
+            raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+    elif 1 < power < 2:
+        if bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0)):
+            raise ValueError(
+                f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
+            )
+    elif power > 2:
+        if bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0)):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    _check_same_shape(preds, targets)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    _validate_domain(preds, targets, power)
+
+    if power == 0:
+        deviance_score = jnp.square(targets - preds)
+    elif power == 1:  # Poisson
+        deviance_score = 2 * (_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:  # Gamma
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        term_1 = jnp.power(jnp.maximum(targets, 0.0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    sum_deviance_score = jnp.sum(deviance_score)
+    num_observations = jnp.asarray(deviance_score.size)
+    return sum_deviance_score, num_observations
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Computes the Tweedie deviance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> targets = jnp.array([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.array([4.0, 3.0, 2.0, 1.0])
+        >>> tweedie_deviance_score(preds, targets, power=2)
+        Array(4.8333335, dtype=float32)
+    """
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power=power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

@@ -1,0 +1,45 @@
+"""Modular MeanSquaredLogError.
+
+Behavior parity with /root/reference/torchmetrics/regression/log_mse.py:23-84.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.log_mse import (
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+)
+
+Array = jax.Array
+
+
+class MeanSquaredLogError(Metric):
+    """Computes mean squared log error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
+        >>> mean_squared_log_error = MeanSquaredLogError()
+        >>> mean_squared_log_error(preds, target)
+        Array(0.03973012, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
+        self.total = self.total + n_obs
+
+    def _compute(self) -> Array:
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)

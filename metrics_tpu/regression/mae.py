@@ -1,0 +1,42 @@
+"""Modular MeanAbsoluteError.
+
+Behavior parity with /root/reference/torchmetrics/regression/mae.py:23-80.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+
+Array = jax.Array
+
+
+class MeanAbsoluteError(Metric):
+    """Computes mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> mean_absolute_error = MeanAbsoluteError()
+        >>> mean_absolute_error(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + n_obs
+
+    def _compute(self) -> Array:
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)

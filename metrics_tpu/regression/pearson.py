@@ -1,0 +1,65 @@
+"""Modular PearsonCorrCoef — streaming per-rank moments with the
+parallel-variance cross-rank merge.
+
+Behavior parity with /root/reference/torchmetrics/regression/pearson.py:23-146:
+the one reference metric with a custom cross-rank merge beyond sum/cat
+(``_final_aggregation``). States use ``dist_reduce_fx=None`` (gathered and
+stacked, not reduced); compute applies the merge when it sees stacked
+multi-rank moments.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Computes the Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2., 7.])
+        >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+        >>> pearson = PearsonCorrCoef()
+        >>> pearson(preds, target)
+        Array(0.98491, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None  # both -1 and 1 are optimal
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
+            self.add_state(name, default=jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def _update(self, preds: Array, target: Array) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    def _compute(self) -> Array:
+        if self.mean_x.ndim == 1 and self.mean_x.shape[0] > 1:
+            # states were gathered (stacked) across ranks — merge the moments
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+    def merge_states(self, a: Dict[str, Array], b: Dict[str, Array]) -> Dict[str, Array]:
+        """Stack the two ranks' moments; compute() applies _final_aggregation."""
+        return {
+            name: jnp.concatenate([jnp.atleast_1d(a[name]), jnp.atleast_1d(b[name])])
+            for name in self._defaults
+        }

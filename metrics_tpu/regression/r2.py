@@ -1,0 +1,66 @@
+"""Modular R2Score.
+
+Behavior parity with /root/reference/torchmetrics/regression/r2.py:23-127.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.r2 import _r2_score_compute, _r2_score_update
+
+Array = jax.Array
+
+
+class R2Score(Metric):
+    """Computes the R² score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2., 7.])
+        >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+        >>> r2score = R2Score()
+        >>> r2score(preds, target)
+        Array(0.9486081, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_outputs: int = 1,
+        adjusted: int = 0,
+        multioutput: str = "uniform_average",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
+            )
+        self.multioutput = multioutput
+
+        zeros_shape = [] if num_outputs == 1 else [num_outputs]
+        self.add_state("sum_squared_error", default=jnp.zeros(zeros_shape), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=jnp.zeros(zeros_shape), dist_reduce_fx="sum")
+        self.add_state("residual", default=jnp.zeros(zeros_shape), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + n_obs
+
+    def _compute(self) -> Array:
+        return _r2_score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )

@@ -1,0 +1,49 @@
+"""Modular SpearmanCorrCoef (cat-state + vectorized rank transform).
+
+Behavior parity with /root/reference/torchmetrics/regression/spearman.py:25-92.
+"""
+from typing import Any
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class SpearmanCorrCoef(Metric):
+    """Computes the Spearman rank correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2., 7.])
+        >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+        >>> spearman = SpearmanCorrCoef()
+        >>> spearman(preds, target)
+        Array(1., dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
+            " For large datasets, this may lead to a large memory footprint."
+        )
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        preds, target = _spearman_corrcoef_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)

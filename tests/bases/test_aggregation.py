@@ -1,0 +1,137 @@
+"""Aggregation metric tests.
+
+Mirrors /root/reference/tests/bases/test_aggregation.py in spirit.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+def compare_mean(values, weights):
+    return np.average(np.asarray(values).flatten(), weights=np.broadcast_to(weights, np.shape(values)).flatten())
+
+
+@pytest.mark.parametrize(
+    "metric_class, compare_fn",
+    [
+        (MinMetric, np.min),
+        (MaxMetric, np.max),
+        (SumMetric, np.sum),
+        (CatMetric, lambda x: np.concatenate([np.atleast_1d(v) for v in x])),
+        (MeanMetric, np.mean),
+    ],
+)
+@pytest.mark.parametrize("case", ["scalar", "tensor", "multidim"])
+def test_aggregation_parity(metric_class, compare_fn, case):
+    rng = np.random.RandomState(5)
+    if case == "scalar":
+        values = [float(v) for v in rng.rand(10)]
+    elif case == "tensor":
+        values = [rng.rand(5).astype(np.float32) for _ in range(10)]
+    else:
+        values = [rng.rand(3, 4).astype(np.float32) for _ in range(10)]
+
+    metric = metric_class()
+    for v in values:
+        metric.update(v)
+    result = np.asarray(metric.compute())
+
+    flat = np.concatenate([np.atleast_1d(np.asarray(v)).ravel() for v in values])
+    if metric_class is CatMetric:
+        if case == "scalar":
+            expected = np.asarray(values, dtype=np.float32)
+        else:
+            expected = np.concatenate([np.asarray(v).reshape(np.asarray(v).shape) for v in values])
+        assert result.ravel() == pytest.approx(expected.ravel(), abs=1e-6)
+    else:
+        expected = compare_fn(flat)
+        assert result == pytest.approx(expected, abs=1e-5)
+
+
+def test_mean_metric_weighted():
+    metric = MeanMetric()
+    metric.update(jnp.asarray([1.0, 2.0, 3.0]), weight=jnp.asarray([1.0, 2.0, 3.0]))
+    metric.update(4.0, weight=2.0)
+    expected = (1 * 1 + 2 * 2 + 3 * 3 + 4 * 2) / (1 + 2 + 3 + 2)
+    assert float(metric.compute()) == pytest.approx(expected, abs=1e-6)
+
+
+@pytest.mark.parametrize("metric_class", [MinMetric, MaxMetric, SumMetric, CatMetric, MeanMetric])
+def test_nan_strategies(metric_class):
+    with pytest.raises(ValueError):
+        metric_class(nan_strategy="invalid")
+
+    m = metric_class(nan_strategy="error")
+    with pytest.raises(RuntimeError):
+        m.update(jnp.asarray([1.0, jnp.nan]))
+
+    m = metric_class(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, jnp.nan, 3.0]))
+    res = np.asarray(m.compute())
+    assert not np.any(np.isnan(res))
+
+    m = metric_class(nan_strategy=2.0)
+    m.update(jnp.asarray([1.0, jnp.nan, 3.0]))
+    res = np.asarray(m.compute())
+    assert not np.any(np.isnan(res))
+
+    m = metric_class(nan_strategy="warn")
+    with pytest.warns(UserWarning):
+        m.update(jnp.asarray([1.0, jnp.nan, 3.0]))
+
+
+def test_zero_value_not_skipped():
+    """The reference's `any(value.flatten())` guard wrongly skips all-zero
+    updates; element count is the correct emptiness check."""
+    m = MaxMetric()
+    m.update(0.0)
+    assert float(m.compute()) == 0.0
+    s = SumMetric()
+    s.update(jnp.zeros(3))
+    assert float(s.compute()) == 0.0
+
+
+def test_aggregator_reset():
+    m = SumMetric()
+    m.update(5.0)
+    m.reset()
+    m.update(2.0)
+    assert float(m.compute()) == 2.0
+
+
+def test_mean_metric_joint_nan_filtering():
+    """Elementwise weight with NaN in value must not desync shapes."""
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, jnp.nan, 3.0]), weight=jnp.asarray([1.0, 5.0, 2.0]))
+    assert float(m.compute()) == pytest.approx((1 * 1 + 3 * 2) / (1 + 2))
+
+
+@pytest.mark.parametrize(
+    "metric_class, values, expected",
+    [
+        (SumMetric, [1.0, np.nan, 3.0], 4.0),
+        (MaxMetric, [1.0, np.nan, 3.0], 3.0),
+        (MinMetric, [1.0, np.nan, 3.0], 1.0),
+        (MeanMetric, [1.0, np.nan, 3.0], 2.0),
+    ],
+)
+def test_nan_ignore_under_jit(metric_class, values, expected):
+    """jit and eager must agree for nan_strategy='ignore'."""
+    import jax
+
+    m = metric_class(nan_strategy="ignore")
+    state = jax.jit(m.update_state)(m.init_state(), jnp.asarray(values))
+    assert float(m.compute_state(state)) == pytest.approx(expected)
+
+
+def test_mean_merge_states():
+    m = MeanMetric()
+    s1 = m.init_state()
+    s1 = m.update_state(s1, 1.0)
+    s2 = m.init_state()
+    s2 = m.update_state(s2, 3.0)
+    merged = m.merge_states(s1, s2)
+    assert float(m.compute_state(merged)) == pytest.approx(2.0)

@@ -1,0 +1,112 @@
+"""Pearson / Spearman correlation vs scipy oracles, and CosineSimilarity."""
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+
+from metrics_tpu.functional import cosine_similarity, pearson_corrcoef, spearman_corrcoef
+from metrics_tpu.regression import CosineSimilarity, PearsonCorrCoef, SpearmanCorrCoef
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(123)
+_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = (_rng.rand(NUM_BATCHES, BATCH_SIZE) + 0.3 * _preds).astype(np.float32)
+# discrete-valued inputs exercise the tie-averaging rank path
+_preds_ties = _rng.randint(0, 10, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_target_ties = _rng.randint(0, 10, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+
+def _sk_pearson(preds, target):
+    return pearsonr(np.asarray(target, np.float64), np.asarray(preds, np.float64))[0]
+
+
+def _sk_spearman(preds, target):
+    return spearmanr(np.asarray(target, np.float64), np.asarray(preds, np.float64))[0]
+
+
+class TestPearson(MetricTester):
+    atol = 1e-4
+
+    def test_pearson_class(self):
+        self.run_class_metric_test(
+            preds=_preds,
+            target=_target,
+            metric_class=PearsonCorrCoef,
+            sk_metric=_sk_pearson,
+        )
+
+    def test_pearson_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=pearson_corrcoef, sk_metric=_sk_pearson
+        )
+
+    def test_pearson_differentiability(self):
+        self.run_differentiability_test(
+            _preds, _target, metric_class=PearsonCorrCoef, metric_functional=pearson_corrcoef
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [(_preds, _target), (_preds_ties, _target_ties)],
+)
+class TestSpearman(MetricTester):
+    atol = 1e-4
+
+    def test_spearman_class(self, preds, target):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=SpearmanCorrCoef,
+            sk_metric=_sk_spearman,
+        )
+
+    def test_spearman_functional(self, preds, target):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=spearman_corrcoef, sk_metric=_sk_spearman
+        )
+
+
+_preds_cos = _rng.rand(NUM_BATCHES, BATCH_SIZE, 4).astype(np.float32)
+_target_cos = _rng.rand(NUM_BATCHES, BATCH_SIZE, 4).astype(np.float32)
+
+
+def _sk_cosine(preds, target, reduction="sum"):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    sim = (preds * target).sum(-1) / (np.linalg.norm(preds, axis=-1) * np.linalg.norm(target, axis=-1))
+    if reduction == "sum":
+        return sim.sum()
+    if reduction == "mean":
+        return sim.mean()
+    return sim
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean"])
+class TestCosineSimilarity(MetricTester):
+    atol = 1e-4
+
+    def test_cosine_class(self, reduction):
+        self.run_class_metric_test(
+            preds=_preds_cos,
+            target=_target_cos,
+            metric_class=CosineSimilarity,
+            sk_metric=lambda p, t: _sk_cosine(p, t, reduction),
+            metric_args={"reduction": reduction},
+        )
+
+    def test_cosine_functional(self, reduction):
+        self.run_functional_metric_test(
+            _preds_cos,
+            _target_cos,
+            metric_functional=cosine_similarity,
+            sk_metric=lambda p, t: _sk_cosine(p, t, reduction),
+            metric_args={"reduction": reduction},
+        )
+
+
+def test_pearson_1d_only():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        pearson_corrcoef(jnp.ones((4, 2, 2)), jnp.ones((4, 2, 2)))
+    with pytest.raises(ValueError):
+        spearman_corrcoef(jnp.ones((4, 2, 2)), jnp.ones((4, 2, 2)))

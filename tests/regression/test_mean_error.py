@@ -1,0 +1,105 @@
+"""Mean-error family vs sklearn oracles (MSE/MAE/MSLE/MAPE/SMAPE/Tweedie).
+
+Mirrors /root/reference/tests/regression/test_mean_error.py in spirit.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+)
+
+from metrics_tpu.functional import (
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+)
+from metrics_tpu.regression import (
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(42)
+_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1
+_target = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1
+
+
+def _sk_smape(preds, target):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    return np.mean(2 * np.abs(preds - target) / np.clip(np.abs(target) + np.abs(preds), 1.17e-06, None))
+
+
+def _sk(fn, preds, target, **kw):
+    return fn(np.asarray(target, np.float64), np.asarray(preds, np.float64), **kw)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_functional, sk_metric, metric_args",
+    [
+        (MeanSquaredError, mean_squared_error, partial(_sk, sk_mse), {}),
+        (
+            MeanSquaredError,
+            mean_squared_error,
+            lambda p, t: np.sqrt(_sk(sk_mse, p, t)),
+            {"squared": False},
+        ),
+        (MeanAbsoluteError, mean_absolute_error, partial(_sk, sk_mae), {}),
+        (MeanSquaredLogError, mean_squared_log_error, partial(_sk, sk_msle), {}),
+        (MeanAbsolutePercentageError, mean_absolute_percentage_error, partial(_sk, sk_mape), {}),
+        (SymmetricMeanAbsolutePercentageError, symmetric_mean_absolute_percentage_error, _sk_smape, {}),
+        (TweedieDevianceScore, tweedie_deviance_score, partial(_sk, sk_tweedie, power=0), {"power": 0}),
+        (TweedieDevianceScore, tweedie_deviance_score, partial(_sk, sk_tweedie, power=1), {"power": 1}),
+        (TweedieDevianceScore, tweedie_deviance_score, partial(_sk, sk_tweedie, power=1.5), {"power": 1.5}),
+        (TweedieDevianceScore, tweedie_deviance_score, partial(_sk, sk_tweedie, power=2), {"power": 2}),
+    ],
+)
+class TestMeanError(MetricTester):
+    atol = 1e-5
+
+    def test_mean_error_class(self, metric_class, metric_functional, sk_metric, metric_args):
+        def sk_wrapped(preds, target):
+            return sk_metric(preds, target)
+
+        self.run_class_metric_test(
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=sk_wrapped,
+            metric_args=metric_args,
+        )
+
+    def test_mean_error_functional(self, metric_class, metric_functional, sk_metric, metric_args):
+        self.run_functional_metric_test(
+            _preds,
+            _target,
+            metric_functional=metric_functional,
+            sk_metric=lambda p, t: sk_metric(p, t),
+            metric_args=metric_args,
+        )
+
+    def test_mean_error_differentiability(self, metric_class, metric_functional, sk_metric, metric_args):
+        self.run_differentiability_test(
+            _preds, _target, metric_class=metric_class, metric_functional=metric_functional, metric_args=metric_args
+        )
+
+
+def test_tweedie_invalid_power():
+    with pytest.raises(ValueError):
+        TweedieDevianceScore(power=0.5)
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        tweedie_deviance_score(jnp.array([1.0, 2.0]), jnp.array([1.0, 2.0]), power=0.5)

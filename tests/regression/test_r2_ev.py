@@ -1,0 +1,90 @@
+"""R2Score and ExplainedVariance vs sklearn oracles."""
+import numpy as np
+import pytest
+from sklearn.metrics import explained_variance_score as sk_ev, r2_score as sk_r2
+
+from metrics_tpu.functional import explained_variance, r2_score
+from metrics_tpu.regression import ExplainedVariance, R2Score
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(7)
+_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = (_rng.rand(NUM_BATCHES, BATCH_SIZE) * 2).astype(np.float32)
+NUM_OUTPUTS = 2
+_preds_mo = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_OUTPUTS).astype(np.float32)
+_target_mo = (_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_OUTPUTS) * 2).astype(np.float32)
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize(
+    "preds, target, num_outputs",
+    [(_preds, _target, 1), (_preds_mo, _target_mo, NUM_OUTPUTS)],
+)
+class TestR2Score(MetricTester):
+    atol = 1e-4
+
+    def test_r2_class(self, multioutput, preds, target, num_outputs):
+        def sk_wrapped(p, t):
+            return sk_r2(np.asarray(t, np.float64), np.asarray(p, np.float64), multioutput=multioutput)
+
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=R2Score,
+            sk_metric=sk_wrapped,
+            metric_args={"multioutput": multioutput, "num_outputs": num_outputs},
+        )
+
+    def test_r2_functional(self, multioutput, preds, target, num_outputs):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=r2_score,
+            sk_metric=lambda p, t: sk_r2(
+                np.asarray(t, np.float64), np.asarray(p, np.float64), multioutput=multioutput
+            ),
+            metric_args={"multioutput": multioutput},
+        )
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize("preds, target", [(_preds, _target), (_preds_mo, _target_mo)])
+class TestExplainedVariance(MetricTester):
+    atol = 1e-4
+
+    def test_ev_class(self, multioutput, preds, target):
+        def sk_wrapped(p, t):
+            return sk_ev(np.asarray(t, np.float64), np.asarray(p, np.float64), multioutput=multioutput)
+
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=ExplainedVariance,
+            sk_metric=sk_wrapped,
+            metric_args={"multioutput": multioutput},
+        )
+
+    def test_ev_functional(self, multioutput, preds, target):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=explained_variance,
+            sk_metric=lambda p, t: sk_ev(
+                np.asarray(t, np.float64), np.asarray(p, np.float64), multioutput=multioutput
+            ),
+            metric_args={"multioutput": multioutput},
+        )
+
+
+def test_r2_needs_two_samples():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        r2_score(jnp.array([1.0]), jnp.array([1.0]))
+
+
+def test_invalid_multioutput():
+    with pytest.raises(ValueError):
+        R2Score(multioutput="invalid")
+    with pytest.raises(ValueError):
+        ExplainedVariance(multioutput="invalid")
