@@ -13,11 +13,19 @@ __version__ = "0.1.0"
 
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402
+    AUC,
+    AUROC,
     Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
     F1Score,
     FBetaScore,
     HammingDistance,
     Precision,
+    PrecisionRecallCurve,
+    ROC,
     Recall,
     Specificity,
     StatScores,
@@ -44,7 +52,13 @@ from metrics_tpu.regression import (  # noqa: E402
 )
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
     "CatMetric",
     "CompositionalMetric",
     "CosineSimilarity",
@@ -63,7 +77,9 @@ __all__ = [
     "SumMetric",
     "PearsonCorrCoef",
     "Precision",
+    "PrecisionRecallCurve",
     "R2Score",
+    "ROC",
     "Recall",
     "SpearmanCorrCoef",
     "Specificity",

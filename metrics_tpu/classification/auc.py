@@ -1,0 +1,43 @@
+"""Modular AUC (generic area under an (x, y) curve).
+
+Behavior parity with /root/reference/torchmetrics/classification/auc.py:24-97.
+"""
+from typing import Any
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class AUC(Metric):
+    """Computes the area under a curve given (x, y) points.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> auc = AUC()
+        >>> auc(jnp.array([0., 1., 2., 3.]), jnp.array([0., 1., 2., 2.]))
+        Array(4., dtype=float32)
+    """
+
+    __jit_unsafe__ = True
+    is_differentiable = False
+
+    def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reorder = reorder
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+
+    def _update(self, x: Array, y: Array) -> None:
+        x, y = _auc_update(x, y)
+        self.x.append(x)
+        self.y.append(y)
+
+    def _compute(self) -> Array:
+        x = dim_zero_cat(self.x)
+        y = dim_zero_cat(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

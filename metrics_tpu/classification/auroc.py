@@ -1,0 +1,92 @@
+"""Modular AUROC (cat-state, exact sorted mode).
+
+Behavior parity with /root/reference/torchmetrics/classification/auroc.py:27-181,
+including the memory-footprint warning (auroc.py:146-149) and mode locking.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import AverageMethod
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class AUROC(Metric):
+    """Computes the Area Under the Receiver Operating Characteristic Curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> auroc = AUROC(pos_label=1)
+        >>> auroc(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    __jit_unsafe__ = True
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.average = average
+        self.max_fpr = max_fpr
+
+        allowed_average = (None, AverageMethod.MACRO, AverageMethod.WEIGHTED, AverageMethod.MICRO, AverageMethod.NONE)
+        if average not in allowed_average:
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+
+        if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
+            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+
+        self.mode = None
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+        rank_zero_warn(
+            "Metric `AUROC` will save all targets and predictions in buffer."
+            " For large datasets this may lead to large memory footprint."
+        )
+
+    def _update(self, preds: Array, target: Array) -> None:
+        preds, target, mode = _auroc_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+        if self.mode and self.mode != mode:
+            raise ValueError(
+                "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
+                f" between batches from {self.mode} to {mode}"
+            )
+        self.mode = mode
+
+    def _compute(self) -> Array:
+        if not self.mode:
+            raise RuntimeError("You have to have determined mode.")
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _auroc_compute(
+            preds,
+            target,
+            self.mode,
+            self.num_classes,
+            self.pos_label,
+            self.average,
+            self.max_fpr,
+        )

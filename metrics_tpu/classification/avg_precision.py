@@ -1,0 +1,64 @@
+"""Modular AveragePrecision (cat-state, exact sorted mode).
+
+Behavior parity with /root/reference/torchmetrics/classification/avg_precision.py:28-143.
+"""
+from typing import Any, List, Optional, Union
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute,
+    _average_precision_update,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class AveragePrecision(Metric):
+    """Computes the average precision score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0., 1., 2., 3.])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> average_precision = AveragePrecision(pos_label=1)
+        >>> average_precision(pred, target)
+        Array(1., dtype=float32)
+    """
+
+    __jit_unsafe__ = True
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        average: Optional[str] = "macro",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        allowed_average = ("micro", "macro", "weighted", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+        self.average = average
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        preds, target, num_classes, pos_label = _average_precision_update(
+            preds, target, self.num_classes, self.pos_label, self.average
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def _compute(self) -> Union[Array, List[Array]]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)

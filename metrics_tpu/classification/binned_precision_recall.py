@@ -1,0 +1,189 @@
+"""Binned (fixed-threshold) precision-recall family — the TPU-native curve
+formulation.
+
+Behavior parity with /root/reference/torchmetrics/classification/
+binned_precision_recall.py:45-322: static-shape ``[num_classes,
+num_thresholds]`` TP/FP/FN accumulators with sum reduction. This is the
+critical TPU template (SURVEY.md §2.4): the whole metric is jit-compatible,
+its state syncs with a single psum, and memory is constant in dataset size.
+
+TPU-first departure: the reference updates with a Python loop over
+thresholds (binned_precision_recall.py:165-171, "to conserve memory");
+here the update is a single vectorized pass — each prediction is bucketized
+with ``searchsorted`` into its threshold bin (O(N·C·log T)), per-bin counts
+are accumulated with a scatter-add (O(N·C + C·T) memory), and the
+``pred >= threshold_t`` counts are recovered with a reversed cumulative sum.
+Identical numerics for sorted thresholds (enforced at construction).
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute_with_precision_recall,
+)
+from metrics_tpu.utils.data import METRIC_EPS, to_onehot
+
+Array = jax.Array
+
+
+def _recall_at_precision(
+    precision: Array,
+    recall: Array,
+    thresholds: Array,
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    """Highest recall with precision >= min_precision (ties -> max precision,
+    then max threshold). Vectorized form of reference
+    binned_precision_recall.py:25-42 (which zips to len(thresholds))."""
+    n = thresholds.shape[0]
+    precision, recall = precision[:n], recall[:n]
+    valid = precision >= min_precision
+    r = jnp.where(valid, recall, -jnp.inf)
+    max_recall = jnp.max(r)
+    cand = valid & (recall == max_recall)
+    p = jnp.where(cand, precision, -jnp.inf)
+    cand = cand & (precision == jnp.max(p))
+    best_threshold = jnp.max(jnp.where(cand, thresholds, -jnp.inf))
+    max_recall = jnp.where(jnp.isfinite(max_recall), max_recall, 0.0)
+    best_threshold = jnp.where(max_recall == 0.0, jnp.asarray(1e6, thresholds.dtype), best_threshold)
+    return max_recall, best_threshold
+
+
+class BinnedPrecisionRecallCurve(Metric):
+    """Precision-recall pairs at fixed thresholds, in constant memory.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        >>> precision, recall, thresholds = pr_curve(pred, target)
+        >>> precision
+        Array([0.5      , 0.5      , 1.       , 1.       , 0.999999 , 1.       ],      dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0.5, 0.5, 0. , 0. ], dtype=float32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Array, List[float], None] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        if isinstance(thresholds, int):
+            self.num_thresholds = thresholds
+            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+        elif thresholds is not None:
+            if not isinstance(thresholds, (list, jnp.ndarray)):
+                raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+            thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
+            if bool(jnp.any(thresholds[1:] < thresholds[:-1])):
+                raise ValueError("Expected argument `thresholds` to be sorted in increasing order")
+            self.num_thresholds = thresholds.size
+            self.thresholds = thresholds
+        else:
+            raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+
+        for name in ("TPs", "FPs", "FNs"):
+            self.add_state(
+                name=name,
+                default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
+                dist_reduce_fx="sum",
+            )
+
+    def _update(self, preds: Array, target: Array) -> None:
+        if preds.ndim == target.ndim == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        if preds.ndim == target.ndim + 1:
+            target = to_onehot(target, num_classes=self.num_classes)
+
+        target = (target == 1).astype(jnp.float32)  # [N, C]
+        preds = preds.astype(jnp.float32)
+
+        # bin index of the largest threshold <= pred; -1 means below all
+        # thresholds (masked out of the scatter)
+        bins = jnp.searchsorted(self.thresholds, preds, side="right") - 1  # [N, C], in [-1, T-1]
+        valid = (bins >= 0).astype(jnp.float32)
+        bins_c = jnp.maximum(bins, 0)
+        cols = jnp.broadcast_to(jnp.arange(preds.shape[1]), preds.shape)
+
+        zeros = jnp.zeros((preds.shape[1], self.num_thresholds), dtype=jnp.float32)
+        pos_per_bin = zeros.at[cols, bins_c].add(target * valid)
+        all_per_bin = zeros.at[cols, bins_c].add(valid)
+
+        # pred >= thresholds[t]  <=>  bin >= t : reversed cumulative sum
+        tp = jnp.cumsum(pos_per_bin[:, ::-1], axis=1)[:, ::-1]
+        pred_pos = jnp.cumsum(all_per_bin[:, ::-1], axis=1)[:, ::-1]
+        total_pos = jnp.sum(target, axis=0)[:, None]
+
+        self.TPs = self.TPs + tp
+        self.FPs = self.FPs + (pred_pos - tp)
+        self.FNs = self.FNs + (total_pos - tp)
+
+    def _compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
+        recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
+
+        # guarantee the curve ends at precision=1, recall=0
+        t_ones = jnp.ones((self.num_classes, 1), dtype=precisions.dtype)
+        precisions = jnp.concatenate([precisions, t_ones], axis=1)
+        t_zeros = jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)
+        recalls = jnp.concatenate([recalls, t_zeros], axis=1)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
+
+class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
+    """Average precision at fixed thresholds, in constant memory.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0.0, 1.0, 2.0, 3.0]) / 3
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> average_precision = BinnedAveragePrecision(num_classes=1, thresholds=10)
+        >>> bool(average_precision(pred, target) > 0.99)
+        True
+    """
+
+    def _compute(self) -> Union[List[Array], Array]:
+        precisions, recalls, _ = super()._compute()
+        return _average_precision_compute_with_precision_recall(
+            precisions, recalls, self.num_classes, average=None
+        )
+
+
+class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
+    """Highest recall at a minimum precision, at fixed thresholds."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Union[int, Array, List[float], None] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, **kwargs)
+        self.min_precision = min_precision
+
+    def _compute(self) -> Tuple[Array, Array]:
+        precisions, recalls, thresholds = super()._compute()
+
+        if self.num_classes == 1:
+            return _recall_at_precision(precisions, recalls, thresholds, self.min_precision)
+
+        recalls_at_p = []
+        thresholds_at_p = []
+        for i in range(self.num_classes):
+            r, t = _recall_at_precision(precisions[i], recalls[i], thresholds[i], self.min_precision)
+            recalls_at_p.append(r)
+            thresholds_at_p.append(t)
+        return jnp.stack(recalls_at_p), jnp.stack(thresholds_at_p)

@@ -1,0 +1,60 @@
+"""Modular PrecisionRecallCurve (cat-state, exact sorted mode).
+
+Behavior parity with /root/reference/torchmetrics/classification/
+precision_recall_curve.py:28-145.
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PrecisionRecallCurve(Metric):
+    """Computes precision-recall pairs for different thresholds.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0., 1., 2., 3.])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> pr_curve = PrecisionRecallCurve(pos_label=1)
+        >>> precision, recall, thresholds = pr_curve(pred, target)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+    """
+
+    __jit_unsafe__ = True  # exact curve mode has data-dependent output shapes
+    is_differentiable = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        preds, target, num_classes, pos_label = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def _compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

@@ -17,3 +17,8 @@ from metrics_tpu.functional.regression import (  # noqa: F401
     symmetric_mean_absolute_percentage_error,
     tweedie_deviance_score,
 )
+from metrics_tpu.functional.classification.auc import auc  # noqa: F401
+from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
+from metrics_tpu.functional.classification.average_precision import average_precision  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
+from metrics_tpu.functional.classification.roc import roc  # noqa: F401

@@ -1,0 +1,64 @@
+"""Area under a curve via the trapezoidal rule.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+auc.py:20-136.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _is_concrete
+
+Array = jax.Array
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    if x.ndim > 1:
+        x = jnp.squeeze(x)
+    if y.ndim > 1:
+        y = jnp.squeeze(y)
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(
+            f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimension {x.ndim} and {y.ndim}"
+        )
+    if x.size != y.size:
+        raise ValueError(
+            f"Expected the same number of elements in `x` and `y` tensor but received {x.size} and {y.size}"
+        )
+    return x, y
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float) -> Array:
+    return jnp.trapezoid(y, x) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        idx = jnp.argsort(x, stable=True)
+        x, y = x[idx], y[idx]
+
+    dx = x[1:] - x[:-1]
+    if _is_concrete(dx):
+        if bool(jnp.any(dx < 0)) and not bool(jnp.all(dx <= 0)):
+            raise ValueError(
+                "The `x` tensor is neither increasing or decreasing. Try setting the reorder argument to `True`."
+            )
+    # trace-safe direction (the mixed-order error above needs concrete values,
+    # but decreasing-x negation must agree between jit and eager)
+    direction = jnp.where(jnp.any(dx < 0) & jnp.all(dx <= 0), -1.0, 1.0)
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Computes the area under the curve (x, y) by the trapezoidal rule.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1., 2., 3.])
+        >>> y = jnp.array([0., 1., 2., 2.])
+        >>> auc(x, y)
+        Array(4., dtype=float32)
+    """
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

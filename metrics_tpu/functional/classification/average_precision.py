@@ -1,0 +1,109 @@
+"""Average precision (area under the PR curve via the step interpolation).
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+average_precision.py:26-233.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _average_precision_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Tuple[Array, Array, int, Optional[int]]:
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    if average == "micro":
+        if preds.ndim == target.ndim:
+            # treat each element of the label indicator matrix as a label
+            preds = preds.flatten()
+            target = target.flatten()
+            num_classes = 1
+        else:
+            raise ValueError("Cannot use `micro` average with multi-class input")
+    return preds, target, num_classes, pos_label
+
+
+def _average_precision_compute_with_precision_recall(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Union[List[Array], Array]:
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = []
+    for p, r in zip(precision, recall):
+        res.append(-jnp.sum((r[1:] - r[:-1]) * p[:-1]))
+
+    if average in ("macro", "weighted"):
+        res = jnp.stack(res)
+        if bool(jnp.any(jnp.isnan(res))):
+            rank_zero_warn(
+                "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+                UserWarning,
+            )
+        if average == "macro":
+            return jnp.mean(res[~jnp.isnan(res)])
+        weights = jnp.where(jnp.isnan(res), 0.0, weights)
+        return jnp.sum(jnp.where(jnp.isnan(res), 0.0, res) * weights / jnp.sum(weights))
+    if average is None or average == "none":
+        return res
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+
+
+def _average_precision_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
+    if average == "weighted":
+        if preds.ndim == target.ndim and target.ndim > 1:
+            weights = jnp.sum(target, axis=0).astype(jnp.float32)
+        else:
+            weights = _bincount(target.astype(jnp.int32), minlength=num_classes).astype(jnp.float32)
+        weights = weights / jnp.sum(weights)
+    else:
+        weights = None
+    return _average_precision_compute_with_precision_recall(precision, recall, num_classes, average, weights)
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """Computes the average precision score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0., 1., 2., 3.])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> average_precision(pred, target, pos_label=1)
+        Array(1., dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
+    return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

@@ -1,0 +1,184 @@
+"""Precision-recall curve — the shared sorted-curve kernel for the exact
+(curve/ranking) classification family.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+precision_recall_curve.py:23-343. ``_binary_clf_curve`` is the sklearn-derived
+sort → dedupe-thresholds → cumsum kernel. These exact-mode functions produce
+data-dependent output shapes (distinct thresholds), so they run host-eager /
+outside jit; the fixed-shape jit-native alternative is the Binned* family
+(metrics_tpu/classification/binned_precision_recall.py).
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Sorted cumulative fps/tps per distinct threshold (sklearn-derived)."""
+    if sample_weights is not None and not isinstance(sample_weights, jnp.ndarray):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc_score_indices = jnp.argsort(-preds)
+
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    distinct_value_indices = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.concatenate([distinct_value_indices, jnp.array([target.shape[0] - 1])])
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Canonicalize curve inputs to flat binary / (N,C) layouts.
+
+    Reference precision_recall_curve.py:64-122.
+    """
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel problem
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).T
+        else:
+            preds = preds.flatten()
+            target = target.flatten()
+            num_classes = 1
+    elif preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                "Argument `pos_label` should be `None` when running"
+                f" multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+        target = target.flatten()
+    else:
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(
+        preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+    )
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+
+    # stop when full recall attained; reverse so recall is decreasing
+    last_ind = int(jnp.nonzero(tps == tps[-1], size=1)[0][0])
+    sl = slice(0, last_ind + 1)
+
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresholds = thresholds[sl][::-1]
+
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    precision, recall, thresholds = [], [], []
+    for cls in range(num_classes):
+        preds_cls = preds[:, cls]
+        prc_args = dict(
+            preds=preds_cls,
+            target=target,
+            num_classes=1,
+            pos_label=cls,
+            sample_weights=sample_weights,
+        )
+        if target.ndim > 1:
+            prc_args.update(dict(target=target[:, cls], pos_label=1))
+        res = precision_recall_curve(**prc_args)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _precision_recall_curve_compute_single_class(preds, target, pos_label, sample_weights)
+    return _precision_recall_curve_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Computes precision-recall pairs for different thresholds.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0., 1., 2., 3.])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0. , 0. ], dtype=float32)
+        >>> thresholds
+        Array([1., 2., 3.], dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

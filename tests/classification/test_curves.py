@@ -1,0 +1,303 @@
+"""Curve metrics (ROC / AUROC / PR-curve / AveragePrecision / AUC) vs sklearn,
+plus the binned fixed-threshold family.
+
+Mirrors the reference tests/classification/test_{roc,auroc,
+precision_recall_curve,average_precision}.py in spirit.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    auc as sk_auc,
+    average_precision_score as sk_average_precision,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_auc_score as sk_roc_auc,
+    roc_curve as sk_roc_curve,
+)
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, MetricTester
+
+_rng = np.random.RandomState(42)
+_preds_binary = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target_binary = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_preds_mc = _softmax(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32) * 3)
+_target_mc = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+
+class TestROC(MetricTester):
+    atol = 1e-5
+
+    def _sk_roc(self, preds, target):
+        fpr, tpr, thresholds = sk_roc_curve(np.asarray(target), np.asarray(preds), drop_intermediate=False)
+        # newer sklearn uses +inf as the first threshold; the reference (and
+        # this framework) use thresholds[1] + 1
+        thresholds = thresholds.copy()
+        if np.isinf(thresholds[0]):
+            thresholds[0] = thresholds[1] + 1
+        return fpr, tpr, thresholds
+
+    def test_roc_binary(self):
+        self.run_class_metric_test(
+            preds=_preds_binary,
+            target=_target_binary,
+            metric_class=ROC,
+            sk_metric=self._sk_roc,
+            metric_args={"pos_label": 1},
+            check_merge=False,
+            check_jit=False,
+        )
+
+    def test_roc_functional(self):
+        self.run_functional_metric_test(
+            _preds_binary, _target_binary, metric_functional=roc, sk_metric=self._sk_roc,
+            metric_args={"pos_label": 1},
+        )
+
+
+class TestPrecisionRecallCurve(MetricTester):
+    atol = 1e-5
+
+    def _sk_prc(self, preds, target):
+        precision, recall, thresholds = sk_precision_recall_curve(np.asarray(target), np.asarray(preds))
+        # sklearn >= 1.1 keeps the full curve; the reference truncates at the
+        # first attainment of full recall — drop the leading duplicated-recall
+        # run (all but its last element) to match
+        m = int(np.max(np.nonzero(recall == recall[0])[0]))
+        return precision[m:], recall[m:], thresholds[m:]
+
+    def test_prc_binary(self):
+        self.run_class_metric_test(
+            preds=_preds_binary,
+            target=_target_binary,
+            metric_class=PrecisionRecallCurve,
+            sk_metric=self._sk_prc,
+            metric_args={"pos_label": 1},
+            check_merge=False,
+            check_jit=False,
+        )
+
+    def test_prc_functional(self):
+        self.run_functional_metric_test(
+            _preds_binary, _target_binary, metric_functional=precision_recall_curve, sk_metric=self._sk_prc,
+            metric_args={"pos_label": 1},
+        )
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+class TestAUROCMulticlass(MetricTester):
+    atol = 1e-5
+
+    def test_auroc_multiclass(self, average):
+        def sk_metric(preds, target):
+            return sk_roc_auc(
+                np.asarray(target), np.asarray(preds), multi_class="ovr", average="macro" if average == "macro" else "weighted",
+                labels=list(range(NUM_CLASSES)),
+            )
+
+        self.run_class_metric_test(
+            preds=_preds_mc,
+            target=_target_mc,
+            metric_class=AUROC,
+            sk_metric=sk_metric,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            check_merge=False,
+            check_jit=False,
+        )
+
+    def test_auroc_functional(self, average):
+        def sk_metric(preds, target):
+            return sk_roc_auc(
+                np.asarray(target), np.asarray(preds), multi_class="ovr",
+                average="macro" if average == "macro" else "weighted", labels=list(range(NUM_CLASSES)),
+            )
+
+        self.run_functional_metric_test(
+            _preds_mc, _target_mc, metric_functional=auroc, sk_metric=sk_metric,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+
+class TestAUROCBinary(MetricTester):
+    atol = 1e-5
+
+    def test_auroc_binary(self):
+        self.run_class_metric_test(
+            preds=_preds_binary,
+            target=_target_binary,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: sk_roc_auc(np.asarray(t), np.asarray(p)),
+            check_merge=False,
+            check_jit=False,
+        )
+
+    def test_auroc_max_fpr(self):
+        for max_fpr in (0.1, 0.5):
+            result = auroc(jnp.asarray(_preds_binary[0]), jnp.asarray(_target_binary[0]), max_fpr=max_fpr)
+            expected = sk_roc_auc(_target_binary[0], _preds_binary[0], max_fpr=max_fpr)
+            np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+
+class TestAveragePrecision(MetricTester):
+    atol = 1e-5
+
+    def test_ap_binary(self):
+        self.run_class_metric_test(
+            preds=_preds_binary,
+            target=_target_binary,
+            metric_class=AveragePrecision,
+            sk_metric=lambda p, t: sk_average_precision(np.asarray(t), np.asarray(p)),
+            metric_args={"pos_label": 1},
+            check_merge=False,
+            check_jit=False,
+        )
+
+    def test_ap_multiclass_macro(self):
+        def sk_metric(preds, target):
+            target_oh = np.eye(NUM_CLASSES)[np.asarray(target)]
+            scores = [
+                sk_average_precision(target_oh[:, i], np.asarray(preds)[:, i]) for i in range(NUM_CLASSES)
+            ]
+            return np.mean(scores)
+
+        self.run_class_metric_test(
+            preds=_preds_mc,
+            target=_target_mc,
+            metric_class=AveragePrecision,
+            sk_metric=sk_metric,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            check_merge=False,
+            check_jit=False,
+        )
+
+    def test_ap_functional(self):
+        self.run_functional_metric_test(
+            _preds_binary,
+            _target_binary,
+            metric_functional=average_precision,
+            sk_metric=lambda p, t: sk_average_precision(np.asarray(t), np.asarray(p)),
+            metric_args={"pos_label": 1},
+        )
+
+
+def test_auc_parity():
+    x = np.sort(_rng.rand(20)).astype(np.float32)
+    y = _rng.rand(20).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(auc(jnp.asarray(x), jnp.asarray(y))), sk_auc(x, y), atol=1e-6)
+    # reorder path
+    perm = _rng.permutation(20)
+    np.testing.assert_allclose(
+        np.asarray(auc(jnp.asarray(x[perm]), jnp.asarray(y[perm]), reorder=True)), sk_auc(x, y), atol=1e-6
+    )
+    m = AUC()
+    m.update(jnp.asarray(x[:10]), jnp.asarray(y[:10]))
+    m.update(jnp.asarray(x[10:]), jnp.asarray(y[10:]))
+    np.testing.assert_allclose(np.asarray(m.compute()), sk_auc(x, y), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# binned family
+# ---------------------------------------------------------------------------
+
+
+def test_binned_pr_curve_matches_exact_at_fine_thresholds():
+    """With thresholds exactly at the distinct prediction values, binned
+    TP/FP/FN match the exact curve's confusion counts."""
+    preds = np.round(_rng.rand(512).astype(np.float32), 2)
+    target = _rng.randint(0, 2, 512)
+
+    metric = BinnedPrecisionRecallCurve(num_classes=1, thresholds=101)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    precision, recall, thresholds = metric.compute()
+
+    # oracle: brute-force per threshold (use the metric's own float32
+    # thresholds — float64 linspace differs at bin boundaries)
+    thr = np.asarray(metric.thresholds)
+    tp = np.array([(preds >= t)[target == 1].sum() for t in thr])
+    fp = np.array([(preds >= t)[target == 0].sum() for t in thr])
+    fn = np.array([(preds < t)[target == 1].sum() for t in thr])
+    eps = 1e-6
+    expected_precision = (tp + eps) / (tp + fp + eps)
+    expected_recall = tp / (tp + fn + eps)
+
+    np.testing.assert_allclose(np.asarray(precision)[:-1], expected_precision, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(recall)[:-1], expected_recall, atol=1e-4)
+
+
+def test_binned_pr_multiclass_shapes():
+    metric = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=11)
+    metric.update(jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]))
+    precision, recall, thresholds = metric.compute()
+    assert len(precision) == NUM_CLASSES
+    assert precision[0].shape == (12,)
+    assert thresholds[0].shape == (11,)
+
+
+def test_binned_average_precision_close_to_exact():
+    preds = _rng.rand(4096).astype(np.float32)
+    target = (preds + 0.3 * _rng.randn(4096) > 0.5).astype(np.int32)
+    metric = BinnedAveragePrecision(num_classes=1, thresholds=201)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    binned = float(metric.compute())
+    exact = sk_average_precision(target, preds)
+    assert abs(binned - exact) < 0.01
+
+
+def test_binned_recall_at_fixed_precision():
+    preds = jnp.asarray([0.0, 0.2, 0.5, 0.8], dtype=jnp.float32)
+    target = jnp.asarray([0, 1, 1, 0])
+    metric = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+    recall, threshold = metric(preds, target)
+    assert float(recall) == pytest.approx(1.0, abs=1e-4)
+    assert float(threshold) == pytest.approx(1 / 9, abs=1e-4)
+
+
+def test_binned_recall_at_fixed_precision_no_valid():
+    preds = jnp.asarray([0.9, 0.9], dtype=jnp.float32)
+    target = jnp.asarray([0, 0])
+    metric = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=5, min_precision=0.99)
+    recall, threshold = metric(preds, target)
+    assert float(recall) == 0.0
+    assert float(threshold) == pytest.approx(1e6)
+
+
+def test_binned_update_is_jittable():
+    import jax
+
+    metric = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=11)
+    state = metric.init_state()
+    state = jax.jit(metric.update_state)(state, jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]))
+    eager = metric.update_state(metric.init_state(), jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]))
+    for k in eager:
+        np.testing.assert_allclose(np.asarray(state[k]), np.asarray(eager[k]), atol=1e-5)
+
+
+def test_binned_merge_and_sync():
+    metric = BinnedPrecisionRecallCurve(num_classes=1, thresholds=21)
+    s1 = metric.update_state(metric.init_state(), jnp.asarray(_preds_binary[0]), jnp.asarray(_target_binary[0]))
+    s2 = metric.update_state(metric.init_state(), jnp.asarray(_preds_binary[1]), jnp.asarray(_target_binary[1]))
+    merged = metric.merge_states(s1, s2)
+    p_merged, r_merged, _ = metric.compute_state(merged)
+
+    both = metric.update_state(s1, jnp.asarray(_preds_binary[1]), jnp.asarray(_target_binary[1]))
+    p_both, r_both, _ = metric.compute_state(both)
+    np.testing.assert_allclose(np.asarray(p_merged), np.asarray(p_both), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r_merged), np.asarray(r_both), atol=1e-6)
