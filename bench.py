@@ -1,10 +1,12 @@
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
-Headline (BASELINE.md config 1→2 ladder): multiclass Accuracy update
-throughput on ImageNet-1k-shaped logits, jit-compiled on the available
-accelerator, compared against the reference TorchMetrics implementation
-running on torch-CPU (the reference publishes no numbers of its own —
-BASELINE.md — so the baseline is measured live from /root/reference).
+Headline (BASELINE.md config 2): ImageNet-1k-shaped AUROC + ConfusionMatrix
+pipeline — per batch, one jitted step updates both metric states AND computes
+exact macro AUROC (Mann-Whitney rank kernel) + the confusion matrix, on the
+available accelerator. Baseline: the reference TorchMetrics AUROC +
+ConfusionMatrix on torch-CPU doing the same work (the reference publishes no
+numbers of its own — BASELINE.md — so it is measured live from
+/root/reference).
 """
 import json
 import sys
@@ -15,50 +17,54 @@ import numpy as np
 BATCH = 4096
 NUM_CLASSES = 1000
 WARMUP = 3
-ITERS = 20
+ITERS = 10
 
 
 def _make_data():
     rng = np.random.RandomState(42)
-    preds = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32) * 4
+    preds = np.exp(logits - logits.max(axis=1, keepdims=True))
+    preds /= preds.sum(axis=1, keepdims=True)
     target = rng.randint(0, NUM_CLASSES, size=(BATCH,)).astype(np.int64)
     return preds, target
 
 
 def bench_tpu() -> float:
-    """Samples/sec through jitted Accuracy update+compute on device."""
+    """Samples/sec through a jitted AUROC+ConfusionMatrix step on device."""
     import jax
     import jax.numpy as jnp
-    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.classification import ConfusionMatrix
+    from metrics_tpu.functional.classification.auroc import auroc_rank_multiclass
 
     preds_np, target_np = _make_data()
     preds = jnp.asarray(preds_np)
     target = jnp.asarray(target_np, dtype=jnp.int32)
 
-    metric = Accuracy(num_classes=NUM_CLASSES, average="micro", multiclass=True)
-    state = metric.init_state()
+    confmat = ConfusionMatrix(num_classes=NUM_CLASSES)
+    state = confmat.init_state()
 
     @jax.jit
     def step(state, preds, target):
-        new_state = metric.update_state(state, preds, target)
-        return new_state, metric.compute_state(new_state)
+        new_state = confmat.update_state(state, preds, target)
+        auc = auroc_rank_multiclass(preds, target, NUM_CLASSES, average="macro")
+        return new_state, auc
 
-    state, value = step(state, preds, target)  # compile
-    jax.block_until_ready((state, value))
+    state, auc = step(state, preds, target)  # compile
+    jax.block_until_ready((state, auc))
     for _ in range(WARMUP):
-        state, value = step(state, preds, target)
-    jax.block_until_ready((state, value))
+        state, auc = step(state, preds, target)
+    jax.block_until_ready((state, auc))
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        state, value = step(state, preds, target)
-    jax.block_until_ready((state, value))
+        state, auc = step(state, preds, target)
+    jax.block_until_ready((state, auc))
     dt = time.perf_counter() - t0
     return BATCH * ITERS / dt
 
 
 def bench_reference() -> float:
-    """Samples/sec through the reference TorchMetrics Accuracy on torch-CPU."""
+    """Samples/sec through reference TorchMetrics AUROC+ConfusionMatrix on torch-CPU."""
     if "pkg_resources" not in sys.modules:
         # modern setuptools dropped pkg_resources; the reference needs a stub
         import types
@@ -78,23 +84,26 @@ def bench_reference() -> float:
     sys.path.insert(0, "/root/reference")
     try:
         import torch
-        from torchmetrics import Accuracy as TorchAccuracy
+        from torchmetrics import AUROC as TorchAUROC, ConfusionMatrix as TorchConfusionMatrix
 
         preds_np, target_np = _make_data()
         preds = torch.from_numpy(preds_np)
         target = torch.from_numpy(target_np)
 
-        metric = TorchAccuracy(num_classes=NUM_CLASSES, average="micro")
-        metric.update(preds, target)
-        metric.compute()
-        metric.reset()
+        auroc = TorchAUROC(num_classes=NUM_CLASSES, average="macro")
+        confmat = TorchConfusionMatrix(num_classes=NUM_CLASSES)
 
+        def step():
+            confmat.update(preds, target)
+            auroc.reset()
+            auroc.update(preds, target)
+            return auroc.compute()
+
+        step()  # warmup
         t0 = time.perf_counter()
-        iters = max(ITERS // 4, 3)
+        iters = 2
         for _ in range(iters):
-            metric.update(preds, target)
-            metric.compute()
-            metric._computed = None
+            step()
         dt = time.perf_counter() - t0
         return BATCH * iters / dt
     finally:
@@ -111,7 +120,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "accuracy_update_throughput",
+                "metric": "imagenet1k_auroc_confmat_throughput",
                 "value": round(tpu_sps, 1),
                 "unit": "samples/sec",
                 "vs_baseline": round(tpu_sps / ref_sps, 3) if ref_sps else None,

@@ -20,9 +20,16 @@ from metrics_tpu.classification import (  # noqa: E402
     BinnedAveragePrecision,
     BinnedPrecisionRecallCurve,
     BinnedRecallAtFixedPrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
     F1Score,
     FBetaScore,
     HammingDistance,
+    HingeLoss,
+    JaccardIndex,
+    KLDivergence,
+    MatthewsCorrCoef,
     Precision,
     PrecisionRecallCurve,
     ROC,
@@ -59,13 +66,20 @@ __all__ = [
     "BinnedAveragePrecision",
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
+    "CalibrationError",
     "CatMetric",
+    "CohenKappa",
+    "ConfusionMatrix",
     "CompositionalMetric",
     "CosineSimilarity",
     "ExplainedVariance",
     "F1Score",
     "FBetaScore",
     "HammingDistance",
+    "HingeLoss",
+    "JaccardIndex",
+    "KLDivergence",
+    "MatthewsCorrCoef",
     "MeanAbsoluteError",
     "MeanAbsolutePercentageError",
     "MeanSquaredError",

@@ -14,3 +14,10 @@ from metrics_tpu.classification.binned_precision_recall import (  # noqa: F401
 )
 from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve  # noqa: F401
 from metrics_tpu.classification.roc import ROC  # noqa: F401
+from metrics_tpu.classification.calibration_error import CalibrationError  # noqa: F401
+from metrics_tpu.classification.cohen_kappa import CohenKappa  # noqa: F401
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix  # noqa: F401
+from metrics_tpu.classification.hinge import HingeLoss  # noqa: F401
+from metrics_tpu.classification.jaccard import JaccardIndex  # noqa: F401
+from metrics_tpu.classification.kl_divergence import KLDivergence  # noqa: F401
+from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrCoef  # noqa: F401

@@ -1,0 +1,52 @@
+"""Modular JaccardIndex (IoU), subclass of ConfusionMatrix.
+
+Behavior parity with /root/reference/torchmetrics/classification/jaccard.py:23-106.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.jaccard import _jaccard_from_confmat
+
+Array = jax.Array
+
+
+class JaccardIndex(ConfusionMatrix):
+    """Computes the Jaccard index (intersection over union).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> jaccard(preds, target)
+        Array(0.58333334, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            normalize=None,
+            threshold=threshold,
+            **kwargs,
+        )
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def _compute(self) -> Array:
+        return _jaccard_from_confmat(
+            self.confmat, self.num_classes, self.ignore_index, self.absent_score, self.reduction
+        )

@@ -1,0 +1,63 @@
+"""Modular KLDivergence.
+
+Behavior parity with /root/reference/torchmetrics/classification/kl_divergence.py:24-105.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.kl_divergence import _kld_compute, _kld_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class KLDivergence(Metric):
+    """Computes the KL divergence between distributions p and q.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> kl_divergence = KLDivergence()
+        >>> kl_divergence(p, q)
+        Array(0.08529962, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(
+        self,
+        log_prob: bool = False,
+        reduction: str = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ("mean", "sum", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, p: Array, q: Array) -> None:
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + jnp.sum(measures)
+        self.total = self.total + total
+
+    def _compute(self) -> Array:
+        measures = dim_zero_cat(self.measures) if isinstance(self.measures, list) else self.measures
+        return _kld_compute(measures, self.total, self.reduction)

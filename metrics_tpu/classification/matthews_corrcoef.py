@@ -1,0 +1,51 @@
+"""Modular MatthewsCorrCoef.
+
+Behavior parity with /root/reference/torchmetrics/classification/
+matthews_corrcoef.py:26-102.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_update,
+)
+
+Array = jax.Array
+
+
+class MatthewsCorrCoef(Metric):
+    """Computes the Matthews correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> matthews_corrcoef = MatthewsCorrCoef(num_classes=2)
+        >>> matthews_corrcoef(preds, target)
+        Array(0.57735026, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: int,
+        threshold: float = 0.5,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def _compute(self) -> Array:
+        return _matthews_corrcoef_compute(self.confmat)

@@ -22,3 +22,11 @@ from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
 from metrics_tpu.functional.classification.average_precision import average_precision  # noqa: F401
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
 from metrics_tpu.functional.classification.roc import roc  # noqa: F401
+from metrics_tpu.functional.classification.calibration_error import calibration_error  # noqa: F401
+from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa  # noqa: F401
+from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix  # noqa: F401
+from metrics_tpu.functional.classification.dice import dice_score  # noqa: F401
+from metrics_tpu.functional.classification.hinge import hinge_loss  # noqa: F401
+from metrics_tpu.functional.classification.jaccard import jaccard_index  # noqa: F401
+from metrics_tpu.functional.classification.kl_divergence import kl_divergence  # noqa: F401
+from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401

@@ -1,0 +1,63 @@
+"""Cohen's kappa from the confusion matrix.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+cohen_kappa.py:22-131.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+
+Array = jax.Array
+
+_cohen_kappa_update = _confusion_matrix_update
+
+
+def _cohen_kappa_compute(confmat: Array, weights: Optional[str] = None) -> Array:
+    confmat = _confusion_matrix_compute(confmat)
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[0]
+    sum0 = jnp.sum(confmat, axis=0, keepdims=True)
+    sum1 = jnp.sum(confmat, axis=1, keepdims=True)
+    expected = sum1 @ sum0 / jnp.sum(sum0)
+
+    if weights is None:
+        w_mat = jnp.ones_like(confmat) - jnp.eye(n_classes, dtype=confmat.dtype)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.broadcast_to(jnp.arange(n_classes, dtype=confmat.dtype), (n_classes, n_classes))
+        if weights == "linear":
+            w_mat = jnp.abs(w_mat - w_mat.T)
+        else:
+            w_mat = jnp.power(w_mat - w_mat.T, 2.0)
+    else:
+        raise ValueError(
+            f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'"
+        )
+
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    threshold: float = 0.5,
+) -> Array:
+    """Computes Cohen's kappa (inter-annotator agreement).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> cohen_kappa(preds, target, num_classes=2)
+        Array(0.5, dtype=float32)
+    """
+    confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
+    return _cohen_kappa_compute(confmat, weights)

@@ -1,0 +1,90 @@
+"""Confusion matrix via index-mapped bincount.
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+confusion_matrix.py:24-186. The (target*C + pred) -> bincount trick becomes a
+static-length ``_bincount`` (jit-safe with ``num_classes`` given).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_update(
+    preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> Array:
+    try:
+        preds, target, mode = _input_format_classification(preds, target, threshold)
+    except ValueError as err:
+        # label inputs under jit cannot infer the class count from values;
+        # retry with the explicit num_classes (eager path stays reference-parity)
+        if "under jit" not in str(err):
+            raise
+        preds, target, mode = _input_format_classification(preds, target, threshold, num_classes=num_classes)
+    if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+    if multilabel:
+        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).flatten()
+        minlength = 4 * num_classes
+    else:
+        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
+        minlength = num_classes**2
+
+    bins = _bincount(unique_mapping.astype(jnp.int32), minlength=minlength)
+    if multilabel:
+        return bins.reshape(num_classes, 2, 2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / jnp.sum(confmat, axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / jnp.sum(confmat, axis=0, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / jnp.sum(confmat)
+
+        nan_mask = jnp.isnan(confmat)
+        from metrics_tpu.utils.checks import _is_concrete
+
+        if _is_concrete(confmat) and bool(jnp.any(nan_mask)):
+            rank_zero_warn(
+                f"{int(jnp.sum(nan_mask))} nan values found in confusion matrix have been replaced with zeros."
+            )
+        confmat = jnp.where(nan_mask, 0.0, confmat)
+    return confmat
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Array:
+    """Computes the confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> confusion_matrix(preds, target, num_classes=2)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
+    return _confusion_matrix_compute(confmat, normalize)

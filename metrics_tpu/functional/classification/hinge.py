@@ -1,0 +1,105 @@
+"""Hinge loss (binary, Crammer-Singer multiclass, one-vs-all).
+
+Behavior parity with /root/reference/torchmetrics/functional/classification/
+hinge.py:24-220, with boolean-mask assignments re-expressed as ``where``
+selects (jit-safe).
+"""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_squeeze
+from metrics_tpu.utils.data import to_onehot
+from metrics_tpu.utils.enums import DataType, EnumStr
+
+Array = jax.Array
+
+
+class MulticlassMode(EnumStr):
+    """Possible multiclass modes of hinge loss."""
+
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds: Array, target: Array) -> DataType:
+    if target.ndim > 1:
+        raise ValueError(
+            f"The `target` should be one dimensional, got `target` with shape={target.shape}.",
+        )
+
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,",
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+            )
+        mode = DataType.BINARY
+    elif preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape in the first dimension,",
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+            )
+        mode = DataType.MULTICLASS
+    else:
+        raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+    return mode
+
+
+def _hinge_update(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[Array, Array]:
+    preds, target = _input_squeeze(preds, target)
+
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        target = to_onehot(target, max(2, preds.shape[1])).astype(bool)
+
+    if mode == DataType.MULTICLASS and (multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER):
+        margin = jnp.sum(jnp.where(target, preds, 0.0), axis=1)
+        margin = margin - jnp.max(jnp.where(target, -jnp.inf, preds), axis=1)
+    elif mode == DataType.BINARY or multiclass_mode == MulticlassMode.ONE_VS_ALL:
+        target = target.astype(bool)
+        margin = jnp.where(target, preds, -preds)
+    else:
+        raise ValueError(
+            "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+            "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+            f" got {multiclass_mode}."
+        )
+
+    measures = jnp.clip(1 - margin, min=0)
+    if squared:
+        measures = jnp.square(measures)
+
+    total = jnp.asarray(target.shape[0])
+    return jnp.sum(measures, axis=0), total
+
+
+def _hinge_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Array:
+    """Computes the mean hinge loss (used in SVMs).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 1])
+        >>> preds = jnp.array([-2.2, 2.4, 0.1])
+        >>> hinge_loss(preds, target)
+        Array(0.3, dtype=float32)
+    """
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)

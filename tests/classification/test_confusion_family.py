@@ -1,0 +1,279 @@
+"""ConfusionMatrix family vs sklearn oracles."""
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    cohen_kappa_score as sk_cohen_kappa,
+    confusion_matrix as sk_confusion_matrix,
+    hinge_loss as sk_hinge,
+    jaccard_score as sk_jaccard,
+    matthews_corrcoef as sk_matthews,
+)
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
+    HingeLoss,
+    JaccardIndex,
+    KLDivergence,
+    MatthewsCorrCoef,
+)
+from metrics_tpu.functional import (
+    calibration_error,
+    cohen_kappa,
+    confusion_matrix,
+    dice_score,
+    hinge_loss,
+    jaccard_index,
+    kl_divergence,
+    matthews_corrcoef,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, MetricTester
+
+_rng = np.random.RandomState(42)
+_preds_mc = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_target_mc = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_preds_bin_prob = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target_bin = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+
+def _sk_cm(preds, target):
+    return sk_confusion_matrix(np.asarray(target), np.asarray(preds), labels=np.arange(NUM_CLASSES))
+
+
+class TestConfusionMatrix(MetricTester):
+    def test_confusion_matrix_class(self):
+        self.run_class_metric_test(
+            preds=_preds_mc,
+            target=_target_mc,
+            metric_class=ConfusionMatrix,
+            sk_metric=_sk_cm,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_confusion_matrix_functional(self):
+        self.run_functional_metric_test(
+            _preds_mc, _target_mc, metric_functional=confusion_matrix, sk_metric=_sk_cm,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_confusion_matrix_normalized(self):
+        cm = confusion_matrix(
+            jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]), num_classes=NUM_CLASSES, normalize="true"
+        )
+        sk_cm_norm = sk_confusion_matrix(
+            _target_mc[0], _preds_mc[0], labels=np.arange(NUM_CLASSES), normalize="true"
+        )
+        np.testing.assert_allclose(np.asarray(cm), sk_cm_norm, atol=1e-6)
+
+    def test_confusion_matrix_binary_prob(self):
+        cm = confusion_matrix(jnp.asarray(_preds_bin_prob[0]), jnp.asarray(_target_bin[0]), num_classes=2)
+        sk_cm_bin = sk_confusion_matrix(_target_bin[0], (_preds_bin_prob[0] >= 0.5).astype(int), labels=[0, 1])
+        np.testing.assert_allclose(np.asarray(cm), sk_cm_bin)
+
+
+class TestCohenKappa(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_cohen_kappa(self, weights):
+        def sk_metric(preds, target):
+            return sk_cohen_kappa(np.asarray(target), np.asarray(preds), weights=weights, labels=np.arange(NUM_CLASSES))
+
+        self.run_class_metric_test(
+            preds=_preds_mc,
+            target=_target_mc,
+            metric_class=CohenKappa,
+            sk_metric=sk_metric,
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        )
+
+
+class TestMatthews(MetricTester):
+    atol = 1e-5
+
+    def test_matthews(self):
+        self.run_class_metric_test(
+            preds=_preds_mc,
+            target=_target_mc,
+            metric_class=MatthewsCorrCoef,
+            sk_metric=lambda p, t: sk_matthews(np.asarray(t), np.asarray(p)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_matthews_functional(self):
+        self.run_functional_metric_test(
+            _preds_mc, _target_mc, metric_functional=matthews_corrcoef,
+            sk_metric=lambda p, t: sk_matthews(np.asarray(t), np.asarray(p)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestJaccard(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("reduction, sk_average", [("elementwise_mean", "macro"), ("none", None)])
+    def test_jaccard(self, reduction, sk_average):
+        def sk_metric(preds, target):
+            return sk_jaccard(
+                np.asarray(target), np.asarray(preds), average=sk_average, labels=np.arange(NUM_CLASSES)
+            )
+
+        self.run_class_metric_test(
+            preds=_preds_mc,
+            target=_target_mc,
+            metric_class=JaccardIndex,
+            sk_metric=sk_metric,
+            metric_args={"num_classes": NUM_CLASSES, "reduction": reduction},
+        )
+
+    def test_jaccard_ignore_index(self):
+        result = jaccard_index(
+            jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]), num_classes=NUM_CLASSES, ignore_index=0
+        )
+        # oracle: per-class jaccard with class 0's row zeroed, then dropped
+        cm = sk_confusion_matrix(_target_mc[0], _preds_mc[0], labels=np.arange(NUM_CLASSES)).astype(float)
+        cm[0] = 0.0
+        inter = np.diag(cm)
+        union = cm.sum(0) + cm.sum(1) - inter
+        scores = np.where(union == 0, 0.0, inter / np.where(union == 0, 1, union))
+        expected = np.delete(scores, 0).mean()
+        np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+
+class TestHinge(MetricTester):
+    atol = 1e-5
+
+    def test_hinge_binary(self):
+        decisions = (_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) - 0.5) * 4
+
+        def sk_metric(preds, target):
+            return sk_hinge(np.asarray(target), np.asarray(preds), labels=[0, 1])
+
+        self.run_class_metric_test(
+            preds=decisions,
+            target=_target_bin,
+            metric_class=HingeLoss,
+            sk_metric=sk_metric,
+        )
+
+    def test_hinge_multiclass_crammer_singer(self):
+        decisions = _rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+
+        def sk_metric(preds, target):
+            return sk_hinge(np.asarray(target), np.asarray(preds), labels=np.arange(NUM_CLASSES))
+
+        self.run_class_metric_test(
+            preds=decisions,
+            target=_target_mc,
+            metric_class=HingeLoss,
+            sk_metric=sk_metric,
+        )
+
+
+class TestKLDivergence(MetricTester):
+    atol = 1e-5
+
+    def test_kld(self):
+        p = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32) + 0.1
+        q = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32) + 0.1
+
+        def sk_metric(p_, q_):
+            p_ = np.asarray(p_, np.float64)
+            q_ = np.asarray(q_, np.float64)
+            p_ = p_ / p_.sum(-1, keepdims=True)
+            q_ = q_ / q_.sum(-1, keepdims=True)
+            return np.mean(np.sum(p_ * np.log(p_ / q_), axis=-1))
+
+        self.run_class_metric_test(
+            preds=p,
+            target=q,
+            metric_class=KLDivergence,
+            sk_metric=sk_metric,
+        )
+
+
+class TestCalibrationError(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_ce_binary(self, norm):
+        def oracle(preds, target):
+            # reference-equivalent binning in numpy float64
+            conf = np.asarray(preds, np.float64)
+            acc = np.asarray(target, np.float64)
+            bins = np.linspace(0, 1, 16)
+            idx = np.clip(np.searchsorted(bins, conf, side="left") - 1, 0, 14)
+            acc_bin = np.zeros(15)
+            conf_bin = np.zeros(15)
+            count = np.zeros(15)
+            np.add.at(count, idx, 1)
+            np.add.at(conf_bin, idx, conf)
+            np.add.at(acc_bin, idx, acc)
+            with np.errstate(invalid="ignore"):
+                conf_bin = np.nan_to_num(conf_bin / count)
+                acc_bin = np.nan_to_num(acc_bin / count)
+            prop = count / count.sum()
+            if norm == "l1":
+                return np.sum(np.abs(acc_bin - conf_bin) * prop)
+            if norm == "max":
+                return np.max(np.abs(acc_bin - conf_bin))
+            ce = np.sum((acc_bin - conf_bin) ** 2 * prop)
+            return np.sqrt(ce) if ce > 0 else 0.0
+
+        self.run_class_metric_test(
+            preds=_preds_bin_prob,
+            target=_target_bin,
+            metric_class=CalibrationError,
+            sk_metric=oracle,
+            metric_args={"norm": norm},
+            check_merge=False,
+            check_jit=False,
+        )
+
+
+def test_dice_score():
+    pred = jnp.asarray(
+        [[0.85, 0.05, 0.05, 0.05],
+         [0.05, 0.85, 0.05, 0.05],
+         [0.05, 0.05, 0.85, 0.05],
+         [0.05, 0.05, 0.05, 0.85]]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    assert float(dice_score(pred, target)) == pytest.approx(0.3333333, abs=1e-5)
+    assert float(dice_score(pred, target, bg=True)) == pytest.approx(0.5, abs=1e-5)
+
+
+def test_kl_divergence_functional():
+    p = jnp.asarray([[0.36, 0.48, 0.16]])
+    q = jnp.asarray([[1 / 3, 1 / 3, 1 / 3]])
+    assert float(kl_divergence(p, q)) == pytest.approx(0.085300, abs=1e-5)
+    assert float(kl_divergence(jnp.log(p), jnp.log(q), log_prob=True)) == pytest.approx(0.085300, abs=1e-5)
+
+
+def test_cohen_kappa_functional():
+    target = jnp.asarray([1, 1, 0, 0])
+    preds = jnp.asarray([0, 1, 0, 0])
+    assert float(cohen_kappa(preds, target, num_classes=2)) == pytest.approx(0.5)
+
+
+def test_hinge_one_vs_all():
+    decisions = _rng.randn(64, NUM_CLASSES).astype(np.float32)
+    target = _rng.randint(0, NUM_CLASSES, 64)
+    result = hinge_loss(jnp.asarray(decisions), jnp.asarray(target), multiclass_mode="one-vs-all")
+    t_oh = np.eye(NUM_CLASSES)[target]
+    margin = np.where(t_oh.astype(bool), decisions, -decisions)
+    expected = np.clip(1 - margin, 0, None).sum(0) / 64
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+
+def test_calibration_error_functional_jit():
+    import jax
+
+    preds = jnp.asarray(_preds_bin_prob[0])
+    target = jnp.asarray(_target_bin[0])
+    eager = calibration_error(preds, target)
+    jitted = jax.jit(lambda p, t: calibration_error(p, t))(preds, target)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)

@@ -301,3 +301,34 @@ def test_binned_merge_and_sync():
     p_both, r_both, _ = metric.compute_state(both)
     np.testing.assert_allclose(np.asarray(p_merged), np.asarray(p_both), atol=1e-6)
     np.testing.assert_allclose(np.asarray(r_merged), np.asarray(r_both), atol=1e-6)
+
+
+def test_auroc_rank_multiclass_exact_parity():
+    """Rank-statistic AUROC must equal sklearn's curve-based value exactly."""
+    import jax
+    from metrics_tpu.functional.classification.auroc import auroc_rank_multiclass
+
+    preds = jnp.asarray(_preds_mc[0])
+    target = jnp.asarray(_target_mc[0])
+    for average, sk_avg in [("macro", "macro"), ("weighted", "weighted")]:
+        got = auroc_rank_multiclass(preds, target, NUM_CLASSES, average=average)
+        want = sk_roc_auc(np.asarray(target), np.asarray(preds), multi_class="ovr",
+                          average=sk_avg, labels=list(range(NUM_CLASSES)))
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+    # jit parity
+    jitted = jax.jit(lambda p, t: auroc_rank_multiclass(p, t, NUM_CLASSES))(preds, target)
+    eager = auroc_rank_multiclass(preds, target, NUM_CLASSES)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+
+def test_auroc_rank_handles_ties():
+    from metrics_tpu.functional.classification.auroc import auroc_rank_multiclass
+
+    rng = np.random.RandomState(1)
+    p = np.round(rng.rand(200).astype(np.float32), 1)  # heavy ties
+    target = rng.randint(0, 2, 200)
+    preds = np.stack([1 - p, p], axis=1)
+    got = auroc_rank_multiclass(jnp.asarray(preds), jnp.asarray(target), 2)
+    # both one-vs-rest AUCs equal the binary AUC, so macro == binary
+    want = sk_roc_auc(target, p)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
