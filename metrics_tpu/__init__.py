@@ -44,6 +44,14 @@ from metrics_tpu.aggregation import (  # noqa: E402
     MinMetric,
     SumMetric,
 )
+from metrics_tpu.collections import MetricCollection  # noqa: E402
+from metrics_tpu.wrappers import (  # noqa: E402
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
 from metrics_tpu.regression import (  # noqa: E402
     CosineSimilarity,
     ExplainedVariance,
@@ -66,8 +74,10 @@ __all__ = [
     "BinnedAveragePrecision",
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
+    "BootStrapper",
     "CalibrationError",
     "CatMetric",
+    "ClasswiseWrapper",
     "CohenKappa",
     "ConfusionMatrix",
     "CompositionalMetric",
@@ -87,7 +97,11 @@ __all__ = [
     "MaxMetric",
     "MeanMetric",
     "Metric",
+    "MetricCollection",
+    "MetricTracker",
+    "MinMaxMetric",
     "MinMetric",
+    "MultioutputWrapper",
     "SumMetric",
     "PearsonCorrCoef",
     "Precision",

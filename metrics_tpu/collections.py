@@ -1,0 +1,335 @@
+"""MetricCollection — chain metrics with one call pattern, with automatic
+compute-group state dedup.
+
+Behavior parity with /root/reference/torchmetrics/collections.py:28-371:
+list/dict/args construction, per-metric kwarg filtering, prefix/postfix,
+clone, and **compute groups** (collections.py:144-227): every metric starts
+as its own group; after the first real update, groups whose member states
+are identical are merged (pairwise deep comparison), and later updates touch
+only group leaders — the documented 2-3x cost reduction. Group discovery
+pre-filters on static state *definitions* (names, shapes, reducers) before
+the value comparison, so no array data is fetched for obviously-different
+metrics.
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _flatten_dict(x: Dict) -> Dict:
+    """Flatten dict-valued results (e.g. ClasswiseWrapper) into the parent."""
+    new_dict = {}
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                new_dict[k] = v
+        else:
+            new_dict[key] = value
+    return new_dict
+
+
+class MetricCollection:
+    """Chain metrics that have the same call pattern into one object.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, Precision, Recall
+        >>> target = jnp.array([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.array([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([Accuracy(),
+        ...                             Precision(num_classes=3, average='macro'),
+        ...                             Recall(num_classes=3, average='macro')])
+        >>> {k: float(v) for k, v in metrics(preds, target).items()}
+        {'Accuracy': 0.125, 'Precision': 0.06666667014360428, 'Recall': 0.1111111119389534}
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups: Dict[int, List[str]] = {}
+        self._groups_checked: bool = False
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------------
+    # dict-like access
+    # ------------------------------------------------------------------
+    def __getitem__(self, key: str) -> Metric:
+        return self._metrics[key]
+
+    def __setitem__(self, key: str, value: Metric) -> None:
+        self._metrics[key] = value
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._metrics
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __iter__(self) -> Iterable[str]:
+        return iter(self._metrics)
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._metrics.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:
+        if keep_base:
+            return self._metrics.items()
+        return self._to_renamed_ordered_dict().items()
+
+    def values(self) -> Iterable[Metric]:
+        return self._metrics.values()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call forward for each metric; kwargs are filtered per metric."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Call update for each metric (only group leaders once groups are known)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._metrics[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+        else:
+            for m in self._metrics.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Pairwise-merge groups whose member states are identical.
+
+        Parity with reference collections.py:159-192.
+        """
+        n_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._metrics[cg_members1[0]]
+                    metric2 = self._metrics[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                if len(self._groups) != n_groups:
+                    break
+            if len(self._groups) == n_groups:
+                break
+            n_groups = len(self._groups)
+
+        self._groups = {idx: values for idx, values in enumerate(deepcopy(self._groups).values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """True if the two metrics' states are identical.
+
+        Static pre-filter on definitions (names, reducers, default shapes)
+        avoids fetching array values for obviously-different metrics; the
+        value comparison then proves the update paths agree (parity with
+        reference collections.py:194-213).
+        """
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        # wrapper metrics hold their real state in child metrics; two wrappers
+        # with (possibly empty) matching registries are NOT state-equal
+        if metric1._children or metric2._children or not metric1._defaults:
+            return False
+        for key in metric1._defaults:
+            d1, d2 = metric1._defaults[key], metric2._defaults[key]
+            if type(d1) is not type(d2):
+                return False
+            if metric1._reductions[key] is not metric2._reductions[key]:
+                return False
+            if isinstance(d1, jnp.ndarray) and (d1.shape != d2.shape or d1.dtype != d2.dtype):
+                return False
+
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) is not type(state2):
+                return False
+            if isinstance(state1, jnp.ndarray):
+                if state1.shape != state2.shape or not bool(jnp.allclose(state1, state2)):
+                    return False
+            elif isinstance(state1, list):
+                if len(state1) != len(state2):
+                    return False
+                if not all(
+                    s1.shape == s2.shape and bool(jnp.allclose(s1, s2)) for s1, s2 in zip(state1, state2)
+                ):
+                    return False
+        return True
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute each metric; group members borrow the leader's state."""
+        if self._enable_compute_groups and self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._metrics[cg[0]]
+                for i in range(1, len(cg)):
+                    mi = self._metrics[cg[i]]
+                    for state in m0._defaults:
+                        object.__setattr__(mi, state, getattr(m0, state))
+                    mi._update_called = m0._update_called
+                    mi._computed = None
+        res = {k: m.compute() for k, m in self.items(keep_base=True)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def reset(self) -> None:
+        """Reset all metrics; discovered compute groups are kept (parity with
+        reference collections.py — discovery cost is amortized across epochs)."""
+        for m in self._metrics.values():
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._metrics.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        destination: Dict[str, Any] = {}
+        for name, m in self._metrics.items():
+            m.state_dict(destination, prefix=f"{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        for name, m in self._metrics.items():
+            m.load_state_dict(state_dict, prefix=f"{name}.")
+
+    def to_device(self, device: Any) -> "MetricCollection":
+        for m in self._metrics.values():
+            m.to_device(device)
+        return self
+
+    def set_dtype(self, dst_type: Any) -> "MetricCollection":
+        for m in self._metrics.values():
+            m.set_dtype(dst_type)
+        return self
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, str):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, Metric):
+                    raise ValueError(f"Value {metric} belonging to key {name} is not an instance of `Metric`")
+                self[name] = metric
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, Metric):
+                    raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `Metric`")
+                name = metric.__class__.__name__
+                if name in self:
+                    raise ValueError(f"Encountered two metrics both named {name}")
+                self[name] = metric
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _init_compute_groups(self) -> None:
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = {i: k for i, k in enumerate(self._enable_compute_groups)}
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the"
+                            f" collection. Please make sure that {self._enable_compute_groups} matches"
+                            f" {list(self.keys(keep_base=True))}"
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self._metrics.keys())}
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    # ------------------------------------------------------------------
+    # naming
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> "OrderedDict[str, Metric]":
+        od: "OrderedDict[str, Metric]" = OrderedDict()
+        for k, v in self._metrics.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for name, m in self._metrics.items():
+            repr_str += f"\n  {name}: {repr(m)}"
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        return repr_str + "\n)" if len(self._metrics) else repr_str + ")"

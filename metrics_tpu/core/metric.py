@@ -107,11 +107,42 @@ class Metric(ABC):
         if children is not None and name != "_children":
             if isinstance(value, Metric):
                 children[name] = value
+            elif isinstance(value, list) and value and all(isinstance(v, Metric) for v in value):
+                # lists of child metrics (BootStrapper/MultioutputWrapper copies)
+                children[name] = value
             elif name in children:
                 del children[name]
         if name in ("higher_is_better", "is_differentiable") and self.__dict__.get("_defaults") is not None:
             raise RuntimeError(f"Can't change const `{name}`.")
         object.__setattr__(self, name, value)
+
+    def _iter_child_metrics(self) -> "Generator[tuple, None, None]":
+        """Yield (name, metric) for every registered child, flattening lists."""
+        for name, child in self._children.items():
+            if isinstance(child, list):
+                for i, c in enumerate(child):
+                    yield f"{name}.{i}", c
+            else:
+                yield name, child
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """Recursive snapshot of own + child states (used by ``forward`` so the
+        batch-value cycle cannot wipe wrapped metrics' accumulation)."""
+        return {
+            "own": {attr: getattr(self, attr) for attr in self._defaults},
+            "children": {n: c._snapshot_state() for n, c in self._iter_child_metrics()},
+            "update_called": self._update_called,
+        }
+
+    def _restore_state(self, snap: Dict[str, Any]) -> None:
+        for attr, val in snap["own"].items():
+            object.__setattr__(self, attr, val)
+        for n, c in self._iter_child_metrics():
+            if n in snap["children"]:
+                c._restore_state(snap["children"][n])
+        self._update_called = snap["update_called"]
+        self._computed = None
+        self._is_synced = False
 
     # ------------------------------------------------------------------
     # state registry
@@ -215,19 +246,16 @@ class Metric(ABC):
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
 
-        cache = {attr: getattr(self, attr) for attr in self._defaults}
+        cache = self._snapshot_state()
 
         self.reset()
         self.update(*args, **kwargs)
         self._forward_cache = self.compute()
 
-        for attr, val in cache.items():
-            object.__setattr__(self, attr, val)
-        self._is_synced = False
+        self._restore_state(cache)
 
         self._should_unsync = True
         self._to_sync = True
-        self._computed = None
         self._update_called = True
 
         return self._forward_cache
@@ -397,7 +425,7 @@ class Metric(ABC):
     def persistent(self, mode: bool = False) -> None:
         for name in self._persistent:
             self._persistent[name] = mode
-        for child in self._children.values():
+        for _, child in self._iter_child_metrics():
             child.persistent(mode)
 
     def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
@@ -410,7 +438,7 @@ class Metric(ABC):
                 destination[prefix + name] = [jnp.array(v) for v in current]
             else:
                 destination[prefix + name] = jnp.array(current)
-        for cname, child in self._children.items():
+        for cname, child in self._iter_child_metrics():
             child.state_dict(destination, prefix=f"{prefix}{cname}.")
         return destination
 
@@ -424,7 +452,7 @@ class Metric(ABC):
                     object.__setattr__(self, name, [jnp.asarray(v) for v in val])
                 else:
                     object.__setattr__(self, name, jnp.asarray(val))
-        for cname, child in self._children.items():
+        for cname, child in self._iter_child_metrics():
             child.load_state_dict(state_dict, prefix=f"{prefix}{cname}.")
 
     # ------------------------------------------------------------------
@@ -471,7 +499,7 @@ class Metric(ABC):
             )
         if self._computed is not None:
             self._computed = apply_to_collection(self._computed, jnp.ndarray, _cast)
-        for child in self._children.values():
+        for _, child in self._iter_child_metrics():
             child.set_dtype(dst_type)
         return self
 
@@ -483,7 +511,7 @@ class Metric(ABC):
                 object.__setattr__(self, name, [jax.device_put(v, device) for v in val])
             else:
                 object.__setattr__(self, name, jax.device_put(val, device))
-        for child in self._children.values():
+        for _, child in self._iter_child_metrics():
             child.to_device(device)
         return self
 

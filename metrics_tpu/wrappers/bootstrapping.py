@@ -1,0 +1,116 @@
+"""BootStrapper — confidence intervals by resampling updates.
+
+Behavior parity with /root/reference/torchmetrics/wrappers/bootstrapping.py:25-174.
+Sampling indices are drawn host-side with numpy (seedable) — the resample is
+data-layout work, not device math.
+"""
+from copy import deepcopy
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(
+    size: int,
+    sampling_strategy: str = "poisson",
+    rng: Optional[np.random.RandomState] = None,
+) -> Array:
+    """Indices resampling [0, size) with replacement."""
+    rng = rng or np.random
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1, size)
+        return jnp.asarray(np.repeat(np.arange(size), n))
+    if sampling_strategy == "multinomial":
+        return jnp.asarray(rng.randint(0, size, size))
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    """Computes bootstrapped mean/std/quantile/raw of a base metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> base_metric = Accuracy()
+        >>> bootstrap = BootStrapper(base_metric, num_bootstraps=20, seed=123)
+        >>> bootstrap.update(jnp.arange(20) % 5, (jnp.arange(20) * 3) % 5)
+        >>> output = bootstrap.compute()
+        >>> sorted(output.keys())
+        ['mean', 'std']
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        self._rng = np.random.RandomState(seed)
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but recieved {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+
+    def _update(self, *args: Any, **kwargs: Any) -> None:
+        """Update all bootstrap copies, each on a fresh resample of the batch."""
+        args_sizes = apply_to_collection(args, jnp.ndarray, len)
+        kwargs_sizes = list(apply_to_collection(kwargs, jnp.ndarray, len).values())
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            new_args = apply_to_collection(args, jnp.ndarray, lambda x: jnp.take(x, sample_idx, axis=0))
+            new_kwargs = apply_to_collection(kwargs, jnp.ndarray, lambda x: jnp.take(x, sample_idx, axis=0))
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def _compute(self) -> Dict[str, Array]:
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output_dict["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

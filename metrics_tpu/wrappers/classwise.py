@@ -1,0 +1,53 @@
+"""ClasswiseWrapper — dict-per-class output.
+
+Behavior parity with /root/reference/torchmetrics/wrappers/classwise.py:8-60.
+"""
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(Metric):
+    """Wraps a per-class metric to return a labeled dict.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None), labels=["horse", "fish", "dog"])
+        >>> preds = jnp.array([0, 1, 2, 1])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> sorted(metric(preds, target).keys())
+        ['accuracy_dog', 'accuracy_fish', 'accuracy_horse']
+    """
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def _update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def _compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()
+        super().reset()

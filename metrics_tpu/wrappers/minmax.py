@@ -1,0 +1,90 @@
+"""MinMaxMetric — tracks the running min/max of a wrapped metric's compute.
+
+Behavior parity with /root/reference/torchmetrics/wrappers/minmax.py:23-120.
+"""
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+
+Array = jax.Array
+
+
+class MinMaxMetric(Metric):
+    """Tracks the min and max of a scalar base metric across compute calls.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> minmax = MinMaxMetric(Accuracy())
+        >>> out = minmax(jnp.array([1, 0, 1, 1]), jnp.array([1, 1, 1, 1]))
+        >>> sorted(out.keys())
+        ['max', 'min', 'raw']
+    """
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        # NOT add_state: min/max accumulate across compute() calls and must
+        # survive forward()'s snapshot/restore cycle (reference keeps them as
+        # buffers outside the state registry for the same reason); they are
+        # checkpointed via the state_dict override below
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        # the base double-update cycle resets this wrapper (clearing min/max)
+        # to get the batch value; merge the pre-existing extremes back in so
+        # min/max track every compute() ever made (reference doctest behavior)
+        prev_min, prev_max = self.min_val, self.max_val
+        out = super().forward(*args, **kwargs)
+        self.min_val = jnp.minimum(prev_min, out["min"])
+        self.max_val = jnp.maximum(prev_max, out["max"])
+        self._forward_cache = {"raw": out["raw"], "min": self.min_val, "max": self.max_val}
+        return self._forward_cache
+
+    def _update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def _compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {val}"
+            )
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    def state_dict(self, destination=None, prefix: str = ""):
+        destination = super().state_dict(destination, prefix=prefix)
+        destination[prefix + "min_val"] = jnp.asarray(self.min_val)
+        destination[prefix + "max_val"] = jnp.asarray(self.max_val)
+        return destination
+
+    def load_state_dict(self, state_dict, prefix: str = "") -> None:
+        super().load_state_dict(state_dict, prefix=prefix)
+        if prefix + "min_val" in state_dict:
+            self.min_val = jnp.asarray(state_dict[prefix + "min_val"])
+        if prefix + "max_val" in state_dict:
+            self.max_val = jnp.asarray(state_dict[prefix + "max_val"])
+
+    @staticmethod
+    def _is_suitable_val(val: Union[int, float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, jnp.ndarray):
+            return val.size == 1
+        return False

@@ -1,0 +1,102 @@
+"""MultioutputWrapper — one metric clone per output dimension.
+
+Behavior parity with /root/reference/torchmetrics/wrappers/multioutput.py:11-152.
+"""
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _get_nan_indices(*arrays: Array) -> Array:
+    """Boolean mask of rows (dim 0) that contain NaNs in any input."""
+    if len(arrays) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = arrays[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for a in arrays:
+        flattened = a.reshape(len(a), -1).astype(jnp.float32)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(flattened), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """Evaluates one clone of ``base_metric`` per output along ``output_dim``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> target = jnp.array([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+        >>> preds = jnp.array([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0]])
+        >>> r2score = MultioutputWrapper(R2Score(), 2)
+        >>> [round(float(v), 4) for v in r2score(preds, target)]
+        [0.9654, 0.9082]
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+    ) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[list, dict]]:
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def select(x, idx=i):
+                return jnp.take(x, jnp.asarray([idx]), axis=self.output_dim)
+
+            selected_args = list(apply_to_collection(args, jnp.ndarray, select))
+            selected_kwargs = apply_to_collection(kwargs, jnp.ndarray, select)
+            if self.remove_nans:
+                args_kwargs = tuple(selected_args) + tuple(selected_kwargs.values())
+                nan_idxs = np.asarray(_get_nan_indices(*args_kwargs))
+                selected_args = [arg[~nan_idxs] for arg in selected_args]
+                selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(arg, axis=self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def _update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def _compute(self) -> List[Array]:
+        return [m.compute() for m in self.metrics]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        results = []
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            results.append(metric(*selected_args, **selected_kwargs))
+        if results[0] is None:
+            return None
+        return results
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

@@ -1,0 +1,128 @@
+"""MetricTracker — a time-series of metric (or collection) snapshots.
+
+Behavior parity with /root/reference/torchmetrics/wrappers/tracker.py:24-185.
+"""
+from copy import deepcopy
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Tracks a metric (or collection) over multiple steps/epochs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> tracker = MetricTracker(Accuracy(num_classes=10))
+        >>> for epoch in range(3):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.arange(10) % 10, (jnp.arange(10) * (epoch + 2)) % 10)
+        >>> tracker.n_steps
+        3
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                f"Metric arg need to be an instance of a metrics_tpu `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._steps: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._steps)
+
+    def increment(self) -> None:
+        """Create a fresh copy of the base metric for a new tracking step."""
+        self._increment_called = True
+        self._steps.append(deepcopy(self._base_metric))
+        self._steps[-1].reset()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Union[Array, Dict[str, Array]]:
+        """Metric values for all tracked steps."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._steps]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
+        return jnp.stack(res, axis=0)
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        if self._steps:
+            self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        """Reset all tracked metrics."""
+        for metric in self._steps:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[
+        float,
+        Tuple[int, float],
+        Dict[str, Union[float, None]],
+        Tuple[Dict[str, Union[int, None]], Dict[str, Union[float, None]]],
+    ]:
+        """The best observed value (and optionally the step it occurred at)."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    f = jnp.argmax if maximize[i] else jnp.argmin
+                    best = int(f(v))
+                    value[k], idx[k] = float(v[best]), best
+                except (ValueError, TypeError):
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        " this is probably due to the 'best' not being defined for this metric."
+                        " Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value
+
+        f = jnp.argmax if self.maximize else jnp.argmin
+        idx_best = int(f(res))
+        if return_step:
+            return float(res[idx_best]), idx_best
+        return float(res[idx_best])
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

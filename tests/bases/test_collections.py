@@ -1,0 +1,149 @@
+"""MetricCollection tests: construction, compute groups, prefix/postfix.
+
+Mirrors /root/reference/tests/bases/test_collections.py in spirit.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    MeanSquaredError,
+    Precision,
+    Recall,
+)
+from metrics_tpu.collections import MetricCollection
+
+_rng = np.random.RandomState(42)
+_preds = jnp.asarray(_rng.randint(0, 3, 32))
+_target = jnp.asarray(_rng.randint(0, 3, 32))
+
+
+def test_list_construction():
+    mc = MetricCollection([Accuracy(), Precision(num_classes=3, average="macro")])
+    res = mc(_preds, _target)
+    assert set(res.keys()) == {"Accuracy", "Precision"}
+
+
+def test_args_construction():
+    mc = MetricCollection(Accuracy(), Precision(num_classes=3, average="macro"))
+    assert set(mc.keys(keep_base=True)) == {"Accuracy", "Precision"}
+
+
+def test_dict_construction():
+    mc = MetricCollection(
+        {"micro": Recall(num_classes=3, average="micro"), "macro": Recall(num_classes=3, average="macro")}
+    )
+    res = mc(_preds, _target)
+    assert set(res.keys()) == {"micro", "macro"}
+
+
+def test_duplicate_names_raise():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([Accuracy(), Accuracy()])
+
+
+def test_not_a_metric_raises():
+    with pytest.raises(ValueError):
+        MetricCollection([Accuracy(), "not-a-metric"])
+    with pytest.raises(ValueError):
+        MetricCollection({"a": "not-a-metric"})
+
+
+def test_prefix_postfix():
+    mc = MetricCollection([Accuracy()], prefix="train_", postfix="_step")
+    res = mc(_preds, _target)
+    assert list(res.keys()) == ["train_Accuracy_step"]
+    clone = mc.clone(prefix="val_")
+    res2 = clone(_preds, _target)
+    assert list(res2.keys()) == ["val_Accuracy_step"]
+    with pytest.raises(ValueError):
+        MetricCollection([Accuracy()], prefix=5)
+
+
+def test_compute_groups_discovered():
+    """Precision and Recall (same StatScores state) must merge into one group;
+    MeanSquaredError stays separate."""
+    mc = MetricCollection(
+        [
+            Precision(num_classes=3, average="macro"),
+            Recall(num_classes=3, average="macro"),
+        ]
+    )
+    mc.update(_preds, _target)
+    groups = mc.compute_groups
+    assert len(groups) == 1 and set(groups[0]) == {"Precision", "Recall"}
+
+    # values must match individually-updated metrics across further updates
+    p2 = jnp.asarray(_rng.randint(0, 3, 32))
+    t2 = jnp.asarray(_rng.randint(0, 3, 32))
+    mc.update(p2, t2)
+    res = mc.compute()
+
+    p_ref = Precision(num_classes=3, average="macro")
+    r_ref = Recall(num_classes=3, average="macro")
+    for p, t in [(_preds, _target), (p2, t2)]:
+        p_ref.update(p, t)
+        r_ref.update(p, t)
+    np.testing.assert_allclose(np.asarray(res["Precision"]), np.asarray(p_ref.compute()), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res["Recall"]), np.asarray(r_ref.compute()), atol=1e-6)
+
+
+def test_compute_groups_not_merged_when_states_differ():
+    mc = MetricCollection(
+        [Accuracy(), ConfusionMatrix(num_classes=3)]
+    )
+    mc.update(_preds, _target)
+    assert len(mc.compute_groups) == 2
+
+
+def test_compute_groups_user_specified():
+    mc = MetricCollection(
+        Precision(num_classes=3, average="macro"),
+        Recall(num_classes=3, average="macro"),
+        MeanSquaredError(),
+        compute_groups=[["Precision", "Recall"], ["MeanSquaredError"]],
+    )
+    assert len(mc.compute_groups) == 2
+    with pytest.raises(ValueError):
+        MetricCollection(Accuracy(), compute_groups=[["NotPresent"]])
+
+
+def test_compute_groups_disabled():
+    mc = MetricCollection([Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")],
+                          compute_groups=False)
+    mc.update(_preds, _target)
+    assert mc.compute_groups == {}
+
+
+def test_reset_keeps_groups_and_correctness():
+    mc = MetricCollection([Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")])
+    mc.update(_preds, _target)
+    assert len(mc.compute_groups) == 1
+    mc.reset()
+    mc.update(_preds, _target)
+    res = mc.compute()
+    p_ref = Precision(num_classes=3, average="macro")
+    p_ref.update(_preds, _target)
+    np.testing.assert_allclose(np.asarray(res["Precision"]), np.asarray(p_ref.compute()), atol=1e-6)
+
+
+def test_state_dict_roundtrip():
+    mc = MetricCollection([Accuracy(), CohenKappa(num_classes=3)])
+    mc.update(_preds, _target)
+    sd = mc.state_dict()
+    mc2 = MetricCollection([Accuracy(), CohenKappa(num_classes=3)])
+    mc2.load_state_dict(sd)
+    res1, res2 = mc.compute(), mc2.compute()
+    for k in res1:
+        np.testing.assert_allclose(np.asarray(res1[k]), np.asarray(res2[k]), atol=1e-6)
+
+
+def test_collection_kwarg_filtering():
+    """Kwargs not in a metric's update signature are filtered out."""
+    mc = MetricCollection([Accuracy()])
+    res = mc(_preds, target=_target, unused_kwarg=123)
+    assert "Accuracy" in res

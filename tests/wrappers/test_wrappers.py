@@ -1,0 +1,244 @@
+"""Wrapper tests: BootStrapper, ClasswiseWrapper, MinMaxMetric,
+MultioutputWrapper, MetricTracker.
+
+Mirrors /root/reference/tests/wrappers/ in spirit.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    ExplainedVariance,
+    MeanSquaredError,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    Precision,
+    R2Score,
+    Recall,
+)
+from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+_rng = np.random.RandomState(42)
+
+
+# ---------------------------------------------------------------------------
+# BootStrapper
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler(sampling_strategy):
+    idx = _bootstrap_sampler(50, sampling_strategy, np.random.RandomState(0))
+    assert np.asarray(idx).min() >= 0 and np.asarray(idx).max() < 50
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrapper(sampling_strategy):
+    base = MeanSquaredError()
+    bs = BootStrapper(
+        base, num_bootstraps=20, mean=True, std=True, quantile=0.95, raw=True,
+        sampling_strategy=sampling_strategy, seed=0,
+    )
+    preds = jnp.asarray(_rng.rand(64), jnp.float32)
+    target = jnp.asarray(_rng.rand(64), jnp.float32)
+    bs.update(preds, target)
+    out = bs.compute()
+    assert set(out.keys()) == {"mean", "std", "quantile", "raw"}
+    assert out["raw"].shape == (20,)
+    true_mse = float(jnp.mean((preds - target) ** 2))
+    assert abs(float(out["mean"]) - true_mse) < 0.05
+
+
+def test_bootstrapper_invalid():
+    with pytest.raises(ValueError):
+        BootStrapper("not a metric")
+    with pytest.raises(ValueError):
+        BootStrapper(MeanSquaredError(), sampling_strategy="bad")
+
+
+# ---------------------------------------------------------------------------
+# ClasswiseWrapper
+# ---------------------------------------------------------------------------
+
+
+def test_classwise_wrapper():
+    metric = ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["horse", "fish", "dog"])
+    preds = jnp.asarray([0, 1, 2, 1])
+    target = jnp.asarray([0, 1, 1, 1])
+    out = metric(preds, target)
+    assert set(out.keys()) == {"accuracy_horse", "accuracy_fish", "accuracy_dog"}
+    metric.update(preds, target)
+    out2 = metric.compute()
+    assert float(out2["accuracy_horse"]) == 1.0
+
+    nolabels = ClasswiseWrapper(Accuracy(num_classes=3, average="none"))
+    out3 = nolabels(preds, target)
+    assert set(out3.keys()) == {"accuracy_0", "accuracy_1", "accuracy_2"}
+
+    with pytest.raises(ValueError):
+        ClasswiseWrapper("nope")
+    with pytest.raises(ValueError):
+        ClasswiseWrapper(Accuracy(), labels=[1, 2])
+
+
+def test_classwise_in_collection():
+    mc = MetricCollection(
+        {"acc": ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["a", "b", "c"])}
+    )
+    out = mc(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+    assert set(out.keys()) == {"accuracy_a", "accuracy_b", "accuracy_c"}
+
+
+# ---------------------------------------------------------------------------
+# MinMaxMetric
+# ---------------------------------------------------------------------------
+
+
+def test_minmax_metric():
+    mm = MinMaxMetric(Accuracy())
+    labels = jnp.asarray([0, 1, 0, 1])
+    out = mm(jnp.asarray([0, 1, 0, 1]), labels)  # acc 1.0
+    assert float(out["raw"]) == 1.0 and float(out["min"]) == 1.0 and float(out["max"]) == 1.0
+    mm.update(jnp.asarray([1, 0, 0, 1]), labels)  # acc drops
+    out = mm.compute()
+    assert float(out["min"]) < 1.0 and float(out["max"]) == 1.0
+    mm.reset()
+    assert float(mm.min_val) == float(jnp.inf)
+
+    with pytest.raises(ValueError):
+        MinMaxMetric("nope")
+
+
+# ---------------------------------------------------------------------------
+# MultioutputWrapper
+# ---------------------------------------------------------------------------
+
+
+def test_multioutput_r2():
+    target = jnp.asarray([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+    preds = jnp.asarray([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0]])
+    r2 = MultioutputWrapper(R2Score(), 2)
+    out = r2(preds, target)
+    np.testing.assert_allclose(np.asarray(out[0]), 0.9654, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out[1]), 0.9082, atol=1e-4)
+
+
+def test_multioutput_remove_nans():
+    target = np.array([[0.5, 1.0], [-1.0, np.nan], [7.0, -6.0], [2.0, 1.5]], dtype=np.float32)
+    preds = np.array([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0], [2.5, 1.0]], dtype=np.float32)
+    r2 = MultioutputWrapper(R2Score(), 2)
+    r2.update(jnp.asarray(preds), jnp.asarray(target))
+    out = r2.compute()
+    # second output computed on the 3 non-nan rows
+    from sklearn.metrics import r2_score as sk_r2
+
+    np.testing.assert_allclose(np.asarray(out[0]), sk_r2(target[:, 0], preds[:, 0]), atol=1e-4)
+    keep = ~np.isnan(target[:, 1])
+    np.testing.assert_allclose(np.asarray(out[1]), sk_r2(target[keep, 1], preds[keep, 1]), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# MetricTracker
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_single_metric():
+    tracker = MetricTracker(Accuracy(num_classes=10), maximize=True)
+    accs = []
+    rng = np.random.RandomState(0)
+    for epoch in range(5):
+        tracker.increment()
+        preds = jnp.asarray(rng.randint(0, 10, 100))
+        target = jnp.asarray(rng.randint(0, 10, 100))
+        tracker.update(preds, target)
+        accs.append(float(tracker.compute()))
+    all_vals = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(all_vals, accs, atol=1e-6)
+    best, step = tracker.best_metric(return_step=True)
+    assert best == max(accs)
+    assert step == int(np.argmax(accs))
+    assert tracker.n_steps == 5
+
+
+def test_tracker_collection():
+    tracker = MetricTracker(
+        MetricCollection([MeanSquaredError(), ExplainedVariance()]), maximize=[False, True]
+    )
+    rng = np.random.RandomState(0)
+    for epoch in range(3):
+        tracker.increment()
+        tracker.update(jnp.asarray(rng.randn(100), jnp.float32), jnp.asarray(rng.randn(100), jnp.float32))
+    res = tracker.compute_all()
+    assert set(res.keys()) == {"MeanSquaredError", "ExplainedVariance"}
+    assert res["MeanSquaredError"].shape == (3,)
+    best, steps = tracker.best_metric(return_step=True)
+    assert set(best.keys()) == {"MeanSquaredError", "ExplainedVariance"}
+
+
+def test_minmax_forward_accumulates():
+    """forward() must not wipe the wrapped metric's accumulated state."""
+    mm = MinMaxMetric(Accuracy())
+    labels = jnp.asarray([0, 1, 0, 1])
+    mm(jnp.asarray([0, 1, 0, 1]), labels)  # acc 1.0
+    mm(jnp.asarray([1, 0, 1, 0]), labels)  # acc 0.0
+    out = mm.compute()
+    assert float(out["raw"]) == pytest.approx(0.5)  # accumulated over 8 samples
+    assert float(out["max"]) == 1.0 and float(out["min"]) == 0.0
+
+
+def test_bootstrapper_forward_accumulates():
+    bs = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=0)
+    preds = jnp.asarray(_rng.rand(32), jnp.float32)
+    target = jnp.asarray(_rng.rand(32), jnp.float32)
+    bs(preds, target)
+    bs(preds + 1.0, target)  # second forward must add to, not replace, state
+    out = bs.compute()
+    assert float(out["mean"]) > float(jnp.mean((preds - target) ** 2))
+
+
+def test_wrapper_state_dict_roundtrip():
+    bs = BootStrapper(MeanSquaredError(), num_bootstraps=3, seed=0)
+    preds = jnp.asarray(_rng.rand(16), jnp.float32)
+    target = jnp.asarray(_rng.rand(16), jnp.float32)
+    bs.update(preds, target)
+    sd = bs.state_dict()
+    assert sd, "BootStrapper state_dict must include bootstrap copies"
+    bs2 = BootStrapper(MeanSquaredError(), num_bootstraps=3, seed=0)
+    bs2.load_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(bs2.compute()["mean"]), np.asarray(bs.compute()["mean"]), atol=1e-6
+    )
+
+    mm = MinMaxMetric(Accuracy())
+    mm.update(jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+    mm.compute()
+    sd = mm.state_dict()
+    assert "min_val" in sd and "max_val" in sd
+
+
+def test_wrappers_not_merged_in_collection():
+    """Compute-group discovery must not merge unrelated wrappers."""
+    mc = MetricCollection(
+        {
+            "cls": ClasswiseWrapper(Accuracy(num_classes=3, average="none")),
+            "minmax": MinMaxMetric(Precision(num_classes=3, average="macro")),
+        }
+    )
+    p = jnp.asarray(_rng.randint(0, 3, 32))
+    t = jnp.asarray(_rng.randint(0, 3, 32))
+    mc.update(p, t)
+    assert len(mc.compute_groups) == 2
+
+
+def test_tracker_requires_increment():
+    tracker = MetricTracker(Accuracy())
+    with pytest.raises(ValueError, match="increment"):
+        tracker.update(jnp.asarray([1]), jnp.asarray([1]))
+    with pytest.raises(TypeError):
+        MetricTracker("nope")
