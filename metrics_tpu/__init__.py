@@ -52,6 +52,16 @@ from metrics_tpu.wrappers import (  # noqa: E402
     MinMaxMetric,
     MultioutputWrapper,
 )
+from metrics_tpu.retrieval import (  # noqa: E402
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
 from metrics_tpu.regression import (  # noqa: E402
     CosineSimilarity,
     ExplainedVariance,
@@ -109,6 +119,14 @@ __all__ = [
     "R2Score",
     "ROC",
     "Recall",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
     "SpearmanCorrCoef",
     "Specificity",
     "StatScores",

@@ -154,6 +154,13 @@ class MetricCollection:
         avoids fetching array values for obviously-different metrics; the
         value comparison then proves the update paths agree (parity with
         reference collections.py:194-213).
+
+        Known limitation (inherited from the reference heuristic): two
+        metrics whose update-time hyperparameters differ (e.g. thresholds)
+        are merged if their states coincide on the FIRST batch — later
+        batches then only update the group leader. Pass explicit
+        ``compute_groups=[[...]]`` (or ``False``) when metrics differ only
+        in update-time arguments.
         """
         if metric1._defaults.keys() != metric2._defaults.keys():
             return False

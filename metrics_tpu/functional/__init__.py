@@ -30,3 +30,19 @@ from metrics_tpu.functional.classification.hinge import hinge_loss  # noqa: F401
 from metrics_tpu.functional.classification.jaccard import jaccard_index  # noqa: F401
 from metrics_tpu.functional.classification.kl_divergence import kl_divergence  # noqa: F401
 from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401
+from metrics_tpu.functional.retrieval import (  # noqa: F401
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.functional.pairwise import (  # noqa: F401
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)

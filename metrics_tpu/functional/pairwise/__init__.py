@@ -1,0 +1,4 @@
+from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity  # noqa: F401
+from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance  # noqa: F401
+from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity  # noqa: F401
+from metrics_tpu.functional.pairwise.manhattan import pairwise_manhattan_distance  # noqa: F401

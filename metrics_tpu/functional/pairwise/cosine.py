@@ -1,0 +1,40 @@
+"""Pairwise cosine similarity.
+
+Behavior parity with /root/reference/torchmetrics/functional/pairwise/cosine.py:20-90.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_cosine_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise cosine similarity between rows of x (and y).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_cosine_similarity(x, y)
+        Array([[0.5547002 , 0.8682431 ],
+               [0.51449573, 0.8436614 ],
+               [0.5300066 , 0.8556387 ]], dtype=float32)
+    """
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,41 @@
+"""Pairwise euclidean distance.
+
+Behavior parity with /root/reference/torchmetrics/functional/pairwise/euclidean.py:20-85.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)[None, :]
+    distance = x_norm + y_norm - 2 * jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    distance = _zero_diagonal(distance, zero_diagonal)
+    return jnp.sqrt(jnp.maximum(distance, 0.0))
+
+
+def pairwise_euclidean_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise euclidean distance between rows of x (and y).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_euclidean_distance(x, y)
+        Array([[3.1622777, 2.       ],
+               [5.385165 , 4.1231055],
+               [8.944272 , 7.6157727]], dtype=float32)
+    """
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

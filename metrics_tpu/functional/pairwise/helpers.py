@@ -1,0 +1,50 @@
+"""Shared input checking / reduction for pairwise metrics.
+
+Behavior parity with /root/reference/torchmetrics/functional/pairwise/
+helpers.py:15-60. The distance-matrix matmuls in the kernels pass
+``precision=HIGHEST``: TPU matmuls default to bfloat16-class accumulation,
+which costs ~1e-2 absolute error on unit-scale inputs (and worse through
+the euclidean kernel's cancellation-prone expansion).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_input(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Tuple[Array, Array, bool]:
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+
+    if y is not None:
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _zero_diagonal(distance: Array, zero_diagonal: bool) -> Array:
+    if zero_diagonal:
+        n = min(distance.shape)
+        distance = distance.at[jnp.arange(n), jnp.arange(n)].set(0)
+    return distance
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    if reduction == "mean":
+        return jnp.mean(distmat, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(distmat, axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")

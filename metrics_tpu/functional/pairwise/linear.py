@@ -1,0 +1,38 @@
+"""Pairwise linear (dot-product) similarity.
+
+Behavior parity with /root/reference/torchmetrics/functional/pairwise/linear.py:20-80.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_linear_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise dot-product similarity between rows of x (and y).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_linear_similarity(x, y)
+        Array([[ 2.,  7.],
+               [ 3., 11.],
+               [ 5., 18.]], dtype=float32)
+    """
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

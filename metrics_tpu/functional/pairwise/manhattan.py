@@ -1,0 +1,38 @@
+"""Pairwise manhattan distance.
+
+Behavior parity with /root/reference/torchmetrics/functional/pairwise/manhattan.py:20-85.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_manhattan_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise manhattan (L1) distance between rows of x (and y).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_manhattan_distance(x, y)
+        Array([[ 4.,  2.],
+               [ 7.,  5.],
+               [12., 10.]], dtype=float32)
+    """
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

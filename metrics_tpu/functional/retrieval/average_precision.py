@@ -1,0 +1,30 @@
+"""Retrieval average precision.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+average_precision.py:20-58.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """Average precision of a single query's ranking.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not jnp.sum(target):
+        return jnp.asarray(0.0, dtype=preds.dtype)
+
+    target = target[jnp.argsort(-preds, axis=-1)]
+    positions = jnp.arange(1, len(target) + 1, dtype=jnp.float32)[target > 0]
+    return jnp.mean((jnp.arange(len(positions), dtype=jnp.float32) + 1) / positions)

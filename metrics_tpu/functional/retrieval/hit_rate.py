@@ -1,0 +1,30 @@
+"""Retrieval hit rate.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+hit_rate.py:20-58.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs, _check_retrieval_k
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """1.0 if any relevant document is in the top k, else 0.0.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_hit_rate(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    _check_retrieval_k(k)
+
+    relevant = jnp.sum(target[jnp.argsort(-preds, axis=-1)][:k])
+    return (relevant > 0).astype(jnp.float32)

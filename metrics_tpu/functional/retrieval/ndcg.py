@@ -1,0 +1,39 @@
+"""Retrieval normalized discounted cumulative gain.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+ndcg.py:20-72 (sort + log2 discount; graded targets allowed).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs, _check_retrieval_k
+
+Array = jax.Array
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1]) + 2.0)
+    return jnp.sum(target / denom, axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG (at k) of a single query's ranking; targets may be graded.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_normalized_dcg(jnp.array([.1, .2, .3, 4., 70.]), jnp.array([10, 0, 0, 1, 5]))
+        Array(0.6956941, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    k = preds.shape[-1] if k is None else k
+    _check_retrieval_k(k)
+
+    sorted_target = target[jnp.argsort(-preds, axis=-1)][:k]
+    ideal_target = -jnp.sort(-target)[:k]
+
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+
+    return jnp.where(ideal_dcg == 0, 0.0, target_dcg / jnp.where(ideal_dcg == 0, 1.0, ideal_dcg))

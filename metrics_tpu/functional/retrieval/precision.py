@@ -1,0 +1,34 @@
+"""Retrieval precision.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+precision.py:20-58.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs, _check_retrieval_k
+
+Array = jax.Array
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of the top k retrieved documents that are relevant.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    _check_retrieval_k(k)
+
+    if not jnp.sum(target):
+        return jnp.asarray(0.0, dtype=preds.dtype)
+
+    order = jnp.argsort(-preds, axis=-1)[: min(k, preds.shape[-1])]
+    relevant = jnp.sum(target[order]).astype(jnp.float32)
+    return relevant / k

@@ -1,0 +1,28 @@
+"""Retrieval R-precision.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+r_precision.py:20-55.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Precision at R where R is the number of relevant documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_r_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    relevant_number = int(jnp.sum(target))
+    if not relevant_number:
+        return jnp.asarray(0.0, dtype=preds.dtype)
+
+    relevant = jnp.sum(target[jnp.argsort(-preds, axis=-1)][:relevant_number]).astype(jnp.float32)
+    return relevant / relevant_number

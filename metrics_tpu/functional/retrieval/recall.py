@@ -1,0 +1,33 @@
+"""Retrieval recall.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+recall.py:20-58.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs, _check_retrieval_k
+
+Array = jax.Array
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of relevant documents retrieved in the top k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_recall(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    _check_retrieval_k(k)
+
+    if not jnp.sum(target):
+        return jnp.asarray(0.0, dtype=preds.dtype)
+
+    relevant = jnp.sum(target[jnp.argsort(-preds, axis=-1)][:k]).astype(jnp.float32)
+    return relevant / jnp.sum(target)

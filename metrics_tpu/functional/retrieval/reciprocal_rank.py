@@ -1,0 +1,28 @@
+"""Retrieval mean reciprocal rank.
+
+Behavior parity with /root/reference/torchmetrics/functional/retrieval/
+reciprocal_rank.py:20-52.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """Reciprocal rank of the first relevant document.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_reciprocal_rank(jnp.array([0.2, 0.3, 0.5]), jnp.array([False, True, False]))
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not jnp.sum(target):
+        return jnp.asarray(0.0, dtype=preds.dtype)
+
+    target = target[jnp.argsort(-preds, axis=-1)]
+    position = jnp.nonzero(target)[0]
+    return 1.0 / (position[0] + 1.0)

@@ -1,0 +1,8 @@
+from metrics_tpu.retrieval.average_precision import RetrievalMAP  # noqa: F401
+from metrics_tpu.retrieval.fall_out import RetrievalFallOut  # noqa: F401
+from metrics_tpu.retrieval.hit_rate import RetrievalHitRate  # noqa: F401
+from metrics_tpu.retrieval.ndcg import RetrievalNormalizedDCG  # noqa: F401
+from metrics_tpu.retrieval.precision import RetrievalPrecision  # noqa: F401
+from metrics_tpu.retrieval.r_precision import RetrievalRPrecision  # noqa: F401
+from metrics_tpu.retrieval.recall import RetrievalRecall  # noqa: F401
+from metrics_tpu.retrieval.reciprocal_rank import RetrievalMRR  # noqa: F401

@@ -1,0 +1,27 @@
+"""RetrievalMAP.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/average_precision.py:20-96.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap(preds, target, indexes=indexes)
+        Array(0.7916667, dtype=float32)
+    """
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_average_precision(preds, target)

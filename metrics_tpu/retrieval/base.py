@@ -1,0 +1,106 @@
+"""RetrievalMetric base: grouped-by-query mean of a per-query metric.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/base.py:27-150:
+cat-states ``indexes/preds/target``; compute = concat -> group by query id ->
+per-group ``_metric`` -> mean; ``empty_target_action`` in neg/pos/skip/error.
+
+The reference groups with a Python dict loop (utilities/data.py:244-253, a
+known hot spot — SURVEY.md §3.6); here ``get_group_indexes`` sorts by query
+id and splits segments (O(N log N) on device), and per-group evaluation
+walks the segments host-side (exact-parity mode — data-dependent group
+sizes are inherently host work; the subclass kernels themselves are
+device ops).
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base class for retrieval metrics over (indexes, preds, target) triples."""
+
+    higher_is_better = True
+    __jit_unsafe__ = True  # grouping by query id has data-dependent shapes
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def _update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes,
+            preds,
+            target,
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _group_empty(self, mini_target: Array) -> bool:
+        """True if this query has no positive target (override to invert)."""
+        return not bool(jnp.sum(mini_target))
+
+    def _empty_error_message(self) -> str:
+        return "`compute` method was provided with a query with no positive target."
+
+    def _compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        res = []
+        groups = get_group_indexes(indexes)
+
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+
+            if self._group_empty(mini_target):
+                if self.empty_target_action == "error":
+                    raise ValueError(self._empty_error_message())
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+
+        if res:
+            return jnp.mean(jnp.stack([jnp.asarray(x, dtype=preds.dtype) for x in res]))
+        return jnp.asarray(0.0, dtype=preds.dtype)
+
+    @abstractmethod
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Compute the metric for a single query's documents."""

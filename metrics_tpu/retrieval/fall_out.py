@@ -1,0 +1,42 @@
+"""RetrievalFallOut — inverts the empty-query handling (queries with no
+*negative* targets).
+
+Behavior parity with /root/reference/torchmetrics/retrieval/fall_out.py:24-130.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.checks import _check_retrieval_k
+
+Array = jax.Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Mean fall-out@k over queries. Lower is better."""
+
+    higher_is_better = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _check_retrieval_k(k)
+        self.k = k
+
+    def _group_empty(self, mini_target: Array) -> bool:
+        # a query is degenerate when it has no NEGATIVE target
+        return not bool(jnp.sum(1 - mini_target))
+
+    def _empty_error_message(self) -> str:
+        return "`compute` method was provided with a query with no negative target."
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_fall_out(preds, target, k=self.k)

@@ -1,0 +1,31 @@
+"""RetrievalHitRate.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/hit_rate.py:22-112.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.checks import _check_retrieval_k
+
+Array = jax.Array
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """Mean hit rate@k over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _check_retrieval_k(k)
+        self.k = k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_hit_rate(preds, target, k=self.k)

@@ -1,0 +1,33 @@
+"""RetrievalNormalizedDCG.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/ndcg.py:22-112
+(graded targets allowed).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.checks import _check_retrieval_k
+
+Array = jax.Array
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Mean nDCG@k over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _check_retrieval_k(k)
+        self.k = k
+        self.allow_non_binary_target = True
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_normalized_dcg(preds, target, k=self.k)

@@ -1,0 +1,17 @@
+"""RetrievalRPrecision.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/r_precision.py:20-96.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean R-precision over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_r_precision(preds, target)

@@ -1,0 +1,31 @@
+"""RetrievalRecall.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/recall.py:22-112.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.checks import _check_retrieval_k
+
+Array = jax.Array
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Mean recall@k over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _check_retrieval_k(k)
+        self.k = k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_recall(preds, target, k=self.k)

@@ -1,0 +1,17 @@
+"""RetrievalMRR.
+
+Behavior parity with /root/reference/torchmetrics/retrieval/reciprocal_rank.py:20-96.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_reciprocal_rank(preds, target)

@@ -394,3 +394,9 @@ def _check_retrieval_inputs(
         preds, target, allow_non_binary_target=allow_non_binary_target
     )
     return indexes.astype(jnp.int32).reshape(-1), preds, target
+
+
+def _check_retrieval_k(k):
+    """Shared @k validation for retrieval metrics."""
+    if (k is not None) and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")

@@ -111,16 +111,17 @@ def apply_to_collection(
 def get_group_indexes(indexes: Array) -> List[Array]:
     """Group positions by value; returns one index array per distinct group id.
 
-    Host-side parity helper (/root/reference/torchmetrics/utilities/data.py:229-253).
-    The on-device retrieval path uses sorted segment ops instead
-    (metrics_tpu/functional/retrieval/_segments.py).
+    Contract parity with /root/reference/torchmetrics/utilities/data.py:229-253,
+    but vectorized: the reference loops a Python dict over every element (a
+    known hot spot, SURVEY.md §3.4); here one stable argsort + split does the
+    grouping in O(N log N). Within each group, positions keep their original
+    order (stable sort); groups are ordered by id rather than first
+    appearance, which no consumer depends on (results are averaged).
     """
     indexes = np.asarray(indexes)
-    res: dict = {}
-    for i, val in enumerate(indexes):
-        val = val.item()
-        res.setdefault(val, []).append(i)
-    return [jnp.asarray(group, dtype=jnp.int32) for group in res.values()]
+    order = np.argsort(indexes, kind="stable")
+    boundaries = np.nonzero(np.diff(indexes[order]))[0] + 1
+    return [jnp.asarray(g, dtype=jnp.int32) for g in np.split(order, boundaries)]
 
 
 def _safe_divide(num: Array, denom: Array) -> Array:

@@ -1,0 +1,76 @@
+"""Pairwise metrics vs sklearn oracles."""
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.RandomState(42)
+X = _rng.rand(12, 5).astype(np.float32)
+Y = _rng.rand(8, 5).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "tpu_fn, sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+        (pairwise_linear_similarity, sk_linear),
+        (pairwise_manhattan_distance, sk_manhattan),
+    ],
+)
+def test_pairwise_two_inputs(tpu_fn, sk_fn):
+    got = tpu_fn(jnp.asarray(X), jnp.asarray(Y))
+    np.testing.assert_allclose(np.asarray(got), sk_fn(X, Y), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "tpu_fn, sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+        (pairwise_linear_similarity, sk_linear),
+        (pairwise_manhattan_distance, sk_manhattan),
+    ],
+)
+def test_pairwise_single_input_zero_diagonal(tpu_fn, sk_fn):
+    got = np.asarray(tpu_fn(jnp.asarray(X)))
+    expected = sk_fn(X, X)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_pairwise_reduction(reduction):
+    got = pairwise_euclidean_distance(jnp.asarray(X), jnp.asarray(Y), reduction=reduction)
+    full = sk_euclidean(X, Y)
+    expected = full.mean(-1) if reduction == "mean" else full.sum(-1)
+    np.testing.assert_allclose(np.asarray(got), expected, atol=1e-4)
+    with pytest.raises(ValueError):
+        pairwise_euclidean_distance(jnp.asarray(X), reduction="bad")
+
+
+def test_pairwise_invalid_shapes():
+    with pytest.raises(ValueError):
+        pairwise_cosine_similarity(jnp.ones(5))
+    with pytest.raises(ValueError):
+        pairwise_cosine_similarity(jnp.ones((4, 5)), jnp.ones((4, 3)))
+
+
+def test_pairwise_jit():
+    import jax
+
+    got = jax.jit(pairwise_euclidean_distance)(jnp.asarray(X), jnp.asarray(Y))
+    np.testing.assert_allclose(np.asarray(got), sk_euclidean(X, Y), atol=1e-5)

@@ -1,0 +1,189 @@
+"""Retrieval metrics vs sklearn / hand-rolled oracles.
+
+Mirrors /root/reference/tests/retrieval/ in spirit: grouped queries with
+random lengths, all empty_target_action modes, argument validation.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap, ndcg_score as sk_ndcg
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+
+_rng = np.random.RandomState(42)
+N_QUERIES = 10
+# each query has 4-12 documents, with at least one positive and one negative
+_indexes, _preds, _target = [], [], []
+for q in range(N_QUERIES):
+    n = _rng.randint(4, 13)
+    t = np.zeros(n, dtype=np.int64)
+    t[_rng.choice(n, _rng.randint(1, n), replace=False)] = 1
+    if t.all():
+        t[0] = 0
+    _indexes.append(np.full(n, q))
+    _preds.append(_rng.rand(n).astype(np.float32))
+    _target.append(t)
+INDEXES = jnp.asarray(np.concatenate(_indexes))
+PREDS = jnp.asarray(np.concatenate(_preds))
+TARGET = jnp.asarray(np.concatenate(_target))
+
+
+def _per_query_mean(fn):
+    return np.mean([fn(p, t) for p, t in zip(_preds, _target)])
+
+
+def _sk_mrr(p, t):
+    order = np.argsort(-p)
+    pos = np.nonzero(t[order])[0]
+    return 1.0 / (pos[0] + 1)
+
+
+def _sk_precision_at(k):
+    def fn(p, t):
+        order = np.argsort(-p)[:k]
+        return t[order].sum() / k
+    return fn
+
+
+def _sk_recall_at(k):
+    def fn(p, t):
+        order = np.argsort(-p)[:k]
+        return t[order].sum() / t.sum()
+    return fn
+
+
+def _sk_hit_at(k):
+    def fn(p, t):
+        return float(t[np.argsort(-p)[:k]].sum() > 0)
+    return fn
+
+
+def _sk_fallout_at(k):
+    def fn(p, t):
+        neg = 1 - t
+        return neg[np.argsort(-p)[:k]].sum() / neg.sum()
+    return fn
+
+
+def _sk_rprec(p, t):
+    r = int(t.sum())
+    return t[np.argsort(-p)[:r]].sum() / r
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_args, oracle",
+    [
+        (RetrievalMAP, {}, lambda: _per_query_mean(lambda p, t: sk_ap(t, p))),
+        (RetrievalMRR, {}, lambda: _per_query_mean(_sk_mrr)),
+        (RetrievalPrecision, {"k": 2}, lambda: _per_query_mean(_sk_precision_at(2))),
+        (RetrievalRecall, {"k": 2}, lambda: _per_query_mean(_sk_recall_at(2))),
+        (RetrievalHitRate, {"k": 2}, lambda: _per_query_mean(_sk_hit_at(2))),
+        (RetrievalFallOut, {"k": 2}, lambda: _per_query_mean(_sk_fallout_at(2))),
+        (RetrievalRPrecision, {}, lambda: _per_query_mean(_sk_rprec)),
+        (
+            RetrievalNormalizedDCG,
+            {},
+            lambda: _per_query_mean(lambda p, t: sk_ndcg(t[None, :], p[None, :])),
+        ),
+        (
+            RetrievalNormalizedDCG,
+            {"k": 3},
+            lambda: _per_query_mean(lambda p, t: sk_ndcg(t[None, :], p[None, :], k=3)),
+        ),
+    ],
+)
+def test_retrieval_metric_parity(metric_class, metric_args, oracle):
+    metric = metric_class(**metric_args)
+    # batched updates split mid-query to exercise cross-batch grouping
+    half = len(PREDS) // 2
+    metric.update(PREDS[:half], TARGET[:half], indexes=INDEXES[:half])
+    metric.update(PREDS[half:], TARGET[half:], indexes=INDEXES[half:])
+    np.testing.assert_allclose(np.asarray(metric.compute()), oracle(), atol=1e-5)
+
+
+def test_empty_target_actions():
+    indexes = jnp.asarray([0, 0, 1, 1])
+    preds = jnp.asarray([0.3, 0.7, 0.2, 0.8], dtype=jnp.float32)
+    target = jnp.asarray([0, 1, 0, 0])  # query 1 has no positives
+
+    for action, expected in [("neg", (1.0 + 0.0) / 2), ("pos", (1.0 + 1.0) / 2), ("skip", 1.0)]:
+        m = RetrievalMAP(empty_target_action=action)
+        m.update(preds, target, indexes=indexes)
+        assert float(m.compute()) == pytest.approx(expected), action
+
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(preds, target, indexes=indexes)
+    with pytest.raises(ValueError, match="no positive"):
+        m.compute()
+
+
+def test_fall_out_inverted_empty_handling():
+    indexes = jnp.asarray([0, 0, 1, 1])
+    preds = jnp.asarray([0.3, 0.7, 0.2, 0.8], dtype=jnp.float32)
+    target = jnp.asarray([0, 1, 1, 1])  # query 1 has no negatives
+
+    m = RetrievalFallOut(empty_target_action="error")
+    m.update(preds, target, indexes=indexes)
+    with pytest.raises(ValueError, match="no negative"):
+        m.compute()
+
+
+def test_ignore_index():
+    indexes = jnp.asarray([0, 0, 0])
+    preds = jnp.asarray([0.3, 0.7, 0.5], dtype=jnp.float32)
+    target = jnp.asarray([0, 1, -100])
+    m = RetrievalMAP(ignore_index=-100)
+    m.update(preds, target, indexes=indexes)
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        RetrievalMAP(empty_target_action="bad")
+    with pytest.raises(ValueError):
+        RetrievalMAP(ignore_index="bad")
+    with pytest.raises(ValueError):
+        RetrievalPrecision(k=-1)
+    m = RetrievalMAP()
+    with pytest.raises(ValueError):
+        m.update(PREDS, TARGET, indexes=None)
+
+
+def test_functional_kernels():
+    p = jnp.asarray([0.2, 0.3, 0.5], dtype=jnp.float32)
+    t = jnp.asarray([True, False, True])
+    assert float(retrieval_average_precision(p, t)) == pytest.approx((1 / 1 + 2 / 3) / 2)
+    assert float(retrieval_reciprocal_rank(p, t)) == pytest.approx(1.0)
+    assert float(retrieval_precision(p, t, k=2)) == pytest.approx(0.5)
+    assert float(retrieval_recall(p, t, k=2)) == pytest.approx(0.5)
+    assert float(retrieval_hit_rate(p, t, k=2)) == pytest.approx(1.0)
+    assert float(retrieval_fall_out(p, t, k=2)) == pytest.approx(1.0)
+    assert float(retrieval_r_precision(p, t)) == pytest.approx(0.5)
+    nd = retrieval_normalized_dcg(jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0]), jnp.asarray([10, 0, 0, 1, 5]))
+    expected = sk_ndcg(np.asarray([[10, 0, 0, 1, 5]]), np.asarray([[0.1, 0.2, 0.3, 4.0, 70.0]]))
+    np.testing.assert_allclose(np.asarray(nd), expected, atol=1e-5)
+
+    # no-positive queries return 0
+    t0 = jnp.asarray([False, False, False])
+    assert float(retrieval_average_precision(p, t0)) == 0.0
+    assert float(retrieval_reciprocal_rank(p, t0)) == 0.0
