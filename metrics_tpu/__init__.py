@@ -52,6 +52,12 @@ from metrics_tpu.wrappers import (  # noqa: E402
     MinMaxMetric,
     MultioutputWrapper,
 )
+from metrics_tpu.image import (  # noqa: E402
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
 from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalFallOut,
     RetrievalHitRate,
@@ -111,7 +117,9 @@ __all__ = [
     "MetricTracker",
     "MinMaxMetric",
     "MinMetric",
+    "MultiScaleStructuralSimilarityIndexMeasure",
     "MultioutputWrapper",
+    "PeakSignalNoiseRatio",
     "SumMetric",
     "PearsonCorrCoef",
     "Precision",
@@ -128,6 +136,8 @@ __all__ = [
     "RetrievalRPrecision",
     "RetrievalRecall",
     "SpearmanCorrCoef",
+    "StructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex",
     "Specificity",
     "StatScores",
     "SymmetricMeanAbsolutePercentageError",

@@ -46,3 +46,10 @@ from metrics_tpu.functional.pairwise import (  # noqa: F401
     pairwise_linear_similarity,
     pairwise_manhattan_distance,
 )
+from metrics_tpu.functional.image import (  # noqa: F401
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    structural_similarity_index_measure,
+    universal_image_quality_index,
+)

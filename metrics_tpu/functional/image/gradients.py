@@ -1,0 +1,44 @@
+"""Image gradients (dy, dx).
+
+Behavior parity with /root/reference/torchmetrics/functional/image/gradients.py:20-85.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not isinstance(img, jnp.ndarray):
+        raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Computes (dy, dx) of an ``(N, C, H, W)`` image tensor.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> dy[0, 0, :, :]
+        Array([[4., 4., 4., 4.],
+               [4., 4., 4., 4.],
+               [4., 4., 4., 4.],
+               [0., 0., 0., 0.]], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,45 @@
+"""Gaussian kernel helper for image metrics.
+
+Behavior parity with /root/reference/torchmetrics/functional/image/helper.py.
+"""
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype) -> Array:
+    """1D gaussian kernel of shape (1, kernel_size)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return (gauss / jnp.sum(gauss))[None, :]
+
+
+def _gaussian_kernel(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
+    """2D gaussian kernel of shape (channel, 1, kh, kw) for a grouped conv."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kernel_x.T @ kernel_y  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """VALID depthwise conv: x [N,C,H,W], kernel [C,1,kh,kw]."""
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        feature_group_count=x.shape[1],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+
+
+def _avg_pool2d(x: Array) -> Array:
+    """2x2 average pool with stride 2 (torch F.avg_pool2d parity)."""
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, window_dimensions=(1, 1, 2, 2), window_strides=(1, 1, 2, 2), padding="VALID"
+    )
+    return summed / 4.0

@@ -1,0 +1,94 @@
+"""Universal Image Quality Index.
+
+Behavior parity with /root/reference/torchmetrics/functional/image/uqi.py:25-160
+(SSIM with c1 = c2 = 0).
+"""
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import _depthwise_conv2d, _gaussian_kernel
+from metrics_tpu.functional.image.ssim import _ssim_check_kernel
+from metrics_tpu.parallel.distributed import reduce
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _uqi_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _uqi_compute(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: str = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> Array:
+    _ssim_check_kernel(kernel_size, sigma)
+
+    channel = preds.shape[1]
+    dtype = preds.dtype
+    kernel = _gaussian_kernel(channel, kernel_size, sigma, dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+
+    pad_cfg = ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w))
+    preds = jnp.pad(preds, pad_cfg, mode="reflect")
+    target = jnp.pad(target, pad_cfg, mode="reflect")
+
+    input_list = jnp.concatenate([preds, target, preds * preds, target * target, preds * target])
+    outputs = _depthwise_conv2d(input_list, kernel)
+    n = preds.shape[0]
+    output_list = [outputs[i * n:(i + 1) * n] for i in range(5)]
+
+    mu_pred_sq = jnp.square(output_list[0])
+    mu_target_sq = jnp.square(output_list[1])
+    mu_pred_target = output_list[0] * output_list[1]
+
+    sigma_pred_sq = output_list[2] - mu_pred_sq
+    sigma_target_sq = output_list[3] - mu_target_sq
+    sigma_pred_target = output_list[4] - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w] if pad_h and pad_w else uqi_idx
+
+    return reduce(uqi_idx, reduction)
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: str = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> Array:
+    """Computes the Universal Image Quality Index.
+
+    Example:
+        >>> import jax
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> bool(universal_image_quality_index(preds, target) > 0.9)
+        True
+    """
+    preds, target = _uqi_update(preds, target)
+    return _uqi_compute(preds, target, kernel_size, sigma, reduction, data_range)

@@ -1,0 +1,6 @@
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio  # noqa: F401
+from metrics_tpu.image.ssim import (  # noqa: F401
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from metrics_tpu.image.uqi import UniversalImageQualityIndex  # noqa: F401

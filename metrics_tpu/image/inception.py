@@ -1,0 +1,82 @@
+"""Inception Score.
+
+Behavior parity with /root/reference/torchmetrics/image/inception.py:28-171.
+``feature`` accepts any callable ``imgs -> [N, num_classes]`` logits
+extractor or 'logits_unbiased'/int for the bundled Flax InceptionV3.
+"""
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    """Computes the Inception Score (mean and std over splits)."""
+
+    __jit_unsafe__ = True
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = "logits_unbiased",
+        splits: int = 10,
+        seed: int = None,
+        feature_extractor_weights_path: str = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        rank_zero_warn(
+            "Metric `InceptionScore` will save all extracted features in buffer."
+            " For large datasets this may lead to large memory footprint.",
+            UserWarning,
+        )
+
+        if isinstance(feature, (str, int)):
+            valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            from metrics_tpu.models.inception import build_fid_inception
+
+            self.inception = build_fid_inception(feature, feature_extractor_weights_path)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+
+        self.splits = splits
+        self._rng = np.random.RandomState(seed)
+        self.add_state("features", [], dist_reduce_fx=None)
+
+    def _update(self, imgs: Array) -> None:
+        features = self.inception(imgs)
+        self.features.append(features)
+
+    def _compute(self) -> Tuple[Array, Array]:
+        features = dim_zero_cat(self.features)
+        idx = self._rng.permutation(features.shape[0])
+        features = features[idx]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+
+        kl_ = []
+        for p, log_p in zip(prob_chunks, log_prob_chunks):
+            m_p = jnp.mean(p, axis=0, keepdims=True)
+            kl = p * (log_p - jnp.log(m_p))
+            kl_.append(jnp.exp(jnp.mean(jnp.sum(kl, axis=1))))
+        kl = jnp.stack(kl_)
+        return jnp.mean(kl), jnp.std(kl, ddof=1)

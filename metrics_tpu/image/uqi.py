@@ -1,0 +1,55 @@
+"""Modular UniversalImageQualityIndex.
+
+Behavior parity with /root/reference/torchmetrics/image/uqi.py:25-110.
+"""
+from typing import Any, Optional, Sequence
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.uqi import _uqi_compute, _uqi_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class UniversalImageQualityIndex(Metric):
+    """Computes UQI over accumulated batches.
+
+    Example:
+        >>> import jax
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> uqi = UniversalImageQualityIndex()
+        >>> bool(uqi(preds, target) > 0.9)
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: str = "elementwise_mean",
+        data_range: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.data_range = data_range
+        self.reduction = reduction
+
+    def _update(self, preds: Array, target: Array) -> None:
+        preds, target = _uqi_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _uqi_compute(preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range)

@@ -1,0 +1,169 @@
+"""Image metrics vs the reference TorchMetrics implementation on torch-CPU
+(the reference's own oracles — skimage/torch_fidelity — are not available in
+this image, so the mounted reference serves as the oracle, mirroring its
+tests' parametrizations)."""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.functional import (
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    structural_similarity_index_measure,
+    universal_image_quality_index,
+)
+
+
+@pytest.fixture(scope="module")
+def reference():
+    if "pkg_resources" not in sys.modules:
+        stub = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        stub.DistributionNotFound = DistributionNotFound
+        stub.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = stub
+    sys.path.insert(0, "/root/reference")
+    import torchmetrics
+
+    yield torchmetrics
+    sys.path.remove("/root/reference")
+
+
+_rng = np.random.RandomState(42)
+PREDS = _rng.rand(4, 3, 32, 32).astype(np.float32)
+TARGET = (0.7 * PREDS + 0.3 * _rng.rand(4, 3, 32, 32)).astype(np.float32)
+
+
+def test_psnr_parity(reference):
+    import torch
+
+    for kwargs in [{}, {"data_range": 1.0}, {"base": 2.0}, {"data_range": 1.0, "dim": (1, 2, 3)}]:
+        got = peak_signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), **kwargs)
+        want = reference.functional.peak_signal_noise_ratio(
+            torch.from_numpy(PREDS), torch.from_numpy(TARGET), **kwargs
+        )
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-4, err_msg=str(kwargs))
+
+
+def test_psnr_class_parity(reference):
+    import torch
+
+    m = PeakSignalNoiseRatio()
+    ref = reference.PeakSignalNoiseRatio()
+    for i in range(2):
+        m.update(jnp.asarray(PREDS[i * 2:(i + 1) * 2]), jnp.asarray(TARGET[i * 2:(i + 1) * 2]))
+        ref.update(torch.from_numpy(PREDS[i * 2:(i + 1) * 2]), torch.from_numpy(TARGET[i * 2:(i + 1) * 2]))
+    np.testing.assert_allclose(np.asarray(m.compute()), ref.compute().numpy(), atol=1e-4)
+
+
+def test_ssim_parity(reference):
+    import torch
+
+    for kwargs in [{}, {"data_range": 1.0}, {"kernel_size": (7, 7), "sigma": (1.0, 1.0)}]:
+        got = structural_similarity_index_measure(jnp.asarray(PREDS), jnp.asarray(TARGET), **kwargs)
+        want = reference.functional.structural_similarity_index_measure(
+            torch.from_numpy(PREDS), torch.from_numpy(TARGET), **kwargs
+        )
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-4, err_msg=str(kwargs))
+
+
+def test_ssim_class_parity(reference):
+    import torch
+
+    m = StructuralSimilarityIndexMeasure()
+    ref = reference.StructuralSimilarityIndexMeasure()
+    m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref.update(torch.from_numpy(PREDS), torch.from_numpy(TARGET))
+    np.testing.assert_allclose(np.asarray(m.compute()), ref.compute().numpy(), atol=1e-4)
+
+
+def test_ms_ssim_parity(reference):
+    import torch
+
+    preds = _rng.rand(1, 2, 256, 256).astype(np.float32)
+    target = (0.8 * preds + 0.2 * _rng.rand(1, 2, 256, 256)).astype(np.float32)
+    for kwargs in [{}, {"normalize": "relu"}, {"normalize": "simple"}]:
+        got = multiscale_structural_similarity_index_measure(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+        want = reference.functional.multiscale_structural_similarity_index_measure(
+            torch.from_numpy(preds), torch.from_numpy(target), **kwargs
+        )
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-4, err_msg=str(kwargs))
+
+
+def test_uqi_parity(reference):
+    import torch
+
+    got = universal_image_quality_index(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    want = reference.functional.universal_image_quality_index(
+        torch.from_numpy(PREDS), torch.from_numpy(TARGET)
+    )
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-4)
+
+    m = UniversalImageQualityIndex()
+    m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    np.testing.assert_allclose(np.asarray(m.compute()), want.numpy(), atol=1e-4)
+
+
+def test_image_gradients():
+    img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+    dy, dx = image_gradients(img)
+    np.testing.assert_allclose(np.asarray(dy[0, 0, :-1]), 4.0)
+    np.testing.assert_allclose(np.asarray(dy[0, 0, -1]), 0.0)
+    np.testing.assert_allclose(np.asarray(dx[0, 0, :, :-1]), 1.0)
+    with pytest.raises(RuntimeError):
+        image_gradients(jnp.ones((4, 4)))
+    with pytest.raises(TypeError):
+        image_gradients([[1.0]])
+
+
+def test_ssim_invalid_inputs():
+    with pytest.raises(ValueError):
+        structural_similarity_index_measure(jnp.ones((4, 4)), jnp.ones((4, 4)))
+    with pytest.raises(TypeError):
+        structural_similarity_index_measure(
+            jnp.ones((1, 1, 8, 8), dtype=jnp.float32), jnp.ones((1, 1, 8, 8), dtype=jnp.bfloat16)
+        )
+    with pytest.raises(ValueError):
+        structural_similarity_index_measure(
+            jnp.ones((1, 1, 8, 8)), jnp.ones((1, 1, 8, 8)), kernel_size=(4, 4)
+        )
+    with pytest.raises(ValueError):
+        multiscale_structural_similarity_index_measure(
+            jnp.ones((1, 1, 16, 16)), jnp.ones((1, 1, 16, 16))
+        )
+
+
+def test_ssim_jit():
+    import jax
+
+    got = jax.jit(structural_similarity_index_measure)(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    eager = structural_similarity_index_measure(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(eager), atol=1e-6)
+
+
+def test_psnr_merge_states():
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    s1 = m.update_state(m.init_state(), jnp.asarray(PREDS[:2]), jnp.asarray(TARGET[:2]))
+    s2 = m.update_state(m.init_state(), jnp.asarray(PREDS[2:]), jnp.asarray(TARGET[2:]))
+    merged = m.merge_states(s1, s2)
+    both = m.update_state(s1, jnp.asarray(PREDS[2:]), jnp.asarray(TARGET[2:]))
+    np.testing.assert_allclose(
+        np.asarray(m.compute_state(merged)), np.asarray(m.compute_state(both)), atol=1e-5
+    )
