@@ -110,7 +110,93 @@ def bench_reference() -> float:
         sys.path.pop(0)
 
 
+def _make_detection_data(n_imgs=64, n_classes=20, seed=3):
+    rng = np.random.default_rng(seed)
+    preds, target = [], []
+    for _ in range(n_imgs):
+        nd = int(rng.integers(5, 25))
+        ng = int(rng.integers(3, 15))
+
+        def boxes(n):
+            x1 = rng.uniform(0, 500, n)
+            y1 = rng.uniform(0, 500, n)
+            w = rng.uniform(4, 150, n)
+            h = rng.uniform(4, 150, n)
+            return np.stack([x1, y1, x1 + w, y1 + h], 1).astype(np.float32)
+
+        preds.append(
+            dict(
+                boxes=boxes(nd),
+                scores=rng.uniform(0, 1, nd).astype(np.float32),
+                labels=rng.integers(0, n_classes, nd).astype(np.int32),
+            )
+        )
+        target.append(dict(boxes=boxes(ng), labels=rng.integers(0, n_classes, ng).astype(np.int32)))
+    return preds, target
+
+
+def bench_map() -> None:
+    """images/sec through COCO mAP update+compute (BASELINE config 3)."""
+    import jax.numpy as jnp
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    preds, target = _make_detection_data()
+    n_imgs = len(preds)
+
+    def run_once():
+        # host numpy inputs, same as the torch-CPU reference is fed
+        m = MeanAveragePrecision(class_metrics=True)
+        m.update(preds, target)
+        return m.compute()
+
+    run_once()  # compile
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    ours = n_imgs * iters / (time.perf_counter() - t0)
+
+    ref_ips = None
+    try:
+        import torch
+
+        import os
+
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+        from detection.test_map import _load_reference_map
+
+        RefMAP = _load_reference_map()
+        t_preds = [{k: torch.as_tensor(v) for k, v in p.items()} for p in preds]
+        t_target = [{k: torch.as_tensor(v) for k, v in t.items()} for t in target]
+
+        def ref_once():
+            m = RefMAP(class_metrics=True)
+            m.update(t_preds, t_target)
+            return m.compute()
+
+        ref_once()
+        t0 = time.perf_counter()
+        ref_once()
+        ref_ips = n_imgs / (time.perf_counter() - t0)
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "coco_map_update_compute_throughput",
+                "value": round(ours, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(ours / ref_ips, 3) if ref_ips else None,
+            }
+        )
+    )
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "map":
+        bench_map()
+        return
     tpu_sps = bench_tpu()
     try:
         ref_sps = bench_reference()

@@ -286,9 +286,11 @@ class Metric(ABC):
             if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
+        import numpy as _np
+
         output_dict = apply_to_collection(
             input_dict,
-            (jnp.ndarray,),
+            (jnp.ndarray, _np.ndarray),  # host-resident states (e.g. detection) gather too
             dist_sync_fn,
             group=process_group or self.process_group,
         )

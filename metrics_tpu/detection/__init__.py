@@ -1,0 +1,4 @@
+"""Detection metrics (reference: /root/reference/torchmetrics/detection/)."""
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision  # noqa: F401
+
+__all__ = ["MeanAveragePrecision"]

@@ -1,0 +1,4 @@
+"""Functional detection kernels (reference: torchvision.ops + detection/map.py)."""
+from metrics_tpu.functional.detection.box_ops import box_area, box_convert, box_iou  # noqa: F401
+
+__all__ = ["box_area", "box_convert", "box_iou"]

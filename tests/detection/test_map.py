@@ -1,0 +1,368 @@
+"""MeanAveragePrecision parity tests.
+
+Oracles:
+1. The official pycocotools values hard-coded in the reference test suite
+   (/root/reference/tests/detection/test_map.py:103-160), at the reference's
+   own atol=1e-1.
+2. The reference torchmetrics implementation itself, imported from
+   /root/reference with minimal torch box-op shims standing in for the absent
+   torchvision dependency — randomized fixtures at atol=1e-6.
+"""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.functional.detection.box_ops import box_area, box_convert, box_iou
+
+# ---------------------------------------------------------------------------
+# the COCO subset fixture (reference tests/detection/test_map.py:26-100;
+# data from pycocotools' instances_val2014_fakebbox100 results)
+# ---------------------------------------------------------------------------
+_PREDS = [
+    [
+        dict(boxes=[[258.15, 41.29, 606.41, 285.07]], scores=[0.236], labels=[4]),
+        dict(
+            boxes=[[61.00, 22.75, 565.00, 632.42], [12.66, 3.32, 281.26, 275.23]],
+            scores=[0.318, 0.726],
+            labels=[3, 2],
+        ),
+    ],
+    [
+        dict(
+            boxes=[
+                [87.87, 276.25, 384.29, 379.43],
+                [0.00, 3.66, 142.15, 316.06],
+                [296.55, 93.96, 314.97, 152.79],
+                [328.94, 97.05, 342.49, 122.98],
+                [356.62, 95.47, 372.33, 147.55],
+                [464.08, 105.09, 495.74, 146.99],
+                [276.11, 103.84, 291.44, 150.72],
+            ],
+            scores=[0.546, 0.3, 0.407, 0.611, 0.335, 0.805, 0.953],
+            labels=[4, 1, 0, 0, 0, 0, 0],
+        ),
+        dict(boxes=[[0.00, 2.87, 601.00, 421.52]], scores=[0.699], labels=[5]),
+    ],
+]
+_TARGET = [
+    [
+        dict(boxes=[[214.1500, 41.2900, 562.4100, 285.0700]], labels=[4]),
+        dict(
+            boxes=[[13.00, 22.75, 548.98, 632.42], [1.66, 3.32, 270.26, 275.23]],
+            labels=[2, 2],
+        ),
+    ],
+    [
+        dict(
+            boxes=[
+                [61.87, 276.25, 358.29, 379.43],
+                [2.75, 3.66, 162.15, 316.06],
+                [295.55, 93.96, 313.97, 152.79],
+                [326.94, 97.05, 340.49, 122.98],
+                [356.62, 95.47, 372.33, 147.55],
+                [462.08, 105.09, 493.74, 146.99],
+                [277.11, 103.84, 292.44, 150.72],
+            ],
+            labels=[4, 1, 0, 0, 0, 0, 0],
+        ),
+        dict(boxes=[[13.99, 2.87, 640.00, 421.52]], labels=[5]),
+    ],
+]
+
+_PYCOCO_EXPECTED = {
+    "map": 0.706,
+    "map_50": 0.901,
+    "map_75": 0.846,
+    "map_small": 0.689,
+    "map_medium": 0.800,
+    "map_large": 0.701,
+    "mar_1": 0.592,
+    "mar_10": 0.716,
+    "mar_100": 0.716,
+    "mar_small": 0.767,
+    "mar_medium": 0.800,
+    "mar_large": 0.700,
+    "map_per_class": [0.725, 0.800, 0.454, -1.000, 0.650, 0.900],
+    "mar_100_per_class": [0.780, 0.800, 0.450, -1.000, 0.650, 0.900],
+}
+
+
+def _as_jnp(sample: dict) -> dict:
+    out = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sample.items() if k != "labels"}
+    out["labels"] = jnp.asarray(np.asarray(sample["labels"], np.int32))
+    return out
+
+
+def test_map_pycocotools_parity():
+    """Full-dataset values vs official pycocotools numbers (reference atol=1e-1)."""
+    metric = MeanAveragePrecision(class_metrics=True)
+    for preds_batch, target_batch in zip(_PREDS, _TARGET):
+        metric.update([_as_jnp(p) for p in preds_batch], [_as_jnp(t) for t in target_batch])
+    result = metric.compute()
+    for key, expected in _PYCOCO_EXPECTED.items():
+        np.testing.assert_allclose(
+            np.asarray(result[key]), np.asarray(expected, np.float32), atol=1e-1,
+            err_msg=f"mismatch for {key}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# reference-implementation oracle (random fixtures, tight tolerance)
+# ---------------------------------------------------------------------------
+def _load_reference_map():
+    """Import the reference MeanAveragePrecision, shimming torchvision ops."""
+    torch = pytest.importorskip("torch")
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    if "pkg_resources" not in sys.modules:
+        # this env's setuptools no longer ships pkg_resources; the reference
+        # only needs these two names for optional-dependency probing
+        import types
+
+        stub = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        stub.DistributionNotFound = DistributionNotFound
+        stub.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = stub
+    try:
+        import torchmetrics.detection.map as ref_map
+    except Exception as err:  # pragma: no cover
+        pytest.skip(f"reference torchmetrics unavailable: {err}")
+
+    def t_area(boxes):
+        return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+    def t_iou(b1, b2):
+        area1, area2 = t_area(b1), t_area(b2)
+        lt = torch.max(b1[:, None, :2], b2[None, :, :2])
+        rb = torch.min(b1[:, None, 2:], b2[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        union = area1[:, None] + area2[None, :] - inter
+        return torch.where(union > 0, inter / union, torch.zeros_like(inter))
+
+    def t_convert(boxes, in_fmt, out_fmt):
+        if in_fmt == out_fmt:
+            return boxes
+        a, b, c, d = boxes.unbind(-1)
+        if in_fmt == "xywh":
+            x1, y1, x2, y2 = a, b, a + c, b + d
+        elif in_fmt == "cxcywh":
+            x1, y1, x2, y2 = a - c / 2, b - d / 2, a + c / 2, b + d / 2
+        else:
+            x1, y1, x2, y2 = a, b, c, d
+        if out_fmt == "xyxy":
+            vals = (x1, y1, x2, y2)
+        elif out_fmt == "xywh":
+            vals = (x1, y1, x2 - x1, y2 - y1)
+        else:
+            vals = ((x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1)
+        return torch.stack(vals, dim=-1)
+
+    ref_map.box_area = t_area
+    ref_map.box_iou = t_iou
+    ref_map.box_convert = t_convert
+    ref_map._TORCHVISION_GREATER_EQUAL_0_8 = True
+    return ref_map.MeanAveragePrecision
+
+
+def _random_sample(rng, n_classes=6, max_boxes=8, with_scores=True):
+    n = int(rng.integers(1, max_boxes + 1))
+    x1 = rng.uniform(0, 300, n)
+    y1 = rng.uniform(0, 300, n)
+    w = rng.uniform(5, 200, n)
+    h = rng.uniform(5, 200, n)
+    boxes = np.stack([x1, y1, x1 + w, y1 + h], axis=1).astype(np.float32)
+    sample = dict(boxes=boxes, labels=rng.integers(0, n_classes, n).astype(np.int32))
+    if with_scores:
+        sample["scores"] = rng.uniform(0.05, 1.0, n).astype(np.float32)
+    return sample
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("class_metrics", [False, True])
+def test_map_reference_parity_random(seed, class_metrics):
+    """Randomized inputs vs the actual reference implementation (atol=1e-6)."""
+    import torch
+
+    RefMAP = _load_reference_map()
+    rng = np.random.default_rng(seed)
+    n_imgs = 8
+    preds = [_random_sample(rng) for _ in range(n_imgs)]
+    target = [_random_sample(rng, with_scores=False) for _ in range(n_imgs)]
+
+    ours = MeanAveragePrecision(class_metrics=class_metrics)
+    ours.update([_as_jnp(p) for p in preds], [_as_jnp(t) for t in target])
+    got = ours.compute()
+
+    ref = RefMAP(class_metrics=class_metrics)
+    ref.update(
+        [{k: torch.as_tensor(v) for k, v in p.items()} for p in preds],
+        [{k: torch.as_tensor(v) for k, v in t.items()} for t in target],
+    )
+    want = ref.compute()
+
+    for key, val in want.items():
+        np.testing.assert_allclose(
+            np.asarray(got[key], np.float64).reshape(-1),
+            np.asarray(val.numpy(), np.float64).reshape(-1),
+            atol=1e-6,
+            err_msg=f"mismatch for {key} (seed={seed})",
+        )
+
+
+@pytest.mark.parametrize("max_dets", [[1, 10], [5, 50, 500]])
+def test_map_custom_max_detections_vs_reference(max_dets):
+    import torch
+
+    RefMAP = _load_reference_map()
+    rng = np.random.default_rng(7)
+    preds = [_random_sample(rng, max_boxes=20) for _ in range(4)]
+    target = [_random_sample(rng, max_boxes=20, with_scores=False) for _ in range(4)]
+
+    ours = MeanAveragePrecision(max_detection_thresholds=max_dets)
+    ours.update([_as_jnp(p) for p in preds], [_as_jnp(t) for t in target])
+    got = ours.compute()
+
+    ref = RefMAP(max_detection_thresholds=max_dets)
+    ref.update(
+        [{k: torch.as_tensor(v) for k, v in p.items()} for p in preds],
+        [{k: torch.as_tensor(v) for k, v in t.items()} for t in target],
+    )
+    want = ref.compute()
+    for key, val in want.items():
+        np.testing.assert_allclose(
+            np.asarray(got[key], np.float64).reshape(-1),
+            np.asarray(val.numpy(), np.float64).reshape(-1),
+            atol=1e-6,
+            err_msg=f"mismatch for {key}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# lifecycle and edge cases (reference tests/detection/test_map.py:194-343)
+# ---------------------------------------------------------------------------
+def test_accumulation_matches_single_update():
+    """Two updates accumulate identically to one combined update."""
+    flat_preds = [_as_jnp(p) for batch in _PREDS for p in batch]
+    flat_target = [_as_jnp(t) for batch in _TARGET for t in batch]
+
+    m1 = MeanAveragePrecision()
+    m1.update(flat_preds, flat_target)
+    m2 = MeanAveragePrecision()
+    for preds_batch, target_batch in zip(_PREDS, _TARGET):
+        m2.update([_as_jnp(p) for p in preds_batch], [_as_jnp(t) for t in target_batch])
+    r1, r2 = m1.compute(), m2.compute()
+    for key in r1:
+        np.testing.assert_allclose(np.asarray(r1[key]), np.asarray(r2[key]))
+
+
+def test_error_on_wrong_init():
+    MeanAveragePrecision()  # no error
+    with pytest.raises(ValueError, match="Expected argument `class_metrics` to be a boolean"):
+        MeanAveragePrecision(class_metrics=0)
+    with pytest.raises(ValueError, match="Expected argument `box_format`"):
+        MeanAveragePrecision(box_format="xxyy")
+
+
+def test_empty_preds():
+    metric = MeanAveragePrecision()
+    metric.update(
+        [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), jnp.int32))],
+        [dict(boxes=jnp.asarray([[214.15, 41.29, 562.41, 285.07]]), labels=jnp.asarray([4]))],
+    )
+    metric.compute()
+
+
+def test_empty_ground_truths():
+    metric = MeanAveragePrecision()
+    metric.update(
+        [
+            dict(
+                boxes=jnp.asarray([[214.15, 41.29, 562.41, 285.07]]),
+                scores=jnp.asarray([0.5]),
+                labels=jnp.asarray([4]),
+            )
+        ],
+        [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,), jnp.int32))],
+    )
+    metric.compute()
+
+
+def test_empty_metric():
+    metric = MeanAveragePrecision()
+    result = metric.compute()
+    assert float(result["map"]) == -1.0
+
+
+def test_reset_clears_state():
+    metric = MeanAveragePrecision()
+    metric.update([_as_jnp(p) for p in _PREDS[0]], [_as_jnp(t) for t in _TARGET[0]])
+    metric.reset()
+    assert metric.detection_boxes == []
+    assert float(metric.compute()["map"]) == -1.0
+
+
+def test_error_on_wrong_input():
+    metric = MeanAveragePrecision()
+    metric.update([], [])  # no error
+
+    with pytest.raises(ValueError, match="Expected argument `preds` to be of type Sequence"):
+        metric.update(jnp.zeros(()), [])
+    with pytest.raises(ValueError, match="Expected argument `target` to be of type Sequence"):
+        metric.update([], jnp.zeros(()))
+    with pytest.raises(ValueError, match="Expected argument `preds` and `target` to have the same length"):
+        metric.update([dict()], [dict(), dict()])
+    with pytest.raises(ValueError, match="Expected all dicts in `preds` to contain the `boxes` key"):
+        metric.update(
+            [dict(scores=jnp.zeros((0,)), labels=jnp.zeros((0,)))],
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))],
+        )
+    with pytest.raises(ValueError, match="Expected all dicts in `preds` to contain the `scores` key"):
+        metric.update(
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))],
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))],
+        )
+    with pytest.raises(ValueError, match="Expected all dicts in `target` to contain the `labels` key"):
+        metric.update(
+            [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,)))],
+            [dict(boxes=jnp.zeros((0, 4)))],
+        )
+    with pytest.raises(ValueError, match="Expected all boxes in `preds` to be of type Tensor"):
+        metric.update(
+            [dict(boxes=[], scores=jnp.zeros((0,)), labels=jnp.zeros((0,)))],
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))],
+        )
+
+
+# ---------------------------------------------------------------------------
+# box ops vs shim formulas
+# ---------------------------------------------------------------------------
+def test_box_ops():
+    rng = np.random.default_rng(0)
+    b1 = _random_sample(rng)["boxes"]
+    b2 = _random_sample(rng)["boxes"]
+    np.testing.assert_allclose(
+        np.asarray(box_area(b1)), (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1]), rtol=1e-6
+    )
+    iou = np.asarray(box_iou(b1, b2))
+    assert iou.shape == (len(b1), len(b2))
+    assert (iou >= 0).all() and (iou <= 1).all()
+    # identity boxes have IoU 1 on the diagonal
+    np.testing.assert_allclose(np.diag(np.asarray(box_iou(b1, b1))), 1.0, rtol=1e-6)
+
+    xywh = np.stack(
+        [b1[:, 0], b1[:, 1], b1[:, 2] - b1[:, 0], b1[:, 3] - b1[:, 1]], axis=1
+    )
+    np.testing.assert_allclose(np.asarray(box_convert(xywh, "xywh", "xyxy")), b1, rtol=1e-5)
+    cxcywh = np.asarray(box_convert(b1, "xyxy", "cxcywh"))
+    np.testing.assert_allclose(np.asarray(box_convert(cxcywh, "cxcywh", "xyxy")), b1, rtol=1e-5, atol=1e-3)
