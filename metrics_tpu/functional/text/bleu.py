@@ -1,0 +1,123 @@
+"""BLEU score (parity: /root/reference/torchmetrics/functional/text/bleu.py).
+
+N-gram counting is host-side Counter math (inherently string-keyed); the
+accumulated numerator/denominator/length states are device arrays so the
+metric syncs over the mesh like any other (SURVEY §7.8).
+"""
+from collections import Counter
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """Count all 1..n_gram tuples in a token list (bleu.py:26-44)."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j : i + j])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: np.ndarray,
+    denominator: np.ndarray,
+    preds_len: float,
+    target_len: float,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[float, float]:
+    """Accumulate clipped n-gram hits into numerator/denominator (bleu.py:58-103).
+
+    ``numerator``/``denominator`` are mutated in place (host numpy staging
+    buffers); returns updated ``(preds_len, target_len)``.
+    """
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        target_len += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+
+        ngram_counter_clip = preds_counter & target_counter
+        for counter_clip in ngram_counter_clip:
+            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in preds_counter:
+            denominator[len(counter) - 1] += preds_counter[counter]
+
+    return preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Geometric mean of n-gram precisions with brevity penalty (bleu.py:106-141)."""
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    preds_len = jnp.asarray(preds_len, jnp.float32)
+    target_len = jnp.asarray(target_len, jnp.float32)
+
+    if float(jnp.min(numerator)) == 0.0:
+        return jnp.asarray(0.0, jnp.float32)
+
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision_scores = numerator / denominator
+
+    log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(
+        preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len)
+    )
+    return brevity_penalty * geometric_mean
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Calculate BLEU score of machine-translated text with one or more references.
+
+    Example:
+        >>> preds = ['my full pytorch program']
+        >>> target = [['my full pytorch program', 'my full pytorch test']]
+        >>> bleu_score(preds, target)
+        Array(0.75983566, dtype=float32)
+    """
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, 0.0, 0.0, n_gram
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth)

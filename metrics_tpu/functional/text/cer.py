@@ -1,0 +1,40 @@
+"""Char Error Rate (parity: /root/reference/torchmetrics/functional/text/cer.py)."""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Sum character-level edit operations and reference char counts (cer.py:22-47)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate of transcription(s); 0 is perfect.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> char_error_rate(preds=preds, target=target)
+        Array(0.34146342, dtype=float32)
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

@@ -1,0 +1,88 @@
+"""Shared text-metric helpers: input validation + vectorized edit distance/LCS.
+
+Behavior parity with /root/reference/torchmetrics/functional/text/helper.py
+(`_edit_distance` :347-368, `_validate_inputs` :307-344).  The reference runs
+pure-Python O(N·M) cell loops; here both DPs are re-expressed as row-wise
+vectorized numpy recurrences (the left-neighbor dependency is resolved with a
+prefix min/max cascade), giving the same exact integers orders of magnitude
+faster.  Tokenization and string handling remain host-side by design — text
+metrics feed scalar device states (SURVEY §7.8).
+"""
+from typing import List, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def _validate_inputs(
+    ref_corpus: Union[Sequence[str], Sequence[Sequence[str]]],
+    hyp_corpus: Union[str, Sequence[str]],
+) -> Tuple[Sequence[Sequence[str]], Sequence[str]]:
+    """Normalize reference/hypothesis corpora to ``Sequence[Sequence[str]]`` / ``Sequence[str]``."""
+    if isinstance(hyp_corpus, str):
+        hyp_corpus = [hyp_corpus]
+
+    if all(isinstance(ref, str) for ref in ref_corpus):
+        if len(hyp_corpus) == 1:
+            ref_corpus = [ref_corpus]  # type: ignore[list-item]
+        else:
+            ref_corpus = [[ref] for ref in ref_corpus]  # type: ignore[misc]
+
+    if hyp_corpus and all(ref for ref in ref_corpus) and len(ref_corpus) != len(hyp_corpus):
+        raise ValueError(f"Corpus has different size {len(ref_corpus)} != {len(hyp_corpus)}")
+    return ref_corpus, hyp_corpus
+
+
+def _token_ids(a: Sequence[str], b: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """Map two token sequences into a shared integer id space."""
+    vocab: dict = {}
+    aid = np.fromiter((vocab.setdefault(t, len(vocab)) for t in a), np.int64, len(a))
+    bid = np.fromiter((vocab.setdefault(t, len(vocab)) for t in b), np.int64, len(b))
+    return aid, bid
+
+
+def _edit_distance(prediction_tokens: List[str], reference_tokens: List[str]) -> int:
+    """Levenshtein distance between two token sequences.
+
+    Same integers as the reference cell-loop DP (helper.py:347-368); each DP
+    row is one vectorized numpy step.  The in-row insertion dependency
+    ``dp[j] = min(dp[j], dp[j-1]+1)`` telescopes to
+    ``min_k<=j (cand[k] + (j-k))``, computed as a running min of
+    ``cand[k]-k`` plus ``j``.
+    """
+    n, m = len(prediction_tokens), len(reference_tokens)
+    if n == 0:
+        return m
+    if m == 0:
+        return n
+    pid, rid = _token_ids(prediction_tokens, reference_tokens)
+
+    jrange = np.arange(m + 1, dtype=np.int64)
+    prev = jrange.copy()
+    cand = np.empty(m + 1, np.int64)
+    for i in range(1, n + 1):
+        subst = (rid != pid[i - 1]).astype(np.int64)
+        cand[0] = i
+        np.minimum(prev[1:] + 1, prev[:-1] + subst, out=cand[1:])
+        prev = np.minimum.accumulate(cand - jrange) + jrange
+    return int(prev[m])
+
+
+def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
+    """Length of the longest common subsequence (reference rouge.py:76-91).
+
+    Row-vectorized: within a row the left-neighbor max telescopes to a plain
+    running maximum (LCS rows are non-decreasing).
+    """
+    n, m = len(pred_tokens), len(target_tokens)
+    if n == 0 or m == 0:
+        return 0
+    pid, tid = _token_ids(pred_tokens, target_tokens)
+
+    prev = np.zeros(m + 1, np.int64)
+    cand = np.empty(m + 1, np.int64)
+    for i in range(1, n + 1):
+        eq = (tid == pid[i - 1]).astype(np.int64)
+        cand[0] = 0
+        np.maximum(prev[1:], prev[:-1] + eq, out=cand[1:])
+        prev = np.maximum.accumulate(cand)
+    return int(prev[m])

@@ -1,0 +1,42 @@
+"""Match Error Rate (parity: /root/reference/torchmetrics/functional/text/mer.py)."""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Sum edit ops and max(len(ref), len(pred)) word counts (mer.py:23-49)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate of transcription(s); 0 is perfect.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> match_error_rate(preds=preds, target=target)
+        Array(0.44444445, dtype=float32)
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)
