@@ -81,22 +81,34 @@ from metrics_tpu.regression import (  # noqa: E402
     SymmetricMeanAbsolutePercentageError,
     TweedieDevianceScore,
 )
+from metrics_tpu.text import (  # noqa: E402
+    BLEUScore,
+    CharErrorRate,
+    MatchErrorRate,
+    ROUGEScore,
+    SacreBLEUScore,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
 
 __all__ = [
     "AUC",
     "AUROC",
     "Accuracy",
     "AveragePrecision",
+    "BLEUScore",
     "BinnedAveragePrecision",
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
     "BootStrapper",
     "CalibrationError",
     "CatMetric",
+    "CharErrorRate",
     "ClasswiseWrapper",
     "CohenKappa",
-    "ConfusionMatrix",
     "CompositionalMetric",
+    "ConfusionMatrix",
     "CosineSimilarity",
     "ExplainedVariance",
     "F1Score",
@@ -105,13 +117,14 @@ __all__ = [
     "HingeLoss",
     "JaccardIndex",
     "KLDivergence",
+    "MatchErrorRate",
     "MatthewsCorrCoef",
+    "MaxMetric",
     "MeanAbsoluteError",
     "MeanAbsolutePercentageError",
+    "MeanMetric",
     "MeanSquaredError",
     "MeanSquaredLogError",
-    "MaxMetric",
-    "MeanMetric",
     "Metric",
     "MetricCollection",
     "MetricTracker",
@@ -120,12 +133,12 @@ __all__ = [
     "MultiScaleStructuralSimilarityIndexMeasure",
     "MultioutputWrapper",
     "PeakSignalNoiseRatio",
-    "SumMetric",
     "PearsonCorrCoef",
     "Precision",
     "PrecisionRecallCurve",
     "R2Score",
     "ROC",
+    "ROUGEScore",
     "Recall",
     "RetrievalFallOut",
     "RetrievalHitRate",
@@ -135,11 +148,16 @@ __all__ = [
     "RetrievalPrecision",
     "RetrievalRPrecision",
     "RetrievalRecall",
+    "SacreBLEUScore",
     "SpearmanCorrCoef",
-    "StructuralSimilarityIndexMeasure",
-    "UniversalImageQualityIndex",
     "Specificity",
     "StatScores",
+    "StructuralSimilarityIndexMeasure",
+    "SumMetric",
     "SymmetricMeanAbsolutePercentageError",
     "TweedieDevianceScore",
+    "UniversalImageQualityIndex",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
 ]

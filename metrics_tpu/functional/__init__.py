@@ -53,3 +53,13 @@ from metrics_tpu.functional.image import (  # noqa: F401
     structural_similarity_index_measure,
     universal_image_quality_index,
 )
+from metrics_tpu.functional.text import (  # noqa: F401
+    bleu_score,
+    char_error_rate,
+    match_error_rate,
+    rouge_score,
+    sacre_bleu_score,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)

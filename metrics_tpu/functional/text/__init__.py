@@ -1,0 +1,8 @@
+from metrics_tpu.functional.text.bleu import bleu_score  # noqa: F401
+from metrics_tpu.functional.text.cer import char_error_rate  # noqa: F401
+from metrics_tpu.functional.text.mer import match_error_rate  # noqa: F401
+from metrics_tpu.functional.text.rouge import rouge_score  # noqa: F401
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score  # noqa: F401
+from metrics_tpu.functional.text.wer import word_error_rate  # noqa: F401
+from metrics_tpu.functional.text.wil import word_information_lost  # noqa: F401
+from metrics_tpu.functional.text.wip import word_information_preserved  # noqa: F401

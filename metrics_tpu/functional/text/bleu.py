@@ -104,10 +104,10 @@ def bleu_score(
     """Calculate BLEU score of machine-translated text with one or more references.
 
     Example:
-        >>> preds = ['my full pytorch program']
-        >>> target = [['my full pytorch program', 'my full pytorch test']]
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> bleu_score(preds, target)
-        Array(0.75983566, dtype=float32)
+        Array(0.75984, dtype=float32)
     """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]

@@ -33,15 +33,56 @@ ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
 ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
 
+def _regex_sent_tokenize(x: str) -> List[str]:
+    """Offline fallback sentence splitter: break after ./!/? followed by space."""
+    sentences = re.split(r"(?<=[.!?])\s+", x.strip())
+    return [s for s in sentences if s]
+
+
+_PUNKT_USABLE: Optional[bool] = None  # resolved once on first rougeLsum use
+
+
+def _punkt_usable() -> bool:
+    """Probe (once) whether nltk sentence tokenization actually works: the
+    required resource is punkt_tab on nltk>=3.8.2, punkt before that, and
+    either may need a network download that an air-gapped host can't do."""
+    global _PUNKT_USABLE
+    if _PUNKT_USABLE is None:
+        import nltk
+
+        try:
+            nltk.sent_tokenize("probe. probe.")
+            _PUNKT_USABLE = True
+        except LookupError:
+            for resource in ("punkt_tab", "punkt"):
+                try:
+                    nltk.download(resource, quiet=True, force=False)
+                except Exception:
+                    pass
+            try:
+                nltk.sent_tokenize("probe. probe.")
+                _PUNKT_USABLE = True
+            except LookupError:
+                _PUNKT_USABLE = False
+    return _PUNKT_USABLE
+
+
 def _add_newline_to_end_of_each_sentence(x: str) -> str:
-    """Sentence-split with nltk and re-join with newlines (rougeLsum prep)."""
+    """Sentence-split with nltk and re-join with newlines (rougeLsum prep).
+
+    When the nltk punkt model is unavailable (offline environment, no
+    downloaded corpora) falls back to a regex splitter — identical on
+    ordinary prose; a deliberate divergence from the reference (which
+    requires a network download at rouge.py:41-46).
+    """
     if not _NLTK_AVAILABLE:
         raise ModuleNotFoundError("ROUGE-Lsum calculation requires that `nltk` is installed. Use `pip install nltk`.")
-    import nltk
-
-    nltk.download("punkt", quiet=True, force=False)
     x = re.sub("<n>", "", x)  # remove pegasus newline char
-    return "\n".join(nltk.sent_tokenize(x))
+    if _punkt_usable():
+        import nltk
+
+        return "\n".join(nltk.sent_tokenize(x))
+    return "\n".join(_regex_sent_tokenize(x))
 
 
 def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:

@@ -52,7 +52,7 @@ def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> word_information_lost(preds=preds, target=target)
-        Array(0.65277773, dtype=float32)
+        Array(0.6527778, dtype=float32)
     """
     errors, target_total, preds_total = _wil_update(preds, target)
     return _wil_compute(errors, target_total, preds_total)

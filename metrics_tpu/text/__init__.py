@@ -1,0 +1,8 @@
+from metrics_tpu.text.bleu import BLEUScore  # noqa: F401
+from metrics_tpu.text.cer import CharErrorRate  # noqa: F401
+from metrics_tpu.text.mer import MatchErrorRate  # noqa: F401
+from metrics_tpu.text.rouge import ROUGEScore  # noqa: F401
+from metrics_tpu.text.sacre_bleu import SacreBLEUScore  # noqa: F401
+from metrics_tpu.text.wer import WordErrorRate  # noqa: F401
+from metrics_tpu.text.wil import WordInfoLost  # noqa: F401
+from metrics_tpu.text.wip import WordInfoPreserved  # noqa: F401

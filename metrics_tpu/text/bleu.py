@@ -1,0 +1,70 @@
+"""Modular BLEUScore.
+
+Behavior parity with /root/reference/torchmetrics/text/bleu.py:29-120. String
+tokenization/counting is host-side (inherently so — SURVEY §7.8); the
+accumulated n-gram numerator/denominator/length states are device arrays
+with ``dist_reduce_fx="sum"`` so the metric syncs over the mesh like any
+other.
+"""
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    """Calculate BLEU score of machine-translated text with one or more references.
+
+    Args:
+        n_gram: Gram value ranged from 1 to 4 (default 4).
+        smooth: Whether to apply add-one smoothing (Lin & Och 2004).
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> metric = BLEUScore()
+        >>> metric(preds, target)
+        Array(0.75984, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        self.tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn
+
+        self.add_state("preds_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def _update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+
+        numerator = np.zeros(self.n_gram)
+        denominator = np.zeros(self.n_gram)
+        preds_len, target_len = _bleu_score_update(
+            preds_, target_, numerator, denominator, 0.0, 0.0, self.n_gram, self.tokenizer
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = self.numerator + jnp.asarray(numerator, self.numerator.dtype)
+        self.denominator = self.denominator + jnp.asarray(denominator, self.denominator.dtype)
+
+    def _compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.smooth
+        )

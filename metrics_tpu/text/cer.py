@@ -1,0 +1,41 @@
+"""Modular CharErrorRate.
+
+Behavior parity with /root/reference/torchmetrics/text/cer.py:24-99.
+"""
+from typing import Any, List, Union
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.cer import _cer_compute, _cer_update
+
+Array = jax.Array
+
+
+class CharErrorRate(Metric):
+    """Character error rate of transcriptions vs references; 0 is perfect.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> metric = CharErrorRate()
+        >>> metric(preds, target)
+        Array(0.3414634, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=0.0, dist_reduce_fx="sum")
+        self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+    def _update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def _compute(self) -> Array:
+        return _cer_compute(self.errors, self.total)

@@ -1,0 +1,131 @@
+"""Modular ROUGEScore.
+
+Behavior parity with /root/reference/torchmetrics/text/rouge.py:31-193:
+per-sentence scores appended to list states (one per ``rouge_key`` ×
+fmeasure/precision/recall), all-gathered across ranks, mean on compute.
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.utils.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """Calculate ROUGE score for automatic summarization.
+
+    Args:
+        use_stemmer: Use the Porter stemmer to strip word suffixes.
+        normalizer: Custom normalization function ``str -> str``.
+        tokenizer: Custom tokenization function ``str -> Sequence[str]``.
+        accumulate: Multi-reference accumulation: ``"best"`` takes the
+            reference with the highest first-key fmeasure, ``"avg"`` averages
+            over all references.
+        rouge_keys: Which rouge scores to compute (``rouge1..rouge9``,
+            ``rougeL``, ``rougeLsum``).
+
+    Example:
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> rouge = ROUGEScore(rouge_keys=("rouge1", "rouge2", "rougeL"))
+        >>> from pprint import pprint
+        >>> pprint(rouge(preds, target))
+        {'rouge1_fmeasure': Array(0.75, dtype=float32),
+         'rouge1_precision': Array(0.75, dtype=float32),
+         'rouge1_recall': Array(0.75, dtype=float32),
+         'rouge2_fmeasure': Array(0., dtype=float32),
+         'rouge2_precision': Array(0., dtype=float32),
+         'rouge2_recall': Array(0., dtype=float32),
+         'rougeL_fmeasure': Array(0.5, dtype=float32),
+         'rougeL_precision': Array(0.5, dtype=float32),
+         'rougeL_recall': Array(0.5, dtype=float32)}
+    """
+
+    higher_is_better = True
+    is_differentiable = False
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer or "rougeLsum" in rouge_keys:
+            if not _NLTK_AVAILABLE:
+                raise ModuleNotFoundError(
+                    "Stemmer and/or `rougeLsum` requires that `nltk` is installed. Use `pip install nltk`."
+                )
+            import nltk
+
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(
+                    f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}"
+                )
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.stemmer = nltk.stem.porter.PorterStemmer() if use_stemmer else None
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+
+        for rouge_key in self.rouge_keys:
+            for score in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{score}", [], dist_reduce_fx=None)
+
+    def _update(
+        self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str], Sequence[Sequence[str]]]
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+
+        output: Dict[Union[int, str], List[Dict[str, float]]] = _rouge_score_update(
+            preds,
+            target,
+            self.rouge_keys_values,
+            stemmer=self.stemmer,
+            normalizer=self.normalizer,
+            tokenizer=self.tokenizer,
+            accumulate=self.accumulate,
+        )
+        for rouge_key, metrics in output.items():
+            for metric in metrics:
+                for tp, value in metric.items():
+                    getattr(self, f"rouge{rouge_key}_{tp}").append(jnp.asarray(value, jnp.float32))
+
+    def _compute(self) -> Dict[str, Array]:
+        update_output = {}
+        for rouge_key in self.rouge_keys_values:
+            for tp in ("fmeasure", "precision", "recall"):
+                update_output[f"rouge{rouge_key}_{tp}"] = getattr(self, f"rouge{rouge_key}_{tp}")
+        return _rouge_score_compute(update_output)
+
+    # NOTE: the reference overrides __hash__ here (rouge.py:183-193) to work
+    # around a torch nn.Module hashing bug with list states; the base
+    # Metric.__hash__ in this framework already hashes list states by id.

@@ -1,0 +1,43 @@
+"""Modular WordInfoPreserved.
+
+Behavior parity with /root/reference/torchmetrics/text/wip.py:23-97.
+"""
+from typing import Any, List, Union
+
+import jax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.wip import _wip_compute, _wip_update
+
+Array = jax.Array
+
+
+class WordInfoPreserved(Metric):
+    """Word information preserved of transcriptions vs references; 1 is perfect.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> metric = WordInfoPreserved()
+        >>> metric(preds, target)
+        Array(0.34722224, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=0.0, dist_reduce_fx="sum")
+        self.add_state("target_total", default=0.0, dist_reduce_fx="sum")
+        self.add_state("preds_total", default=0.0, dist_reduce_fx="sum")
+
+    def _update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, target_total, preds_total = _wip_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def _compute(self) -> Array:
+        return _wip_compute(self.errors, self.target_total, self.preds_total)

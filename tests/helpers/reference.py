@@ -1,0 +1,37 @@
+"""Import helper for using the reference implementation as a test oracle.
+
+The reference tree at /root/reference is pure Python over torch (CPU build
+available in this environment), so domains whose usual PyPI oracle is absent
+(e.g. jiwer for the WER family) can be checked against the reference itself
+— the same pattern tests/detection/test_map.py uses for mAP.
+"""
+import sys
+import types
+
+import pytest
+
+
+def load_reference_module(dotted: str):
+    """Import ``torchmetrics...`` submodule from /root/reference, or skip."""
+    pytest.importorskip("torch")
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    if "pkg_resources" not in sys.modules:
+        # this env's setuptools no longer ships pkg_resources; the reference
+        # only needs these two names for optional-dependency probing
+        stub = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        stub.DistributionNotFound = DistributionNotFound
+        stub.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = stub
+    try:
+        __import__(dotted)
+    except Exception as err:  # pragma: no cover
+        pytest.skip(f"reference torchmetrics unavailable: {err}")
+    return sys.modules[dotted]

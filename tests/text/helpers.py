@@ -1,0 +1,99 @@
+"""String-native metric test harness for the text domain.
+
+Parity in spirit with the reference TextTester
+(/root/reference/tests/text/helpers.py:226-430): per-batch and accumulated
+parity vs an oracle, pickle round-trip, hashability, and — replacing the
+2-process Gloo pool — a virtual-rank merge-parity check via the pure state
+API (the same substitution tests/helpers/testers.py makes for array
+domains; real-collective coverage lives in tests/bases).
+"""
+import pickle
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+NUM_PROCESSES = 2
+
+
+def _assert_allclose(result: Any, oracle: Any, atol: float) -> None:
+    if isinstance(result, dict):
+        for key in result:
+            np.testing.assert_allclose(
+                np.asarray(result[key]), np.asarray(oracle[key]), atol=atol, rtol=1e-5, err_msg=f"key={key}"
+            )
+    else:
+        np.testing.assert_allclose(np.asarray(result), np.asarray(oracle), atol=atol, rtol=1e-5)
+
+
+def _flatten(batches: Sequence[Sequence[Any]]) -> list:
+    return [item for batch in batches for item in batch]
+
+
+class TextTester:
+    """Base class for text metric tests; fixtures are lists of string batches."""
+
+    atol: float = 1e-4
+
+    def run_class_metric_test(
+        self,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Any],
+        metric_class: type,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+        check_merge: bool = True,
+        atol: Optional[float] = None,
+        key: Optional[str] = None,
+    ) -> None:
+        """``key`` selects one entry of a dict-valued metric for comparison
+        against a scalar oracle (the ROUGE pattern)."""
+        atol = self.atol if atol is None else atol
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+
+        def _select(value: Any) -> Any:
+            return value[key] if key is not None else value
+
+        for i, (pred_batch, target_batch) in enumerate(zip(preds, targets)):
+            batch_result = metric(pred_batch, target_batch)
+            if i == 0:
+                clone = pickle.loads(pickle.dumps(metric))
+                assert type(clone) is type(metric)
+            if check_batch:
+                _assert_allclose(_select(batch_result), sk_metric(pred_batch, target_batch), atol=atol)
+
+        result = _select(metric.compute())
+        full_oracle = sk_metric(_flatten(preds), _flatten(targets))
+        _assert_allclose(result, full_oracle, atol=atol)
+        assert isinstance(hash(metric), int)
+
+        # virtual-rank merge parity: ranks stride batches, states merge via
+        # each state's declared reducer, merged compute == full-corpus value
+        if check_merge and len(preds) >= NUM_PROCESSES:
+            states = []
+            for rank in range(NUM_PROCESSES):
+                m = metric_class(**metric_args)
+                state = m.init_state()
+                for i in range(rank, len(preds), NUM_PROCESSES):
+                    state = m.update_state(state, preds[i], targets[i])
+                states.append(state)
+            merged = metric.merge_states(states[0], states[1])
+            _assert_allclose(_select(metric.compute_state(merged)), full_oracle, atol=atol)
+
+    def run_functional_metric_test(
+        self,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Any],
+        metric_functional: Callable,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        atol = self.atol if atol is None else atol
+        metric_args = metric_args or {}
+        for pred_batch, target_batch in zip(preds, targets):
+            result = metric_functional(pred_batch, target_batch, **metric_args)
+            _assert_allclose(result, sk_metric(pred_batch, target_batch), atol=atol)
+        result = metric_functional(_flatten(preds), _flatten(targets), **metric_args)
+        _assert_allclose(result, sk_metric(_flatten(preds), _flatten(targets)), atol=atol)

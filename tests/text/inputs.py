@@ -1,0 +1,45 @@
+"""Text-domain test fixtures: batched corpora with single and multiple references."""
+from collections import namedtuple
+
+TextInput = namedtuple("TextInput", ["preds", "targets"])
+
+# machine-translation style corpus, two references per sentence
+_HYP_1 = "the quick brown fox jumped over the lazy dog near the river bank"
+_REF_1A = "the quick brown fox jumps over the lazy dog by the river bank"
+_REF_1B = "a fast brown fox leaped over a lazy dog close to the river"
+
+_HYP_2 = "she decided to stay home because the weather forecast predicted rain"
+_REF_2A = "she chose to remain at home since rain was predicted by the forecast"
+_REF_2B = "because the forecast predicted rain she decided to stay at home"
+
+# intentional extra whitespace exercises tokenizer normalization
+_HYP_3 = "the dog the   dog sat on the log "
+_REF_3A = "the  dog is     on the log "
+_REF_3B = "there is a   dog on the log"
+
+_inputs_multiple_references = TextInput(
+    preds=[[_HYP_1, _HYP_2], [_HYP_2, _HYP_3]],
+    targets=[[[_REF_1A, _REF_1B], [_REF_2A, _REF_2B]], [[_REF_2A, _REF_2B], [_REF_3A, _REF_3B]]],
+)
+
+_inputs_single_sentence_multiple_references = TextInput(
+    preds=[[_HYP_2]],
+    targets=[[[_REF_2A, _REF_2B]]],
+)
+
+# speech-recognition style corpus for the error-rate family (single reference)
+_inputs_error_rate_batch_size_1 = TextInput(
+    preds=[["hello there world"], ["what a fine day"]],
+    targets=[["hello world"], ["what a wonderfully fine day"]],
+)
+
+_inputs_error_rate_batch_size_2 = TextInput(
+    preds=[
+        ["i prefer lisp", "what you mean or swallow"],
+        ["greetings duck", "i prefer lisp"],
+    ],
+    targets=[
+        ["i prefer common lisp", "what do you mean, african or european swallow"],
+        ["greetings world", "i prefer common lisp"],
+    ],
+)

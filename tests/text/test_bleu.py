@@ -1,0 +1,68 @@
+"""BLEUScore parity vs nltk corpus_bleu (the reference's own oracle,
+/root/reference/tests/text/test_bleu.py:18-28)."""
+from functools import partial
+
+import pytest
+
+nltk_bleu = pytest.importorskip("nltk.translate.bleu_score")
+
+from metrics_tpu.functional.text.bleu import bleu_score
+from metrics_tpu.text.bleu import BLEUScore
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_multiple_references, _inputs_single_sentence_multiple_references
+
+smooth_func = nltk_bleu.SmoothingFunction().method2
+
+
+def _nltk_bleu(preds, targets, weights, smoothing_function):
+    preds_ = [pred.split() for pred in preds]
+    targets_ = [[line.split() for line in target] for target in targets]
+    return nltk_bleu.corpus_bleu(
+        list_of_references=targets_, hypotheses=preds_, weights=weights, smoothing_function=smoothing_function
+    )
+
+
+@pytest.mark.parametrize(
+    ["weights", "n_gram", "smooth_fn", "smooth"],
+    [
+        ([1], 1, None, False),
+        ([0.5, 0.5], 2, smooth_func, True),
+        ([0.333333, 0.333333, 0.333333], 3, None, False),
+        ([0.25, 0.25, 0.25, 0.25], 4, smooth_func, True),
+    ],
+)
+class TestBLEUScore(TextTester):
+    def test_bleu_score_class(self, weights, n_gram, smooth_fn, smooth):
+        self.run_class_metric_test(
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_class=BLEUScore,
+            sk_metric=partial(_nltk_bleu, weights=weights, smoothing_function=smooth_fn),
+            metric_args={"n_gram": n_gram, "smooth": smooth},
+        )
+
+    def test_bleu_score_functional(self, weights, n_gram, smooth_fn, smooth):
+        self.run_functional_metric_test(
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_functional=bleu_score,
+            sk_metric=partial(_nltk_bleu, weights=weights, smoothing_function=smooth_fn),
+            metric_args={"n_gram": n_gram, "smooth": smooth},
+        )
+
+
+def test_bleu_empty():
+    """No n-gram overlap at all -> 0 (reference test_bleu.py:85-89)."""
+    assert float(bleu_score([""], [[""]])) == 0.0
+
+
+def test_no_4_gram():
+    """Shorter-than-n predictions -> 0."""
+    assert float(bleu_score(["My full program"], [["My full program tests"]])) == 0.0
+
+
+def test_bleu_single_sentence():
+    preds = _inputs_single_sentence_multiple_references.preds[0]
+    targets = _inputs_single_sentence_multiple_references.targets[0]
+    expected = _nltk_bleu(preds, targets, weights=[0.25] * 4, smoothing_function=None)
+    assert float(bleu_score(preds, targets)) == pytest.approx(expected, abs=1e-4)

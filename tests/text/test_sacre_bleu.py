@@ -1,0 +1,48 @@
+"""SacreBLEUScore parity vs the sacrebleu package (the reference's own
+oracle, /root/reference/tests/text/test_sacre_bleu.py:25-39)."""
+from functools import partial
+
+import pytest
+
+sacrebleu_metrics = pytest.importorskip("sacrebleu.metrics")
+
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+from metrics_tpu.text.sacre_bleu import SacreBLEUScore
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_multiple_references
+
+TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+
+def _sacrebleu_oracle(preds, targets, tokenize, lowercase):
+    oracle = sacrebleu_metrics.BLEU(tokenize=tokenize, lowercase=lowercase)
+    # sacrebleu wants targets transposed: one stream per reference position
+    targets_t = [[target[i] for target in targets] for i in range(len(targets[0]))]
+    return oracle.corpus_score(preds, targets_t).score / 100
+
+
+@pytest.mark.parametrize("lowercase", [False, True])
+@pytest.mark.parametrize("tokenize", TOKENIZERS)
+class TestSacreBLEUScore(TextTester):
+    def test_sacre_bleu_class(self, tokenize, lowercase):
+        self.run_class_metric_test(
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_class=SacreBLEUScore,
+            sk_metric=partial(_sacrebleu_oracle, tokenize=tokenize, lowercase=lowercase),
+            metric_args={"tokenize": tokenize, "lowercase": lowercase},
+        )
+
+    def test_sacre_bleu_functional(self, tokenize, lowercase):
+        self.run_functional_metric_test(
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_functional=sacre_bleu_score,
+            sk_metric=partial(_sacrebleu_oracle, tokenize=tokenize, lowercase=lowercase),
+            metric_args={"tokenize": tokenize, "lowercase": lowercase},
+        )
+
+
+def test_unknown_tokenizer_raises():
+    with pytest.raises(ValueError, match="Argument `tokenize`"):
+        SacreBLEUScore(tokenize="not-a-tokenizer")

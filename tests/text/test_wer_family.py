@@ -1,0 +1,70 @@
+"""Edit-distance family (WER/CER/MER/WIL/WIP) parity.
+
+Oracle: the reference implementation imported from /root/reference (jiwer,
+the reference's usual oracle, is not installed in this environment — same
+substitution tests/detection/test_map.py makes with pycocotools).
+"""
+from functools import partial
+
+import pytest
+
+from metrics_tpu.functional.text import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.text import CharErrorRate, MatchErrorRate, WordErrorRate, WordInfoLost, WordInfoPreserved
+from tests.helpers.reference import load_reference_module
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_error_rate_batch_size_1, _inputs_error_rate_batch_size_2
+
+
+def _reference_oracle(preds, targets, module, func):
+    ref = load_reference_module(f"torchmetrics.functional.text.{module}")
+    return getattr(ref, func)(preds, targets).item()
+
+
+CASES = [
+    ("wer", "word_error_rate", WordErrorRate, word_error_rate),
+    ("cer", "char_error_rate", CharErrorRate, char_error_rate),
+    ("mer", "match_error_rate", MatchErrorRate, match_error_rate),
+    ("wil", "word_information_lost", WordInfoLost, word_information_lost),
+    ("wip", "word_information_preserved", WordInfoPreserved, word_information_preserved),
+]
+
+
+@pytest.mark.parametrize(
+    ["preds", "targets"],
+    [
+        (_inputs_error_rate_batch_size_1.preds, _inputs_error_rate_batch_size_1.targets),
+        (_inputs_error_rate_batch_size_2.preds, _inputs_error_rate_batch_size_2.targets),
+    ],
+)
+@pytest.mark.parametrize(["module", "func", "metric_class", "metric_functional"], CASES)
+class TestErrorRates(TextTester):
+    atol = 1e-6
+
+    def test_class(self, preds, targets, module, func, metric_class, metric_functional):
+        self.run_class_metric_test(
+            preds=preds,
+            targets=targets,
+            metric_class=metric_class,
+            sk_metric=partial(_reference_oracle, module=module, func=func),
+        )
+
+    def test_functional(self, preds, targets, module, func, metric_class, metric_functional):
+        self.run_functional_metric_test(
+            preds=preds,
+            targets=targets,
+            metric_functional=metric_functional,
+            sk_metric=partial(_reference_oracle, module=module, func=func),
+        )
+
+
+def test_wer_accepts_single_string():
+    assert float(word_error_rate("hello world", "hello world")) == 0.0
+    metric = WordErrorRate()
+    metric.update("hello there", "hello world")
+    assert float(metric.compute()) == 0.5
