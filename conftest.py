@@ -1,0 +1,11 @@
+"""Root pytest configuration — applies to doctest runs over ``metrics_tpu/``
+(``pytest --doctest-modules metrics_tpu``), which don't see tests/conftest.py.
+
+Doctest expected values are generated on CPU; the axon TPU backend produces
+floats differing in the last ulp, so doctests must run on the same forced-CPU
+virtual-device config the test suite uses (single source:
+tests/helpers/force_cpu.py).
+"""
+from tests.helpers.force_cpu import setup_forced_cpu
+
+setup_forced_cpu()

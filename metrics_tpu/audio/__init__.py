@@ -1,0 +1,13 @@
+"""Audio metrics.
+
+Coverage decision: SNR, SI-SNR, SDR, SI-SDR, and PIT are implemented
+TPU-native (reference audio/{snr,sdr,pit}.py). PESQ and STOI are
+deliberately deferred: both wrap external native DSP packages (the C
+``pesq`` library and ``pystoi`` — reference audio/pesq.py:25,
+audio/stoi.py:25 / SURVEY §2.9) that are not installed in this
+environment, and their per-utterance host DSP offers no TPU win; they gate
+cleanly behind optional-import errors when attempted.
+"""
+from metrics_tpu.audio.pit import PermutationInvariantTraining  # noqa: F401
+from metrics_tpu.audio.sdr import ScaleInvariantSignalDistortionRatio, SignalDistortionRatio  # noqa: F401
+from metrics_tpu.audio.snr import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio  # noqa: F401

@@ -1,0 +1,61 @@
+"""Modular PermutationInvariantTraining.
+
+Behavior parity with /root/reference/torchmetrics/audio/pit.py:22-108.
+"""
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Mean of a pairwise metric evaluated under the best speaker permutation.
+
+    Args:
+        metric_func: batched pairwise metric,
+            ``metric_func(preds[:, j], target[:, i], **kwargs) -> [batch]``.
+        eval_func: ``"max"`` (higher better) or ``"min"``.
+        kwargs: additional args; those matching ``metric_func``'s signature
+            are forwarded to it.
+
+    Example:
+        >>> from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+        >>> preds = jnp.array([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.array([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, 'max')
+        >>> pit(preds, target)
+        Array(-5.1091003, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in ("dist_sync_on_step", "process_group", "dist_sync_fn", "compute_on_step")
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(
+            preds, target, self.metric_func, self.eval_func, **self.kwargs
+        )[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def _compute(self) -> Array:
+        return self.sum_pit_metric / self.total

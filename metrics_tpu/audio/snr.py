@@ -1,0 +1,73 @@
+"""Modular SNR / SI-SNR.
+
+Behavior parity with /root/reference/torchmetrics/audio/snr.py:22-173.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(Metric):
+    """Mean signal-to-noise ratio over all seen signals, in dB.
+
+    Args:
+        zero_mean: subtract the time-axis mean from both signals first.
+
+    Example:
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SignalNoiseRatio()
+        >>> snr(preds, target)
+        Array(16.180481, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds, target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + jnp.sum(snr_batch)
+        self.total = self.total + snr_batch.size
+
+    def _compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Mean scale-invariant SNR over all seen signals, in dB.
+
+    Example:
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> si_snr(preds, target)
+        Array(15.091757, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds, target)
+        self.sum_si_snr = self.sum_si_snr + jnp.sum(si_snr_batch)
+        self.total = self.total + si_snr_batch.size
+
+    def _compute(self) -> Array:
+        return self.sum_si_snr / self.total

@@ -62,9 +62,11 @@ class BinnedPrecisionRecallCurve(Metric):
         >>> pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
         >>> precision, recall, thresholds = pr_curve(pred, target)
         >>> precision
-        Array([0.5      , 0.5      , 1.       , 1.       , 0.999999 , 1.       ],      dtype=float32)
+        Array([0.5000001 , 0.50000024, 1.        , 1.        , 1.        ,
+               1.        ], dtype=float32)
         >>> recall
-        Array([1. , 0.5, 0.5, 0.5, 0. , 0. ], dtype=float32)
+        Array([0.9999995 , 0.49999976, 0.49999976, 0.49999976, 0.        ,
+               0.        ], dtype=float32)
     """
 
     is_differentiable = False

@@ -26,7 +26,7 @@ class HingeLoss(Metric):
         >>> preds = jnp.array([-2.2, 2.4, 0.1])
         >>> hinge = HingeLoss()
         >>> hinge(preds, target)
-        Array(0.3, dtype=float32)
+        Array(0.29999998, dtype=float32)
     """
 
     is_differentiable = True

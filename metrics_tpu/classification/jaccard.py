@@ -21,7 +21,7 @@ class JaccardIndex(ConfusionMatrix):
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> jaccard = JaccardIndex(num_classes=2)
         >>> jaccard(preds, target)
-        Array(0.58333334, dtype=float32)
+        Array(0.5833334, dtype=float32)
     """
 
     is_differentiable = False

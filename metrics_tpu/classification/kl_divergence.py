@@ -23,7 +23,7 @@ class KLDivergence(Metric):
         >>> q = jnp.array([[1/3, 1/3, 1/3]])
         >>> kl_divergence = KLDivergence()
         >>> kl_divergence(p, q)
-        Array(0.08529962, dtype=float32)
+        Array(0.0852996, dtype=float32)
     """
 
     is_differentiable = True

@@ -22,7 +22,7 @@ class Specificity(StatScores):
         >>> target = jnp.array([1, 1, 2, 0])
         >>> specificity = Specificity(average='macro', num_classes=3)
         >>> specificity(preds, target)
-        Array(0.6111111, dtype=float32)
+        Array(0.61111116, dtype=float32)
     """
 
     is_differentiable = False

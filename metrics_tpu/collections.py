@@ -46,7 +46,7 @@ class MetricCollection:
         ...                             Precision(num_classes=3, average='macro'),
         ...                             Recall(num_classes=3, average='macro')])
         >>> {k: float(v) for k, v in metrics(preds, target).items()}
-        {'Accuracy': 0.125, 'Precision': 0.06666667014360428, 'Recall': 0.1111111119389534}
+        {'Accuracy': 0.125, 'Precision': 0.06666667014360428, 'Recall': 0.111111119389534}
     """
 
     def __init__(

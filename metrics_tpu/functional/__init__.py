@@ -53,6 +53,14 @@ from metrics_tpu.functional.image import (  # noqa: F401
     structural_similarity_index_measure,
     universal_image_quality_index,
 )
+from metrics_tpu.functional.audio import (  # noqa: F401
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
 from metrics_tpu.functional.text import (  # noqa: F401
     bleu_score,
     char_error_rate,

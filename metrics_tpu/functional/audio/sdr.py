@@ -1,0 +1,190 @@
+"""SDR / SI-SDR (parity: /root/reference/torchmetrics/functional/audio/sdr.py:23-241).
+
+The reference delegates the BSS-eval distortion-filter solve to the
+``fast_bss_eval`` package (torch/numpy Toeplitz + conjugate gradient —
+SURVEY §2.9). Here the whole pipeline is TPU-native jnp:
+
+- correlation statistics via rFFT (one batched FFT per signal, O(T log T),
+  XLA-fused, instead of fast_bss_eval's per-pair time-domain fallback),
+- the ``[L, L]`` Toeplitz system assembled by a vectorized gather and
+  solved with ``jnp.linalg.solve`` (MXU-friendly dense solve), or
+- optionally an FFT-matvec conjugate-gradient loop (``use_cg_iter``) that
+  never materializes the Toeplitz matrix — O(L log L) per iteration via
+  circulant embedding. Unpreconditioned (the reference's CG uses a
+  circulant preconditioner); with the default 10 iterations both agree
+  with the direct solve to ~1e-3 dB on speech-scale signals, which the
+  tests pin.
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _l2_normalize(x: Array, eps: float) -> Array:
+    """Scale to unit L2 norm along time (fast_bss_eval helpers._normalize)."""
+    return x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), eps, None)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def _correlation_stats(target: Array, preds: Array, length: int):
+    """Auto-correlation of target and target↔preds cross-correlation, first
+    ``length`` lags, via rFFT (fast_bss_eval metrics.compute_stats semantics).
+    """
+    n_fft = _next_pow2(target.shape[-1] + length)
+    tf = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    pf = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    acf = jnp.fft.irfft(jnp.abs(tf) ** 2, n=n_fft, axis=-1)[..., :length]
+    xcorr = jnp.fft.irfft(jnp.conj(tf) * pf, n=n_fft, axis=-1)[..., :length]
+    return acf, xcorr
+
+
+def _toeplitz_solve(acf: Array, xcorr: Array) -> Array:
+    """Direct dense solve of ``toeplitz(acf) · h = xcorr`` (batched)."""
+    length = acf.shape[-1]
+    idx = jnp.abs(jnp.arange(length)[:, None] - jnp.arange(length)[None, :])
+    r_mat = acf[..., idx]  # [..., L, L] symmetric Toeplitz
+    return jnp.linalg.solve(r_mat, xcorr[..., None])[..., 0]
+
+
+def _toeplitz_matvec(acf: Array, v: Array) -> Array:
+    """``toeplitz(acf) @ v`` without materializing the matrix: embed the
+    symmetric Toeplitz operator in a circulant of size 2L and multiply in
+    the Fourier domain."""
+    length = acf.shape[-1]
+    # first column of the 2L circulant: [acf_0..acf_{L-1}, 0, acf_{L-1}..acf_1]
+    circ = jnp.concatenate(
+        [acf, jnp.zeros_like(acf[..., :1]), jnp.flip(acf[..., 1:], axis=-1)], axis=-1
+    )
+    n = 2 * length
+    prod = jnp.fft.irfft(
+        jnp.fft.rfft(circ, n=n, axis=-1) * jnp.fft.rfft(v, n=n, axis=-1), n=n, axis=-1
+    )
+    return prod[..., :length]
+
+
+def _toeplitz_cg(acf: Array, xcorr: Array, n_iter: int) -> Array:
+    """Fixed-iteration conjugate gradient on the Toeplitz normal equations,
+    FFT matvec, jit-friendly fori_loop (no data-dependent stopping)."""
+
+    def matvec(v: Array) -> Array:
+        return _toeplitz_matvec(acf, v)
+
+    x = jnp.zeros_like(xcorr)
+    r = xcorr - matvec(x)
+    p = r
+    rs = jnp.sum(r * r, axis=-1, keepdims=True)
+
+    def body(_, carry):
+        x, r, p, rs = carry
+        ap = matvec(p)
+        alpha = rs / jnp.clip(jnp.sum(p * ap, axis=-1, keepdims=True), 1e-20, None)
+        x = x + alpha * p
+        r = r - alpha * ap
+        rs_new = jnp.sum(r * r, axis=-1, keepdims=True)
+        p = r + (rs_new / jnp.clip(rs, 1e-20, None)) * p
+        return x, r, p, rs_new
+
+    x, _, _, _ = jax.lax.fori_loop(0, n_iter, body, (x, r, p, rs))
+    return x
+
+
+@partial(jax.jit, static_argnames=("use_cg_iter", "filter_length", "zero_mean"))
+def _sdr_kernel(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int],
+    filter_length: int,
+    zero_mean: bool,
+    load_diag: Optional[Array],
+) -> Array:
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+
+    preds = _l2_normalize(preds, eps)
+    target = _l2_normalize(target, eps)
+
+    acf, xcorr = _correlation_stats(target, preds, filter_length)
+    if load_diag is not None:
+        acf = acf.at[..., 0].add(load_diag)
+
+    if use_cg_iter is not None:
+        sol = _toeplitz_cg(acf, xcorr, use_cg_iter)
+    else:
+        sol = _toeplitz_solve(acf, xcorr)
+
+    # coherence = energy of preds captured by the length-L filtered target
+    coh = jnp.sum(xcorr * sol, axis=-1)
+    ratio = coh / (1 - coh)
+    return 10.0 * jnp.log10(ratio)
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """Signal-to-distortion ratio with a length-``filter_length`` allowed
+    distortion filter (BSS-eval v4 semantics; reference sdr.py:36-196).
+
+    Args:
+        preds: estimate, shape ``[..., time]``.
+        target: reference, shape ``[..., time]``.
+        use_cg_iter: if given, solve the filter with this many conjugate-
+            gradient iterations instead of the dense solve.
+        filter_length: allowed distortion-filter length (default 512).
+        zero_mean: subtract time-axis means first.
+        load_diag: diagonal loading to stabilize near-singular systems.
+
+    Returns:
+        SDR in dB, shape ``[...]``.
+    """
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+    if preds.dtype == jnp.float16 or preds.dtype == jnp.bfloat16:
+        preds = preds.astype(jnp.float32)
+    if target.dtype != preds.dtype:
+        target = target.astype(preds.dtype)
+    diag = None if load_diag is None else jnp.asarray(load_diag, preds.dtype)
+    return _sdr_kernel(preds, target, use_cg_iter, filter_length, zero_mean, diag)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR: SNR after optimal scalar rescaling of the target (sdr.py:198-241).
+
+    Example:
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_distortion_ratio(preds, target)
+        Array(18.402992, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(val)

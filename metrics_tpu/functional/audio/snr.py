@@ -1,0 +1,53 @@
+"""SNR / SI-SNR (parity: /root/reference/torchmetrics/functional/audio/snr.py).
+
+Pure jnp elementwise/reduction math — fully jittable, batched over leading
+dims, MXU-free (bandwidth-bound reductions XLA fuses into one pass).
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Signal-to-noise ratio: 10·log10(‖target‖² / ‖target − preds‖²) (snr.py:22-68).
+
+    Args:
+        preds: estimate, shape ``[..., time]``.
+        target: reference, shape ``[..., time]``.
+        zero_mean: subtract the time-axis mean from both signals first.
+
+    Returns:
+        SNR in dB, shape ``[...]``.
+
+    Example:
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> signal_noise_ratio(preds, target)
+        Array(16.180481, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """Scale-invariant SNR — SI-SDR with zero-mean inputs (snr.py:71-95).
+
+    Example:
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_noise_ratio(preds, target)
+        Array(15.091757, dtype=float32)
+    """
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean=True)

@@ -99,7 +99,7 @@ def hinge_loss(
         >>> target = jnp.array([0, 1, 1])
         >>> preds = jnp.array([-2.2, 2.4, 0.1])
         >>> hinge_loss(preds, target)
-        Array(0.3, dtype=float32)
+        Array(0.29999998, dtype=float32)
     """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

@@ -52,7 +52,7 @@ def jaccard_index(
         >>> target = jnp.array([1, 1, 0, 0])
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> jaccard_index(preds, target, num_classes=2)
-        Array(0.58333334, dtype=float32)
+        Array(0.5833334, dtype=float32)
     """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
     return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

@@ -61,7 +61,7 @@ def specificity(
         >>> preds  = jnp.array([2, 0, 2, 1])
         >>> target = jnp.array([1, 1, 2, 0])
         >>> specificity(preds, target, average='macro', num_classes=3)
-        Array(0.6111111, dtype=float32)
+        Array(0.61111116, dtype=float32)
     """
     _check_avg_arguments(average, mdmc_average, num_classes, ignore_index)
 

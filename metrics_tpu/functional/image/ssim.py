@@ -216,7 +216,7 @@ def multiscale_structural_similarity_index_measure(
 
     Example:
         >>> import jax
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 64, 64))
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 192, 192))
         >>> target = preds * 0.75
         >>> bool(multiscale_structural_similarity_index_measure(preds, target) > 0.9)
         True

@@ -32,9 +32,9 @@ def pairwise_cosine_similarity(
         >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
         >>> y = jnp.array([[1., 0.], [2., 1.]])
         >>> pairwise_cosine_similarity(x, y)
-        Array([[0.5547002 , 0.8682431 ],
-               [0.51449573, 0.8436614 ],
-               [0.5300066 , 0.8556387 ]], dtype=float32)
+        Array([[0.5547002 , 0.86824316],
+               [0.5144958 , 0.84366155],
+               [0.52999896, 0.85328186]], dtype=float32)
     """
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -34,8 +34,8 @@ def pairwise_euclidean_distance(
         >>> y = jnp.array([[1., 0.], [2., 1.]])
         >>> pairwise_euclidean_distance(x, y)
         Array([[3.1622777, 2.       ],
-               [5.385165 , 4.1231055],
-               [8.944272 , 7.6157727]], dtype=float32)
+               [5.3851647, 4.1231055],
+               [8.944272 , 7.615773 ]], dtype=float32)
     """
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -71,7 +71,7 @@ def explained_variance(
         >>> target = jnp.array([3., -0.5, 2., 7.])
         >>> preds = jnp.array([2.5, 0.0, 2., 8.])
         >>> explained_variance(preds, target)
-        Array(0.9572649, dtype=float32)
+        Array(0.95717347, dtype=float32)
     """
     n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
     return _explained_variance_compute(

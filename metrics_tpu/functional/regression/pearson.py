@@ -96,7 +96,7 @@ def pearson_corrcoef(preds: Array, target: Array) -> Array:
         >>> target = jnp.array([3., -0.5, 2., 7.])
         >>> preds = jnp.array([2.5, 0.0, 2., 8.])
         >>> pearson_corrcoef(preds, target)
-        Array(0.98491, dtype=float32)
+        Array(0.98486954, dtype=float32)
     """
     zero = jnp.asarray(0.0, dtype=jnp.result_type(preds.dtype, jnp.float32))
     _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(

@@ -92,7 +92,7 @@ def r2_score(
         >>> target = jnp.array([3., -0.5, 2., 7.])
         >>> preds = jnp.array([2.5, 0.0, 2., 8.])
         >>> r2_score(preds, target)
-        Array(0.9486081, dtype=float32)
+        Array(0.94860816, dtype=float32)
     """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)

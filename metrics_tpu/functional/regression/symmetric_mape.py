@@ -35,7 +35,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
         >>> target = jnp.array([1., 10., 1e6])
         >>> preds = jnp.array([0.9, 15., 1.2e6])
         >>> symmetric_mean_absolute_percentage_error(preds, target)
-        Array(0.2290271, dtype=float32)
+        Array(0.22902714, dtype=float32)
     """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

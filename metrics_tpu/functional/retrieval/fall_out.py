@@ -19,7 +19,7 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
     Example:
         >>> import jax.numpy as jnp
         >>> retrieval_fall_out(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
-        Array(0., dtype=float32)
+        Array(1., dtype=float32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     k = preds.shape[-1] if k is None else k

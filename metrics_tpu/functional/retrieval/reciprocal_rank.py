@@ -25,4 +25,4 @@ def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
 
     target = target[jnp.argsort(-preds, axis=-1)]
     position = jnp.nonzero(target)[0]
-    return 1.0 / (position[0] + 1.0)
+    return jnp.asarray(1.0 / (position[0] + 1.0), dtype=preds.dtype)

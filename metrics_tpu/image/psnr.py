@@ -23,7 +23,7 @@ class PeakSignalNoiseRatio(Metric):
         >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
         >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
         >>> psnr(preds, target)
-        Array(2.5527068, dtype=float32)
+        Array(2.552725, dtype=float32)
     """
 
     is_differentiable = True

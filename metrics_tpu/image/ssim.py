@@ -70,7 +70,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
 
     Example:
         >>> import jax
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 64, 64))
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 192, 192))
         >>> target = preds * 0.75
         >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure()
         >>> bool(ms_ssim(preds, target) > 0.9)

@@ -25,7 +25,7 @@ class ExplainedVariance(Metric):
         >>> preds = jnp.array([2.5, 0.0, 2., 8.])
         >>> explained_variance = ExplainedVariance()
         >>> explained_variance(preds, target)
-        Array(0.9572649, dtype=float32)
+        Array(0.95717347, dtype=float32)
     """
 
     is_differentiable = True

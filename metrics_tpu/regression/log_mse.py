@@ -25,7 +25,7 @@ class MeanSquaredLogError(Metric):
         >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
         >>> mean_squared_log_error = MeanSquaredLogError()
         >>> mean_squared_log_error(preds, target)
-        Array(0.03973012, dtype=float32)
+        Array(0.03973011, dtype=float32)
     """
 
     is_differentiable = True

@@ -31,7 +31,7 @@ class PearsonCorrCoef(Metric):
         >>> preds = jnp.array([2.5, 0.0, 2., 8.])
         >>> pearson = PearsonCorrCoef()
         >>> pearson(preds, target)
-        Array(0.98491, dtype=float32)
+        Array(0.98486954, dtype=float32)
     """
 
     is_differentiable = True

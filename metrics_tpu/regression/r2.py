@@ -22,7 +22,7 @@ class R2Score(Metric):
         >>> preds = jnp.array([2.5, 0.0, 2., 8.])
         >>> r2score = R2Score()
         >>> r2score(preds, target)
-        Array(0.9486081, dtype=float32)
+        Array(0.94860816, dtype=float32)
     """
 
     is_differentiable = True

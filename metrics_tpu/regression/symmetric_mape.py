@@ -25,7 +25,7 @@ class SymmetricMeanAbsolutePercentageError(Metric):
         >>> preds = jnp.array([0.9, 15., 1.2e6])
         >>> smape = SymmetricMeanAbsolutePercentageError()
         >>> smape(preds, target)
-        Array(0.2290271, dtype=float32)
+        Array(0.22902714, dtype=float32)
     """
 
     is_differentiable = True

@@ -25,7 +25,7 @@ class TweedieDevianceScore(Metric):
         >>> preds = jnp.array([4.0, 3.0, 2.0, 1.0])
         >>> deviance_score = TweedieDevianceScore(power=2)
         >>> deviance_score(preds, targets)
-        Array(4.8333335, dtype=float32)
+        Array(1.2083333, dtype=float32)
     """
 
     is_differentiable = True

@@ -30,7 +30,7 @@ class BLEUScore(Metric):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> metric = BLEUScore()
         >>> metric(preds, target)
-        Array(0.75984, dtype=float32)
+        Array(0.75983566, dtype=float32)
     """
 
     is_differentiable = False

@@ -20,7 +20,7 @@ class CharErrorRate(Metric):
         >>> target = ["this is the reference", "there is another one"]
         >>> metric = CharErrorRate()
         >>> metric(preds, target)
-        Array(0.3414634, dtype=float32)
+        Array(0.34146342, dtype=float32)
     """
 
     is_differentiable = False

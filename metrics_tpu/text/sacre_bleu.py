@@ -26,7 +26,7 @@ class SacreBLEUScore(BLEUScore):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> metric = SacreBLEUScore()
         >>> metric(preds, target)
-        Array(0.75984, dtype=float32)
+        Array(0.75983566, dtype=float32)
     """
 
     def __init__(

@@ -1,0 +1,82 @@
+"""SNR / SI-SNR parity vs the reference implementation (pure torch host code,
+imported from /root/reference — its usual external oracle ``mir_eval`` is not
+installed in this environment)."""
+from functools import partial
+
+import numpy as np
+import pytest
+
+from metrics_tpu.audio import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+from metrics_tpu.functional.audio import scale_invariant_signal_noise_ratio, signal_noise_ratio
+from tests.helpers.reference import load_reference_module
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES, BATCH_SIZE, TIME = 4, 8, 500
+
+_rng = np.random.RandomState(42)
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32)
+_target = _rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32)
+
+
+def _ref_snr(preds, target, zero_mean):
+    import torch
+
+    ref = load_reference_module("torchmetrics.functional.audio.snr")
+    val = ref.signal_noise_ratio(torch.tensor(np.asarray(preds)), torch.tensor(np.asarray(target)), zero_mean)
+    return val.mean().numpy()
+
+
+def _ref_si_snr(preds, target):
+    import torch
+
+    ref = load_reference_module("torchmetrics.functional.audio.snr")
+    val = ref.scale_invariant_signal_noise_ratio(torch.tensor(np.asarray(preds)), torch.tensor(np.asarray(target)))
+    return val.mean().numpy()
+
+
+@pytest.mark.parametrize("zero_mean", [False, True])
+class TestSNR(MetricTester):
+    atol = 1e-3
+
+    def test_snr_class(self, zero_mean):
+        self.run_class_metric_test(
+            preds=_preds,
+            target=_target,
+            metric_class=SignalNoiseRatio,
+            sk_metric=partial(_ref_snr, zero_mean=zero_mean),
+            metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_snr_functional(self, zero_mean):
+        self.run_functional_metric_test(
+            preds=_preds,
+            target=_target,
+            metric_functional=lambda p, t, zero_mean: signal_noise_ratio(p, t, zero_mean).mean(),
+            sk_metric=partial(_ref_snr, zero_mean=zero_mean),
+            metric_args={"zero_mean": zero_mean},
+        )
+
+
+class TestSISNR(MetricTester):
+    atol = 1e-3
+
+    def test_si_snr_class(self):
+        self.run_class_metric_test(
+            preds=_preds,
+            target=_target,
+            metric_class=ScaleInvariantSignalNoiseRatio,
+            sk_metric=_ref_si_snr,
+        )
+
+    def test_si_snr_functional(self):
+        self.run_functional_metric_test(
+            preds=_preds,
+            target=_target,
+            metric_functional=lambda p, t: scale_invariant_signal_noise_ratio(p, t).mean(),
+            sk_metric=_ref_si_snr,
+        )
+
+
+def test_snr_shape_mismatch_raises():
+    with pytest.raises(RuntimeError, match="same shape"):
+        signal_noise_ratio(np.zeros((2, 10)), np.zeros((2, 11)))

@@ -1,20 +1,11 @@
 """Test session configuration: force CPU with 8 virtual devices so mesh /
 collective tests run without TPU hardware (SURVEY.md §4 implication).
+Setup logic is shared with the repo-root conftest via
+tests/helpers/force_cpu.py."""
+from tests.helpers.force_cpu import setup_forced_cpu
 
-A pytest plugin (jaxtyping) imports jax before this conftest runs, so the
-platform must be set via ``jax.config.update`` (still possible until the
-backend is first queried), and the XLA flag via the environment (read at
-backend initialization).
-"""
-import os
-
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+setup_forced_cpu()
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 assert jax.device_count() >= 8, f"expected >=8 virtual devices, got {jax.device_count()}"

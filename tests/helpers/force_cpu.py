@@ -1,0 +1,25 @@
+"""Shared forced-CPU virtual-device setup, imported by BOTH conftests
+(repo root for doctest runs, tests/ for the suite) so the config cannot
+drift between them.
+
+A pytest plugin (jaxtyping) imports jax before conftests run, so the
+platform must be set via ``jax.config.update`` (still possible until the
+backend is first queried), and the XLA flag via the environment (read at
+backend initialization).
+"""
+import os
+
+VIRTUAL_DEVICES = 8
+
+
+def setup_forced_cpu() -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={VIRTUAL_DEVICES}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
