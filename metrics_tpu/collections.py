@@ -147,6 +147,35 @@ class MetricCollection:
         self._groups = {idx: values for idx, values in enumerate(deepcopy(self._groups).values())}
 
     @staticmethod
+    def _equal_update_attrs(metric1: Metric, metric2: Metric) -> bool:
+        """True if every public attribute the two metrics share compares equal.
+
+        Hyperparameters (threshold, top_k, num_classes, ...) live as public
+        instance attributes; if any common one differs, the metrics' update
+        paths may diverge on later batches, so they must not share a group
+        even when their states coincide on the first one.
+        """
+        skip = set(metric1._defaults) | set(metric2._defaults)
+        attrs1 = {k: v for k, v in vars(metric1).items() if not k.startswith("_") and k not in skip}
+        attrs2 = {k: v for k, v in vars(metric2).items() if not k.startswith("_") and k not in skip}
+        for key in attrs1.keys() & attrs2.keys():
+            v1, v2 = attrs1[key], attrs2[key]
+            try:
+                if isinstance(v1, jnp.ndarray) or isinstance(v2, jnp.ndarray):
+                    if (
+                        not isinstance(v1, jnp.ndarray)
+                        or not isinstance(v2, jnp.ndarray)
+                        or v1.shape != v2.shape
+                        or not bool(jnp.all(v1 == v2))
+                    ):
+                        return False
+                elif v1 != v2:
+                    return False
+            except Exception:  # incomparable values: refuse to merge
+                return False
+        return True
+
+    @staticmethod
     def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
         """True if the two metrics' states are identical.
 
@@ -155,14 +184,16 @@ class MetricCollection:
         value comparison then proves the update paths agree (parity with
         reference collections.py:194-213).
 
-        Known limitation (inherited from the reference heuristic): two
-        metrics whose update-time hyperparameters differ (e.g. thresholds)
-        are merged if their states coincide on the FIRST batch — later
-        batches then only update the group leader. Pass explicit
-        ``compute_groups=[[...]]`` (or ``False``) when metrics differ only
-        in update-time arguments.
+        Unlike the reference heuristic (which merges two metrics whose states
+        coincide on the FIRST batch even when their update-time
+        hyperparameters differ, e.g. thresholds), shared public attributes
+        are also compared — metrics differing in any common hyperparameter
+        never share a group. Pass explicit ``compute_groups=[[...]]`` to
+        override.
         """
         if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        if not MetricCollection._equal_update_attrs(metric1, metric2):
             return False
         # wrapper metrics hold their real state in child metrics; two wrappers
         # with (possibly empty) matching registries are NOT state-equal

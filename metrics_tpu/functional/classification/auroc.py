@@ -111,6 +111,19 @@ def _auroc_compute(
             )
         return _auc_compute_without_check(fpr, tpr, 1.0)
 
+    # partial AUC needs both classes present: the roc kernel zero-fills the
+    # degenerate axis (roc.py:45-55), which the interpolation below would
+    # silently turn into NaN (no negatives) or a meaningless value (no
+    # positives) — raise instead
+    if not bool(fpr[-1] > 0):
+        raise ValueError(
+            "Partial AUC (`max_fpr`) is undefined when `target` contains no negative samples."
+        )
+    if not bool(tpr[-1] > 0):
+        raise ValueError(
+            "Partial AUC (`max_fpr`) is undefined when `target` contains no positive samples."
+        )
+
     max_area = jnp.asarray(max_fpr, dtype=jnp.float32)
     # add a single point at max_fpr by linear interpolation
     stop = int(jnp.searchsorted(fpr, max_area, side="right"))

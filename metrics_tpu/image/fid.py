@@ -48,7 +48,13 @@ def _compute_fid(
     """d^2 = ||mu1 - mu2||^2 + Tr(s1 + s2 - 2 sqrtm(s1 s2)). Reference fid.py:95-122."""
     diff = mu1 - mu2
 
-    tr_covmean = _trace_sqrtm_product(sigma1, sigma2)
+    # eigvalsh raises LinAlgError (rather than returning NaN the way scipy's
+    # sqrtm does) when the product is numerically degenerate — map both
+    # failure shapes onto the reference's add-eps-and-retry path (fid.py:95-122)
+    try:
+        tr_covmean = _trace_sqrtm_product(sigma1, sigma2)
+    except np.linalg.LinAlgError:
+        tr_covmean = float("nan")
     if not np.isfinite(tr_covmean):
         rank_zero_info(f"FID calculation produces singular product; adding {eps} to diagonal of covariance estimates")
         offset = np.eye(sigma1.shape[0]) * eps

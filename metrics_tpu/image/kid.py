@@ -135,4 +135,5 @@ class KernelInceptionDistance(Metric):
             f_fake = fake_features[perm[: self.subset_size]]
             kid_scores_.append(poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
         kid_scores = jnp.stack(kid_scores_)
-        return jnp.mean(kid_scores), jnp.std(kid_scores)
+        # ddof=1: reference kid.py returns torch.std (unbiased) over subsets
+        return jnp.mean(kid_scores), jnp.std(kid_scores, ddof=1)

@@ -100,6 +100,24 @@ def test_compute_groups_not_merged_when_states_differ():
     assert len(mc.compute_groups) == 2
 
 
+def test_compute_groups_not_merged_when_hyperparams_differ():
+    # states coincide on the first batch only by chance of the update path;
+    # differing update-time hyperparameters must keep the metrics separate
+    mc = MetricCollection({"lo": Accuracy(threshold=0.3), "hi": Accuracy(threshold=0.7)})
+    probs = jnp.asarray([0.35, 0.5, 0.65, 0.2])
+    tgt = jnp.asarray([0, 1, 1, 0])
+    mc.update(probs, tgt)
+    assert len(mc.compute_groups) == 2
+    mc.update(probs, tgt)
+    res = mc.compute()
+    lo_ref, hi_ref = Accuracy(threshold=0.3), Accuracy(threshold=0.7)
+    for _ in range(2):
+        lo_ref.update(probs, tgt)
+        hi_ref.update(probs, tgt)
+    np.testing.assert_allclose(np.asarray(res["lo"]), np.asarray(lo_ref.compute()))
+    np.testing.assert_allclose(np.asarray(res["hi"]), np.asarray(hi_ref.compute()))
+
+
 def test_compute_groups_user_specified():
     mc = MetricCollection(
         Precision(num_classes=3, average="macro"),

@@ -155,6 +155,13 @@ class TestAUROCBinary(MetricTester):
             expected = sk_roc_auc(_target_binary[0], _preds_binary[0], max_fpr=max_fpr)
             np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
 
+    def test_auroc_max_fpr_degenerate_target_raises(self):
+        preds = jnp.asarray([0.1, 0.6, 0.3, 0.9])
+        with pytest.raises(ValueError, match="no negative samples"):
+            auroc(preds, jnp.ones(4, dtype=jnp.int32), max_fpr=0.5)
+        with pytest.raises(ValueError, match="no positive samples"):
+            auroc(preds, jnp.zeros(4, dtype=jnp.int32), max_fpr=0.5)
+
 
 class TestAveragePrecision(MetricTester):
     atol = 1e-5
