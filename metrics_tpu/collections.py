@@ -160,8 +160,13 @@ class MetricCollection:
         attrs2 = {k: v for k, v in vars(metric2).items() if not k.startswith("_") and k not in skip}
         for key in attrs1.keys() & attrs2.keys():
             v1, v2 = attrs1[key], attrs2[key]
+            if v1 is v2:  # shared objects (callables, extractors, arrays) compare equal
+                continue
             try:
-                if isinstance(v1, jnp.ndarray) or isinstance(v2, jnp.ndarray):
+                if isinstance(v1, np.ndarray) or isinstance(v2, np.ndarray):
+                    if not (isinstance(v1, np.ndarray) and isinstance(v2, np.ndarray) and np.array_equal(v1, v2)):
+                        return False
+                elif isinstance(v1, jnp.ndarray) or isinstance(v2, jnp.ndarray):
                     if (
                         not isinstance(v1, jnp.ndarray)
                         or not isinstance(v2, jnp.ndarray)

@@ -4,3 +4,6 @@ from metrics_tpu.image.ssim import (  # noqa: F401
     StructuralSimilarityIndexMeasure,
 )
 from metrics_tpu.image.uqi import UniversalImageQualityIndex  # noqa: F401
+from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
+from metrics_tpu.image.inception import InceptionScore  # noqa: F401
+from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401

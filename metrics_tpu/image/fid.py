@@ -58,7 +58,13 @@ def _compute_fid(
     if not np.isfinite(tr_covmean):
         rank_zero_info(f"FID calculation produces singular product; adding {eps} to diagonal of covariance estimates")
         offset = np.eye(sigma1.shape[0]) * eps
-        tr_covmean = _trace_sqrtm_product(sigma1 + offset, sigma2 + offset)
+        try:
+            tr_covmean = _trace_sqrtm_product(sigma1 + offset, sigma2 + offset)
+        except np.linalg.LinAlgError as err:
+            raise ValueError(
+                "FID covariance square root failed even after adding eps to the diagonals —"
+                " the feature matrices likely contain NaN/Inf (broken or overflowing extractor)."
+            ) from err
 
     return float(diff @ diff + np.trace(sigma1) + np.trace(sigma2) - 2 * tr_covmean)
 

@@ -146,10 +146,14 @@ if _FLAX_AVAILABLE:
 
         @nn.compact
         def __call__(self, x: Array, feature: Union[int, str] = 2048) -> Array:
-            # NCHW -> NHWC, resize, scale to [-1, 1]
+            # NCHW -> NHWC, resize, scale to [-1, 1]. The value-range decision
+            # is made from the *dtype* (static at trace time, jit-safe):
+            # integer inputs are [0, 255], floats are [0, 1] — same contract as
+            # the reference (uint8 by default, float via normalize=True).
+            is_int = jnp.issubdtype(x.dtype, jnp.integer)
             x = jnp.transpose(x.astype(jnp.float32), (0, 2, 3, 1))
             x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
-            x = x / 127.5 - 1.0 if x.max() > 1.5 else x * 2.0 - 1.0
+            x = x / 127.5 - 1.0 if is_int else x * 2.0 - 1.0
 
             x = BasicConv2d(32, (3, 3), strides=(2, 2))(x)
             x = BasicConv2d(32, (3, 3))(x)
@@ -190,15 +194,97 @@ if _FLAX_AVAILABLE:
             return logits
 
 
-def convert_torch_fidelity_weights(state_dict: Any) -> dict:  # pragma: no cover
-    """Map a torch-fidelity FeatureExtractorInceptionV3 state_dict onto the
-    Flax parameter tree (host-side, torch required). Save the result with
-    ``numpy.savez`` and pass its path as ``feature_extractor_weights_path``."""
-    raise NotImplementedError(
-        "Weight conversion requires the torch-fidelity checkpoint, which this"
-        " environment cannot download. Run this helper where the checkpoint"
-        " is available."
-    )
+# torch-fidelity / pytorch-fid module names for each Flax submodule, in the
+# order the Flax `@nn.compact` bodies create them (creation order defines the
+# auto-generated ``BasicConv2d_<i>`` names).
+_STEM_CONVS = ("Conv2d_1a_3x3", "Conv2d_2a_3x3", "Conv2d_2b_3x3", "Conv2d_3b_1x1", "Conv2d_4a_3x3")
+_A_BRANCHES = ("branch1x1", "branch5x5_1", "branch5x5_2",
+               "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool")
+_B_BRANCHES = ("branch3x3", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3")
+_C_BRANCHES = ("branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3",
+               "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3",
+               "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool")
+_D_BRANCHES = ("branch3x3_1", "branch3x3_2", "branch7x7x3_1",
+               "branch7x7x3_2", "branch7x7x3_3", "branch7x7x3_4")
+_E_BRANCHES = ("branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b",
+               "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a",
+               "branch3x3dbl_3b", "branch_pool")
+_BLOCK_LAYOUT = (
+    # (flax submodule name, torch module name, torch branch-conv order)
+    ("InceptionA_0", "Mixed_5b", _A_BRANCHES),
+    ("InceptionA_1", "Mixed_5c", _A_BRANCHES),
+    ("InceptionA_2", "Mixed_5d", _A_BRANCHES),
+    ("InceptionB_0", "Mixed_6a", _B_BRANCHES),
+    ("InceptionC_0", "Mixed_6b", _C_BRANCHES),
+    ("InceptionC_1", "Mixed_6c", _C_BRANCHES),
+    ("InceptionC_2", "Mixed_6d", _C_BRANCHES),
+    ("InceptionC_3", "Mixed_6e", _C_BRANCHES),
+    ("InceptionD_0", "Mixed_7a", _D_BRANCHES),
+    ("InceptionE_0", "Mixed_7b", _E_BRANCHES),
+    ("InceptionE_1", "Mixed_7c", _E_BRANCHES),
+)
+
+
+def convert_torch_fidelity_weights(state_dict: Any) -> dict:
+    """Map a torch-fidelity ``FeatureExtractorInceptionV3`` state_dict (or any
+    torchvision-style inception with ``Mixed_*``/``Conv2d_*`` module names,
+    e.g. pytorch-fid's underlying ``fid_inception_v3()`` — NOT its
+    ``blocks.N.M``-indexed wrapper) onto this module's Flax variable tree.
+
+    Host-side helper: accepts torch tensors or numpy arrays keyed by the
+    standard inception module names (``Mixed_5b.branch1x1.conv.weight`` ...).
+    Returns ``{"params": ..., "batch_stats": ...}``. Persist with
+    ``np.savez(path, variables=variables)`` and pass ``path`` as
+    ``feature_extractor_weights_path``. Replaces the torch-side loading at
+    reference image/fid.py:26-57 (torch-fidelity download + torch state_dict).
+    """
+    import numpy as np
+
+    def _np(t: Any) -> np.ndarray:
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().numpy()
+        return np.asarray(t, dtype=np.float32)
+
+    sd = dict(state_dict)
+    # tolerate a uniform key prefix (e.g. "model." or "inception.")
+    probe = f"{_STEM_CONVS[0]}.conv.weight"
+    if probe not in sd:
+        prefixes = {k[: -len(probe)] for k in sd if k.endswith(probe)}
+        if len(prefixes) != 1:
+            raise KeyError(f"Cannot locate '{probe}' (or a unique prefixed variant) in state_dict")
+        prefix = prefixes.pop()
+        sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+
+    def _basic_conv(torch_name: str):
+        kernel = _np(sd[f"{torch_name}.conv.weight"]).transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        params = {
+            "Conv_0": {"kernel": kernel},
+            "BatchNorm_0": {"scale": _np(sd[f"{torch_name}.bn.weight"]), "bias": _np(sd[f"{torch_name}.bn.bias"])},
+        }
+        stats = {
+            "BatchNorm_0": {
+                "mean": _np(sd[f"{torch_name}.bn.running_mean"]),
+                "var": _np(sd[f"{torch_name}.bn.running_var"]),
+            }
+        }
+        return params, stats
+
+    params: dict = {}
+    batch_stats: dict = {}
+    for i, torch_name in enumerate(_STEM_CONVS):
+        params[f"BasicConv2d_{i}"], batch_stats[f"BasicConv2d_{i}"] = _basic_conv(torch_name)
+    for flax_name, torch_name, branch_order in _BLOCK_LAYOUT:
+        block_params: dict = {}
+        block_stats: dict = {}
+        for j, branch in enumerate(branch_order):
+            block_params[f"BasicConv2d_{j}"], block_stats[f"BasicConv2d_{j}"] = _basic_conv(
+                f"{torch_name}.{branch}"
+            )
+        params[flax_name] = block_params
+        batch_stats[flax_name] = block_stats
+    if "fc.weight" in sd:
+        params["Dense_0"] = {"kernel": _np(sd["fc.weight"]).T, "bias": _np(sd["fc.bias"])}
+    return {"params": params, "batch_stats": batch_stats}
 
 
 def build_fid_inception(
@@ -226,7 +312,24 @@ def build_fid_inception(
     loaded = dict(np.load(weights_path, allow_pickle=True))
     variables = jax.tree_util.tree_map(jnp.asarray, loaded["variables"].item())
 
-    def extract(imgs: Array) -> Array:
-        return model.apply(variables, imgs, feature=feature)
+    jitted = jax.jit(lambda imgs: model.apply(variables, imgs, feature=feature))
+    checked = False
 
-    return jax.jit(extract, static_argnames=())
+    def extract(imgs: Array) -> Array:
+        # Host-side guard (extract itself is not jitted; the forward is):
+        # float inputs must be [0, 1] — a float image holding [0, 255] values
+        # (e.g. uint8 cast to float32) would be silently mis-scaled by the
+        # dtype-keyed normalization inside the jitted forward. Checked on the
+        # first batch only: the range convention is fixed per pipeline and the
+        # max() forces a device sync that would otherwise serialize every step.
+        nonlocal checked
+        if not checked and jnp.issubdtype(imgs.dtype, jnp.floating):
+            if float(imgs.max()) > 1.5:
+                raise ValueError(
+                    "Float images must be in [0, 1] (got max value"
+                    f" {float(imgs.max()):.3g}). Pass uint8 images for the [0, 255] range."
+                )
+            checked = True
+        return jitted(imgs)
+
+    return extract
