@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import functools
 import inspect
-import operator as _op
+import operator
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -561,101 +561,101 @@ class Metric(ABC):
     # operator algebra (parity with reference metric.py:685-788)
     # ------------------------------------------------------------------
     def __add__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, self, other)
+        return CompositionalMetric(operator.add, self, other)
 
     def __radd__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, other, self)
+        return CompositionalMetric(operator.add, other, self)
 
     def __sub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, self, other)
+        return CompositionalMetric(operator.sub, self, other)
 
     def __rsub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, other, self)
+        return CompositionalMetric(operator.sub, other, self)
 
     def __mul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, self, other)
+        return CompositionalMetric(operator.mul, self, other)
 
     def __rmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, other, self)
+        return CompositionalMetric(operator.mul, other, self)
 
     def __truediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.true_divide, self, other)
+        return CompositionalMetric(operator.truediv, self, other)
 
     def __rtruediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.true_divide, other, self)
+        return CompositionalMetric(operator.truediv, other, self)
 
     def __floordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, self, other)
+        return CompositionalMetric(operator.floordiv, self, other)
 
     def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, other, self)
+        return CompositionalMetric(operator.floordiv, other, self)
 
     def __mod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, self, other)
+        return CompositionalMetric(operator.mod, self, other)
 
     def __rmod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, other, self)
+        return CompositionalMetric(operator.mod, other, self)
 
     def __pow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, self, other)
+        return CompositionalMetric(operator.pow, self, other)
 
     def __rpow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, other, self)
+        return CompositionalMetric(operator.pow, other, self)
 
     def __matmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, self, other)
+        return CompositionalMetric(operator.matmul, self, other)
 
     def __rmatmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, other, self)
+        return CompositionalMetric(operator.matmul, other, self)
 
     def __and__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(operator.and_, self, other)
 
     def __rand__(self, other: Any) -> "CompositionalMetric":
         # bitwise_and is commutative
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(operator.and_, self, other)
 
     def __or__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, self, other)
+        return CompositionalMetric(operator.or_, self, other)
 
     def __ror__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, other, self)
+        return CompositionalMetric(operator.or_, other, self)
 
     def __xor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, self, other)
+        return CompositionalMetric(operator.xor, self, other)
 
     def __rxor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, other, self)
+        return CompositionalMetric(operator.xor, other, self)
 
     def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.equal, self, other)
+        return CompositionalMetric(operator.eq, self, other)
 
     def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.not_equal, self, other)
+        return CompositionalMetric(operator.ne, self, other)
 
     def __lt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less, self, other)
+        return CompositionalMetric(operator.lt, self, other)
 
     def __le__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less_equal, self, other)
+        return CompositionalMetric(operator.le, self, other)
 
     def __gt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater, self, other)
+        return CompositionalMetric(operator.gt, self, other)
 
     def __ge__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater_equal, self, other)
+        return CompositionalMetric(operator.ge, self, other)
 
     def __abs__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(operator.abs, self, None)
 
     def __neg__(self) -> "CompositionalMetric":
         return CompositionalMetric(_neg, self, None)
 
     def __pos__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(operator.abs, self, None)
 
     def __invert__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_not, self, None)
+        return CompositionalMetric(operator.invert, self, None)
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
         return CompositionalMetric(lambda x: x[idx], self, None)

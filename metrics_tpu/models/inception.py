@@ -313,23 +313,21 @@ def build_fid_inception(
     variables = jax.tree_util.tree_map(jnp.asarray, loaded["variables"].item())
 
     jitted = jax.jit(lambda imgs: model.apply(variables, imgs, feature=feature))
-    checked = False
 
     def extract(imgs: Array) -> Array:
         # Host-side guard (extract itself is not jitted; the forward is):
         # float inputs must be [0, 1] — a float image holding [0, 255] values
         # (e.g. uint8 cast to float32) would be silently mis-scaled by the
-        # dtype-keyed normalization inside the jitted forward. Checked on the
-        # first batch only: the range convention is fixed per pipeline and the
-        # max() forces a device sync that would otherwise serialize every step.
-        nonlocal checked
-        if not checked and jnp.issubdtype(imgs.dtype, jnp.floating):
-            if float(imgs.max()) > 1.5:
-                raise ValueError(
-                    "Float images must be in [0, 1] (got max value"
-                    f" {float(imgs.max()):.3g}). Pass uint8 images for the [0, 255] range."
-                )
-            checked = True
+        # dtype-keyed normalization inside the jitted forward. Checked every
+        # batch: the max() forces a device sync, but that cost is negligible
+        # next to the 299x299 inception forward it gates, and a mis-ranged
+        # batch can arrive at any point in the stream (real vs fake, mixed
+        # loaders).
+        if jnp.issubdtype(imgs.dtype, jnp.floating) and float(imgs.max()) > 1.5:
+            raise ValueError(
+                "Float images must be in [0, 1] (got max value"
+                f" {float(imgs.max()):.3g}). Pass uint8 images for the [0, 255] range."
+            )
         return jitted(imgs)
 
     return extract

@@ -61,3 +61,13 @@ _input_multidim_multiclass = Input(
     preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
     target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
 )
+
+_input_multilabel_multidim_prob = Input(
+    preds=_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+_input_multilabel_multidim = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)

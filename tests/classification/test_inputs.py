@@ -1,0 +1,292 @@
+"""Input-deduction matrix for ``_input_format_classification``.
+
+Coverage parity with /root/reference/tests/classification/test_inputs.py:
+the "usual cases" grid (deduced case + exact canonical preds/target for every
+input style, including the multiclass-flag overrides in both directions and
+batch_size=1), threshold semantics, and the incorrect-input / incorrect-top_k
+rejection matrices.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.classification.inputs import (
+    Input,
+    _input_binary as _bin,
+    _input_binary_prob as _bin_prob,
+    _input_multiclass as _mc,
+    _input_multiclass_prob as _mc_prob,
+    _input_multidim_multiclass as _mdmc,
+    _input_multidim_multiclass_prob as _mdmc_prob,
+    _input_multilabel as _ml,
+    _input_multilabel_multidim as _mlmd,
+    _input_multilabel_multidim_prob as _mlmd_prob,
+    _input_multilabel_prob as _ml_prob,
+)
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, THRESHOLD
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+
+_rng = np.random.default_rng(42)
+
+# Additional special-case fixtures (reference test_inputs.py:38-54)
+_ml_prob_half = Input(_ml_prob.preds.astype(np.float16), _ml_prob.target)
+
+_mc_prob_2cls_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, 2)).astype(np.float32)
+_mc_prob_2cls_preds /= _mc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mc_prob_2cls = Input(_mc_prob_2cls_preds, _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+
+_mdmc_prob_many_dims_preds = _rng.random(
+    (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM)
+).astype(np.float32)
+_mdmc_prob_many_dims_preds /= _mdmc_prob_many_dims_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_many_dims = Input(
+    _mdmc_prob_many_dims_preds,
+    _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+
+_mdmc_prob_2cls_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, 2, EXTRA_DIM)).astype(np.float32)
+_mdmc_prob_2cls_preds /= _mdmc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_2cls = Input(_mdmc_prob_2cls_preds, _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)))
+
+
+# Post-transformation helpers (reference test_inputs.py:59-121)
+def _idn(x):
+    return jnp.asarray(x)
+
+
+def _usq(x):
+    return jnp.expand_dims(jnp.asarray(x), -1)
+
+
+def _thrs(x):
+    return jnp.asarray(x) >= THRESHOLD
+
+
+def _rshp1(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(jnp.asarray(x).astype(jnp.int32), NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(jnp.asarray(x).astype(jnp.int32), 2)
+
+
+def _top1(x):
+    return select_topk(jnp.asarray(x), 1)
+
+
+def _top2(x):
+    return select_topk(jnp.asarray(x), 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        # usual expected cases (reference test_inputs.py:130-148)
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, None, "multi-label", _ml_preds_tr, _rshp1),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        # special cases (reference test_inputs.py:151-170)
+        # half precision converts to full precision
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(np.asarray(x, np.float32)), _rshp1),
+        # binary as multiclass
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        # binary probs as multiclass
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        # multilabel as multiclass
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        # multilabel probs as multiclass
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        # multidim multilabel as multiclass
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        # multidim multilabel probs as multiclass
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        # multiclass probs with 2 classes as binary
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        # multidim multiclass probs with 2 classes as multilabel
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target):
+    for mode_probe in (exp_mode, DataType(exp_mode)):
+        for batch_slice in (np.s_[:], np.s_[[0], ...]):
+            preds_in = inputs.preds[0][batch_slice]
+            target_in = inputs.target[0][batch_slice]
+            preds_out, target_out, mode = _input_format_classification(
+                preds=jnp.asarray(preds_in),
+                target=jnp.asarray(target_in),
+                threshold=THRESHOLD,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                top_k=top_k,
+            )
+            assert mode == mode_probe
+            np.testing.assert_array_equal(
+                np.asarray(preds_out), np.asarray(post_preds(preds_in)).astype(np.int32)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(target_out), np.asarray(post_target(target_in)).astype(np.int32)
+            )
+
+
+def test_threshold():
+    target = jnp.asarray([1, 1, 1], dtype=jnp.int32)
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(preds_out).squeeze(), [0, 1, 1])
+
+
+def _ri(*shape, low=0, high=2):
+    return jnp.asarray(_rng.integers(low, high, shape))
+
+
+def _rf(*shape):
+    return jnp.asarray(_rng.random(shape).astype(np.float32))
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass",
+    [
+        # target not integer
+        (_ri(7), _ri(7).astype(jnp.float32), None, None),
+        # target negative
+        (_ri(7), -_ri(7) - 1, None, None),
+        # preds negative integers
+        (-_ri(7) - 1, _ri(7), None, None),
+        # multiclass=False and target > 1
+        (_rf(7), _ri(7, low=2, high=4), None, False),
+        # multiclass=False and preds integers with > 1
+        (_ri(7, low=2, high=4), _ri(7), None, False),
+        # wrong batch size
+        (_ri(8), _ri(7), None, None),
+        # completely wrong shape
+        (_ri(7), _ri(7, 4), None, None),
+        # same #dims, different shape
+        (_ri(7, 3), _ri(7, 4), None, None),
+        # same shape, preds float, target not binary
+        (_rf(7, 3), _ri(7, 3, low=2, high=4), None, None),
+        # #dims preds = 1 + #dims target, C not second or last
+        (_rf(7, 3, 4, 3), _ri(7, 3, 3, high=4), None, None),
+        # #dims preds = 1 + #dims target, preds not float
+        (_ri(7, 3, 3, 4), _ri(7, 3, 3, high=4), None, None),
+        # multiclass=False with C dimension > 2
+        (jnp.asarray(_mc_prob.preds[0]), _ri(BATCH_SIZE), None, False),
+        # max target >= C dimension
+        (jnp.asarray(_mc_prob.preds[0]), _ri(BATCH_SIZE, low=NUM_CLASSES + 1, high=100), None, None),
+        # C dimension != num_classes
+        (jnp.asarray(_mc_prob.preds[0]), jnp.asarray(_mc_prob.target[0]), NUM_CLASSES + 1, None),
+        # max target > num_classes (#dims preds = 1 + #dims target)
+        (jnp.asarray(_mc_prob.preds[0]), _ri(BATCH_SIZE, NUM_CLASSES, low=NUM_CLASSES + 1, high=100), 4, None),
+        # max target > num_classes (#dims preds = #dims target)
+        (_ri(7, 3, high=4), _ri(7, 3, low=5, high=7), 4, None),
+        # num_classes=1 but multiclass not false
+        (_ri(7), _ri(7), 1, None),
+        # multiclass=False but implied class dim != num_classes
+        (_ri(7, 3, 3), _ri(7, 3, 3), 4, False),
+        # multilabel input with implied class dim != num_classes
+        (_rf(7, 3, 3), _ri(7, 3, 3), 4, False),
+        # multilabel input with multiclass=True but num_classes != 2
+        (_rf(7, 3), _ri(7, 3), 4, True),
+        # binary input, num_classes > 2
+        (_rf(7), _ri(7), 4, None),
+        # binary input, num_classes == 2, multiclass not True
+        (_rf(7), _ri(7), 2, None),
+        (_rf(7), _ri(7), 2, False),
+        # binary input, num_classes == 1, multiclass=True
+        (_rf(7), _ri(7), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=preds, target=target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, top_k",
+    [
+        # top_k set with non-(md)mc or ml prob data
+        (jnp.asarray(_bin.preds[0]), jnp.asarray(_bin.target[0]), None, None, 2),
+        (jnp.asarray(_bin_prob.preds[0]), jnp.asarray(_bin_prob.target[0]), None, None, 2),
+        (jnp.asarray(_mc.preds[0]), jnp.asarray(_mc.target[0]), None, None, 2),
+        (jnp.asarray(_ml.preds[0]), jnp.asarray(_ml.target[0]), None, None, 2),
+        (jnp.asarray(_mlmd.preds[0]), jnp.asarray(_mlmd.target[0]), None, None, 2),
+        (jnp.asarray(_mdmc.preds[0]), jnp.asarray(_mdmc.target[0]), None, None, 2),
+        # top_k = 0
+        (jnp.asarray(_mc_prob_2cls.preds[0]), jnp.asarray(_mc_prob_2cls.target[0]), None, None, 0),
+        # top_k = float
+        (jnp.asarray(_mc_prob_2cls.preds[0]), jnp.asarray(_mc_prob_2cls.target[0]), None, None, 0.123),
+        # top_k = 2 with 2 classes, multiclass=False
+        (jnp.asarray(_mc_prob_2cls.preds[0]), jnp.asarray(_mc_prob_2cls.target[0]), None, False, 2),
+        # top_k = number of classes
+        (jnp.asarray(_mc_prob.preds[0]), jnp.asarray(_mc_prob.target[0]), None, None, NUM_CLASSES),
+        # multiclass=True for ml prob inputs, top_k set
+        (jnp.asarray(_ml_prob.preds[0]), jnp.asarray(_ml_prob.target[0]), None, True, 2),
+        # top_k = num_classes for ml prob inputs
+        (jnp.asarray(_ml_prob.preds[0]), jnp.asarray(_ml_prob.target[0]), None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=preds,
+            target=target,
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            top_k=top_k,
+        )
