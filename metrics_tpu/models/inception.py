@@ -313,21 +313,33 @@ def build_fid_inception(
     variables = jax.tree_util.tree_map(jnp.asarray, loaded["variables"].item())
 
     jitted = jax.jit(lambda imgs: model.apply(variables, imgs, feature=feature))
+    pending_max = None  # async max of the previous device batch, checked next call
 
-    def extract(imgs: Array) -> Array:
-        # Host-side guard (extract itself is not jitted; the forward is):
-        # float inputs must be [0, 1] — a float image holding [0, 255] values
-        # (e.g. uint8 cast to float32) would be silently mis-scaled by the
-        # dtype-keyed normalization inside the jitted forward. Checked every
-        # batch: the max() forces a device sync, but that cost is negligible
-        # next to the 299x299 inception forward it gates, and a mis-ranged
-        # batch can arrive at any point in the stream (real vs fake, mixed
-        # loaders).
-        if jnp.issubdtype(imgs.dtype, jnp.floating) and float(imgs.max()) > 1.5:
+    def _validate_max(mx: float) -> None:
+        if mx > 1.5:
             raise ValueError(
                 "Float images must be in [0, 1] (got max value"
-                f" {float(imgs.max()):.3g}). Pass uint8 images for the [0, 255] range."
+                f" {mx:.3g}). Pass uint8 images for the [0, 255] range."
             )
+
+    def extract(imgs: Array) -> Array:
+        # Guard against mis-ranged float inputs: a float image holding
+        # [0, 255] values (e.g. uint8 cast to float32) would be silently
+        # mis-scaled by the dtype-keyed normalization inside the jitted
+        # forward. Host numpy inputs are checked synchronously (free); device
+        # arrays are checked with a one-batch delay — the max is enqueued
+        # async and read back on the NEXT call, by which point it has long
+        # finished, so dispatch stays pipelined (no per-call device sync).
+        # The final batch of a stream is therefore only validated if another
+        # call follows; the synchronous numpy path has no such gap.
+        nonlocal pending_max
+        if jnp.issubdtype(imgs.dtype, jnp.floating):
+            if isinstance(imgs, np.ndarray):
+                _validate_max(float(imgs.max()))
+            else:
+                if pending_max is not None:
+                    _validate_max(float(pending_max))
+                pending_max = jnp.max(imgs)
         return jitted(imgs)
 
     return extract
