@@ -63,10 +63,9 @@ def bench_tpu() -> float:
     return BATCH * ITERS / dt
 
 
-def bench_reference() -> float:
-    """Samples/sec through reference TorchMetrics AUROC+ConfusionMatrix on torch-CPU."""
+def _stub_pkg_resources() -> None:
+    """Modern setuptools dropped pkg_resources; the reference needs a stub."""
     if "pkg_resources" not in sys.modules:
-        # modern setuptools dropped pkg_resources; the reference needs a stub
         import types
 
         stub = types.ModuleType("pkg_resources")
@@ -80,6 +79,11 @@ def bench_reference() -> float:
         stub.DistributionNotFound = DistributionNotFound
         stub.get_distribution = get_distribution
         sys.modules["pkg_resources"] = stub
+
+
+def bench_reference() -> float:
+    """Samples/sec through reference TorchMetrics AUROC+ConfusionMatrix on torch-CPU."""
+    _stub_pkg_resources()
 
     sys.path.insert(0, "/root/reference")
     try:
@@ -196,9 +200,81 @@ def bench_map() -> None:
     )
 
 
+def bench_retrieval() -> None:
+    """queries/sec through NDCG+MAP update+compute (BASELINE config 4,
+    MSLR-WEB30K-shaped: many queries, ~40-200 candidate docs each)."""
+    import jax.numpy as jnp
+    from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+
+    rng = np.random.RandomState(7)
+    n_queries = 5000
+    counts = rng.randint(40, 200, n_queries)
+    idx = np.repeat(np.arange(n_queries), counts)
+    n = len(idx)
+    preds = rng.rand(n).astype(np.float32)
+    target = (rng.rand(n) < 0.08).astype(np.int32)
+
+    j_idx, j_preds, j_target = jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target)
+
+    def run_once():
+        ndcg = RetrievalNormalizedDCG()
+        rmap = RetrievalMAP()
+        ndcg.update(j_preds, j_target, indexes=j_idx)
+        rmap.update(j_preds, j_target, indexes=j_idx)
+        return ndcg.compute(), rmap.compute()
+
+    run_once()  # compile
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    ours = n_queries * iters / (time.perf_counter() - t0)
+
+    ref_qps = None
+    try:
+        import torch
+
+        _stub_pkg_resources()
+        sys.path.insert(0, "/root/reference")
+        from torchmetrics.retrieval import RetrievalMAP as TRefMAP
+        from torchmetrics.retrieval import RetrievalNormalizedDCG as TRefNDCG
+
+        t_idx = torch.as_tensor(idx)
+        t_preds = torch.as_tensor(preds)
+        t_target = torch.as_tensor(target)
+
+        def ref_once():
+            ndcg = TRefNDCG()
+            rmap = TRefMAP()
+            ndcg.update(t_preds, t_target, indexes=t_idx)
+            rmap.update(t_preds, t_target, indexes=t_idx)
+            return ndcg.compute(), rmap.compute()
+
+        ref_once()
+        t0 = time.perf_counter()
+        ref_once()
+        ref_qps = n_queries / (time.perf_counter() - t0)
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "mslr_shaped_ndcg_map_throughput",
+                "value": round(ours, 1),
+                "unit": "queries/sec",
+                "vs_baseline": round(ours / ref_qps, 3) if ref_qps else None,
+            }
+        )
+    )
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "map":
         bench_map()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "retrieval":
+        bench_retrieval()
         return
     tpu_sps = bench_tpu()
     try:

@@ -1,0 +1,181 @@
+"""Padded fixed-shape per-query retrieval kernels (TPU-native compute path).
+
+The reference evaluates retrieval metrics with a Python loop over query
+groups (/root/reference/torchmetrics/retrieval/base.py:115-150 over
+``get_group_indexes``, utilities/data.py:229-253 — SURVEY §3.6 flags it as a
+hot spot). Here the ragged (query, documents) structure is packed ONCE into
+static ``[num_queries, max_docs]`` buffers host-side (vectorized numpy, no
+per-element Python), and every per-query metric plus the empty-query policy
+and the final mean run as ONE jitted vmapped kernel on device.
+
+Row kernels replicate the single-query functional kernels' semantics exactly
+(functional/retrieval/*.py, themselves parity ports of the reference):
+padded slots carry ``preds=-inf`` (sort last), ``target=0``, ``mask=False``.
+"""
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.jit
+def _segment_layout(indexes: Array) -> Tuple[Array, Array, Array]:
+    """Stable sort by query id -> (order, dense row id, within-row column).
+
+    The stable sort preserves within-query document order, so tie-breaking in
+    the downstream per-row argsort matches the reference's group-loop path.
+    """
+    order = jnp.argsort(indexes, stable=True)
+    sorted_idx = indexes[order]
+    change = jnp.concatenate(
+        [jnp.zeros(1, bool), sorted_idx[1:] != sorted_idx[:-1]]
+    )
+    row = jnp.cumsum(change.astype(jnp.int32))
+    pos = jnp.arange(sorted_idx.shape[0], dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(change, pos, 0))
+    col = pos - seg_start
+    return order, row, col
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _scatter_pack(
+    preds: Array, target: Array, order: Array, row: Array, col: Array, num_queries: int, max_docs: int
+) -> Tuple[Array, Array, Array]:
+    padded_preds = jnp.full((num_queries, max_docs), -jnp.inf, jnp.float32).at[row, col].set(
+        preds[order].astype(jnp.float32)
+    )
+    padded_target = jnp.zeros((num_queries, max_docs), jnp.float32).at[row, col].set(
+        target[order].astype(jnp.float32)
+    )
+    mask = jnp.zeros((num_queries, max_docs), bool).at[row, col].set(True)
+    return padded_preds, padded_target, mask
+
+
+def pack_queries(
+    indexes: Array, preds: Array, target: Array, max_expand: Optional[int] = None
+) -> Optional[Tuple[Array, Array, Array]]:
+    """Pack ragged (indexes, preds, target) into padded [Q, Dmax] device buffers.
+
+    Everything stays on device (sort, segment layout, scatter); only TWO
+    scalars (the number of queries and the max docs-per-query, needed as
+    static shapes) cross to the host. This matters: on tunneled/remote
+    accelerators bulk host<->device copies are the bottleneck, and the raw
+    ragged data never leaves the device here.
+
+    Returns None (before allocating anything) when the padded layout would
+    exceed ``max_expand`` times the raw element count — heavily skewed query
+    sizes (one huge query among many small ones) make dense padding blow up.
+    """
+    indexes = jnp.asarray(indexes).reshape(-1)
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+
+    order, row, col = _segment_layout(indexes)
+    num_queries = int(row[-1]) + 1
+    max_docs = int(jnp.max(col)) + 1
+    if max_expand is not None and num_queries * max_docs > max_expand * indexes.size:
+        return None
+    return _scatter_pack(preds, target, order, row, col, num_queries, max_docs)
+
+
+def _row_sort(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
+    """Target and mask reordered by descending preds (padding sorts last)."""
+    order = jnp.argsort(-preds)
+    return target[order], mask[order]
+
+
+def _positions(d: int) -> Array:
+    return jnp.arange(1, d + 1, dtype=jnp.float32)
+
+
+def average_precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    """functional/retrieval/average_precision.py semantics on a padded row."""
+    st, _ = _row_sort(preds, target, mask)
+    num_pos = jnp.sum(st)
+    terms = st * jnp.cumsum(st) / _positions(st.shape[0])
+    return jnp.where(num_pos > 0, jnp.sum(terms) / jnp.maximum(num_pos, 1.0), 0.0)
+
+
+def reciprocal_rank_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    st, _ = _row_sort(preds, target, mask)
+    num_pos = jnp.sum(st)
+    first = jnp.argmax(st > 0)
+    return jnp.where(num_pos > 0, 1.0 / (first + 1.0), 0.0)
+
+
+def precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    st, sm = _row_sort(preds, target, mask)
+    num_pos = jnp.sum(st)
+    if k is None:
+        # k defaults to the per-query document count (reference precision.py)
+        n_docs = jnp.sum(sm)
+        return jnp.where(num_pos > 0, num_pos / jnp.maximum(n_docs, 1.0), 0.0)
+    in_k = _positions(st.shape[0]) <= k
+    return jnp.where(num_pos > 0, jnp.sum(st * in_k) / k, 0.0)
+
+
+def recall_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    st, _ = _row_sort(preds, target, mask)
+    num_pos = jnp.sum(st)
+    in_k = _positions(st.shape[0]) <= (k if k is not None else st.shape[0])
+    return jnp.where(num_pos > 0, jnp.sum(st * in_k) / jnp.maximum(num_pos, 1.0), 0.0)
+
+
+def r_precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    st, _ = _row_sort(preds, target, mask)
+    num_pos = jnp.sum(st)
+    in_r = _positions(st.shape[0]) <= num_pos
+    return jnp.where(num_pos > 0, jnp.sum(st * in_r) / jnp.maximum(num_pos, 1.0), 0.0)
+
+
+def hit_rate_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    st, _ = _row_sort(preds, target, mask)
+    in_k = _positions(st.shape[0]) <= (k if k is not None else st.shape[0])
+    return (jnp.sum(st * in_k) > 0).astype(jnp.float32)
+
+
+def fall_out_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    """Top-k fraction of NON-relevant docs; padding must not count as negative."""
+    st, sm = _row_sort(preds, target, mask)
+    neg = (1.0 - st) * sm
+    num_neg = jnp.sum(neg)
+    in_k = _positions(st.shape[0]) <= (k if k is not None else st.shape[0])
+    return jnp.where(num_neg > 0, jnp.sum(neg * in_k) / jnp.maximum(num_neg, 1.0), 0.0)
+
+
+def ndcg_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+    """Graded-target nDCG@k (functional/retrieval/ndcg.py semantics)."""
+    st, _ = _row_sort(preds, target, mask)
+    ideal = -jnp.sort(-target)  # padding zeros sort last; contribute nothing
+    pos = _positions(st.shape[0])
+    in_k = pos <= (k if k is not None else st.shape[0])
+    discount = jnp.log2(pos + 1.0)
+    target_dcg = jnp.sum(st * in_k / discount)
+    ideal_dcg = jnp.sum(ideal * in_k / discount)
+    return jnp.where(ideal_dcg > 0, target_dcg / jnp.maximum(ideal_dcg, 1e-38), 0.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _padded_compute_fn(kernel: Callable, k: Optional[int], empty_target_action: str):
+    """One jitted function: vmapped per-query kernel + empty policy + mean."""
+
+    @jax.jit
+    def run(padded_preds: Array, padded_target: Array, mask: Array, empty: Array) -> Array:
+        vals = jax.vmap(lambda p, t, m: kernel(p, t, m, k))(padded_preds, padded_target, mask)
+        if empty_target_action == "pos":
+            vals = jnp.where(empty, 1.0, vals)
+            weights = jnp.ones_like(vals)
+        elif empty_target_action == "neg":
+            vals = jnp.where(empty, 0.0, vals)
+            weights = jnp.ones_like(vals)
+        elif empty_target_action == "skip":
+            weights = (~empty).astype(vals.dtype)
+        else:  # "error" is raised host-side before this runs
+            weights = jnp.ones_like(vals)
+        total = jnp.sum(weights)
+        return jnp.where(total > 0, jnp.sum(vals * weights) / jnp.maximum(total, 1.0), 0.0)
+
+    return run

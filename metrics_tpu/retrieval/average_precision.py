@@ -5,6 +5,7 @@ Behavior parity with /root/reference/torchmetrics/retrieval/average_precision.py
 import jax
 
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.padded import average_precision_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -22,6 +23,8 @@ class RetrievalMAP(RetrievalMetric):
         >>> rmap(preds, target, indexes=indexes)
         Array(0.7916667, dtype=float32)
     """
+
+    _padded_metric = staticmethod(average_precision_row)
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_average_precision(preds, target)

@@ -5,19 +5,22 @@ cat-states ``indexes/preds/target``; compute = concat -> group by query id ->
 per-group ``_metric`` -> mean; ``empty_target_action`` in neg/pos/skip/error.
 
 The reference groups with a Python dict loop (utilities/data.py:244-253, a
-known hot spot — SURVEY.md §3.6); here ``get_group_indexes`` sorts by query
-id and splits segments (O(N log N) on device), and per-group evaluation
-walks the segments host-side (exact-parity mode — data-dependent group
-sizes are inherently host work; the subclass kernels themselves are
-device ops).
+known hot spot — SURVEY.md §3.6). TPU-native compute path (SURVEY §7.5):
+the ragged per-query structure is packed once into static
+``[num_queries, max_docs]`` device buffers (sort + scatter on device), and the per-query
+kernel, empty-query policy, and final mean all run as ONE jitted vmapped
+call (functional/retrieval/padded.py). Subclasses declare their padded row
+kernel via ``_padded_metric``; user subclasses that only implement
+``_metric`` fall back to the host group loop (exact-parity mode).
 """
 from abc import ABC, abstractmethod
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.retrieval.padded import _padded_compute_fn, pack_queries
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
@@ -68,14 +71,53 @@ class RetrievalMetric(Metric, ABC):
         self.preds.append(preds)
         self.target.append(target)
 
+    #: padded per-query row kernel ``(preds, target, mask, k) -> value`` from
+    #: functional/retrieval/padded.py; None falls back to the host group loop
+    _padded_metric: Optional[Callable] = None
+    #: static top-k forwarded to the padded kernel (subclasses with a ``k`` arg
+    #: override via property)
+    _padded_k: Optional[int] = None
+
     def _group_empty(self, mini_target: Array) -> bool:
         """True if this query has no positive target (override to invert)."""
         return not bool(jnp.sum(mini_target))
+
+    def _empty_rows(self, padded_target: Array, mask: Array) -> Array:
+        """Vectorized ``_group_empty`` over the padded layout (override to invert)."""
+        return (padded_target * mask).sum(-1) == 0
 
     def _empty_error_message(self) -> str:
         return "`compute` method was provided with a query with no positive target."
 
     def _compute(self) -> Array:
+        if self._padded_metric is not None:
+            return self._compute_padded()
+        return self._compute_host_loop()
+
+    def _compute_padded(self) -> Array:
+        """Device-resident compute over the packed [num_queries, max_docs]
+        layout: pack (sort + scatter), per-query kernels, empty policy, and
+        mean all run on device; only two static-shape scalars (and the error
+        flag when ``empty_target_action='error'``) cross to the host."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        # heavily skewed query sizes make the [Q, Dmax] padding blow up (one
+        # 50k-doc query among 100k small ones -> ~billions of padded slots);
+        # past 16x expansion over the raw data the O(N) host loop wins
+        packed = pack_queries(indexes, preds, target, max_expand=16)
+        if packed is None:
+            return self._compute_host_loop()
+        padded_preds, padded_target, mask = packed
+        empty = self._empty_rows(padded_target, mask)
+        if self.empty_target_action == "error" and bool(jnp.any(empty)):
+            raise ValueError(self._empty_error_message())
+
+        run = _padded_compute_fn(type(self)._padded_metric, self._padded_k, self.empty_target_action)
+        return run(padded_preds, padded_target, mask, jnp.asarray(empty))
+
+    def _compute_host_loop(self) -> Array:
         indexes = dim_zero_cat(self.indexes)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)

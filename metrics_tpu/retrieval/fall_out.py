@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+from metrics_tpu.functional.retrieval.padded import fall_out_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 from metrics_tpu.utils.checks import _check_retrieval_k
 
@@ -17,6 +18,12 @@ Array = jax.Array
 
 class RetrievalFallOut(RetrievalMetric):
     """Mean fall-out@k over queries. Lower is better."""
+
+    _padded_metric = staticmethod(fall_out_row)
+
+    @property
+    def _padded_k(self):
+        return self.k
 
     higher_is_better = False
 
@@ -30,6 +37,10 @@ class RetrievalFallOut(RetrievalMetric):
         super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
         _check_retrieval_k(k)
         self.k = k
+
+    def _empty_rows(self, padded_target, mask):
+        # queries with no NEGATIVE target are "empty" for fall-out
+        return ((1.0 - padded_target) * mask).sum(-1) == 0
 
     def _group_empty(self, mini_target: Array) -> bool:
         # a query is degenerate when it has no NEGATIVE target

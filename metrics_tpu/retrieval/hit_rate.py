@@ -7,6 +7,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
+from metrics_tpu.functional.retrieval.padded import hit_rate_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 from metrics_tpu.utils.checks import _check_retrieval_k
 
@@ -15,6 +16,12 @@ Array = jax.Array
 
 class RetrievalHitRate(RetrievalMetric):
     """Mean hit rate@k over queries."""
+
+    _padded_metric = staticmethod(hit_rate_row)
+
+    @property
+    def _padded_k(self):
+        return self.k
 
     def __init__(
         self,

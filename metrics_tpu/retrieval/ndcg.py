@@ -8,6 +8,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.functional.retrieval.padded import ndcg_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 from metrics_tpu.utils.checks import _check_retrieval_k
 
@@ -16,6 +17,12 @@ Array = jax.Array
 
 class RetrievalNormalizedDCG(RetrievalMetric):
     """Mean nDCG@k over queries."""
+
+    _padded_metric = staticmethod(ndcg_row)
+
+    @property
+    def _padded_k(self):
+        return self.k
 
     def __init__(
         self,

@@ -7,6 +7,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.padded import precision_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 from metrics_tpu.utils.checks import _check_retrieval_k
 
@@ -15,6 +16,12 @@ Array = jax.Array
 
 class RetrievalPrecision(RetrievalMetric):
     """Mean precision@k over queries."""
+
+    _padded_metric = staticmethod(precision_row)
+
+    @property
+    def _padded_k(self):
+        return self.k
 
     def __init__(
         self,

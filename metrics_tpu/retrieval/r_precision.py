@@ -5,6 +5,7 @@ Behavior parity with /root/reference/torchmetrics/retrieval/r_precision.py:20-96
 import jax
 
 from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
+from metrics_tpu.functional.retrieval.padded import r_precision_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -12,6 +13,8 @@ Array = jax.Array
 
 class RetrievalRPrecision(RetrievalMetric):
     """Mean R-precision over queries."""
+
+    _padded_metric = staticmethod(r_precision_row)
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_r_precision(preds, target)

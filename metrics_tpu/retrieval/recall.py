@@ -7,6 +7,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.functional.retrieval.padded import recall_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 from metrics_tpu.utils.checks import _check_retrieval_k
 
@@ -15,6 +16,12 @@ Array = jax.Array
 
 class RetrievalRecall(RetrievalMetric):
     """Mean recall@k over queries."""
+
+    _padded_metric = staticmethod(recall_row)
+
+    @property
+    def _padded_k(self):
+        return self.k
 
     def __init__(
         self,

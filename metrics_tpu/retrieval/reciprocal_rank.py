@@ -5,6 +5,7 @@ Behavior parity with /root/reference/torchmetrics/retrieval/reciprocal_rank.py:2
 import jax
 
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from metrics_tpu.functional.retrieval.padded import reciprocal_rank_row
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -12,6 +13,8 @@ Array = jax.Array
 
 class RetrievalMRR(RetrievalMetric):
     """Mean reciprocal rank over queries."""
+
+    _padded_metric = staticmethod(reciprocal_rank_row)
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_reciprocal_rank(preds, target)

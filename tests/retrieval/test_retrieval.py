@@ -187,3 +187,102 @@ def test_functional_kernels():
     t0 = jnp.asarray([False, False, False])
     assert float(retrieval_average_precision(p, t0)) == 0.0
     assert float(retrieval_reciprocal_rank(p, t0)) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# padded single-jit compute path vs host group-loop (exact-parity fallback)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_args",
+    [
+        (RetrievalMAP, {}),
+        (RetrievalMRR, {}),
+        (RetrievalPrecision, {"k": 3}),
+        (RetrievalPrecision, {}),
+        (RetrievalRecall, {"k": 3}),
+        (RetrievalRecall, {}),
+        (RetrievalHitRate, {"k": 3}),
+        (RetrievalFallOut, {"k": 3}),
+        (RetrievalRPrecision, {}),
+        (RetrievalNormalizedDCG, {}),
+        (RetrievalNormalizedDCG, {"k": 4}),
+    ],
+)
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_padded_compute_equals_host_loop(metric_class, metric_args, action):
+    """The single-jit padded path must agree with the per-group host loop on
+    uneven group sizes, queries with no positives, and all-positive queries."""
+    rng = np.random.default_rng(5)
+    idx_list, preds_list, target_list = [], [], []
+    for q in range(30):
+        n = int(rng.integers(1, 13))
+        idx_list.append(np.full(n, q))
+        preds_list.append(rng.random(n).astype(np.float32))
+        if q % 7 == 0:
+            t = np.zeros(n)  # no positives: exercises empty action
+        elif q % 7 == 1:
+            t = np.ones(n)  # no negatives: exercises fall-out empty action
+        else:
+            t = rng.integers(0, 2, n)
+        target_list.append(t.astype(np.int32))
+    indexes = jnp.asarray(np.concatenate(idx_list))
+    preds = jnp.asarray(np.concatenate(preds_list))
+    target = jnp.asarray(np.concatenate(target_list))
+
+    m = metric_class(empty_target_action=action, **metric_args)
+    assert type(m)._padded_metric is not None  # library classes all have kernels
+    m.update(preds, target, indexes=indexes)
+    padded_val = np.asarray(m._compute())
+    host_val = np.asarray(m._compute_host_loop())
+    np.testing.assert_allclose(padded_val, host_val, atol=1e-6)
+
+
+def test_padded_graded_ndcg_equals_host_loop():
+    rng = np.random.default_rng(9)
+    n_per = [3, 8, 5, 12, 1]
+    indexes = jnp.asarray(np.concatenate([np.full(n, q) for q, n in enumerate(n_per)]))
+    preds = jnp.asarray(rng.random(sum(n_per)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 6, sum(n_per)).astype(np.int32))  # graded
+    m = RetrievalNormalizedDCG(k=4)
+    m.update(preds, target, indexes=indexes)
+    np.testing.assert_allclose(np.asarray(m._compute()), np.asarray(m._compute_host_loop()), atol=1e-6)
+
+
+def test_padded_error_action_raises():
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 0]), indexes=jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive"):
+        m.compute()
+
+
+def test_custom_subclass_falls_back_to_host_loop():
+    from metrics_tpu.retrieval.base import RetrievalMetric
+
+    class MyMetric(RetrievalMetric):
+        def _metric(self, preds, target):
+            return jnp.max(preds * target)
+
+    m = MyMetric()
+    assert m._padded_metric is None
+    m.update(jnp.asarray([0.2, 0.9]), jnp.asarray([1, 0]), indexes=jnp.asarray([0, 0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), 0.2, atol=1e-6)
+
+
+def test_skewed_groups_fall_back_to_host_loop():
+    """One huge query among many tiny ones must not densify into a huge pad."""
+    from metrics_tpu.functional.retrieval.padded import pack_queries
+
+    rng = np.random.default_rng(3)
+    # 200 single-doc queries + 1 query with 400 docs: Q*Dmax = 201*400 >> 16*600
+    idx = np.concatenate([np.arange(200), np.full(400, 200)])
+    n = len(idx)
+    preds = rng.random(n).astype(np.float32)
+    target = rng.integers(0, 2, n).astype(np.int32)
+
+    assert pack_queries(jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target), max_expand=16) is None
+
+    m = RetrievalMAP()
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(m._compute()), np.asarray(m._compute_host_loop()), atol=1e-6)
