@@ -1,0 +1,79 @@
+"""Fixed-capacity exact-mode support for the curve metric classes.
+
+TPU-native extension (no reference analog): passing ``capacity=N`` to
+AUROC / AveragePrecision / PrecisionRecallCurve / ROC switches the unbounded
+cat-list states to a static ``[N]`` buffer triple (preds, target, valid) so
+the ENTIRE metric — update, compute, sync — is jit-traceable and mesh-
+syncable (SURVEY §7 design-3; kernels in
+functional/classification/exact_curve.py). Binary mode only: inputs must be
+1-D scores and binary integer targets (the shape/dtype case deduction of the
+unbounded path is host logic).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.exact_curve import curve_buffer_init
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+try:  # jax.core.is_concrete moved across versions; checks has the shim
+    from metrics_tpu.utils.checks import _is_concrete
+except ImportError:  # pragma: no cover
+    def _is_concrete(*arrays):
+        return True
+
+
+class CapacityCurveMixin:
+    """Adds ``capacity`` handling. Call ``_init_capacity`` in ``__init__``
+    INSTEAD of registering the list states when capacity is not None; guard
+    ``_update``/``_compute`` with ``self._capacity is not None``."""
+
+    _capacity: Optional[int] = None
+
+    def _init_capacity(self, capacity: int) -> None:
+        if not (isinstance(capacity, int) and capacity > 0):
+            raise ValueError(f"Argument `capacity` must be a positive int, got {capacity}")
+        self._capacity = capacity
+        buf = curve_buffer_init(capacity)
+        self.add_state("preds", default=buf["preds"], dist_reduce_fx="cat")
+        self.add_state("target", default=buf["target"], dist_reduce_fx="cat")
+        self.add_state("valid", default=buf["valid"], dist_reduce_fx="cat")
+        # fixed-shape states + pure array ops: the whole metric traces under jit
+        self.__dict__["__jit_unsafe__"] = False
+
+    def _capacity_update(self, preds, target, pos_label=None) -> None:
+        preds = jnp.asarray(preds).reshape(-1)
+        target = jnp.asarray(target).reshape(-1)
+        if preds.shape != target.shape:
+            raise ValueError("preds and target must have the same shape in capacity mode")
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("preds must be float scores/probabilities in capacity mode")
+        if pos_label is not None:
+            # same binarization the unbounded path applies (target == pos_label)
+            target = (target == pos_label).astype(jnp.int32)
+        elif jnp.issubdtype(target.dtype, jnp.floating):
+            raise ValueError("target must be integer binary labels in capacity mode")
+        elif _is_concrete(target) and target.size and (
+            int(jnp.min(target)) < 0 or int(jnp.max(target)) > 1
+        ):
+            raise ValueError(
+                "target must be binary (0/1) in capacity mode; pass `pos_label` to"
+                " select the positive class"
+            )
+        count = jnp.sum(self.valid).astype(jnp.int32)
+        if _is_concrete(count) and int(count) + preds.shape[0] > self._capacity:
+            raise MetricsUserError(
+                f"Exact-curve capacity overflow: buffer holds {int(count)} of"
+                f" {self._capacity} samples and the batch adds {preds.shape[0]}."
+                " Construct the metric with a larger `capacity`."
+            )
+        idx = count + jnp.arange(preds.shape[0], dtype=jnp.int32)
+        self.preds = self.preds.at[idx].set(preds.astype(jnp.float32), mode="drop")
+        self.target = self.target.at[idx].set(target.astype(jnp.int32), mode="drop")
+        self.valid = self.valid.at[idx].set(True, mode="drop")
+
+    def _capacity_buffers(self):
+        """Flattened (preds, target, valid): after a distributed sync the
+        stacked ``(num_process, capacity)`` state (reference tensor-state sync
+        convention) flattens to the cross-rank union; locally it's a no-op."""
+        return self.preds.reshape(-1), self.target.reshape(-1), self.valid.reshape(-1)

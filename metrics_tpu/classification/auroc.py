@@ -7,8 +7,10 @@ from typing import Any, Optional
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.functional.classification.exact_curve import binary_auroc_fixed
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import AverageMethod
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -16,7 +18,7 @@ from metrics_tpu.utils.prints import rank_zero_warn
 Array = jax.Array
 
 
-class AUROC(Metric):
+class AUROC(CapacityCurveMixin, Metric):
     """Computes the Area Under the Receiver Operating Characteristic Curve.
 
     Example:
@@ -38,6 +40,7 @@ class AUROC(Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -56,15 +59,26 @@ class AUROC(Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode = None
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
+            if num_classes not in (None, 1):
+                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
+            if max_fpr is not None:
+                raise ValueError("`capacity` mode does not support `max_fpr`")
+            self._init_capacity(capacity)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-        rank_zero_warn(
-            "Metric `AUROC` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
-        )
+            rank_zero_warn(
+                "Metric `AUROC` will save all targets and predictions in buffer."
+                " For large datasets this may lead to large memory footprint."
+            )
 
     def _update(self, preds: Array, target: Array) -> None:
+        if self._capacity is not None:
+            self._capacity_update(preds, target, pos_label=self.pos_label)
+            return
         preds, target, mode = _auroc_update(preds, target)
         self.preds.append(preds)
         self.target.append(target)
@@ -77,6 +91,8 @@ class AUROC(Metric):
         self.mode = mode
 
     def _compute(self) -> Array:
+        if self._capacity is not None:
+            return binary_auroc_fixed(*self._capacity_buffers())
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
         preds = dim_zero_cat(self.preds)

@@ -6,7 +6,9 @@ from typing import Any, List, Optional, Union
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.exact_curve import binary_average_precision_fixed
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
@@ -16,7 +18,7 @@ from metrics_tpu.utils.data import dim_zero_cat
 Array = jax.Array
 
 
-class AveragePrecision(Metric):
+class AveragePrecision(CapacityCurveMixin, Metric):
     """Computes the average precision score.
 
     Example:
@@ -37,6 +39,7 @@ class AveragePrecision(Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -46,10 +49,19 @@ class AveragePrecision(Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
+            if num_classes not in (None, 1):
+                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
+            self._init_capacity(capacity)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def _update(self, preds: Array, target: Array) -> None:
+        if self._capacity is not None:
+            self._capacity_update(preds, target, pos_label=self.pos_label)
+            return
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )
@@ -59,6 +71,8 @@ class AveragePrecision(Metric):
         self.pos_label = pos_label
 
     def _compute(self) -> Union[Array, List[Array]]:
+        if self._capacity is not None:
+            return binary_average_precision_fixed(*self._capacity_buffers())
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)

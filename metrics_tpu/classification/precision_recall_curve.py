@@ -7,7 +7,9 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.exact_curve import binary_precision_recall_curve_fixed
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
@@ -17,7 +19,7 @@ from metrics_tpu.utils.data import dim_zero_cat
 Array = jax.Array
 
 
-class PrecisionRecallCurve(Metric):
+class PrecisionRecallCurve(CapacityCurveMixin, Metric):
     """Computes precision-recall pairs for different thresholds.
 
     Example:
@@ -37,15 +39,25 @@ class PrecisionRecallCurve(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
+            if num_classes not in (None, 1):
+                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
+            self._init_capacity(capacity)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def _update(self, preds: Array, target: Array) -> None:
+        if self._capacity is not None:
+            self._capacity_update(preds, target, pos_label=self.pos_label)
+            return
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
@@ -54,7 +66,18 @@ class PrecisionRecallCurve(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
-    def _compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    def _compute(
+        self,
+    ) -> Union[
+        Tuple[Array, Array, Array],
+        Tuple[List[Array], List[Array], List[Array]],
+        # capacity mode: (precision, recall, thresholds, point_mask, last_point)
+        Tuple[Array, Array, Array, Array, Array],
+    ]:
+        if self._capacity is not None:
+            # static-shape output: (precision, recall, thresholds, point_mask,
+            # last_point); see exact_curve.binary_precision_recall_curve_fixed
+            return binary_precision_recall_curve_fixed(*self._capacity_buffers())
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

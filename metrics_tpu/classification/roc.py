@@ -6,14 +6,16 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.exact_curve import binary_roc_fixed
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
-class ROC(Metric):
+class ROC(CapacityCurveMixin, Metric):
     """Computes the Receiver Operating Characteristic curve.
 
     Example:
@@ -33,22 +35,41 @@ class ROC(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
+            if num_classes not in (None, 1):
+                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
+            self._init_capacity(capacity)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def _update(self, preds: Array, target: Array) -> None:
+        if self._capacity is not None:
+            self._capacity_update(preds, target, pos_label=self.pos_label)
+            return
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
         self.preds.append(preds)
         self.target.append(target)
         self.num_classes = num_classes
         self.pos_label = pos_label
 
-    def _compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    def _compute(
+        self,
+    ) -> Union[
+        Tuple[Array, Array, Array],
+        Tuple[List[Array], List[Array], List[Array]],
+        Tuple[Array, Array, Array, Array],  # capacity mode: (fpr, tpr, thresholds, point_mask)
+    ]:
+        if self._capacity is not None:
+            # static-shape output: (fpr, tpr, thresholds, point_mask)
+            return binary_roc_fixed(*self._capacity_buffers())
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _roc_compute(preds, target, self.num_classes, self.pos_label)

@@ -1,0 +1,172 @@
+"""Exact curve metrics with STATIC shapes: fixed-capacity buffer + valid mask.
+
+The reference's exact curve family (AUROC/ROC/PRC/AveragePrecision) keeps
+unbounded cat-states and dedupes thresholds with data-dependent shapes
+(/root/reference/torchmetrics/functional/classification/
+precision_recall_curve.py:23-62), which cannot trace under jit. This module
+is the SURVEY §7 design-3 alternative: a user-declared capacity buffer with a
+validity mask, and curve kernels whose outputs are static-shape.
+
+The tie/dedup problem is solved without dynamic shapes: after sorting by
+descending score, each position gathers the cumulative tp/fp values at the
+END of its equal-score run (reverse-cummin of run boundaries). Consecutive
+positions inside a run then carry identical curve points, so trapezoidal
+integration and the step-wise AP sum are EXACTLY the deduped values — ties
+included — while every array stays ``[capacity]``.
+
+Everything here is jit-traceable, vmap-able, and mesh-syncable: the buffer
+triple (preds, target, valid) composes with ``lax.all_gather`` by simple
+concatenation along the buffer axis.
+"""
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# fixed-capacity buffer
+# ---------------------------------------------------------------------------
+
+
+def curve_buffer_init(capacity: int) -> Dict[str, Array]:
+    """Fresh (preds, target, valid) buffer state."""
+    return {
+        "preds": jnp.zeros((capacity,), jnp.float32),
+        "target": jnp.zeros((capacity,), jnp.int32),
+        "valid": jnp.zeros((capacity,), bool),
+    }
+
+
+def curve_buffer_update(state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
+    """Append a batch at the current fill level (jit-safe).
+
+    Writes past capacity are dropped silently under jit (XLA scatter
+    ``mode='drop'``); the stateful wrapper raises eagerly on overflow.
+    """
+    count = jnp.sum(state["valid"]).astype(jnp.int32)
+    idx = count + jnp.arange(preds.shape[0], dtype=jnp.int32)
+    return {
+        "preds": state["preds"].at[idx].set(preds.astype(jnp.float32), mode="drop"),
+        "target": state["target"].at[idx].set(target.astype(jnp.int32), mode="drop"),
+        "valid": state["valid"].at[idx].set(True, mode="drop"),
+    }
+
+
+def curve_buffer_merge(*states: Dict[str, Array]) -> Dict[str, Array]:
+    """Concatenate buffers (e.g. per-rank shards after an all_gather)."""
+    return {
+        "preds": jnp.concatenate([s["preds"] for s in states]),
+        "target": jnp.concatenate([s["target"] for s in states]),
+        "valid": jnp.concatenate([s["valid"] for s in states]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# masked static-shape curve kernels
+# ---------------------------------------------------------------------------
+
+
+def _masked_sorted_cumulants(
+    preds: Array, target: Array, valid: Array
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Sort by descending score (invalid last) and return run-end cumulants.
+
+    Returns ``(sorted_key, sorted_valid, tps, fps, run_end)`` where ``tps``/
+    ``fps`` are cumulative counts and ``run_end[i]`` is the index of the last
+    position sharing ``sorted_key[i]`` — the threshold point that position
+    belongs to.
+    """
+    key = jnp.where(valid, preds.astype(jnp.float32), -jnp.inf)
+    order = jnp.argsort(-key, stable=True)
+    sorted_key = key[order]
+    sorted_tgt = jnp.where(valid, target, 0)[order].astype(jnp.float32)
+    sorted_valid = valid[order]
+
+    tps = jnp.cumsum(sorted_tgt)
+    fps = jnp.cumsum((1.0 - sorted_tgt) * sorted_valid)
+
+    n = sorted_key.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_run_last = jnp.concatenate([sorted_key[1:] != sorted_key[:-1], jnp.ones(1, bool)])
+    run_end = jax.lax.cummin(jnp.where(is_run_last, idx, n - 1)[::-1])[::-1]
+    return sorted_key, sorted_valid, tps, fps, run_end
+
+
+def binary_average_precision_fixed(preds: Array, target: Array, valid: Array) -> Array:
+    """Exact binary average precision over the valid entries (jit-safe).
+
+    Matches the reference AP (step-wise sum over deduped thresholds,
+    functional/classification/average_precision.py): every positive
+    contributes the precision at the END of its tie run. NaN when there are
+    no positive targets (reference 0/0 semantics).
+    """
+    _, sorted_valid, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    total_pos = tps[-1]
+    precision = tps / jnp.clip(tps + fps, 1.0, None)
+    contributions = jnp.diff(tps, prepend=0.0) * precision[run_end] * sorted_valid
+    return jnp.where(total_pos > 0, jnp.sum(contributions) / jnp.clip(total_pos, 1.0, None), jnp.nan)
+
+
+def binary_auroc_fixed(preds: Array, target: Array, valid: Array) -> Array:
+    """Exact binary AUROC over the valid entries (jit-safe, tie-exact).
+
+    Trapezoidal area over run-end ROC points: positions inside a tie run
+    carry identical (fpr, tpr), so their segments contribute zero width and
+    the result equals the deduped-threshold integral. NaN when either class
+    is absent.
+    """
+    _, _, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    total_pos, total_neg = tps[-1], fps[-1]
+    tpr = tps[run_end] / jnp.clip(total_pos, 1.0, None)
+    fpr = fps[run_end] / jnp.clip(total_neg, 1.0, None)
+    first = 0.5 * tpr[0] * fpr[0]  # segment from the implicit (0, 0) point
+    rest = jnp.sum(0.5 * (tpr[1:] + tpr[:-1]) * (fpr[1:] - fpr[:-1]))
+    return jnp.where((total_pos > 0) & (total_neg > 0), first + rest, jnp.nan)
+
+
+def binary_roc_fixed(
+    preds: Array, target: Array, valid: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Static-shape ROC: ``(fpr, tpr, thresholds, point_mask)``, each
+    ``[capacity + 1]``.
+
+    Valid points (where ``point_mask``) reproduce the reference ROC exactly:
+    the leading point is the prepended (0, 0) at ``thresholds[0] + 1``
+    (reference functional/classification/roc.py), then one point per distinct
+    threshold in descending-score order. Padded slots repeat the final point.
+    """
+    sorted_key, sorted_valid, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    total_pos, total_neg = tps[-1], fps[-1]
+    idx = jnp.arange(sorted_key.shape[0])
+    is_threshold = (run_end == idx) & sorted_valid
+
+    tpr = jnp.concatenate([jnp.zeros(1), tps / jnp.clip(total_pos, 1.0, None)])
+    fpr = jnp.concatenate([jnp.zeros(1), fps / jnp.clip(total_neg, 1.0, None)])
+    thresholds = jnp.concatenate([sorted_key[:1] + 1.0, sorted_key])
+    point_mask = jnp.concatenate([jnp.any(valid)[None], is_threshold])
+    return fpr, tpr, thresholds, point_mask
+
+
+def binary_precision_recall_curve_fixed(
+    preds: Array, target: Array, valid: Array
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Static-shape PRC: ``(precision, recall, thresholds, point_mask,
+    last_point)``, arrays ``[capacity]`` plus the appended reference endpoint.
+
+    Valid points in descending-score order; the reference output
+    (functional/classification/precision_recall_curve.py:150-176) is these
+    points REVERSED with ``(precision=1, recall=0)`` appended — returned
+    separately as ``last_point`` so the caller keeps static shapes.
+    """
+    sorted_key, sorted_valid, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    total_pos = tps[-1]
+    idx = jnp.arange(sorted_key.shape[0])
+    is_threshold = (run_end == idx) & sorted_valid
+
+    precision = tps / jnp.clip(tps + fps, 1.0, None)
+    recall = jnp.where(total_pos > 0, tps / jnp.clip(total_pos, 1.0, None), jnp.nan)
+    last_point = jnp.asarray([1.0, 0.0])
+    return precision, recall, sorted_key, is_threshold, last_point

@@ -1,0 +1,281 @@
+"""Exact-mode curves with static capacity (SURVEY §7 design-3).
+
+Verifies 1e-6 sklearn parity for exact AUROC / AveragePrecision / ROC / PRC
+computed entirely INSIDE one jit (fixed-capacity buffer + valid mask, no
+data-dependent shapes), including tied scores, and distributed accumulation
+over the 8-virtual-device mesh via all_gather of the buffer triple.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    average_precision_score,
+    precision_recall_curve as sk_prc,
+    roc_auc_score,
+    roc_curve as sk_roc,
+)
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.functional.classification.exact_curve import (
+    binary_auroc_fixed,
+    binary_average_precision_fixed,
+    binary_precision_recall_curve_fixed,
+    binary_roc_fixed,
+    curve_buffer_init,
+    curve_buffer_merge,
+    curve_buffer_update,
+)
+
+CAPACITY = 512
+
+
+def _data(seed, n, ties=False):
+    rng = np.random.default_rng(seed)
+    preds = rng.random(n).astype(np.float32)
+    if ties:
+        preds = np.round(preds * 10) / 10  # heavy ties
+    target = (rng.random(n) < 0.4).astype(np.int32)
+    if target.sum() == 0:
+        target[0] = 1
+    if target.sum() == n:
+        target[0] = 0
+    return preds, target
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("ties", [False, True])
+def test_auroc_ap_inside_one_jit(seed, ties):
+    preds, target = _data(seed, 300, ties)
+
+    @jax.jit
+    def run(preds, target):
+        state = curve_buffer_init(CAPACITY)
+        # three uneven batches through the jit-safe buffer
+        state = curve_buffer_update(state, preds[:100], target[:100])
+        state = curve_buffer_update(state, preds[100:250], target[100:250])
+        state = curve_buffer_update(state, preds[250:], target[250:])
+        auroc = binary_auroc_fixed(state["preds"], state["target"], state["valid"])
+        ap = binary_average_precision_fixed(state["preds"], state["target"], state["valid"])
+        return auroc, ap
+
+    auroc, ap = run(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(auroc), roc_auc_score(target, preds), atol=1e-6)
+    np.testing.assert_allclose(float(ap), average_precision_score(target, preds), atol=1e-6)
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_roc_curve_points_match_sklearn(ties):
+    preds, target = _data(5, 200, ties)
+
+    @jax.jit
+    def run(preds, target):
+        state = curve_buffer_init(CAPACITY)
+        state = curve_buffer_update(state, preds, target)
+        return binary_roc_fixed(state["preds"], state["target"], state["valid"])
+
+    fpr, tpr, thr, mask = (np.asarray(v) for v in run(jnp.asarray(preds), jnp.asarray(target)))
+    got_fpr, got_tpr, got_thr = fpr[mask], tpr[mask], thr[mask]
+
+    # sklearn drops collinear points (drop_intermediate); compare on the
+    # union convention instead: every sklearn point must appear in ours, and
+    # trapz areas must agree exactly.
+    sk_fpr, sk_tpr, sk_thr = sk_roc(target, preds, drop_intermediate=False)
+    np.testing.assert_allclose(got_fpr, sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(got_tpr, sk_tpr, atol=1e-6)
+    np.testing.assert_allclose(got_thr[1:], sk_thr[1:], atol=1e-6)  # [0] is the +1 sentinel
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_prc_points_match_sklearn(ties):
+    preds, target = _data(6, 200, ties)
+
+    @jax.jit
+    def run(preds, target):
+        state = curve_buffer_init(CAPACITY)
+        state = curve_buffer_update(state, preds, target)
+        return binary_precision_recall_curve_fixed(state["preds"], state["target"], state["valid"])
+
+    precision, recall, thr, mask, last = (
+        np.asarray(v) for v in run(jnp.asarray(preds), jnp.asarray(target))
+    )
+    # reference order: reversed valid points, then the appended (1, 0)
+    got_prec = np.concatenate([precision[mask][::-1], [last[0]]])
+    got_rec = np.concatenate([recall[mask][::-1], [last[1]]])
+    sk_prec, sk_rec, sk_thr = sk_prc(target, preds)
+    np.testing.assert_allclose(got_prec, sk_prec, atol=1e-6)
+    np.testing.assert_allclose(got_rec, sk_rec, atol=1e-6)
+    np.testing.assert_allclose(thr[mask][::-1], sk_thr, atol=1e-6)
+
+
+def test_buffer_capacity_drop_and_merge():
+    preds, target = _data(7, 64)
+    state = curve_buffer_init(32)
+    state = curve_buffer_update(state, jnp.asarray(preds), jnp.asarray(target))
+    assert int(jnp.sum(state["valid"])) == 32  # overflow dropped, not wrapped
+
+    a = curve_buffer_init(32)
+    a = curve_buffer_update(a, jnp.asarray(preds[:20]), jnp.asarray(target[:20]))
+    b = curve_buffer_init(32)
+    b = curve_buffer_update(b, jnp.asarray(preds[20:40]), jnp.asarray(target[20:40]))
+    merged = curve_buffer_merge(a, b)
+    auroc = binary_auroc_fixed(merged["preds"], merged["target"], merged["valid"])
+    np.testing.assert_allclose(float(auroc), roc_auc_score(target[:40], preds[:40]), atol=1e-6)
+
+
+def test_exact_curves_sync_over_mesh():
+    """Each of 8 devices accumulates a shard; one in-jit all_gather of the
+    buffer triple reproduces the global sklearn AUROC/AP on every device."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rank",))
+    preds, target = _data(8, 8 * 64)
+
+    local_cap = 96  # > 64 so padding participates in the gather
+
+    def step(p, t):
+        state = curve_buffer_init(local_cap)
+        state = curve_buffer_update(state, p[0], t[0])
+        gathered = {
+            k: jax.lax.all_gather(v, "rank").reshape(-1) for k, v in state.items()
+        }
+        auroc = binary_auroc_fixed(gathered["preds"], gathered["target"], gathered["valid"])
+        ap = binary_average_precision_fixed(gathered["preds"], gathered["target"], gathered["valid"])
+        return auroc[None], ap[None]
+
+    auroc, ap = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P("rank"), P("rank")),
+            out_specs=(P("rank"), P("rank")),
+        )
+    )(jnp.asarray(preds).reshape(8, 64), jnp.asarray(target).reshape(8, 64))
+
+    expected_auroc = roc_auc_score(target, preds)
+    expected_ap = average_precision_score(target, preds)
+    np.testing.assert_allclose(np.asarray(auroc), expected_auroc, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ap), expected_ap, atol=1e-6)
+
+
+def test_degenerate_single_class_is_nan():
+    state = curve_buffer_init(16)
+    state = curve_buffer_update(state, jnp.asarray([0.1, 0.8]), jnp.asarray([1, 1]))
+    assert np.isnan(float(binary_auroc_fixed(state["preds"], state["target"], state["valid"])))
+    state = curve_buffer_init(16)
+    state = curve_buffer_update(state, jnp.asarray([0.1, 0.8]), jnp.asarray([0, 0]))
+    assert np.isnan(
+        float(binary_average_precision_fixed(state["preds"], state["target"], state["valid"]))
+    )
+
+
+# ---------------------------------------------------------------------------
+# modular classes in capacity mode
+# ---------------------------------------------------------------------------
+
+
+def test_auroc_class_capacity_mode_jit_safe():
+    from metrics_tpu import AUROC
+
+    preds, target = _data(10, 128)
+    m = AUROC(capacity=256)
+    assert not m.__jit_unsafe__
+
+    @jax.jit
+    def run(p, t):
+        state = m.init_state()
+        state = m.update_state(state, p[:64], t[:64])
+        state = m.update_state(state, p[64:], t[64:])
+        return m.compute_state(state)
+
+    got = float(run(jnp.asarray(preds), jnp.asarray(target)))
+    np.testing.assert_allclose(got, roc_auc_score(target, preds), atol=1e-6)
+
+    # eager lifecycle too
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), roc_auc_score(target, preds), atol=1e-6)
+    m.reset()
+    assert int(jnp.sum(m.valid)) == 0
+
+
+def test_average_precision_class_capacity_mode():
+    from metrics_tpu import AveragePrecision
+
+    preds, target = _data(11, 100)
+    m = AveragePrecision(capacity=128)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), average_precision_score(target, preds), atol=1e-6)
+
+
+def test_roc_prc_class_capacity_mode():
+    from metrics_tpu import ROC, PrecisionRecallCurve
+
+    preds, target = _data(12, 80, ties=True)
+    roc = ROC(capacity=128)
+    roc.update(jnp.asarray(preds), jnp.asarray(target))
+    fpr, tpr, thr, mask = (np.asarray(v) for v in roc.compute())
+    sk_fpr, sk_tpr, _ = sk_roc(target, preds, drop_intermediate=False)
+    np.testing.assert_allclose(fpr[mask], sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(tpr[mask], sk_tpr, atol=1e-6)
+
+    prc = PrecisionRecallCurve(capacity=128)
+    prc.update(jnp.asarray(preds), jnp.asarray(target))
+    precision, recall, thr, mask, last = (np.asarray(v) for v in prc.compute())
+    sk_prec, sk_rec, _ = sk_prc(target, preds)
+    np.testing.assert_allclose(np.concatenate([precision[mask][::-1], [last[0]]]), sk_prec, atol=1e-6)
+    np.testing.assert_allclose(np.concatenate([recall[mask][::-1], [last[1]]]), sk_rec, atol=1e-6)
+
+
+def test_capacity_overflow_raises_eagerly():
+    from metrics_tpu import AUROC
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    m = AUROC(capacity=8)
+    m.update(jnp.asarray(np.random.rand(6)), jnp.asarray([0, 1, 0, 1, 0, 1]))
+    with pytest.raises(MetricsUserError, match="capacity overflow"):
+        m.update(jnp.asarray(np.random.rand(6)), jnp.asarray([0, 1, 0, 1, 0, 1]))
+
+
+def test_capacity_mode_rejects_multiclass_and_max_fpr():
+    from metrics_tpu import AUROC
+
+    with pytest.raises(ValueError, match="binary"):
+        AUROC(num_classes=5, capacity=64)
+    with pytest.raises(ValueError, match="max_fpr"):
+        AUROC(max_fpr=0.5, capacity=64)
+
+
+def test_capacity_mode_ddp_sync():
+    """cat-sync of the buffer triple across 2 simulated ranks."""
+    from metrics_tpu import AUROC
+
+    preds, target = _data(13, 64)
+    m_other = AUROC(capacity=64)
+    m_other.update(jnp.asarray(preds[32:]), jnp.asarray(target[32:]))
+    other_states = iter([m_other.preds, m_other.target, m_other.valid])
+
+    m = AUROC(capacity=64, dist_sync_fn=lambda x, group=None: [x, next(other_states)])
+    m.update(jnp.asarray(preds[:32]), jnp.asarray(target[:32]))
+    got = float(m.compute())
+    np.testing.assert_allclose(got, roc_auc_score(target, preds), atol=1e-6)
+
+
+def test_capacity_mode_pos_label_and_validation():
+    from metrics_tpu import AUROC
+
+    preds, target = _data(14, 64)
+    # pos_label=0: class 0 treated as positive, parity with the unbounded path
+    m = AUROC(capacity=128, pos_label=0)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), roc_auc_score(1 - target, preds), atol=1e-6)
+
+    with pytest.raises(ValueError, match="binary"):
+        bad = AUROC(capacity=64)
+        bad.update(jnp.asarray(preds[:4]), jnp.asarray([0, 1, 2, 1]))
+    with pytest.raises(ValueError, match="integer"):
+        bad = AUROC(capacity=64)
+        bad.update(jnp.asarray(preds[:4]), jnp.asarray([0.0, 1.0, 0.0, 1.0]))
+    with pytest.raises(ValueError, match="float"):
+        bad = AUROC(capacity=64)
+        bad.update(jnp.asarray([1, 0, 1, 0]), jnp.asarray([0, 1, 0, 1]))
