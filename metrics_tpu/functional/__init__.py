@@ -64,9 +64,13 @@ from metrics_tpu.functional.audio import (  # noqa: F401
 from metrics_tpu.functional.text import (  # noqa: F401
     bleu_score,
     char_error_rate,
+    chrf_score,
+    extended_edit_distance,
     match_error_rate,
     rouge_score,
     sacre_bleu_score,
+    squad,
+    translation_edit_rate,
     word_error_rate,
     word_information_lost,
     word_information_preserved,

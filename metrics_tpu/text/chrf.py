@@ -1,0 +1,98 @@
+"""Modular CHRFScore.
+
+Behavior parity with /root/reference/torchmetrics/text/chrf.py:46-208 (which
+registers one scalar state per n-gram order so corpus statistics sum across
+ranks; here the per-order scalars are kept in the same layout).
+"""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.chrf import (
+    _chrf_score_compute,
+    _chrf_score_update,
+    _validate_chrf_args,
+    _zero_totals,
+)
+
+Array = jax.Array
+
+_TOTAL_NAMES = ("pred_char", "pred_word", "tgt_char", "tgt_word", "match_char", "match_word")
+
+
+class CHRFScore(Metric):
+    """Corpus chrF/chrF++ with per-order accumulator states.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> metric = CHRFScore()
+        >>> float(metric(preds, target))  # doctest: +ELLIPSIS
+        0.8640...
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_chrf_args(n_char_order, n_word_order, beta)
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+
+        # one scalar state per (accumulator, n-gram order): sums across ranks
+        for name, orders in zip(_TOTAL_NAMES, _zero_totals(n_char_order, n_word_order)):
+            for n in orders:
+                self.add_state(f"total_{name}_{n}grams", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
+
+    def _totals(self):
+        out = []
+        for name, orders in zip(_TOTAL_NAMES, _zero_totals(self.n_char_order, self.n_word_order)):
+            out.append({n: float(getattr(self, f"total_{name}_{n}grams")) for n in orders})
+        return tuple(out)
+
+    def _store_totals(self, totals) -> None:
+        for name, orders in zip(_TOTAL_NAMES, totals):
+            for n, value in orders.items():
+                setattr(self, f"total_{name}_{n}grams", jnp.asarray(value, jnp.float32))
+
+    def _update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        totals, sentence_scores = _chrf_score_update(
+            preds,
+            target,
+            self._totals(),
+            self.n_char_order,
+            self.n_word_order,
+            self.n_order,
+            self.beta,
+            self.lowercase,
+            self.whitespace,
+        )
+        self._store_totals(totals)
+        if self.return_sentence_level_score:
+            self.sentence_chrf_score.extend(jnp.asarray(s, jnp.float32)[None] for s in sentence_scores)
+
+    def _compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _chrf_score_compute(self._totals(), self.n_order, self.beta)
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate(self.sentence_chrf_score)
+        return score

@@ -1,0 +1,74 @@
+"""Modular ExtendedEditDistance.
+
+Behavior parity with /root/reference/torchmetrics/text/eed.py:24-131 (list
+state of sentence scores, gathered across ranks and averaged).
+"""
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """Corpus Extended Edit Distance (average of sentence-level scores).
+
+    Example:
+        >>> preds = ["this is the prediction", "here is an other sample"]
+        >>> target = ["this is the reference", "here is another one"]
+        >>> metric = ExtendedEditDistance()
+        >>> float(metric(preds, target))  # doctest: +ELLIPSIS
+        0.3078...
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param_name, param in zip(["alpha", "rho", "deletion", "insertion"], [alpha, rho, deletion, insertion]):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def _update(
+        self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> None:
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion
+        )
+        self.sentence_eed.extend(jnp.asarray(s, jnp.float32)[None] for s in scores)
+
+    def _compute(self) -> Union[Array, Tuple[Array, Array]]:
+        if not self.sentence_eed:
+            average = jnp.asarray(0.0, jnp.float32)
+            scores = jnp.zeros((0,), jnp.float32)
+        else:
+            scores = jnp.concatenate(self.sentence_eed)
+            average = jnp.mean(scores)
+        if self.return_sentence_level_score:
+            return average, scores
+        return average

@@ -1,0 +1,51 @@
+"""Modular SQuAD metric.
+
+Behavior parity with /root/reference/torchmetrics/text/squad.py:29-151.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.squad import (
+    PREDS_TYPE,
+    TARGETS_TYPE,
+    _squad_compute,
+    _squad_input_check,
+    _squad_update,
+)
+
+Array = jax.Array
+
+
+class SQuAD(Metric):
+    """SQuAD v1 exact-match + token-F1 over accumulated question/answer pairs.
+
+    Example:
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> metric = SQuAD()
+        >>> {k: float(v) for k, v in metric(preds, target).items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    __jit_unsafe__ = True  # update consumes Python dicts of strings
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("exact_match", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def _update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
+        preds_dict, target_dict = _squad_input_check(preds, target)
+        f1_score, exact_match, total = _squad_update(preds_dict, target_dict)
+        self.f1_score = self.f1_score + f1_score
+        self.exact_match = self.exact_match + exact_match
+        self.total = self.total + total
+
+    def _compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)

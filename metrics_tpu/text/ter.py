@@ -1,0 +1,69 @@
+"""Modular TranslationEditRate.
+
+Behavior parity with /root/reference/torchmetrics/text/ter.py:24-146.
+"""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    """Corpus Translation Edit Rate with Tercom shift search.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> metric = TranslationEditRate()
+        >>> float(metric(preds, target))  # doctest: +ELLIPSIS
+        0.1538461...
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        for name, value in [
+            ("normalize", normalize),
+            ("no_punctuation", no_punctuation),
+            ("lowercase", lowercase),
+            ("asian_support", asian_support),
+        ]:
+            if not isinstance(value, bool):
+                raise ValueError(f"Expected argument `{name}` to be of type boolean but got {value}.")
+
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def _update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        num_edits, tgt_length, sentence_ter = _ter_update(preds, target, self.tokenizer)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_len = self.total_tgt_len + tgt_length
+        if self.return_sentence_level_score:
+            self.sentence_ter.extend(jnp.asarray(s, jnp.float32)[None] for s in sentence_ter)
+
+    def _compute(self) -> Union[Array, Tuple[Array, List[Array]]]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_len)
+        if self.return_sentence_level_score:
+            return score, self.sentence_ter
+        return score
