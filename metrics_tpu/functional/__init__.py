@@ -62,6 +62,7 @@ from metrics_tpu.functional.audio import (  # noqa: F401
     signal_noise_ratio,
 )
 from metrics_tpu.functional.text import (  # noqa: F401
+    bert_score,
     bleu_score,
     char_error_rate,
     chrf_score,

@@ -1,0 +1,264 @@
+"""BERTScore (contextual-embedding cosine matching).
+
+Behavior parity with /root/reference/torchmetrics/functional/text/bert.py:40-680:
+tokenize, embed with a (HF) encoder, L2-normalize, zero out [CLS]/[SEP] via
+the processed attention mask, greedy cosine matching (row/column max),
+IDF weighting computed on the TARGET corpus, optional all-layers output and
+baseline rescaling.
+
+TPU-native departures:
+- the encoder is a **Flax** transformers model (or any user callable
+  ``(input_ids, attention_mask) -> [batch, seq, dim]`` jnp array) and the
+  similarity/matching math is jnp under jit;
+- batches keep ONE static padded length (the reference sorts by length and
+  re-trims every batch — dynamic shapes that would retrace under XLA; the
+  attention mask makes the results identical). Scores are returned in INPUT
+  order (the reference returns them in length-sorted order as a side effect
+  of its dataloader);
+- no network: ``model_name_or_path`` must be a local path, and baselines
+  load from ``baseline_path`` only.
+"""
+import csv
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: Array) -> Array:
+    """Zero the [CLS] (first) and [SEP] (last attended) positions."""
+    attention_mask = attention_mask.at[:, 0].set(0)
+    sep_pos = jnp.argmax(jnp.cumsum(attention_mask - 0.1, axis=-1), axis=-1)
+    return attention_mask.at[jnp.arange(attention_mask.shape[0]), sep_pos].set(0)
+
+
+def _tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """log((N+1)/(df+1)) inverse document frequencies over a corpus
+    (reference bert.py:189-206); unseen tokens default to log(N+1)."""
+    num_sentences = len(input_ids)
+    counter: Counter = Counter()
+    for ids in input_ids:
+        # the reference deliberately counts ALL input_ids incl. padding
+        # (bert.py:209-211), so the pad token gets df = num_sentences
+        counter.update(set(ids.tolist()))
+    idf = {idx: math.log((num_sentences + 1) / (df + 1)) for idx, df in counter.items()}
+    default = math.log(num_sentences + 1)
+    return {"__default__": default, **idf}
+
+
+def _idf_matrix(input_ids: np.ndarray, idf: Dict[int, float]) -> np.ndarray:
+    default = idf["__default__"]
+    lookup = np.vectorize(lambda t: idf.get(int(t), default))
+    return lookup(input_ids).astype(np.float32)
+
+
+def _default_forward(model: Any, num_layers: Optional[int], all_layers: bool) -> Callable:
+    """Forward through a Flax transformers model, selecting hidden layers."""
+
+    def forward(input_ids: Array, attention_mask: Array) -> Array:
+        out = model(input_ids=input_ids, attention_mask=attention_mask, output_hidden_states=True)
+        hidden = out.hidden_states
+        if all_layers:
+            return jnp.stack(hidden, axis=1)  # [B, L, S, D]
+        layer = hidden[num_layers if num_layers is not None else -1]
+        return layer[:, None]  # [B, 1, S, D]
+
+    return forward
+
+
+def _embed_corpus(
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    forward: Callable,
+    batch_size: int,
+    idf_weights: Optional[np.ndarray],
+) -> Tuple[Array, Array]:
+    """Normalized, special-token-masked embeddings + per-token weight scale."""
+    embeddings = []
+    scales = []
+    for lo in range(0, len(input_ids), batch_size):
+        ids = jnp.asarray(input_ids[lo : lo + batch_size])
+        mask = jnp.asarray(attention_mask[lo : lo + batch_size])
+        out = forward(ids, mask)
+        if out.ndim == 3:  # user forward fn returns [B, S, D]
+            if out.shape[:2] != ids.shape[:2]:
+                raise ValueError(
+                    "The model output must be of shape [batch_size, seq_len, model_dim],"
+                    f" i.e. [{ids.shape[0]}, {ids.shape[1]}, model_dim], but got {out.shape}."
+                )
+            out = out[:, None]
+        out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-30, None)
+        processed_mask = _process_attention_mask_for_special_tokens(mask)
+        out = jnp.einsum("blsd,bs->blsd", out, processed_mask.astype(out.dtype))
+        embeddings.append(out)
+
+        if idf_weights is not None:
+            scale = jnp.asarray(idf_weights[lo : lo + batch_size]) * processed_mask
+        else:
+            scale = processed_mask.astype(out.dtype)
+        scale = scale / jnp.clip(scale.sum(-1, keepdims=True), 1e-30, None)
+        scales.append(scale)
+    return jnp.concatenate(embeddings), jnp.concatenate(scales)
+
+
+@jax.jit
+def _greedy_cosine_scores(
+    preds_embeddings: Array, target_embeddings: Array, preds_scale: Array, target_scale: Array
+) -> Tuple[Array, Array, Array]:
+    """Greedy matching: precision = row max, recall = column max, weighted."""
+    cos_sim = jnp.einsum("blpd,blrd->blpr", preds_embeddings, target_embeddings)
+    precision = jnp.einsum("bls,bs->bl", cos_sim.max(axis=3), preds_scale)
+    recall = jnp.einsum("bls,bs->bl", cos_sim.max(axis=2), target_scale)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
+    # [B, L] -> [L, B] to match the original BERTScore layout, squeezed below
+    return precision.T, recall.T, f1.T
+
+
+def _read_baseline_csv(baseline_path: str) -> np.ndarray:
+    with open(baseline_path) as handle:
+        rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(handle)) if idx > 0]
+    return np.asarray(rows, np.float32)[:, 1:]
+
+
+def _rescale_with_baseline(
+    precision: Array, recall: Array, f1: Array, baseline: np.ndarray, num_layers: Optional[int], all_layers: bool
+) -> Tuple[Array, Array, Array]:
+    if num_layers is None and not all_layers:
+        num_layers = -1
+    stacked = jnp.stack([precision, recall, f1], axis=-1)
+    scale = jnp.asarray(baseline)[:, None] if all_layers else jnp.asarray(baseline)[num_layers]
+    stacked = (stacked - scale) / (1 - scale)
+    return stacked[..., 0], stacked[..., 1], stacked[..., 2]
+
+
+def _tokenize(texts: List[str], tokenizer: Any, max_length: int, own_tokenizer: bool) -> Dict[str, np.ndarray]:
+    """HF-style tokenizers are called with padding/truncation kwargs (the
+    reference does the same even for user tokenizers, bert.py:72-75); plain
+    ``(texts, max_length)`` callables are supported as a fallback."""
+    if not own_tokenizer:
+        encoded = tokenizer(texts, padding=True, max_length=max_length, truncation=True, return_tensors="np")
+    else:
+        try:
+            encoded = tokenizer(texts, padding=True, max_length=max_length, truncation=True, return_tensors="np")
+        except TypeError:
+            try:
+                encoded = tokenizer(texts, max_length)
+            except BaseException as ex:  # reference bert.py:77-80
+                raise BaseException(f"Tokenization was not successful: {ex}")
+    return {
+        "input_ids": np.asarray(encoded["input_ids"]),
+        "attention_mask": np.asarray(encoded["attention_mask"]),
+    }
+
+
+def bert_score(
+    preds: Union[List[str], Dict[str, Any]],
+    target: Union[List[str], Dict[str, Any]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    idf: bool = False,
+    max_length: int = 512,
+    batch_size: int = 64,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    **_ignored: Any,
+) -> Dict[str, Union[List[float], str]]:
+    """BERTScore precision/recall/F1 per sentence pair.
+
+    ``model`` may be a Flax transformers model or any callable
+    ``(input_ids, attention_mask) -> [batch, seq, dim]``; with
+    ``model_name_or_path`` a LOCAL transformers checkpoint is loaded
+    (this environment has no network; the reference defaults to downloading
+    roberta-large).
+    """
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    empty_lists = all(isinstance(t, list) and len(t) == 0 for t in (preds, target))
+    if empty_lists:
+        output: Dict[str, Union[List[float], str]] = {"precision": [0.0], "recall": [0.0], "f1": [0.0]}
+        if return_hash:
+            output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+        return output
+
+    tokenizer = user_tokenizer
+    if model is None:
+        if model_name_or_path is None:
+            raise ValueError(
+                "`bert_score` needs either a `model` callable or a LOCAL `model_name_or_path`"
+                " transformers checkpoint — this environment cannot download the default model."
+            )
+        from transformers import AutoTokenizer, FlaxAutoModel
+
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    elif user_forward_fn is None and not callable(getattr(model, "__call__", None)):
+        raise ValueError("`model` must be callable or `user_forward_fn` must be provided.")
+
+    valid_lists = all(isinstance(t, list) and len(t) > 0 and isinstance(t[0], str) for t in (preds, target))
+    if valid_lists:
+        if tokenizer is None:
+            raise ValueError("A tokenizer is required for string inputs (pass `user_tokenizer`).")
+        target_tok = _tokenize(target, tokenizer, max_length, own_tokenizer=user_tokenizer is not None)
+        preds_tok = _tokenize(preds, tokenizer, max_length, own_tokenizer=user_tokenizer is not None)
+    elif all(isinstance(t, dict) and "input_ids" in t for t in (preds, target)):
+        target_tok = {k: np.asarray(target[k]) for k in ("input_ids", "attention_mask")}
+        preds_tok = {k: np.asarray(preds[k]) for k in ("input_ids", "attention_mask")}
+    else:
+        raise ValueError("Invalid input provided.")
+
+    idf_dict = _tokens_idf(target_tok["input_ids"], target_tok["attention_mask"]) if idf else None
+    preds_idf = _idf_matrix(preds_tok["input_ids"], idf_dict) if idf else None
+    target_idf = _idf_matrix(target_tok["input_ids"], idf_dict) if idf else None
+
+    if user_forward_fn is not None:
+        if all_layers:
+            raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
+        forward = lambda ids, mask: user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})
+    elif callable(model) and not hasattr(model, "config"):
+        forward = lambda ids, mask: model(ids, mask)
+    else:
+        forward = _default_forward(model, num_layers, all_layers)
+
+    target_embeddings, target_scale = _embed_corpus(
+        target_tok["input_ids"], target_tok["attention_mask"], forward, batch_size, target_idf
+    )
+    preds_embeddings, preds_scale = _embed_corpus(
+        preds_tok["input_ids"], preds_tok["attention_mask"], forward, batch_size, preds_idf
+    )
+
+    precision, recall, f1 = _greedy_cosine_scores(
+        preds_embeddings, target_embeddings, preds_scale, target_scale
+    )
+    if precision.shape[0] == 1:  # single-layer: squeeze to [B]
+        precision, recall, f1 = precision[0], recall[0], f1[0]
+
+    if rescale_with_baseline:
+        if baseline_path is None:
+            raise ValueError(
+                "`rescale_with_baseline=True` requires `baseline_path` (no network access to fetch baselines)."
+            )
+        precision, recall, f1 = _rescale_with_baseline(
+            precision, recall, f1, _read_baseline_csv(baseline_path), num_layers, all_layers
+        )
+
+    output = {
+        "precision": np.atleast_1d(np.asarray(precision)).tolist(),
+        "recall": np.atleast_1d(np.asarray(recall)).tolist(),
+        "f1": np.atleast_1d(np.asarray(f1)).tolist(),
+    }
+    if return_hash:
+        output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+    return output

@@ -1,0 +1,131 @@
+"""Modular BERTScore.
+
+Behavior parity with /root/reference/torchmetrics/text/bert.py:40-212: the
+class tokenizes at update time and accumulates ``input_ids``/``attention_mask``
+list states for both corpora (device-synced), then delegates to the
+functional pipeline at compute time.
+"""
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.bert import _tokenize, bert_score
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """Accumulating BERTScore (precision/recall/f1 per sentence pair).
+
+    Requires either a ``model`` callable (Flax transformers model or
+    ``(input_ids, attention_mask) -> [batch, seq, dim]``) plus
+    ``user_tokenizer``, or a LOCAL ``model_name_or_path`` checkpoint.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    __jit_unsafe__ = True  # update consumes Python strings
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Any = None,
+        user_forward_fn: Optional[Callable] = None,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.model = model
+        self.user_forward_fn = user_forward_fn
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+
+        if user_tokenizer is not None:
+            self.tokenizer = user_tokenizer
+            self.user_tokenizer = True
+        else:
+            if model_name_or_path is None:
+                raise ValueError(
+                    "`BERTScore` needs either `user_tokenizer` (+ `model`) or a LOCAL"
+                    " `model_name_or_path` checkpoint — this environment cannot download"
+                    " the default model."
+                )
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+            self.user_tokenizer = False
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def _update(self, preds: List[str], target: List[str]) -> None:
+        if isinstance(preds, str):
+            preds = [preds]
+        elif not isinstance(preds, list):
+            preds = list(preds)
+        if isinstance(target, str):
+            target = [target]
+        elif not isinstance(target, list):
+            target = list(target)
+        preds_tok = _tokenize(preds, self.tokenizer, self.max_length, self.user_tokenizer)
+        target_tok = _tokenize(target, self.tokenizer, self.max_length, self.user_tokenizer)
+        self.preds_input_ids.append(jnp.asarray(preds_tok["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(preds_tok["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(target_tok["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(target_tok["attention_mask"]))
+
+    @staticmethod
+    def _pad_cat(chunks: List[Array]) -> np.ndarray:
+        """Concatenate [N_i, S_i] chunks along N, right-padding S with zeros."""
+        max_len = max(int(c.shape[1]) for c in chunks)
+        return np.concatenate(
+            [np.pad(np.asarray(c), ((0, 0), (0, max_len - c.shape[1]))) for c in chunks]
+        )
+
+    def _compute(self) -> Dict[str, Union[List[float], str]]:
+        preds = {
+            "input_ids": self._pad_cat(self.preds_input_ids),
+            "attention_mask": self._pad_cat(self.preds_attention_mask),
+        }
+        target = {
+            "input_ids": self._pad_cat(self.target_input_ids),
+            "attention_mask": self._pad_cat(self.target_attention_mask),
+        }
+        return bert_score(
+            preds,
+            target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_forward_fn=self.user_forward_fn,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+        )

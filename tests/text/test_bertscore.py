@@ -1,0 +1,169 @@
+"""BERTScore parity vs the reference implementation.
+
+No network: a tiny randomly-initialized BERT + WordPiece tokenizer is built
+locally, saved to disk, and loaded twice — as a torch model for the
+reference oracle (/root/reference/torchmetrics/functional/text/bert.py) and
+as a Flax model for our implementation. Sentences are pre-sorted by token
+length because the reference returns scores in length-sorted order (its
+dataloader sorts and never restores input order).
+"""
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.text.bert import BERTScore
+from tests.helpers.reference import load_reference_module
+
+_VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "hello", "there", "general", "kenobi", "master", "the", "cat", "sat",
+    "on", "a", "mat", "dog", "ran", "fast", "big", "red", "house",
+]
+
+# strictly increasing token lengths -> the reference's length sort is identity
+_PREDS = ["hello there", "the cat sat on a mat", "the big red dog ran fast on the mat"]
+_TARGET = ["hello there", "a cat sat on the mat", "the big red cat ran fast on a mat"]
+
+
+def _own_tokenizer(tokenizer, tensors):
+    """Adapt an AutoTokenizer to the (text, max_length) user-tokenizer protocol."""
+
+    def call(texts, max_length):
+        return tokenizer(texts, padding=True, max_length=max_length, truncation=True, return_tensors=tensors)
+
+    return call
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_dir(tmp_path_factory):
+    import torch
+    from transformers import BertConfig, BertModel, BertTokenizerFast
+
+    directory = tmp_path_factory.mktemp("tiny_bert")
+    vocab_file = directory / "vocab.txt"
+    vocab_file.write_text("\n".join(_VOCAB))
+    tokenizer = BertTokenizerFast(vocab_file=str(vocab_file), do_lower_case=True)
+    tokenizer.save_pretrained(str(directory))
+
+    torch.manual_seed(0)
+    config = BertConfig(
+        vocab_size=len(_VOCAB),
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    model = BertModel(config).eval()
+    model.save_pretrained(str(directory))
+    return str(directory)
+
+
+def _reference_scores(model_dir, preds, target, **kwargs):
+    import torch
+    from transformers import AutoTokenizer, BertModel
+
+    ref_bert = load_reference_module("torchmetrics.functional.text.bert")
+    tokenizer = AutoTokenizer.from_pretrained(model_dir)
+    model = BertModel.from_pretrained(model_dir).eval()
+    with torch.no_grad():
+        return ref_bert.bert_score(
+            preds,
+            target,
+            model=model,
+            user_tokenizer=tokenizer,
+            num_threads=0,
+            **kwargs,
+        )
+
+
+@pytest.fixture(scope="module")
+def flax_model(tiny_bert_dir):
+    from transformers import FlaxBertModel
+
+    return FlaxBertModel.from_pretrained(tiny_bert_dir, from_pt=True)
+
+
+@pytest.mark.parametrize("idf", [False, True])
+def test_bert_score_matches_reference(tiny_bert_dir, flax_model, idf):
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tiny_bert_dir)
+    got = bert_score(
+        _PREDS, _TARGET, model=flax_model,
+        user_tokenizer=tokenizer, idf=idf, num_layers=2, batch_size=2, max_length=32,
+    )
+    want = _reference_scores(tiny_bert_dir, _PREDS, _TARGET, idf=idf, num_layers=2, batch_size=2, max_length=32)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(got[key], want[key], atol=2e-4, err_msg=key)
+
+
+def test_bert_score_all_layers(tiny_bert_dir, flax_model):
+    want = _reference_scores(tiny_bert_dir, _PREDS, _TARGET, all_layers=True, batch_size=2, max_length=32)
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tiny_bert_dir)
+    got = bert_score(
+        _PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer,
+        all_layers=True, batch_size=2, max_length=32,
+    )
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(
+            np.asarray(got[key]).reshape(-1), np.asarray(want[key]).reshape(-1), atol=2e-4, err_msg=key
+        )
+
+
+def test_bert_score_identical_sentences_near_one(flax_model, tiny_bert_dir):
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tiny_bert_dir)
+    got = bert_score(["hello there"], ["hello there"], model=flax_model, user_tokenizer=tokenizer)
+    assert got["f1"][0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_bert_score_user_forward_fn(flax_model, tiny_bert_dir):
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tiny_bert_dir)
+
+    def forward_fn(model, batch):
+        out = model(input_ids=batch["input_ids"], attention_mask=batch["attention_mask"],
+                    output_hidden_states=True)
+        return out.hidden_states[-1]
+
+    got = bert_score(
+        _PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer, user_forward_fn=forward_fn
+    )
+    direct = bert_score(_PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer)
+    # the plain (texts, max_length) user-tokenizer protocol also works
+    protocol = bert_score(
+        _PREDS, _TARGET, model=flax_model, user_tokenizer=_own_tokenizer(tokenizer, "np")
+    )
+    np.testing.assert_allclose(protocol["f1"], direct["f1"], atol=1e-6)
+    np.testing.assert_allclose(got["f1"], direct["f1"], atol=1e-6)
+
+
+def test_bert_score_class_accumulates(flax_model, tiny_bert_dir):
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tiny_bert_dir)
+    metric = BERTScore(model=flax_model, user_tokenizer=tokenizer, batch_size=2)
+    metric.update(_PREDS[:1], _TARGET[:1])
+    metric.update(_PREDS[1:], _TARGET[1:])
+    got = metric.compute()
+    whole = bert_score(_PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer, batch_size=2)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(got[key], whole[key], atol=1e-5, err_msg=key)
+
+
+def test_bert_score_errors():
+    with pytest.raises(ValueError, match="same"):
+        bert_score(["a"], ["a", "b"], model=lambda i, m: None)
+    with pytest.raises(ValueError, match="model"):
+        bert_score(["a"], ["b"])  # no model, no local path
+    with pytest.raises(ValueError, match="user_tokenizer|tokenizer"):
+        BERTScore()  # no tokenizer and no local path
+    out = bert_score([], [], model=lambda i, m: None, return_hash=True)
+    assert out["precision"] == [0.0] and "hash" in out
