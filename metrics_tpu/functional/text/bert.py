@@ -37,7 +37,7 @@ def _process_attention_mask_for_special_tokens(attention_mask: Array) -> Array:
     return attention_mask.at[jnp.arange(attention_mask.shape[0]), sep_pos].set(0)
 
 
-def _tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+def _tokens_idf(input_ids: np.ndarray) -> Dict[int, float]:
     """log((N+1)/(df+1)) inverse document frequencies over a corpus
     (reference bert.py:189-206); unseen tokens default to log(N+1)."""
     num_sentences = len(input_ids)
@@ -137,15 +137,17 @@ def _rescale_with_baseline(
     return stacked[..., 0], stacked[..., 1], stacked[..., 2]
 
 
-def _tokenize(texts: List[str], tokenizer: Any, max_length: int, own_tokenizer: bool) -> Dict[str, np.ndarray]:
+def _tokenize(
+    texts: List[str], tokenizer: Any, max_length: int, own_tokenizer: bool, truncation: bool = True
+) -> Dict[str, np.ndarray]:
     """HF-style tokenizers are called with padding/truncation kwargs (the
     reference does the same even for user tokenizers, bert.py:72-75); plain
     ``(texts, max_length)`` callables are supported as a fallback."""
     if not own_tokenizer:
-        encoded = tokenizer(texts, padding=True, max_length=max_length, truncation=True, return_tensors="np")
+        encoded = tokenizer(texts, padding=True, max_length=max_length, truncation=truncation, return_tensors="np")
     else:
         try:
-            encoded = tokenizer(texts, padding=True, max_length=max_length, truncation=True, return_tensors="np")
+            encoded = tokenizer(texts, padding=True, max_length=max_length, truncation=truncation, return_tensors="np")
         except TypeError:
             try:
                 encoded = tokenizer(texts, max_length)
@@ -219,7 +221,7 @@ def bert_score(
     else:
         raise ValueError("Invalid input provided.")
 
-    idf_dict = _tokens_idf(target_tok["input_ids"], target_tok["attention_mask"]) if idf else None
+    idf_dict = _tokens_idf(target_tok["input_ids"]) if idf else None
     preds_idf = _idf_matrix(preds_tok["input_ids"], idf_dict) if idf else None
     target_idf = _idf_matrix(target_tok["input_ids"], idf_dict) if idf else None
 

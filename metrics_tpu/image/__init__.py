@@ -7,3 +7,4 @@ from metrics_tpu.image.uqi import UniversalImageQualityIndex  # noqa: F401
 from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
 from metrics_tpu.image.inception import InceptionScore  # noqa: F401
 from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity  # noqa: F401

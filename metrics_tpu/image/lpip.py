@@ -1,0 +1,82 @@
+"""Learned Perceptual Image Patch Similarity (LPIPS).
+
+Behavior parity with /root/reference/torchmetrics/image/lpip.py:43-165:
+sum/count scalar states, [-1, 1] NCHW input validation, mean/sum reduction.
+``net`` accepts any callable ``(img1, img2) -> [N]`` scores (JAX), or the
+bundled Flax AlexNet/VGG LPIPS with locally converted weights
+(metrics_tpu/models/lpips.py — the reference wraps the `lpips` torch
+package, which needs a download this environment cannot perform).
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+
+Array = jax.Array
+
+
+def _valid_img(img: Array) -> bool:
+    return img.ndim == 4 and img.shape[1] == 3 and float(img.min()) >= -1.0 and float(img.max()) <= 1.0
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """Average LPIPS between image batches (lower = perceptually closer).
+
+    Args:
+        net_type: 'alex' or 'vgg' for the bundled Flax net (requires
+            ``net_weights_path``), ignored when ``net`` is given.
+        net: a callable ``(img1, img2) -> [N]`` LPIPS scorer.
+        reduction: 'mean' or 'sum' over all accumulated image pairs.
+        net_weights_path: npz produced by
+            ``metrics_tpu.models.lpips.convert_lpips_weights``.
+    """
+
+    __jit_unsafe__ = True
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        net: Optional[Callable] = None,
+        net_weights_path: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        if net is not None:
+            if not callable(net):
+                raise TypeError("Argument `net` must be callable")
+            self.net = net
+        else:
+            from metrics_tpu.models.lpips import build_lpips
+
+            self.net = build_lpips(net_type, net_weights_path)
+
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+
+        self.add_state("sum_scores", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, img1: Array, img2: Array) -> None:
+        if not (_valid_img(img1) and _valid_img(img2)):
+            raise ValueError(
+                "Expected both input arguments to be normalized tensors (all values in range [-1,1])"
+                f" and to have shape [N, 3, H, W] but `img1` have shape {img1.shape} with values in"
+                f" range {[float(img1.min()), float(img1.max())]} and `img2` have shape {img2.shape}"
+                f" with value in range {[float(img2.min()), float(img2.max())]}"
+            )
+        loss = jnp.squeeze(self.net(img1, img2))
+        self.sum_scores = self.sum_scores + jnp.sum(loss)
+        self.total = self.total + img1.shape[0]
+
+    def _compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

@@ -1,0 +1,164 @@
+"""Flax LPIPS networks (AlexNet / VGG16 backbones + linear heads).
+
+TPU-native replacement for the `lpips` torch package the reference wraps
+(/root/reference/torchmetrics/image/lpip.py:28-41): the fixed input scaling
+layer, the backbone feature stages, channel-unit-normalized squared
+differences, 1x1 linear heads, and spatial averaging — expressed in Flax.
+
+Weights are NOT bundled (no network access): convert a locally available
+`lpips` package state_dict with ``convert_lpips_weights`` and pass the saved
+``.npz``. Constructing the bundled net without weights raises (LPIPS values
+from random weights are meaningless).
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+
+    _FLAX_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    _FLAX_AVAILABLE = False
+
+Array = jax.Array
+
+# fixed normalization constants from the LPIPS scaling layer
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+# backbone stage layouts: (out_channels, kernel, stride, padding, pool_before)
+_ALEX_STAGES = (
+    ((64, 11, 4, 2, False),),
+    ((192, 5, 1, 2, True),),
+    ((384, 3, 1, 1, True),),
+    ((256, 3, 1, 1, False),),
+    ((256, 3, 1, 1, False),),
+)
+_VGG_STAGES = (
+    ((64, 3, 1, 1, False), (64, 3, 1, 1, False)),
+    ((128, 3, 1, 1, True), (128, 3, 1, 1, False)),
+    ((256, 3, 1, 1, True), (256, 3, 1, 1, False), (256, 3, 1, 1, False)),
+    ((512, 3, 1, 1, True), (512, 3, 1, 1, False), (512, 3, 1, 1, False)),
+    ((512, 3, 1, 1, True), (512, 3, 1, 1, False), (512, 3, 1, 1, False)),
+)
+_NET_STAGES = {"alex": _ALEX_STAGES, "vgg": _VGG_STAGES}
+
+
+if _FLAX_AVAILABLE:
+
+    class _Backbone(nn.Module):
+        """Feature stages of AlexNet / VGG16, returning each stage's ReLU output."""
+
+        stages: Tuple
+        pool_window: int  # 3 for AlexNet, 2 for VGG
+
+        @nn.compact
+        def __call__(self, x: Array) -> List[Array]:
+            outputs = []
+            for stage in self.stages:
+                for out_ch, kernel, stride, pad, pool_before in stage:
+                    if pool_before:
+                        x = nn.max_pool(x, (self.pool_window, self.pool_window), strides=(2, 2))
+                    x = nn.Conv(out_ch, (kernel, kernel), strides=(stride, stride), padding=pad)(x)
+                    x = nn.relu(x)
+                outputs.append(x)
+            return outputs
+
+    class LPIPSNet(nn.Module):
+        """Full LPIPS: scaling -> backbone stages -> normalized diff -> heads.
+
+        Input images are NCHW in [-1, 1] (the reference's contract,
+        lpip.py:37-39).
+        """
+
+        net_type: str = "alex"
+
+        @nn.compact
+        def __call__(self, img1: Array, img2: Array) -> Array:
+            shift = jnp.asarray(_SHIFT).reshape(1, 1, 1, 3)
+            scale = jnp.asarray(_SCALE).reshape(1, 1, 1, 3)
+
+            def prep(x: Array) -> Array:
+                x = jnp.transpose(x.astype(jnp.float32), (0, 2, 3, 1))  # NCHW -> NHWC
+                return (x - shift) / scale
+
+            backbone = _Backbone(
+                stages=_NET_STAGES[self.net_type], pool_window=3 if self.net_type == "alex" else 2
+            )
+            feats1 = backbone(prep(img1))
+            feats2 = backbone(prep(img2))
+
+            total = 0.0
+            for k, (f1, f2) in enumerate(zip(feats1, feats2)):
+                f1 = f1 / (jnp.linalg.norm(f1, axis=-1, keepdims=True) + 1e-10)
+                f2 = f2 / (jnp.linalg.norm(f2, axis=-1, keepdims=True) + 1e-10)
+                diff = (f1 - f2) ** 2
+                head = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{k}")(diff)
+                total = total + jnp.mean(head, axis=(1, 2))  # spatial average
+            return total[:, 0]  # [N]
+
+
+def convert_lpips_weights(state_dict: Any, net_type: str = "alex") -> dict:
+    """Map an `lpips` package ``LPIPS(net=...)`` state_dict onto the Flax tree.
+
+    Torch keys: ``net.sliceK.I.weight/bias`` (backbone convs, OIHW) and
+    ``linK.model.1.weight`` (1x1 heads). Persist with
+    ``np.savez(path, variables=np.asarray(variables, dtype=object))``.
+    """
+    import numpy as np
+
+    def _np(t: Any) -> np.ndarray:
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().numpy()
+        return np.asarray(t, dtype=np.float32)
+
+    sd = {k.replace("module.", ""): v for k, v in dict(state_dict).items()}
+    stages = _NET_STAGES[net_type]
+
+    # backbone conv indices per slice, mirroring the lpips package's
+    # torchvision slicing: within each sliceK the convs appear at positions
+    # (pool/convs/relus interleaved); enumerate conv layers in order
+    params: dict = {"_Backbone_0": {}}
+    conv_idx = 0
+    for k, stage in enumerate(stages):
+        torch_slice = f"net.slice{k + 1}"
+        conv_keys = sorted(
+            {key.split(".")[2] for key in sd if key.startswith(torch_slice + ".") and key.endswith(".weight")},
+            key=int,
+        )
+        if len(conv_keys) != len(stage):
+            raise KeyError(
+                f"Expected {len(stage)} convs under {torch_slice}, found {len(conv_keys)}"
+            )
+        for layer_idx in conv_keys:
+            kernel = _np(sd[f"{torch_slice}.{layer_idx}.weight"]).transpose(2, 3, 1, 0)
+            bias = _np(sd[f"{torch_slice}.{layer_idx}.bias"])
+            params["_Backbone_0"][f"Conv_{conv_idx}"] = {"kernel": kernel, "bias": bias}
+            conv_idx += 1
+
+    for k in range(len(stages)):
+        head = _np(sd[f"lin{k}.model.1.weight"]).transpose(2, 3, 1, 0)  # [1,C,1,1] -> [1,1,C,1]
+        params[f"lin{k}"] = {"kernel": head}
+    return {"params": params}
+
+
+def build_lpips(net_type: str = "alex", weights_path: Optional[str] = None) -> Callable[[Array, Array], Array]:
+    """Build a jitted ``(img1, img2) -> [N]`` LPIPS scorer from saved weights."""
+    if not _FLAX_AVAILABLE:
+        raise ModuleNotFoundError("The bundled LPIPS net requires `flax` to be installed.")
+    if net_type not in _NET_STAGES:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(_NET_STAGES)}, but got {net_type}.")
+    if weights_path is None:
+        raise ValueError(
+            "The bundled LPIPS net needs pretrained weights for meaningful values and none"
+            " are bundled (no network access). Provide `weights_path` (an .npz produced by"
+            " `metrics_tpu.models.lpips.convert_lpips_weights`), or pass a callable `net`."
+        )
+    import numpy as np
+
+    model = LPIPSNet(net_type=net_type)
+    loaded = dict(np.load(weights_path, allow_pickle=True))
+    variables = jax.tree_util.tree_map(jnp.asarray, loaded["variables"].item())
+    return jax.jit(lambda img1, img2: model.apply(variables, img1, img2))

@@ -70,10 +70,17 @@ class BERTScore(Metric):
                     " `model_name_or_path` checkpoint — this environment cannot download"
                     " the default model."
                 )
-            from transformers import AutoTokenizer
+            from transformers import AutoTokenizer, FlaxAutoModel
 
             self.tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
             self.user_tokenizer = False
+            # load once; _compute would otherwise re-read the checkpoint per call
+            self.model = FlaxAutoModel.from_pretrained(model_name_or_path)
+            if num_layers is not None and num_layers > self.model.config.num_hidden_layers:
+                raise ValueError(
+                    f"num_layers={num_layers} is forbidden for {model_name_or_path}."
+                    f" Please use num_layers <= {self.model.config.num_hidden_layers}"
+                )
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
@@ -89,30 +96,48 @@ class BERTScore(Metric):
             target = [target]
         elif not isinstance(target, list):
             target = list(target)
-        preds_tok = _tokenize(preds, self.tokenizer, self.max_length, self.user_tokenizer)
-        target_tok = _tokenize(target, self.tokenizer, self.max_length, self.user_tokenizer)
-        self.preds_input_ids.append(jnp.asarray(preds_tok["input_ids"]))
-        self.preds_attention_mask.append(jnp.asarray(preds_tok["attention_mask"]))
-        self.target_input_ids.append(jnp.asarray(target_tok["input_ids"]))
-        self.target_attention_mask.append(jnp.asarray(target_tok["attention_mask"]))
+        # truncation=False at update time (reference text/bert.py:205-220)
+        preds_tok = _tokenize(preds, self.tokenizer, self.max_length, self.user_tokenizer, truncation=False)
+        target_tok = _tokenize(target, self.tokenizer, self.max_length, self.user_tokenizer, truncation=False)
+        for state, tok in (
+            (self.preds_input_ids, preds_tok["input_ids"]),
+            (self.preds_attention_mask, preds_tok["attention_mask"]),
+            (self.target_input_ids, target_tok["input_ids"]),
+            (self.target_attention_mask, target_tok["attention_mask"]),
+        ):
+            # right-pad every chunk to max_length so the "cat" list states
+            # concatenate across updates AND across ranks (dist sync
+            # pre-concatenates list states; ragged widths would crash there)
+            tok = np.asarray(tok)
+            if tok.shape[1] < self.max_length:
+                tok = np.pad(tok, ((0, 0), (0, self.max_length - tok.shape[1])))
+            state.append(jnp.asarray(tok))
 
     @staticmethod
     def _pad_cat(chunks: List[Array]) -> np.ndarray:
-        """Concatenate [N_i, S_i] chunks along N, right-padding S with zeros."""
+        """Concatenate [N_i, S_i] chunks along N (chunks may still be ragged
+        when truncation=False produced sequences beyond max_length)."""
         max_len = max(int(c.shape[1]) for c in chunks)
         return np.concatenate(
             [np.pad(np.asarray(c), ((0, 0), (0, max_len - c.shape[1]))) for c in chunks]
         )
 
+    @staticmethod
+    def _trim(tok: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Trim the uniform max_length padding back to the longest attended
+        sequence (the reference's _input_data_collator, bert.py:116-126)."""
+        width = max(int(np.max(np.sum(tok["attention_mask"], axis=1))), 1)
+        return {k: v[:, :width] for k, v in tok.items()}
+
     def _compute(self) -> Dict[str, Union[List[float], str]]:
-        preds = {
+        preds = self._trim({
             "input_ids": self._pad_cat(self.preds_input_ids),
             "attention_mask": self._pad_cat(self.preds_attention_mask),
-        }
-        target = {
+        })
+        target = self._trim({
             "input_ids": self._pad_cat(self.target_input_ids),
             "attention_mask": self._pad_cat(self.target_attention_mask),
-        }
+        })
         return bert_score(
             preds,
             target,
