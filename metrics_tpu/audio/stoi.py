@@ -1,0 +1,46 @@
+"""Modular ShortTimeObjectiveIntelligibility.
+
+Behavior parity with /root/reference/torchmetrics/audio/stoi.py:25-126
+(sum/count states averaging per-utterance STOI); the DSP itself is the
+JAX implementation in functional/audio/stoi.py (the reference wraps pystoi).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """Average STOI over accumulated utterances.
+
+    Args:
+        fs: sampling frequency of the input waveforms.
+        extended: use extended STOI (eSTOI).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    __jit_unsafe__ = True  # silent-frame removal is data-dependent host work
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(fs, int) and fs > 0):
+            raise ValueError(f"Expected argument `fs` to be a positive int, but got {fs}")
+        self.fs = fs
+        self.extended = extended
+
+        self.add_state("sum_stoi", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def _update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended).reshape(-1)
+        self.sum_stoi = self.sum_stoi + jnp.sum(stoi_batch)
+        self.total = self.total + stoi_batch.shape[0]
+
+    def _compute(self) -> Array:
+        return self.sum_stoi / self.total

@@ -74,9 +74,12 @@ class BERTScore(Metric):
 
             self.tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
             self.user_tokenizer = False
-            # load once; _compute would otherwise re-read the checkpoint per call
-            self.model = FlaxAutoModel.from_pretrained(model_name_or_path)
-            if num_layers is not None and num_layers > self.model.config.num_hidden_layers:
+            if self.model is None:
+                # load once; _compute would otherwise re-read the checkpoint per call
+                self.model = FlaxAutoModel.from_pretrained(model_name_or_path)
+            if num_layers is not None and hasattr(self.model, "config") and (
+                num_layers > self.model.config.num_hidden_layers
+            ):
                 raise ValueError(
                     f"num_layers={num_layers} is forbidden for {model_name_or_path}."
                     f" Please use num_layers <= {self.model.config.num_hidden_layers}"
@@ -105,13 +108,23 @@ class BERTScore(Metric):
             (self.target_input_ids, target_tok["input_ids"]),
             (self.target_attention_mask, target_tok["attention_mask"]),
         ):
-            # right-pad every chunk to max_length so the "cat" list states
-            # concatenate across updates AND across ranks (dist sync
-            # pre-concatenates list states; ragged widths would crash there)
-            tok = np.asarray(tok)
-            if tok.shape[1] < self.max_length:
-                tok = np.pad(tok, ((0, 0), (0, self.max_length - tok.shape[1])))
-            state.append(jnp.asarray(tok))
+            self._append_uniform(state, np.asarray(tok))
+
+    def _append_uniform(self, state: List[Array], tok: np.ndarray) -> None:
+        """Append keeping ALL chunks in a state the same width, so the "cat"
+        list states concatenate across updates AND across ranks (dist sync
+        pre-concatenates list states; ragged widths would crash there).
+        truncation=False can exceed max_length, in which case the narrower
+        chunks already stored are re-padded to the new width."""
+        width = max(self.max_length, tok.shape[1], *(int(c.shape[1]) for c in state)) if state else max(
+            self.max_length, tok.shape[1]
+        )
+        if tok.shape[1] < width:
+            tok = np.pad(tok, ((0, 0), (0, width - tok.shape[1])))
+        for i, chunk in enumerate(state):
+            if chunk.shape[1] < width:
+                state[i] = jnp.asarray(np.pad(np.asarray(chunk), ((0, 0), (0, width - chunk.shape[1]))))
+        state.append(jnp.asarray(tok))
 
     @staticmethod
     def _pad_cat(chunks: List[Array]) -> np.ndarray:
