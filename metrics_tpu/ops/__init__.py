@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for hot ops (SURVEY §2.9 native-equivalents plan).
+
+Kernels dispatch through shape/backend heuristics with jnp fallbacks, so
+every entry point works on CPU (interpret mode in tests) and TPU alike.
+"""
+from metrics_tpu.ops.box_iou_pallas import box_iou_dispatch, box_iou_tiled  # noqa: F401

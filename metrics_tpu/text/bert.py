@@ -112,18 +112,20 @@ class BERTScore(Metric):
 
     def _append_uniform(self, state: List[Array], tok: np.ndarray) -> None:
         """Append keeping ALL chunks in a state the same width, so the "cat"
-        list states concatenate across updates AND across ranks (dist sync
+        list states concatenate across updates on a rank (dist sync
         pre-concatenates list states; ragged widths would crash there).
         truncation=False can exceed max_length, in which case the narrower
-        chunks already stored are re-padded to the new width."""
-        width = max(self.max_length, tok.shape[1], *(int(c.shape[1]) for c in state)) if state else max(
-            self.max_length, tok.shape[1]
-        )
+        chunks already stored are re-padded to the new width. NOTE:
+        cross-RANK sync additionally requires all ranks to agree on the
+        width — guaranteed at max_length unless truncation=False meets
+        longer-than-max_length inputs on some rank only (the reference has
+        the same constraint)."""
+        width = max(self.max_length, tok.shape[1], *(int(c.shape[1]) for c in state))
         if tok.shape[1] < width:
             tok = np.pad(tok, ((0, 0), (0, width - tok.shape[1])))
         for i, chunk in enumerate(state):
             if chunk.shape[1] < width:
-                state[i] = jnp.asarray(np.pad(np.asarray(chunk), ((0, 0), (0, width - chunk.shape[1]))))
+                state[i] = jnp.pad(chunk, ((0, 0), (0, width - chunk.shape[1])))
         state.append(jnp.asarray(tok))
 
     @staticmethod

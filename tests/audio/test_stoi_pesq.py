@@ -14,28 +14,73 @@ import pytest
 import jax.numpy as jnp
 
 from metrics_tpu.audio import PerceptualEvaluationSpeechQuality, ShortTimeObjectiveIntelligibility
-from metrics_tpu.functional.audio.stoi import (
-    _hann,
-    _remove_silent_frames,
-    _resample,
-    _third_octave_matrix,
-    short_time_objective_intelligibility,
-)
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 
 _EPS = np.finfo(np.float64).eps
 
 
-def _numpy_stoi(deg, clean, fs, extended=False):
-    """Loop-based re-derivation of the STOI spec (kept deliberately naive)."""
-    x = _resample(np.asarray(clean, np.float64), fs, 10000)
-    y = _resample(np.asarray(deg, np.float64), fs, 10000)
-    x, y = _remove_silent_frames(x, y, 40.0, 256, 128)
+def _np_hann(n):
+    # hanning(n+2)[1:-1], written out from the definition
+    return np.asarray([0.5 * (1 - np.cos(2 * np.pi * (k + 1) / (n + 1))) for k in range(n)])
 
-    window = _hann(256)
+
+def _np_resample(x, fs_in, fs_out):
+    if fs_in == fs_out:
+        return x
+    from scipy.signal import resample_poly
+
+    g = int(np.gcd(fs_in, fs_out))
+    return resample_poly(x, fs_out // g, fs_in // g)
+
+
+def _np_thirdoct(fs, nfft, num_bands, min_freq):
+    freqs = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    obm = np.zeros((num_bands, len(freqs)))
+    for band in range(num_bands):
+        center = min_freq * 2.0 ** (band / 3.0)
+        f_low, f_high = center / 2 ** (1 / 6), center * 2 ** (1 / 6)
+        i_low = int(np.argmin(np.abs(freqs - f_low)))
+        i_high = int(np.argmin(np.abs(freqs - f_high)))
+        obm[band, i_low:i_high] = 1.0
+    return obm
+
+
+def _np_remove_silent(x, y, dyn_range=40.0, framelen=256, hop=128):
+    window = _np_hann(framelen)
+    frames_x, frames_y, energies = [], [], []
+    i = 0
+    while i < len(x) - framelen:  # exclusive of the final boundary frame
+        fx = window * x[i : i + framelen]
+        fy = window * y[i : i + framelen]
+        frames_x.append(fx)
+        frames_y.append(fy)
+        energies.append(20 * np.log10(np.linalg.norm(fx) + _EPS))
+        i += hop
+    if not frames_x:
+        return x, y
+    threshold = max(energies) - dyn_range
+    kept_x = [f for f, e in zip(frames_x, energies) if e > threshold]
+    kept_y = [f for f, e in zip(frames_y, energies) if e > threshold]
+    out_len = (len(kept_x) - 1) * hop + framelen if kept_x else 0
+    x_out, y_out = np.zeros(out_len), np.zeros(out_len)
+    for i, (fx, fy) in enumerate(zip(kept_x, kept_y)):
+        x_out[i * hop : i * hop + framelen] += fx
+        y_out[i * hop : i * hop + framelen] += fy
+    return x_out, y_out
+
+
+def _numpy_stoi(deg, clean, fs, extended=False):
+    """Loop-based re-derivation of the STOI spec; shares NO code with the
+    library implementation (its own window/resample/octave/silence steps)."""
+    x = _np_resample(np.asarray(clean, np.float64), fs, 10000)
+    y = _np_resample(np.asarray(deg, np.float64), fs, 10000)
+    x, y = _np_remove_silent(x, y)
+
+    window = _np_hann(256)
     n_frames = max(-(-(len(x) - 256) // 128), 0) if len(x) > 256 else 0
     x_spec = np.stack([np.fft.rfft(window * x[i * 128 : i * 128 + 256], 512) for i in range(n_frames)])
     y_spec = np.stack([np.fft.rfft(window * y[i * 128 : i * 128 + 256], 512) for i in range(n_frames)])
-    obm = _third_octave_matrix(10000, 512, 15, 150.0)
+    obm = _np_thirdoct(10000, 512, 15, 150.0)
     x_tob = np.sqrt(obm @ (np.abs(x_spec.T) ** 2))
     y_tob = np.sqrt(obm @ (np.abs(y_spec.T) ** 2))
 
