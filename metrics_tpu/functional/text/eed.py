@@ -155,7 +155,7 @@ def extended_edit_distance(
         >>> preds = ["this is the prediction", "here is an other sample"]
         >>> target = ["this is the reference", "here is another one"]
         >>> float(extended_edit_distance(preds=preds, target=target))  # doctest: +ELLIPSIS
-        0.3078...
+        0.3077...
     """
     for param_name, param in zip(["alpha", "rho", "deletion", "insertion"], [alpha, rho, deletion, insertion]):
         if not isinstance(param, float) or param < 0:

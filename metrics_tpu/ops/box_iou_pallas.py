@@ -64,23 +64,14 @@ def box_iou_tiled(boxes1: ArrayLike, boxes2: ArrayLike, interpret: bool = False)
     b1 = jnp.zeros((4, n_pad), jnp.float32).at[:, :n].set(boxes1.T)
     b2 = jnp.zeros((4, m_pad), jnp.float32).at[:, :m].set(boxes2.T)
 
-    kwargs = {}
-    if not interpret and _VMEM is not None:
-        kwargs = {
-            "in_specs": [
-                pl.BlockSpec((4, _TILE), lambda i, j: (0, i), memory_space=_VMEM),
-                pl.BlockSpec((4, _TILE), lambda i, j: (0, j), memory_space=_VMEM),
-            ],
-            "out_specs": pl.BlockSpec((_TILE, _TILE), lambda i, j: (i, j), memory_space=_VMEM),
-        }
-    else:
-        kwargs = {
-            "in_specs": [
-                pl.BlockSpec((4, _TILE), lambda i, j: (0, i)),
-                pl.BlockSpec((4, _TILE), lambda i, j: (0, j)),
-            ],
-            "out_specs": pl.BlockSpec((_TILE, _TILE), lambda i, j: (i, j)),
-        }
+    ms = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
+    kwargs = {
+        "in_specs": [
+            pl.BlockSpec((4, _TILE), lambda i, j: (0, i), **ms),
+            pl.BlockSpec((4, _TILE), lambda i, j: (0, j), **ms),
+        ],
+        "out_specs": pl.BlockSpec((_TILE, _TILE), lambda i, j: (i, j), **ms),
+    }
 
     iou = pl.pallas_call(
         _iou_tile_kernel,
@@ -107,5 +98,8 @@ def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 <<
     boxes2 = jnp.asarray(boxes2)
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu and boxes1.ndim == 2 and boxes2.ndim == 2 and boxes1.shape[0] * boxes2.shape[0] >= min_elems:
-        return box_iou_tiled(boxes1, boxes2)
+        # cast back so the dispatch is dtype-transparent (the tile kernel
+        # computes in float32; the jnp fallback preserves the input dtype)
+        out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype)
+        return box_iou_tiled(boxes1, boxes2).astype(out_dtype)
     return _jnp_box_iou(boxes1, boxes2)

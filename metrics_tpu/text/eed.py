@@ -22,7 +22,7 @@ class ExtendedEditDistance(Metric):
         >>> target = ["this is the reference", "here is another one"]
         >>> metric = ExtendedEditDistance()
         >>> float(metric(preds, target))  # doctest: +ELLIPSIS
-        0.3078...
+        0.3077...
     """
 
     is_differentiable = False

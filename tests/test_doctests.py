@@ -1,0 +1,31 @@
+"""Execute every docstring example in the package (reference Makefile:23 runs
+pytest with doctests over torchmetrics; same discipline here, as a single
+explicit runner so the skip list is visible)."""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+_MODULES = [info.name for info in pkgutil.walk_packages(metrics_tpu.__path__, "metrics_tpu.")]
+
+
+@pytest.mark.parametrize("module_name", _MODULES)
+def test_module_doctests(module_name):
+    module = importlib.import_module(module_name)
+    result = doctest.testmod(
+        module, optionflags=doctest.ELLIPSIS | doctest.NORMALIZE_WHITESPACE, verbose=False
+    )
+    assert result.failed == 0, f"{result.failed} doctest failure(s) in {module_name}"
+
+
+def test_doctest_volume():
+    """The example corpus must not silently evaporate (regression guard)."""
+    total = 0
+    for name in _MODULES:
+        module = importlib.import_module(name)
+        finder = doctest.DocTestFinder()
+        total += sum(len(t.examples) for t in finder.find(module))
+    assert total > 400, f"only {total} doctest examples discovered"
