@@ -50,15 +50,17 @@ def bench_tpu() -> float:
         return new_state, auc
 
     state, auc = step(state, preds, target)  # compile
-    jax.block_until_ready((state, auc))
+    float(auc)  # definitive completion: block_until_ready is unreliable over
+    # the tunneled accelerator transport, so every timed region below ends
+    # with a scalar device->host readback that drains the dispatch queue
     for _ in range(WARMUP):
         state, auc = step(state, preds, target)
-    jax.block_until_ready((state, auc))
+    float(auc)
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
         state, auc = step(state, preds, target)
-    jax.block_until_ready((state, auc))
+    float(auc)
     dt = time.perf_counter() - t0
     return BATCH * ITERS / dt
 
@@ -221,7 +223,8 @@ def bench_retrieval() -> None:
         rmap = RetrievalMAP()
         ndcg.update(j_preds, j_target, indexes=j_idx)
         rmap.update(j_preds, j_target, indexes=j_idx)
-        return ndcg.compute(), rmap.compute()
+        # scalar readbacks so the timed region includes kernel completion
+        return float(ndcg.compute()), float(rmap.compute())
 
     run_once()  # compile
     iters = 3

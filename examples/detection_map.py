@@ -1,0 +1,42 @@
+"""Object-detection mAP walkthrough (analog of the reference's
+tm_examples/detection_map.py): per-image prediction/target dicts in, full
+COCO summary out."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo root
+
+import jax.numpy as jnp
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+
+def main() -> None:
+    # two images: one near-perfect detection, one with a shifted box and a
+    # spurious low-confidence detection
+    preds = [
+        dict(
+            boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+            scores=jnp.asarray([0.536]),
+            labels=jnp.asarray([0]),
+        ),
+        dict(
+            boxes=jnp.asarray([[12.0, 8.0, 92.0, 110.0], [300.0, 300.0, 320.0, 330.0]]),
+            scores=jnp.asarray([0.715, 0.121]),
+            labels=jnp.asarray([1, 1]),
+        ),
+    ]
+    target = [
+        dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.asarray([0])),
+        dict(boxes=jnp.asarray([[10.0, 10.0, 90.0, 105.0]]), labels=jnp.asarray([1])),
+    ]
+
+    metric = MeanAveragePrecision(class_metrics=True)
+    metric.update(preds, target)
+    results = metric.compute()
+    for key, value in results.items():
+        print(f"{key}: {value}")
+
+
+if __name__ == "__main__":
+    main()

@@ -365,6 +365,21 @@ class Metric(ABC):
     # ------------------------------------------------------------------
     # pure-functional state API (TPU-native extension; no reference analog)
     # ------------------------------------------------------------------
+    def state_reductions(self) -> Dict[str, Union[str, Callable, None]]:
+        """Reducer spec per state ("sum"/"mean"/"max"/"min"/"cat", a custom
+        callable, or None) — exactly what
+        :func:`metrics_tpu.parallel.distributed.sync_in_mesh` takes, so metric
+        states sync inside shard_map with one call:
+        ``sync_in_mesh(state, metric.state_reductions(), axis)``."""
+        names = {
+            dim_zero_sum: "sum",
+            dim_zero_mean: "mean",
+            dim_zero_max: "max",
+            dim_zero_min: "min",
+            dim_zero_cat: "cat",
+        }
+        return {k: names.get(fn, fn) for k, fn in self._reductions.items()}
+
     def init_state(self) -> Dict[str, StateValue]:
         """Fresh state pytree (defaults)."""
         return {

@@ -98,8 +98,11 @@ def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 <<
     boxes2 = jnp.asarray(boxes2)
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu and boxes1.ndim == 2 and boxes2.ndim == 2 and boxes1.shape[0] * boxes2.shape[0] >= min_elems:
-        # cast back so the dispatch is dtype-transparent (the tile kernel
-        # computes in float32; the jnp fallback preserves the input dtype)
-        out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype)
+        # IoU is a ratio: both paths produce floating point. Match the jnp
+        # fallback's promotion (true division promotes ints to float) so the
+        # dispatch threshold never changes dtype or values.
+        out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype, jnp.float32)
+        if not jnp.issubdtype(out_dtype, jnp.floating):
+            out_dtype = jnp.float32
         return box_iou_tiled(boxes1, boxes2).astype(out_dtype)
     return _jnp_box_iou(boxes1, boxes2)
