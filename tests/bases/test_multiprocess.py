@@ -92,7 +92,12 @@ def test_two_process_distributed_sync(tmp_path):
             )
         )
 
-    outs = [p.communicate(timeout=240) for p in procs]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:  # never leak workers wedged in jax.distributed.initialize
+            if p.returncode is None:
+                p.kill()
     for rank, (p, (stdout, stderr)) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{stderr[-2000:]}"
         assert f"RANK{rank}_OK" in stdout
