@@ -15,9 +15,10 @@ import numpy as np
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.detection.mean_ap import (
     _calculate_precision_recall,
-    _match_units_kernel,
+    _match_units_kernel_packed,
     _pack_units,
     _summarize,
+    _unpack_bool_bits,
 )
 
 Array = jax.Array
@@ -226,7 +227,7 @@ class MeanAveragePrecision(Metric):
                 hi = min(lo + chunk, U)
                 n = hi - lo
                 pad = chunk - n if U > chunk else 0  # keep one compiled shape
-                dm, dao, npig_c = _match_units_kernel(
+                dm, dao, npig_c = _match_units_kernel_packed(
                     jnp.asarray(np.pad(packed.det_boxes[lo:hi], ((0, pad), (0, 0), (0, 0)))),
                     jnp.asarray(np.pad(packed.det_valid[lo:hi], ((0, pad), (0, 0)))),
                     jnp.asarray(np.pad(packed.gt_boxes[lo:hi], ((0, pad), (0, 0), (0, 0)))),
@@ -234,8 +235,9 @@ class MeanAveragePrecision(Metric):
                     iou_thrs,
                     areas_arr,
                 )
-                dm_parts.append(np.asarray(dm)[:n])
-                dao_parts.append(np.asarray(dao)[:n])
+                max_det_dim = packed.det_boxes.shape[1]
+                dm_parts.append(_unpack_bool_bits(np.asarray(dm)[:n], max_det_dim))
+                dao_parts.append(_unpack_bool_bits(np.asarray(dao)[:n], max_det_dim))
                 npig_parts.append(np.asarray(npig_c)[:n])
             det_matches = np.concatenate(dm_parts)
             det_area_out = np.concatenate(dao_parts)

@@ -102,6 +102,39 @@ def _match_units_kernel(
     return det_matches, det_area_out, npig
 
 
+def _pack_bool_bits(x: Array) -> Array:
+    """Pack a trailing bool axis into little-endian uint8 bytes (in-jit)."""
+    d = x.shape[-1]
+    padded = -(-d // 8) * 8
+    x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, padded - d)])
+    x = x.reshape(x.shape[:-1] + (padded // 8, 8))
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint32)).astype(jnp.uint32)
+    return jnp.sum(x.astype(jnp.uint32) * weights, axis=-1).astype(jnp.uint8)
+
+
+def _unpack_bool_bits(packed: np.ndarray, d: int) -> np.ndarray:
+    bits = np.unpackbits(np.asarray(packed), axis=-1, bitorder="little")
+    return bits[..., :d].astype(bool)
+
+
+@jax.jit
+def _match_units_kernel_packed(
+    det_boxes: Array,
+    det_valid: Array,
+    gt_boxes: Array,
+    gt_valid: Array,
+    iou_thresholds: Array,
+    area_ranges: Array,
+) -> Tuple[Array, Array, Array]:
+    """Matching kernel with bit-packed boolean outputs: the ``[U, A, T, D]``
+    match matrix dominates the device->host transfer (8x smaller as bytes,
+    which matters on hosts where the accelerator link is the bottleneck)."""
+    det_matches, det_area_out, npig = _match_units_kernel(
+        det_boxes, det_valid, gt_boxes, gt_valid, iou_thresholds, area_ranges
+    )
+    return _pack_bool_bits(det_matches), _pack_bool_bits(det_area_out), npig
+
+
 # ---------------------------------------------------------------------------
 # host packing
 # ---------------------------------------------------------------------------
@@ -213,30 +246,34 @@ def _calculate_precision_recall(
     precision = -np.ones((T, R, num_classes, num_areas, M))
     recall = -np.ones((T, num_classes, num_areas, M))
 
+    # per-max_det validity masks over the padded det axis: element (u, d) is
+    # live iff d < min(n_det[u], max_det). Boolean row-major indexing with
+    # these masks reproduces the reference's per-unit concatenation order
+    # (units ascending, then detection rank) without per-unit Python slicing.
+    D = packed.scores.shape[1]
+    det_rank = np.arange(D)[None, :]
+    live_masks = [
+        det_rank < np.minimum(packed.n_det, max_det)[:, None]
+        for max_det in max_detection_thresholds
+    ]
+
     for k in range(num_classes):
         sel = np.flatnonzero(packed.unit_class == k)
         if len(sel) == 0:
             continue
+        scores_k = packed.scores[sel]  # [S, D]
+        matches_k = det_matches[sel]  # [S, A, T, D]
+        area_out_k = det_area_out[sel]  # [S, A, D]
         for a in range(num_areas):
             npig = int(npig_units[sel, a].sum())
             if npig == 0:
                 continue  # reference map.py:641-642
             for mi, max_det in enumerate(max_detection_thresholds):
-                trims = [min(int(packed.n_det[u]), max_det) for u in sel]
-                nd = sum(trims)
-                scores = np.concatenate(
-                    [packed.scores[u, :t] for u, t in zip(sel, trims)]
-                ) if nd else np.zeros((0,), np.float64)
-                matches = np.concatenate(
-                    [det_matches[u, a, :, :t] for u, t in zip(sel, trims)], axis=1
-                ) if nd else np.zeros((T, 0), bool)
-                ignore = np.concatenate(
-                    [
-                        (~det_matches[u, a, :, :t]) & det_area_out[u, a, None, :t]
-                        for u, t in zip(sel, trims)
-                    ],
-                    axis=1,
-                ) if nd else np.zeros((T, 0), bool)
+                live = live_masks[mi][sel]  # [S, D]
+                nd = int(live.sum())
+                scores = scores_k[live]  # [nd], unit-major order
+                matches = np.moveaxis(matches_k[:, a], 1, 0)[:, live]  # [T, nd]
+                ignore = (~matches) & area_out_k[:, a][live][None, :]
 
                 # mergesort for Matlab-consistent ordering (map.py:632-634)
                 inds = np.argsort(-scores, kind="mergesort")
