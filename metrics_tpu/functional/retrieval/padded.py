@@ -17,6 +17,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
@@ -74,8 +75,11 @@ def pack_queries(
     target = jnp.asarray(target).reshape(-1)
 
     order, row, col = _segment_layout(indexes)
-    num_queries = int(row[-1]) + 1
-    max_docs = int(jnp.max(col)) + 1
+    # ONE device->host transfer for both static shapes (each separate scalar
+    # fetch costs a full accelerator-link round trip)
+    shape_info = np.asarray(jnp.stack([row[-1], jnp.max(col)]))
+    num_queries = int(shape_info[0]) + 1
+    max_docs = int(shape_info[1]) + 1
     if max_expand is not None and num_queries * max_docs > max_expand * indexes.size:
         return None
     return _scatter_pack(preds, target, order, row, col, num_queries, max_docs)
