@@ -27,6 +27,7 @@ import functools
 import inspect
 import operator
 from abc import ABC, abstractmethod
+import contextlib
 from contextlib import contextmanager
 from copy import deepcopy
 from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
@@ -202,11 +203,22 @@ class Metric(ABC):
     def _compute(self) -> Any:
         """Compute the final value from the accumulated states."""
 
+    #: set True (class- or instance-level) to wrap update/compute in named
+    #: jax.profiler traces so metric cost shows up in TPU profiles (SURVEY §5:
+    #: the reference has no tracing; this is a new opt-in capability)
+    enable_profiling: bool = False
+
+    def _trace(self, phase: str):
+        if self.enable_profiling:
+            return jax.profiler.TraceAnnotation(f"{self.__class__.__name__}.{phase}")
+        return contextlib.nullcontext()
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate into global state. Parity with reference metric.py:421-428,460-463."""
         self._computed = None
         self._update_called = True
-        self._update(*args, **kwargs)
+        with self._trace("update"):
+            self._update(*args, **kwargs)
 
     def compute(self) -> Any:
         """Compute (and cache) the metric from accumulated state, syncing across
@@ -225,7 +237,8 @@ class Metric(ABC):
             should_sync=self._to_sync,
             should_unsync=self._should_unsync,
         ):
-            value = self._compute()
+            with self._trace("compute"):
+                value = self._compute()
             self._computed = _squeeze_if_scalar(value)
         return self._computed
 
@@ -365,6 +378,30 @@ class Metric(ABC):
     # ------------------------------------------------------------------
     # pure-functional state API (TPU-native extension; no reference analog)
     # ------------------------------------------------------------------
+    def shard_states(self, shardings: Any) -> None:
+        """Place array states (and their reset defaults) under mesh shardings.
+
+        SURVEY §5 long-context analog as a library feature: large per-class /
+        per-threshold accumulator states (confusion matrices, binned curve
+        TPs/FPs/FNs, capacity-mode buffers) can live SHARDED over a
+        ``jax.sharding.Mesh`` so full-dataset state scales with the mesh
+        instead of one chip's HBM. ``shardings`` is a single
+        ``jax.sharding.Sharding`` applied to every array state, or a dict
+        mapping state names to shardings (missing names stay as they are).
+        List states (ragged host-side accumulators) are not shardable and are
+        skipped. Reset defaults are re-placed too, so ``reset()`` preserves
+        the layout.
+        """
+        for name in list(self._defaults):
+            sharding = shardings.get(name) if isinstance(shardings, dict) else shardings
+            if sharding is None:
+                continue
+            value = getattr(self, name)
+            if isinstance(value, list) or isinstance(self._defaults[name], list):
+                continue
+            object.__setattr__(self, name, jax.device_put(jnp.asarray(value), sharding))
+            self._defaults[name] = jax.device_put(jnp.asarray(self._defaults[name]), sharding)
+
     def state_reductions(self) -> Dict[str, Union[str, Callable, None]]:
         """Reducer spec per state ("sum"/"mean"/"max"/"min"/"cat", a custom
         callable, or None) — exactly what

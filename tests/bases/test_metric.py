@@ -224,3 +224,41 @@ def test_clone_independent():
     c.update(1.0)
     assert np.allclose(m.compute(), 1.0)
     assert np.allclose(c.compute(), 2.0)
+
+
+def test_shard_states_over_mesh():
+    """SURVEY §5 long-context analog: per-class state sharded over the mesh
+    stays sharded through update/compute/reset and computes correctly."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from metrics_tpu import ConfusionMatrix
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rank",))
+    metric = ConfusionMatrix(num_classes=16)
+    metric.shard_states(NamedSharding(mesh, P("rank", None)))
+
+    rng = np.random.default_rng(0)
+    preds = rng.integers(0, 16, 200)
+    target = rng.integers(0, 16, 200)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    assert metric.confmat.sharding.spec == P("rank", None)
+
+    got = np.asarray(metric.compute())
+    want = np.zeros((16, 16))
+    for p, t in zip(preds, target):
+        want[t, p] += 1
+    np.testing.assert_array_equal(got, want)
+
+    metric.reset()
+    assert metric.confmat.sharding.spec == P("rank", None)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_array_equal(np.asarray(metric.compute()), want)
+
+
+def test_enable_profiling_annotations_run():
+    """Opt-in jax.profiler annotations must not change behavior."""
+    m = DummyMetric()
+    m.enable_profiling = True
+    m.update(3.0)
+    assert float(m.compute()) == 3.0
