@@ -401,6 +401,11 @@ class Metric(ABC):
                 continue
             object.__setattr__(self, name, jax.device_put(jnp.asarray(value), sharding))
             self._defaults[name] = jax.device_put(jnp.asarray(self._defaults[name]), sharding)
+        # wrappers/compositions keep their states in children (same recursion
+        # every other state-wide operation performs)
+        if not isinstance(shardings, dict):
+            for _, child in self._iter_child_metrics():
+                child.shard_states(shardings)
 
     def state_reductions(self) -> Dict[str, Union[str, Callable, None]]:
         """Reducer spec per state ("sum"/"mean"/"max"/"min"/"cat", a custom

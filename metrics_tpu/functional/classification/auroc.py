@@ -139,6 +139,23 @@ def _auroc_compute(
     return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
 
 
+def _sorted_mean_ranks(sorted_x: Array) -> Array:
+    """Tie-averaged 1-based ranks of an ALREADY column-sorted ``[N, C]``.
+
+    The mean rank of a tie group is (first + last position)/2 + 1, computed
+    from run boundaries with cummax/cummin — no vmapped scatters or
+    segment-sums (those serialize per column on TPU).
+    """
+    n, c = sorted_x.shape
+    pos = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], sorted_x.shape)
+    change = sorted_x[1:] != sorted_x[:-1]
+    is_start = jnp.concatenate([jnp.ones((1, c), bool), change])
+    is_last = jnp.concatenate([change, jnp.ones((1, c), bool)])
+    start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=0)
+    end = jax.lax.cummin(jnp.where(is_last, pos, n - 1), axis=0, reverse=True)
+    return (start + end).astype(jnp.float32) / 2 + 1
+
+
 def auroc_rank_multiclass(
     preds: Array,
     target: Array,
@@ -168,18 +185,23 @@ def auroc_rank_multiclass(
         num_classes: number of classes ``C`` (static).
         average: 'macro' | 'weighted' | 'none'/None.
     """
-    from metrics_tpu.functional.regression.spearman import _rank_data
-
     if preds.ndim != 2 or preds.shape[1] != num_classes:
         raise ValueError(f"Expected `preds` of shape [N, {num_classes}], got {preds.shape}")
 
     n = preds.shape[0]
-    ranks = jax.vmap(_rank_data, in_axes=1, out_axes=1)(preds.astype(jnp.float32))  # [N, C]
-    pos = jax.nn.one_hot(target, num_classes, dtype=jnp.float32)  # [N, C]
-    n_pos = jnp.sum(pos, axis=0)
+    # tie-averaged ranks in SORTED order; the positive-rank sum is computed
+    # there directly (gathering the labels through the sort permutation), so
+    # no unsort/inverse-permutation pass is needed — one argsort total
+    scores = preds.astype(jnp.float32)
+    idx = jnp.argsort(scores, axis=0)
+    mean_rank_sorted = _sorted_mean_ranks(jnp.take_along_axis(scores, idx, axis=0))
+
+    tgt_sorted = target[idx]  # [N, C]
+    pos_mask = (tgt_sorted == jnp.arange(num_classes)[None, :]).astype(jnp.float32)
+    n_pos = jnp.sum(pos_mask, axis=0)
     n_neg = n - n_pos
 
-    rank_sum_pos = jnp.sum(ranks * pos, axis=0)
+    rank_sum_pos = jnp.sum(mean_rank_sorted * pos_mask, axis=0)
     u = rank_sum_pos - n_pos * (n_pos + 1) / 2
     defined = (n_pos > 0) & (n_neg > 0)
     auc_per_class = jnp.where(defined, u / jnp.where(defined, n_pos * n_neg, 1.0), jnp.nan)

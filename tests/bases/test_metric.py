@@ -262,3 +262,16 @@ def test_enable_profiling_annotations_run():
     m.enable_profiling = True
     m.update(3.0)
     assert float(m.compute()) == 3.0
+
+
+def test_shard_states_recurses_into_children():
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from metrics_tpu import ConfusionMatrix
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rank",))
+    composed = ConfusionMatrix(num_classes=16) + ConfusionMatrix(num_classes=16)
+    composed.shard_states(NamedSharding(mesh, P("rank", None)))
+    assert composed.metric_a.confmat.sharding.spec == P("rank", None)
+    assert composed.metric_b.confmat.sharding.spec == P("rank", None)
