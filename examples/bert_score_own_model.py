@@ -1,0 +1,47 @@
+"""BERTScore with your own encoder + tokenizer (analog of the reference's
+tm_examples/bert_score-own_model.py): any callable that maps
+(input_ids, attention_mask) -> [batch, seq, dim] works as the model — here a
+trivial hash-embedding encoder, so the example runs with no downloads."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo root
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from metrics_tpu.text import BERTScore
+
+_VOCAB = {w: i + 4 for i, w in enumerate("hello there general kenobi master the cat sat on a mat".split())}
+
+
+def tokenizer(texts, max_length):
+    """User-tokenizer protocol: (texts, max_length) -> input_ids + mask.
+    Must prepend a [CLS]-like (2) and append a [SEP]-like (3) token."""
+    rows = [[2] + [_VOCAB.get(w, 1) for w in t.split()][: max_length - 2] + [3] for t in texts]
+    width = max(len(r) for r in rows)
+    ids = np.zeros((len(rows), width), np.int32)
+    mask = np.zeros((len(rows), width), np.int32)
+    for i, r in enumerate(rows):
+        ids[i, : len(r)] = r
+        mask[i, : len(r)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+def model(input_ids, attention_mask):
+    """Deterministic toy encoder: fixed random embedding per token id."""
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.standard_normal((64, 16)).astype(np.float32))
+    return table[input_ids]
+
+
+def main() -> None:
+    metric = BERTScore(model=model, user_tokenizer=tokenizer)
+    metric.update(["hello there", "master kenobi"], ["hello there", "general kenobi"])
+    for key, values in metric.compute().items():
+        print(f"{key}: {[round(v, 3) for v in values]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""ROUGE with a custom normalizer and tokenizer (analog of the reference's
+tm_examples/rouge_score-own_normalizer_and_tokenizer.py)."""
+import pathlib
+import re
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo root
+
+from metrics_tpu.functional.text import rouge_score
+
+
+def normalizer(text: str) -> str:
+    """Keep digits and letters only, lowercase (the default drops digits)."""
+    return re.sub(r"[^a-z0-9]+", " ", text.lower())
+
+
+def tokenizer(text: str):
+    return text.split()
+
+
+def main() -> None:
+    preds = "Version 2 of the model scored 95 points"
+    target = "version 2 of the model scored 95"
+    scores = rouge_score(preds, target, normalizer=normalizer, tokenizer=tokenizer)
+    for key in sorted(scores):
+        print(f"{key}: {float(scores[key]):.4f}")
+
+
+if __name__ == "__main__":
+    main()
