@@ -5,9 +5,9 @@ scipy ``linear_sum_assignment`` on host (spk >= 3). Here the exhaustive
 search is a fully vectorized device kernel — the metric matrix is gathered
 along all P = spk! permutations in one ``take_along_axis`` and reduced on
 device, which stays jittable and beats a host round-trip up to the default
-``max_exhaustive_spk=6`` (720 perms). Beyond that the scipy Hungarian host
-path takes over (same optimum, host-side; inherently data-dependent —
-SURVEY §2.9).
+``max_exhaustive_spk=6`` (720 perms). Beyond that our own C++ batched
+Hungarian solver takes over (metrics_tpu/native/, compiled on demand;
+scipy fallback) — host-side by nature, data-dependent — SURVEY §2.9.
 """
 from itertools import permutations
 from typing import Any, Callable, Tuple
@@ -38,11 +38,13 @@ def _find_best_perm_exhaustive(metric_mtx: Array, eval_max: bool) -> Tuple[Array
 
 
 def _find_best_perm_lsa(metric_mtx: Array, eval_max: bool) -> Tuple[Array, Array]:
-    """Hungarian assignment on host (scipy) for large speaker counts."""
-    from scipy.optimize import linear_sum_assignment
+    """Hungarian assignment on host for large speaker counts — the in-repo
+    C++ batched solver (metrics_tpu/native/lsap.cpp, compiled on demand),
+    with scipy as the no-toolchain fallback."""
+    from metrics_tpu.native import lsap
 
     mtx = np.asarray(metric_mtx)
-    best_perm = np.stack([linear_sum_assignment(m, maximize=eval_max)[1] for m in mtx])
+    best_perm = lsap(mtx, maximize=eval_max).astype(np.int64)
     best_metric = np.take_along_axis(mtx, best_perm[:, :, None], axis=2).mean(axis=(-1, -2))
     return jnp.asarray(best_metric), jnp.asarray(best_perm)
 

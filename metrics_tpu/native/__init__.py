@@ -1,0 +1,103 @@
+"""Native (C++) host runtime components.
+
+The reference keeps zero native code in-repo and leans on external C++
+(scipy, torchvision, pesq — SURVEY §2.9). Where a host-side algorithm
+genuinely benefits, this package ships our OWN C++ compiled on demand with
+the system toolchain and bound via ctypes (no pybind11 dependency), with a
+pure-Python/scipy fallback when no compiler is available.
+
+Current components:
+- ``lsap``: batched linear sum assignment (shortest-augmenting-path
+  Hungarian), used by PIT's large-speaker path.
+"""
+import ctypes
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("lsap.cpp")
+_LIB_PATH = Path(__file__).with_name("_lsap.so")
+_lib: Optional[ctypes.CDLL] = None
+_native_failed = False
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    """Compile (once, cached next to the source) and load the solver."""
+    global _lib, _native_failed
+    if _lib is not None:
+        return _lib
+    if _native_failed:
+        return None
+    try:
+        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
+            with tempfile.NamedTemporaryFile(
+                suffix=".so", dir=str(_LIB_PATH.parent), delete=False
+            ) as tmp:
+                tmp_path = tmp.name
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", tmp_path],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp_path, _LIB_PATH)  # atomic under concurrent builds
+            finally:
+                if os.path.exists(tmp_path):  # failed/interrupted build
+                    os.unlink(tmp_path)
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.lsap_batch.restype = ctypes.c_int
+        lib.lsap_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+    except Exception:
+        _native_failed = True
+        return None
+
+
+def native_lsap_available() -> bool:
+    return _load_library() is not None
+
+
+def lsap(costs: np.ndarray, maximize: bool = False) -> np.ndarray:
+    """Batched square linear sum assignment: ``[B, N, N] -> [B, N]`` columns.
+
+    Uses the in-repo C++ solver when the toolchain is available, otherwise
+    scipy's ``linear_sum_assignment`` (identical optima; assignments may
+    differ between equally-optimal solutions).
+    """
+    costs = np.ascontiguousarray(costs, dtype=np.float64)
+    if costs.ndim == 2:
+        costs = costs[None]
+    if costs.ndim != 3 or costs.shape[1] != costs.shape[2]:
+        raise ValueError(f"Expected [batch, n, n] square cost matrices, got {costs.shape}")
+    if not np.isfinite(costs).all():
+        # non-finite costs hang the augmenting-path solver / poison potentials
+        raise ValueError("cost matrix contains invalid numeric entries (inf or nan)")
+    batch, n = costs.shape[0], costs.shape[1]
+
+    lib = _load_library()
+    if lib is None:
+        from scipy.optimize import linear_sum_assignment
+
+        return np.stack([linear_sum_assignment(m, maximize=maximize)[1] for m in costs]).astype(np.int32)
+
+    work = -costs if maximize else costs
+    out = np.empty((batch, n), dtype=np.int32)
+    rc = lib.lsap_batch(
+        work.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        batch,
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"native lsap_batch failed with code {rc}")
+    return out
