@@ -155,7 +155,7 @@ def _bucket(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
-def _pack_units(
+def _pack_units_loop(
     det_boxes: Sequence[np.ndarray],
     det_scores: Sequence[np.ndarray],
     det_labels: Sequence[np.ndarray],
@@ -217,6 +217,110 @@ def _pack_units(
         p_ndet[u] = nd
 
     return _PackedUnits(p_det, p_det_valid, p_gt, p_gt_valid, p_scores, p_class, p_ndet)
+
+
+
+def _pack_units(
+    det_boxes: Sequence[np.ndarray],
+    det_scores: Sequence[np.ndarray],
+    det_labels: Sequence[np.ndarray],
+    gt_boxes: Sequence[np.ndarray],
+    gt_labels: Sequence[np.ndarray],
+    classes: Sequence[int],
+    max_det: int,
+) -> Optional[_PackedUnits]:
+    """Vectorized unit packing (same output as ``_pack_units_loop``).
+
+    One global lexsort of all detections by (image, class, -score) and one of
+    all ground truths by (image, class) replace the per-image/per-class
+    Python loops; unit order (image-major, class-minor) and within-unit
+    tie order are preserved exactly, which matters because the PR
+    reduction's mergesort tie-breaking follows unit order.
+    """
+    n_imgs = len(gt_boxes)
+    class_arr = np.asarray(list(classes), dtype=np.int64)
+    num_classes = len(class_arr)
+    if n_imgs == 0 or num_classes == 0:
+        return None
+
+    # images contributing anything: >=1 detection AND >=1 ground truth
+    has_det = np.array([len(l) > 0 for l in det_labels], bool)
+    has_gt = np.array([len(l) > 0 for l in gt_labels], bool)
+    keep_img = has_det & has_gt
+    if not keep_img.any():
+        return None
+
+    def _flatten(boxes_seq, labels_seq, scores_seq=None):
+        imgs, boxes, labels, scores = [], [], [], []
+        for i in np.flatnonzero(keep_img):
+            n = len(labels_seq[i])
+            imgs.append(np.full(n, i, np.int64))
+            boxes.append(np.asarray(boxes_seq[i], np.float32).reshape(n, 4))
+            labels.append(np.asarray(labels_seq[i], np.int64).reshape(n))
+            if scores_seq is not None:
+                scores.append(np.asarray(scores_seq[i], np.float64).reshape(n))
+        return (
+            np.concatenate(imgs),
+            np.concatenate(boxes),
+            np.concatenate(labels),
+            np.concatenate(scores) if scores_seq is not None else None,
+        )
+
+    d_img, d_box, d_label, d_score = _flatten(det_boxes, det_labels, det_scores)
+    g_img, g_box, g_label, _ = _flatten(gt_boxes, gt_labels)
+
+    d_cls = np.searchsorted(class_arr, d_label)
+    g_cls = np.searchsorted(class_arr, g_label)
+
+    # stable global sorts: detections by (img, class, -score), gts by (img, class)
+    d_order = np.lexsort((-d_score, d_cls, d_img))
+    d_img, d_box, d_cls, d_score = d_img[d_order], d_box[d_order], d_cls[d_order], d_score[d_order]
+    g_order = np.lexsort((g_cls, g_img))
+    g_img, g_box, g_cls = g_img[g_order], g_box[g_order], g_cls[g_order]
+
+    # unit ids: unique (img, class) keys over BOTH sides, image-major order
+    d_key = d_img * num_classes + d_cls
+    g_key = g_img * num_classes + g_cls
+    unit_keys = np.unique(np.concatenate([d_key, g_key]))
+    U = len(unit_keys)
+    d_unit = np.searchsorted(unit_keys, d_key)
+    g_unit = np.searchsorted(unit_keys, g_key)
+
+    def _ranks(unit_ids):
+        """Position of each element within its (sorted, contiguous) unit run."""
+        n = len(unit_ids)
+        if n == 0:
+            return np.zeros(0, np.int64)
+        pos = np.arange(n)
+        start = np.zeros(n, np.int64)
+        new_run = np.flatnonzero(np.diff(unit_ids)) + 1
+        start[new_run] = new_run
+        return pos - np.maximum.accumulate(start)
+
+    d_rank = _ranks(d_unit)
+    keep = d_rank < max_det  # per-unit detection cap, score-descending
+    d_unit_k, d_rank_k = d_unit[keep], d_rank[keep]
+    g_rank = _ranks(g_unit)
+
+    n_det = np.bincount(d_unit_k, minlength=U).astype(np.int64)
+    n_gt = np.bincount(g_unit, minlength=U).astype(np.int64)
+    D = max(_bucket(max(int(n_det.max()), 1)), 1)
+    G = max(_bucket(max(int(n_gt.max()), 1)), 1)
+
+    p_det = np.zeros((U, D, 4), np.float32)
+    p_det_valid = np.zeros((U, D), bool)
+    p_scores = np.full((U, D), -np.inf, np.float64)
+    p_det[d_unit_k, d_rank_k] = d_box[keep]
+    p_det_valid[d_unit_k, d_rank_k] = True
+    p_scores[d_unit_k, d_rank_k] = d_score[keep]
+
+    p_gt = np.zeros((U, G, 4), np.float32)
+    p_gt_valid = np.zeros((U, G), bool)
+    p_gt[g_unit, g_rank] = g_box
+    p_gt_valid[g_unit, g_rank] = True
+
+    p_class = (unit_keys % num_classes).astype(np.int64)
+    return _PackedUnits(p_det, p_det_valid, p_gt, p_gt_valid, p_scores, p_class, n_det)
 
 
 # ---------------------------------------------------------------------------

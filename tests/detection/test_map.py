@@ -446,3 +446,35 @@ def test_map_sync_unsync_state_machine():
     assert len(m.detection_boxes) == 6  # 3 local + 3 gathered
     m.unsync()
     assert len(m.detection_boxes) == 3
+
+
+def test_vectorized_pack_equals_loop_pack():
+    """The global-lexsort packing must reproduce the per-image loop packing
+    EXACTLY (unit order and within-unit tie order feed the PR reduction's
+    mergesort tie-breaking)."""
+    from metrics_tpu.functional.detection.mean_ap import _pack_units, _pack_units_loop
+
+    rng = np.random.default_rng(0)
+    for trial in range(10):
+        n_imgs = int(rng.integers(1, 25))
+        det_b, det_s, det_l, gt_b, gt_l = [], [], [], [], []
+        for _ in range(n_imgs):
+            nd = int(rng.integers(0, 12))
+            ng = int(rng.integers(0, 8))
+            det_b.append(rng.uniform(0, 100, (nd, 4)).astype(np.float32))
+            det_s.append(np.round(rng.uniform(0, 1, nd), 1))  # score ties
+            det_l.append(rng.integers(0, 5, nd).astype(np.int32))
+            gt_b.append(rng.uniform(0, 100, (ng, 4)).astype(np.float32))
+            gt_l.append(rng.integers(0, 5, ng).astype(np.int32))
+        labels = np.concatenate(det_l + gt_l)
+        classes = sorted(int(c) for c in np.unique(labels)) if labels.size else []
+        max_det = int(rng.choice([1, 3, 100]))
+        fast = _pack_units(det_b, det_s, det_l, gt_b, gt_l, classes, max_det)
+        slow = _pack_units_loop(det_b, det_s, det_l, gt_b, gt_l, classes, max_det)
+        assert (fast is None) == (slow is None)
+        if fast is None:
+            continue
+        for name in fast._fields:
+            np.testing.assert_array_equal(
+                getattr(fast, name), getattr(slow, name), err_msg=f"trial {trial}: {name}"
+            )
