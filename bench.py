@@ -284,12 +284,65 @@ def bench_retrieval() -> None:
     )
 
 
+def bench_image() -> None:
+    """images/sec through SSIM update+compute (BASELINE config 5's measurable
+    half; FID throughput needs pretrained Inception weights absent here)."""
+    import jax
+    import jax.numpy as jnp
+    from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
+
+    rng = np.random.RandomState(5)
+    n, hw = 64, 192
+    a = rng.rand(n, 3, hw, hw).astype(np.float32)
+    b = np.clip(a + 0.05 * rng.randn(n, 3, hw, hw).astype(np.float32), 0, 1)
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+
+    fn = jax.jit(lambda x, y: structural_similarity_index_measure(x, y, data_range=1.0))
+    float(fn(ja, jb))
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v = fn(ja, jb)
+    float(v)
+    ours = n * iters / (time.perf_counter() - t0)
+
+    ref_ips = None
+    try:
+        import torch
+
+        _stub_pkg_resources()
+        sys.path.insert(0, "/root/reference")
+        from torchmetrics.functional import structural_similarity_index_measure as ref_ssim
+
+        ta, tb = torch.from_numpy(a), torch.from_numpy(b)
+        ref_ssim(ta, tb, data_range=1.0)
+        t0 = time.perf_counter()
+        ref_ssim(ta, tb, data_range=1.0)
+        ref_ips = n / (time.perf_counter() - t0)
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "ssim_update_compute_throughput",
+                "value": round(ours, 1),
+                "unit": "images/sec",
+                "vs_baseline": round(ours / ref_ips, 3) if ref_ips else None,
+            }
+        )
+    )
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "map":
         bench_map()
         return
     if len(sys.argv) > 1 and sys.argv[1] == "retrieval":
         bench_retrieval()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "image":
+        bench_image()
         return
     tpu_sps = bench_tpu()
     try:
