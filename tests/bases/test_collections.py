@@ -16,6 +16,7 @@ from metrics_tpu import (
     Recall,
 )
 from metrics_tpu.collections import MetricCollection
+from tests.helpers.testers import NUM_CLASSES
 
 _rng = np.random.RandomState(42)
 _preds = jnp.asarray(_rng.randint(0, 3, 32))
@@ -165,3 +166,37 @@ def test_collection_kwarg_filtering():
     mc = MetricCollection([Accuracy()])
     res = mc(_preds, target=_target, unused_kwarg=123)
     assert "Accuracy" in res
+
+
+def test_add_metrics_and_clone_prefix():
+    """Parity with reference test_collections.py:234-246 add_metrics and
+    clone-with-prefix behaviors."""
+    col = MetricCollection([Accuracy()])
+    col.add_metrics({"prec": Precision(num_classes=NUM_CLASSES, average="macro")})
+    col.add_metrics(Recall(num_classes=NUM_CLASSES, average="macro"))
+    assert set(col.keys()) == {"Accuracy", "prec", "Recall"}
+
+    cloned = col.clone(prefix="val_")
+    assert set(cloned.keys()) == {"val_Accuracy", "val_prec", "val_Recall"}
+    preds = jnp.asarray(_rng.rand(16, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(_rng.randint(0, NUM_CLASSES, 16))
+    cloned.update(preds, target)
+    out = cloned.compute()
+    assert set(out.keys()) == {"val_Accuracy", "val_prec", "val_Recall"}
+    # clone is independent: original remains un-updated
+    import pytest as _pytest
+    with _pytest.warns(UserWarning, match="before"):
+        col.compute()
+
+
+def test_collection_repr_and_order():
+    col = MetricCollection([Accuracy(), MeanSquaredError()])
+    rep = repr(col)
+    assert "Accuracy" in rep and "MeanSquaredError" in rep
+    # insertion order is preserved (reference test_metric_collection_same_order)
+    assert list(col.keys()) == ["Accuracy", "MeanSquaredError"]
+
+
+def test_error_on_wrong_compute_groups_spec():
+    with pytest.raises(ValueError, match="compute_groups"):
+        MetricCollection([Accuracy(), MeanSquaredError()], compute_groups=[["Accuracy", "NotThere"]])
