@@ -1,0 +1,99 @@
+"""Property-based fuzzing of the input-canonicalization layer (hypothesis).
+
+The deduction/canonicalization code (utils/checks.py) is the one component
+every classification metric flows through; these properties must hold for
+ANY valid input, not just the fixture grid:
+
+- idempotence: re-formatting an already-canonical (N, C) int pair is stable;
+- outputs are always binary int arrays of rank 2 or 3;
+- the deduced case is stable under batch slicing;
+- to_onehot/select_topk structural invariants.
+"""
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import select_topk, to_onehot
+
+_settings = settings(max_examples=60, deadline=None)
+
+
+@st.composite
+def _multiclass_prob_inputs(draw):
+    n = draw(st.integers(2, 12))
+    c = draw(st.integers(2, 6))
+    preds = draw(
+        st.lists(st.lists(st.floats(0.01, 0.99), min_size=c, max_size=c), min_size=n, max_size=n)
+    )
+    target = draw(st.lists(st.integers(0, c - 1), min_size=n, max_size=n))
+    return np.asarray(preds, np.float32), np.asarray(target, np.int32)
+
+
+@given(_multiclass_prob_inputs())
+@_settings
+def test_canonical_outputs_are_binary_int(data):
+    preds, target = data
+    p, t, mode = _input_format_classification(jnp.asarray(preds), jnp.asarray(target))
+    p, t = np.asarray(p), np.asarray(t)
+    assert p.dtype == np.int32 and t.dtype == np.int32
+    assert set(np.unique(p)) <= {0, 1} and set(np.unique(t)) <= {0, 1}
+    assert p.shape == t.shape
+    assert p.ndim in (2, 3)
+    # exactly one predicted class per sample (top-1 on prob inputs)
+    assert (p.sum(axis=1) == 1).all()
+    assert (t.sum(axis=1) == 1).all()
+
+
+@given(_multiclass_prob_inputs())
+@_settings
+def test_canonical_form_preserves_semantics(data):
+    """The canonical one-hot form encodes exactly the top-1 prediction and
+    the true label — no information is reshuffled. (True idempotence does
+    NOT hold: the deduction table deliberately re-one-hots (N, 2) int inputs
+    under multiclass=True, same as the reference.)"""
+    preds, target = data
+    p, t, _ = _input_format_classification(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_array_equal(np.argmax(np.asarray(p), axis=1), np.argmax(preds, axis=1))
+    np.testing.assert_array_equal(np.argmax(np.asarray(t), axis=1), target)
+
+
+@given(_multiclass_prob_inputs())
+@_settings
+def test_case_deduction_stable_under_slicing(data):
+    preds, target = data
+    if len(preds) < 4:
+        return
+    _, _, full_mode = _input_format_classification(jnp.asarray(preds), jnp.asarray(target))
+    _, _, half_mode = _input_format_classification(
+        jnp.asarray(preds[: len(preds) // 2]), jnp.asarray(target[: len(target) // 2])
+    )
+    assert full_mode == half_mode
+
+
+@given(st.integers(2, 10), st.integers(1, 40))
+@_settings
+def test_to_onehot_roundtrip(num_classes, n):
+    rng = np.random.default_rng(n * 100 + num_classes)
+    labels = rng.integers(0, num_classes, n)
+    onehot = np.asarray(to_onehot(jnp.asarray(labels), num_classes))
+    assert onehot.shape == (n, num_classes)
+    assert (onehot.sum(axis=1) == 1).all()
+    np.testing.assert_array_equal(np.argmax(onehot, axis=1), labels)
+
+
+@given(st.integers(2, 6), st.integers(2, 20), st.integers(1, 3))
+@_settings
+def test_select_topk_invariants(num_classes, n, k):
+    if k > num_classes:
+        return
+    rng = np.random.default_rng(n * 7 + num_classes + k)
+    probs = rng.random((n, num_classes)).astype(np.float32)
+    mask = np.asarray(select_topk(jnp.asarray(probs), k))
+    assert mask.shape == probs.shape
+    assert (mask.sum(axis=1) == k).all()
+    # selected entries dominate unselected ones row-wise
+    for row_probs, row_mask in zip(probs, mask):
+        if 0 < row_mask.sum() < num_classes:
+            assert row_probs[row_mask == 1].min() >= row_probs[row_mask == 0].max()
