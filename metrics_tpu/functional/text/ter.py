@@ -424,7 +424,7 @@ def _compute_sentence_statistics(
 ) -> Tuple[float, float]:
     """Best edit count over references + average reference length. NOTE: the
     reference evaluates ``_translation_edit_rate(tgt_words, pred_words)``
-    with swapped roles (ter.py:461-465) — preserved for parity."""
+    with swapped roles (ter.py:467) — preserved for parity."""
     tgt_lengths = 0.0
     best_num_edits = float(2e16)
     for tgt_words in target_words:

@@ -5,7 +5,7 @@ upstream) for CHRF, and the reference implementation itself (loaded from
 /root/reference) for TER/EED/SQuAD plus cross-checks, mirroring the
 reference's tests/text/{test_ter,test_chrf,test_eed,test_squad}.py. TER is
 pinned to the reference rather than modern sacrebleu because 0.8.0dev swaps
-hypothesis/reference roles (ter.py:461-465), which newer sacrebleu fixed.
+hypothesis/reference roles (ter.py:467), which newer sacrebleu fixed.
 """
 import numpy as np
 import pytest
@@ -31,7 +31,7 @@ _FLAT_TARGETS = [t for batch in _TARGETS_BATCHES for t in batch]
 def _ref_ter(preds, targets, **kw):
     # Oracle is the reference implementation itself: torchmetrics 0.8.0dev
     # computes _translation_edit_rate with swapped hypothesis/reference roles
-    # (reference functional/text/ter.py:461-465) — a quirk later sacrebleu
+    # (reference functional/text/ter.py:467) — a quirk later sacrebleu
     # versions do not share, so modern sacrebleu values differ and parity is
     # pinned against the reference.
     ref = load_reference_module("torchmetrics.functional.text.ter")
