@@ -277,3 +277,49 @@ def test_calibration_error_functional_jit():
     eager = calibration_error(preds, target)
     jitted = jax.jit(lambda p, t: calibration_error(p, t))(preds, target)
     np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+
+@pytest.mark.parametrize("squared", [False, True])
+@pytest.mark.parametrize("multiclass_mode", [None, "crammer-singer", "one-vs-all"])
+def test_hinge_modes_vs_reference(squared, multiclass_mode):
+    """All (squared x multiclass_mode) combos vs the reference implementation
+    (functional/classification/hinge.py:24-121)."""
+    from tests.helpers.reference import load_reference_module
+
+    ref_hinge = load_reference_module("torchmetrics.functional.classification.hinge").hinge_loss
+    import torch
+
+    rng = np.random.RandomState(3)
+    if multiclass_mode is None:
+        preds_np = rng.randn(32).astype(np.float32)
+        target_np = rng.randint(0, 2, 32)
+    else:
+        preds_np = rng.randn(32, NUM_CLASSES).astype(np.float32)
+        target_np = rng.randint(0, NUM_CLASSES, 32)
+
+    kwargs = {"squared": squared}
+    if multiclass_mode is not None:
+        kwargs["multiclass_mode"] = multiclass_mode
+    got = np.asarray(hinge_loss(jnp.asarray(preds_np), jnp.asarray(target_np), **kwargs))
+    want = np.asarray(ref_hinge(torch.from_numpy(preds_np), torch.from_numpy(target_np), **kwargs))
+    np.testing.assert_allclose(got, want, atol=1e-5)  # one-vs-all returns per-class
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("n_bins", [10, 30])
+def test_calibration_norms_vs_reference(norm, n_bins):
+    """ECE/RMSCE/MCE norms vs the reference (functional/classification/
+    calibration_error.py:24-126)."""
+    from tests.helpers.reference import load_reference_module
+
+    ref_cal = load_reference_module(
+        "torchmetrics.functional.classification.calibration_error"
+    ).calibration_error
+    import torch
+
+    rng = np.random.RandomState(5)
+    preds_np = rng.rand(256).astype(np.float32)
+    target_np = rng.randint(0, 2, 256)
+    got = float(calibration_error(jnp.asarray(preds_np), jnp.asarray(target_np), n_bins=n_bins, norm=norm))
+    want = float(ref_cal(torch.from_numpy(preds_np), torch.from_numpy(target_np), n_bins=n_bins, norm=norm))
+    np.testing.assert_allclose(got, want, atol=1e-6)
