@@ -478,3 +478,47 @@ def test_vectorized_pack_equals_loop_pack():
             np.testing.assert_array_equal(
                 getattr(fast, name), getattr(slow, name), err_msg=f"trial {trial}: {name}"
             )
+
+
+@pytest.mark.parametrize(
+    "iou_thresholds, rec_thresholds",
+    [
+        # NOTE: grids must keep 0.5 and 0.75 — the reference's summarize
+        # unconditionally looks them up and raises ValueError otherwise
+        # (map.py:507); ours returns -1 for absent thresholds instead
+        # (documented divergence, detection/mean_ap.py).
+        ([0.3, 0.5, 0.75], None),
+        (None, [0.0, 0.2, 0.6, 1.0]),
+        ([0.5, 0.75], [0.0, 0.5, 1.0]),
+    ],
+)
+def test_map_custom_thresholds_vs_reference(iou_thresholds, rec_thresholds):
+    """Custom IoU/recall threshold grids must track the reference exactly
+    (reference map.py:250-253 defaults overridden)."""
+    import torch
+
+    RefMAP = _load_reference_map()
+    rng = np.random.default_rng(21)
+    preds = [_random_sample(rng) for _ in range(6)]
+    target = [_random_sample(rng, with_scores=False) for _ in range(6)]
+
+    kwargs = {}
+    if iou_thresholds is not None:
+        kwargs["iou_thresholds"] = iou_thresholds
+    if rec_thresholds is not None:
+        kwargs["rec_thresholds"] = rec_thresholds
+
+    ours = MeanAveragePrecision(**kwargs)
+    ours.update(preds, target)
+    got = ours.compute()
+
+    ref = RefMAP(**kwargs)
+    ref.update(
+        [{k: torch.as_tensor(np.asarray(v)) for k, v in p.items()} for p in preds],
+        [{k: torch.as_tensor(np.asarray(v)) for k, v in t.items()} for t in target],
+    )
+    want = ref.compute()
+    for key in want:
+        np.testing.assert_allclose(
+            np.asarray(got[key]), np.asarray(want[key].numpy()), atol=1e-6, err_msg=key
+        )
