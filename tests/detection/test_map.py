@@ -502,11 +502,7 @@ def test_map_custom_thresholds_vs_reference(iou_thresholds, rec_thresholds):
     preds = [_random_sample(rng) for _ in range(6)]
     target = [_random_sample(rng, with_scores=False) for _ in range(6)]
 
-    kwargs = {}
-    if iou_thresholds is not None:
-        kwargs["iou_thresholds"] = iou_thresholds
-    if rec_thresholds is not None:
-        kwargs["rec_thresholds"] = rec_thresholds
+    kwargs = {"iou_thresholds": iou_thresholds, "rec_thresholds": rec_thresholds}
 
     ours = MeanAveragePrecision(**kwargs)
     ours.update(preds, target)
@@ -520,5 +516,23 @@ def test_map_custom_thresholds_vs_reference(iou_thresholds, rec_thresholds):
     want = ref.compute()
     for key in want:
         np.testing.assert_allclose(
-            np.asarray(got[key]), np.asarray(want[key].numpy()), atol=1e-6, err_msg=key
+            np.asarray(got[key], np.float64).reshape(-1),
+            np.asarray(want[key].numpy(), np.float64).reshape(-1),
+            atol=1e-6,
+            err_msg=key,
         )
+
+
+def test_map_absent_summary_thresholds_return_minus_one():
+    """The documented divergence from the reference: with custom grids
+    lacking 0.5/0.75 the reference CRASHES (map.py:507 list lookup); ours
+    returns -1 for the unavailable summary entries (detection/mean_ap.py)."""
+    rng = np.random.default_rng(3)
+    preds = [_random_sample(rng) for _ in range(3)]
+    target = [_random_sample(rng, with_scores=False) for _ in range(3)]
+    m = MeanAveragePrecision(iou_thresholds=[0.3, 0.6])
+    m.update(preds, target)
+    out = m.compute()
+    assert float(out["map_50"]) == -1.0
+    assert float(out["map_75"]) == -1.0
+    assert float(out["map"]) >= -1.0  # overall map still computed (mdet=100 present)
