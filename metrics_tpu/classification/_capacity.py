@@ -30,36 +30,56 @@ class CapacityCurveMixin:
 
     _capacity: Optional[int] = None
 
-    def _init_capacity(self, capacity: int) -> None:
+    def _init_capacity(self, capacity: int, num_cols: Optional[int] = None) -> None:
+        """Register the fixed-capacity buffer triple. ``num_cols`` switches the
+        score buffer from ``[capacity]`` (binary) to ``[capacity, num_cols]``
+        (per-class score rows, the multiclass exact mode)."""
         if not (isinstance(capacity, int) and capacity > 0):
             raise ValueError(f"Argument `capacity` must be a positive int, got {capacity}")
         self._capacity = capacity
+        self._capacity_cols = num_cols
         buf = curve_buffer_init(capacity)
-        self.add_state("preds", default=buf["preds"], dist_reduce_fx="cat")
+        preds_default = buf["preds"] if num_cols is None else jnp.zeros((capacity, num_cols), jnp.float32)
+        self.add_state("preds", default=preds_default, dist_reduce_fx="cat")
         self.add_state("target", default=buf["target"], dist_reduce_fx="cat")
         self.add_state("valid", default=buf["valid"], dist_reduce_fx="cat")
         # fixed-shape states + pure array ops: the whole metric traces under jit
         self.__dict__["__jit_unsafe__"] = False
 
+    _capacity_cols: Optional[int] = None
+
     def _capacity_update(self, preds, target, pos_label=None) -> None:
-        preds = jnp.asarray(preds).reshape(-1)
+        num_cols = self._capacity_cols
+        preds = jnp.asarray(preds)
         target = jnp.asarray(target).reshape(-1)
-        if preds.shape != target.shape:
-            raise ValueError("preds and target must have the same shape in capacity mode")
+        if num_cols is None:
+            preds = preds.reshape(-1)
+            if preds.shape != target.shape:
+                raise ValueError("preds and target must have the same shape in capacity mode")
+        else:
+            if preds.ndim != 2 or preds.shape[1] != num_cols:
+                raise ValueError(
+                    f"Expected `preds` of shape [N, {num_cols}] in multiclass capacity mode,"
+                    f" got {preds.shape}"
+                )
+            if preds.shape[0] != target.shape[0]:
+                raise ValueError("preds and target must agree on the batch dimension")
         if not jnp.issubdtype(preds.dtype, jnp.floating):
             raise ValueError("preds must be float scores/probabilities in capacity mode")
-        if pos_label is not None:
+        if jnp.issubdtype(target.dtype, jnp.floating):
+            raise ValueError("target must be integer labels in capacity mode")
+        if pos_label is not None and num_cols is None:
             # same binarization the unbounded path applies (target == pos_label)
             target = (target == pos_label).astype(jnp.int32)
-        elif jnp.issubdtype(target.dtype, jnp.floating):
-            raise ValueError("target must be integer binary labels in capacity mode")
-        elif _is_concrete(target) and target.size and (
-            int(jnp.min(target)) < 0 or int(jnp.max(target)) > 1
-        ):
-            raise ValueError(
-                "target must be binary (0/1) in capacity mode; pass `pos_label` to"
-                " select the positive class"
-            )
+        elif _is_concrete(target) and target.size:
+            upper = 1 if num_cols is None else num_cols - 1
+            if int(jnp.min(target)) < 0 or int(jnp.max(target)) > upper:
+                hint = (
+                    "target must be binary (0/1); pass `pos_label` to select the positive class"
+                    if num_cols is None
+                    else f"labels must be in [0, {upper}]"
+                )
+                raise ValueError(f"target out of range in capacity mode; {hint}")
         count = jnp.sum(self.valid).astype(jnp.int32)
         if _is_concrete(count) and int(count) + preds.shape[0] > self._capacity:
             raise MetricsUserError(

@@ -6,10 +6,15 @@ including the memory-footprint warning (auroc.py:146-149) and mode locking.
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.functional.classification.auroc import (
+    _auroc_compute,
+    _auroc_update,
+    auroc_rank_multiclass_masked,
+)
 from metrics_tpu.functional.classification.exact_curve import binary_auroc_fixed
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import AverageMethod
@@ -60,12 +65,24 @@ class AUROC(CapacityCurveMixin, Metric):
 
         self.mode = None
         if capacity is not None:
-            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
-            if num_classes not in (None, 1):
-                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
+            # TPU-native exact mode: static [capacity] buffers, fully jit-safe.
+            # Binary (num_classes None/1) uses the curve-buffer triple;
+            # multiclass (num_classes >= 2) keeps [capacity, C] score rows and
+            # computes the exact one-vs-rest rank AUROC with a validity mask.
             if max_fpr is not None:
                 raise ValueError("`capacity` mode does not support `max_fpr`")
-            self._init_capacity(capacity)
+            if num_classes is not None and num_classes >= 2:
+                if average == AverageMethod.MICRO:
+                    raise ValueError(
+                        "`capacity` multiclass mode supports average in"
+                        " ('macro', 'weighted', 'none'); 'micro' is not defined for the"
+                        " one-vs-rest rank kernel"
+                    )
+                self._init_capacity(capacity, num_cols=num_classes)
+                self._multiclass_capacity = True
+            else:
+                self._init_capacity(capacity)
+                self._multiclass_capacity = False
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -75,9 +92,13 @@ class AUROC(CapacityCurveMixin, Metric):
                 " For large datasets this may lead to large memory footprint."
             )
 
+    _multiclass_capacity: bool = False
+
     def _update(self, preds: Array, target: Array) -> None:
         if self._capacity is not None:
-            self._capacity_update(preds, target, pos_label=self.pos_label)
+            self._capacity_update(
+                preds, target, pos_label=None if self._multiclass_capacity else self.pos_label
+            )
             return
         preds, target, mode = _auroc_update(preds, target)
         self.preds.append(preds)
@@ -92,6 +113,14 @@ class AUROC(CapacityCurveMixin, Metric):
 
     def _compute(self) -> Array:
         if self._capacity is not None:
+            if self._multiclass_capacity:
+                # post-sync states may be stacked (num_process, ...): flatten
+                preds = self.preds.reshape(-1, self.num_classes)
+                target = self.target.reshape(-1)
+                valid = self.valid.reshape(-1)
+                return auroc_rank_multiclass_masked(
+                    preds, target, valid, self.num_classes, average=self.average
+                )
             return binary_auroc_fixed(*self._capacity_buffers())
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")

@@ -156,6 +156,53 @@ def _sorted_mean_ranks(sorted_x: Array) -> Array:
     return (start + end).astype(jnp.float32) / 2 + 1
 
 
+def auroc_rank_multiclass_masked(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+) -> Array:
+    """``auroc_rank_multiclass`` over a fixed-capacity buffer with a validity
+    mask (jit-safe; the stateful exact multiclass mode).
+
+    Invalid rows get ``-inf`` scores so they sort strictly below every real
+    score; their rank block (1..n_invalid) is subtracted from the positive
+    rank sums, which reproduces the ranks computed among valid rows alone.
+    Real ``-inf`` scores in ``preds`` would tie with the padding and are not
+    supported.
+    """
+    if preds.ndim != 2 or preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds` of shape [capacity, {num_classes}], got {preds.shape}")
+
+    n = preds.shape[0]
+    scores = jnp.where(valid[:, None], preds.astype(jnp.float32), -jnp.inf)
+    idx = jnp.argsort(scores, axis=0)
+    mean_rank_sorted = _sorted_mean_ranks(jnp.take_along_axis(scores, idx, axis=0))
+
+    masked_target = jnp.where(valid, target, -1)
+    tgt_sorted = masked_target[idx]  # [N, C]
+    pos_mask = (tgt_sorted == jnp.arange(num_classes)[None, :]).astype(jnp.float32)
+    n_pos = jnp.sum(pos_mask, axis=0)
+    n_valid = jnp.sum(valid).astype(jnp.float32)
+    n_invalid = n - n_valid
+    n_neg = n_valid - n_pos
+
+    rank_sum_pos = jnp.sum(mean_rank_sorted * pos_mask, axis=0) - n_pos * n_invalid
+    u = rank_sum_pos - n_pos * (n_pos + 1) / 2
+    defined = (n_pos > 0) & (n_neg > 0)
+    auc_per_class = jnp.where(defined, u / jnp.where(defined, n_pos * n_neg, 1.0), jnp.nan)
+
+    if average in (None, "none", AverageMethod.NONE):
+        return auc_per_class
+    if average == AverageMethod.MACRO:
+        return jnp.sum(jnp.where(defined, auc_per_class, 0.0)) / jnp.maximum(jnp.sum(defined), 1)
+    if average == AverageMethod.WEIGHTED:
+        w = jnp.where(defined, n_pos, 0.0)
+        return jnp.sum(jnp.where(defined, auc_per_class, 0.0) * w) / jnp.maximum(jnp.sum(w), 1.0)
+    raise ValueError(f"Argument `average` expected to be one of ('macro', 'weighted', 'none') but got {average}")
+
+
 def auroc_rank_multiclass(
     preds: Array,
     target: Array,
@@ -185,35 +232,10 @@ def auroc_rank_multiclass(
         num_classes: number of classes ``C`` (static).
         average: 'macro' | 'weighted' | 'none'/None.
     """
-    if preds.ndim != 2 or preds.shape[1] != num_classes:
-        raise ValueError(f"Expected `preds` of shape [N, {num_classes}], got {preds.shape}")
-
     n = preds.shape[0]
-    # tie-averaged ranks in SORTED order; the positive-rank sum is computed
-    # there directly (gathering the labels through the sort permutation), so
-    # no unsort/inverse-permutation pass is needed — one argsort total
-    scores = preds.astype(jnp.float32)
-    idx = jnp.argsort(scores, axis=0)
-    mean_rank_sorted = _sorted_mean_ranks(jnp.take_along_axis(scores, idx, axis=0))
-
-    tgt_sorted = target[idx]  # [N, C]
-    pos_mask = (tgt_sorted == jnp.arange(num_classes)[None, :]).astype(jnp.float32)
-    n_pos = jnp.sum(pos_mask, axis=0)
-    n_neg = n - n_pos
-
-    rank_sum_pos = jnp.sum(mean_rank_sorted * pos_mask, axis=0)
-    u = rank_sum_pos - n_pos * (n_pos + 1) / 2
-    defined = (n_pos > 0) & (n_neg > 0)
-    auc_per_class = jnp.where(defined, u / jnp.where(defined, n_pos * n_neg, 1.0), jnp.nan)
-
-    if average in (None, "none", AverageMethod.NONE):
-        return auc_per_class
-    if average == AverageMethod.MACRO:
-        return jnp.sum(jnp.where(defined, auc_per_class, 0.0)) / jnp.maximum(jnp.sum(defined), 1)
-    if average == AverageMethod.WEIGHTED:
-        w = jnp.where(defined, n_pos, 0.0)
-        return jnp.sum(jnp.where(defined, auc_per_class, 0.0) * w) / jnp.maximum(jnp.sum(w), 1.0)
-    raise ValueError(f"Argument `average` expected to be one of ('macro', 'weighted', 'none') but got {average}")
+    return auroc_rank_multiclass_masked(
+        preds, target, jnp.ones((n,), bool), num_classes, average=average
+    )
 
 
 def auroc(

@@ -237,13 +237,14 @@ def test_capacity_overflow_raises_eagerly():
         m.update(jnp.asarray(np.random.rand(6)), jnp.asarray([0, 1, 0, 1, 0, 1]))
 
 
-def test_capacity_mode_rejects_multiclass_and_max_fpr():
-    from metrics_tpu import AUROC
+def test_capacity_mode_rejects_unsupported_configs():
+    from metrics_tpu import AUROC, AveragePrecision
 
-    with pytest.raises(ValueError, match="binary"):
-        AUROC(num_classes=5, capacity=64)
     with pytest.raises(ValueError, match="max_fpr"):
         AUROC(max_fpr=0.5, capacity=64)
+    # AUROC now supports multiclass capacity; the curve-output classes stay binary
+    with pytest.raises(ValueError, match="binary"):
+        AveragePrecision(num_classes=5, capacity=64)
 
 
 def test_capacity_mode_ddp_sync():
@@ -279,3 +280,63 @@ def test_capacity_mode_pos_label_and_validation():
     with pytest.raises(ValueError, match="float"):
         bad = AUROC(capacity=64)
         bad.update(jnp.asarray([1, 0, 1, 0]), jnp.asarray([0, 1, 0, 1]))
+
+
+def test_auroc_multiclass_capacity_mode():
+    """Exact multiclass one-vs-rest AUROC as a stateful jit-safe metric."""
+    from metrics_tpu import AUROC
+
+    rng = np.random.default_rng(20)
+    n, c = 120, 5
+    preds_np = np.round(rng.random((n, c)), 2).astype(np.float32)  # ties
+    target_np = rng.integers(0, c, n).astype(np.int32)
+
+    for avg in ("macro", "weighted", "none"):
+        m = AUROC(num_classes=c, capacity=256, average=avg)
+        assert not m.__jit_unsafe__
+        m.update(jnp.asarray(preds_np[:50]), jnp.asarray(target_np[:50]))
+        m.update(jnp.asarray(preds_np[50:]), jnp.asarray(target_np[50:]))
+        got = np.asarray(m.compute())
+        per_class = np.asarray([
+            roc_auc_score((target_np == k).astype(int), preds_np[:, k]) for k in range(c)
+        ])
+        if avg == "macro":
+            want = np.mean(per_class)
+        elif avg == "weighted":
+            counts = np.bincount(target_np, minlength=c)
+            want = np.average(per_class, weights=counts)
+        else:
+            want = per_class
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_auroc_multiclass_capacity_inside_jit_and_sync():
+    from metrics_tpu import AUROC
+
+    rng = np.random.default_rng(21)
+    n, c = 64, 4
+    preds_np = rng.random((n, c)).astype(np.float32)
+    target_np = rng.integers(0, c, n).astype(np.int32)
+
+    m = AUROC(num_classes=c, capacity=64)
+
+    @jax.jit
+    def run(p, t):
+        state = m.init_state()
+        state = m.update_state(state, p[:32], t[:32])
+        state = m.update_state(state, p[32:], t[32:])
+        return m.compute_state(state)
+
+    got = float(run(jnp.asarray(preds_np), jnp.asarray(target_np)))
+    want = float(np.mean([
+        roc_auc_score((target_np == k).astype(int), preds_np[:, k]) for k in range(c)
+    ]))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # simulated 2-rank cat-sync over the [capacity, C] buffers
+    other = AUROC(num_classes=c, capacity=64)
+    other.update(jnp.asarray(preds_np[32:]), jnp.asarray(target_np[32:]))
+    states = iter([other.preds, other.target, other.valid])
+    synced = AUROC(num_classes=c, capacity=64, dist_sync_fn=lambda x, group=None: [x, next(states)])
+    synced.update(jnp.asarray(preds_np[:32]), jnp.asarray(target_np[:32]))
+    np.testing.assert_allclose(float(synced.compute()), want, atol=1e-6)
