@@ -167,3 +167,32 @@ def test_bert_score_errors():
         BERTScore()  # no tokenizer and no local path
     out = bert_score([], [], model=lambda i, m: None, return_hash=True)
     assert out["precision"] == [0.0] and "hash" in out
+
+
+def test_bert_score_rescale_with_local_baseline(flax_model, tiny_bert_dir, tmp_path):
+    """Baseline rescaling from a LOCAL csv (the reference downloads these;
+    here the (x - b) / (1 - b) transform is checked against a manual
+    computation; reference bert.py:440-456)."""
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tiny_bert_dir)
+    raw = bert_score(_PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer, num_layers=2)
+
+    baseline = 0.25
+    csv_path = tmp_path / "baseline.csv"
+    # bert-score baseline format: header row, then one row per layer:
+    # layer_index, P, R, F  (num_layers=2 -> row index 2 must exist)
+    lines = ["LAYER,P,R,F"] + [f"{i},{baseline},{baseline},{baseline}" for i in range(4)]
+    csv_path.write_text("\n".join(lines))
+
+    rescaled = bert_score(
+        _PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer, num_layers=2,
+        rescale_with_baseline=True, baseline_path=str(csv_path),
+    )
+    for key in ("precision", "recall", "f1"):
+        want = (np.asarray(raw[key]) - baseline) / (1 - baseline)
+        np.testing.assert_allclose(rescaled[key], want, atol=1e-6, err_msg=key)
+
+    with pytest.raises(ValueError, match="baseline_path"):
+        bert_score(_PREDS, _TARGET, model=flax_model, user_tokenizer=tokenizer,
+                   rescale_with_baseline=True)
