@@ -1,14 +1,12 @@
 """Modular PerceptualEvaluationSpeechQuality.
 
-The reference wraps the external `pesq` C library
-(/root/reference/torchmetrics/audio/pesq.py:25-118) — ITU-T P.862 is ~5k LoC
-of licensed DSP C that is inherently host-side per-utterance (SURVEY §2.9).
-DECISION: rather than re-implementing P.862, this class keeps the reference's
-exact metric surface (fs/mode validation, sum/count states, per-utterance
-averaging) and takes the scorer as an injectable host callable ``pesq_fn(ref,
-deg, fs, mode) -> float`` — the `pesq` package's ``pesq`` function slots in
-unchanged where it is installed. Constructing without a scorer raises the
-same ModuleNotFoundError shape as the reference does without the package.
+Parity surface with /root/reference/torchmetrics/audio/pesq.py:25-118
+(fs/mode validation, per-utterance scoring, sum/count averaging states). The
+reference wraps the external ``pesq`` C binding; here the default scorer is
+the IN-REPO ITU-T P.862 engine
+(:mod:`metrics_tpu.functional.audio._pesq_engine`) — no external package is
+needed. ``pesq_fn`` stays injectable for bit-exact ITU conformance via the
+``pesq`` binding where it is installed.
 """
 from typing import Any, Callable, Optional
 
@@ -17,18 +15,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio._pesq_engine import pesq as _engine_pesq
 
 Array = jax.Array
 
 
 class PerceptualEvaluationSpeechQuality(Metric):
-    """Average PESQ over accumulated utterances (scorer injected host-side).
+    """Average PESQ MOS-LQO over accumulated utterances (host-side P.862 DSP).
 
     Args:
         fs: sampling frequency (8000 for narrow-band, 16000 for wide-band).
         mode: 'nb' (narrow-band) or 'wb' (wide-band; requires fs=16000).
-        pesq_fn: host callable ``(ref, deg, fs, mode) -> float`` implementing
-            ITU-T P.862 (e.g. ``pesq.pesq`` reordered); required.
+        pesq_fn: optional scorer override ``(ref, deg, fs, mode) -> float``;
+            defaults to the in-repo P.862 engine.
     """
 
     is_differentiable = False
@@ -45,18 +44,7 @@ class PerceptualEvaluationSpeechQuality(Metric):
         if mode == "wb" and fs == 8000:
             raise ValueError("Wide-band PESQ ('wb') requires fs=16000")
         self.mode = mode
-
-        if pesq_fn is None:
-            try:  # use the C-library binding when present (reference behavior)
-                from pesq import pesq as _pesq
-
-                pesq_fn = lambda ref, deg, fs_, mode_: _pesq(fs_, ref, deg, mode_)
-            except ImportError:
-                raise ModuleNotFoundError(
-                    "PESQ metric requires an ITU-T P.862 scorer: install the `pesq` package"
-                    " or pass `pesq_fn(ref, deg, fs, mode) -> float` explicitly."
-                )
-        self.pesq_fn = pesq_fn
+        self.pesq_fn = pesq_fn or _engine_pesq
 
         self.add_state("sum_pesq", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")

@@ -43,6 +43,11 @@ class CapacityCurveMixin:
         self.add_state("preds", default=preds_default, dist_reduce_fx="cat")
         self.add_state("target", default=buf["target"], dist_reduce_fx="cat")
         self.add_state("valid", default=buf["valid"], dist_reduce_fx="cat")
+        # overflow tally: counts samples dropped by the `mode='drop'` scatter
+        # when the fill count is traced (inside jit the eager raise below
+        # cannot fire); compute NaN-poisons / raises when it is non-zero so a
+        # too-small capacity can never yield a silently wrong exact value
+        self.add_state("overflow", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
         # fixed-shape states + pure array ops: the whole metric traces under jit
         self.__dict__["__jit_unsafe__"] = False
 
@@ -87,13 +92,37 @@ class CapacityCurveMixin:
                 f" {self._capacity} samples and the batch adds {preds.shape[0]}."
                 " Construct the metric with a larger `capacity`."
             )
-        idx = count + jnp.arange(preds.shape[0], dtype=jnp.int32)
+        # write into the first free slots rather than at offset `count`: a
+        # state restored from a merged/gathered buffer may be non-contiguous,
+        # and an offset write would clobber later valid entries
+        idx = jnp.nonzero(~self.valid, size=preds.shape[0], fill_value=self._capacity)[0].astype(jnp.int32)
         self.preds = self.preds.at[idx].set(preds.astype(jnp.float32), mode="drop")
         self.target = self.target.at[idx].set(target.astype(jnp.int32), mode="drop")
         self.valid = self.valid.at[idx].set(True, mode="drop")
+        self.overflow = self.overflow + jnp.maximum(
+            count + preds.shape[0] - self._capacity, 0
+        ).astype(jnp.int32)
+
+    def _capacity_guard(self):
+        """Overflow-checked flat valid mask.
+
+        Outside jit a non-zero overflow tally raises; under tracing the mask
+        is blanked instead, which routes every downstream kernel into its
+        degenerate branch (NaN scalars / empty curve points) — a truncated
+        buffer can never produce a plausible-but-wrong exact value.
+        """
+        overflow = jnp.sum(self.overflow).astype(jnp.int32)
+        if _is_concrete(overflow) and int(overflow) > 0:
+            raise MetricsUserError(
+                f"Exact-curve capacity overflow: {int(overflow)} sample(s) were dropped by"
+                f" jitted updates beyond the declared capacity ({self._capacity})."
+                " Construct the metric with a larger `capacity`."
+            )
+        return jnp.asarray(self.valid).reshape(-1) & (overflow == 0)
 
     def _capacity_buffers(self):
         """Flattened (preds, target, valid): after a distributed sync the
         stacked ``(num_process, capacity)`` state (reference tensor-state sync
         convention) flattens to the cross-rank union; locally it's a no-op."""
-        return self.preds.reshape(-1), self.target.reshape(-1), self.valid.reshape(-1)
+        valid = self._capacity_guard()
+        return self.preds.reshape(-1), self.target.reshape(-1), valid

@@ -117,7 +117,7 @@ class AUROC(CapacityCurveMixin, Metric):
                 # post-sync states may be stacked (num_process, ...): flatten
                 preds = self.preds.reshape(-1, self.num_classes)
                 target = self.target.reshape(-1)
-                valid = self.valid.reshape(-1)
+                valid = self._capacity_guard()
                 return auroc_rank_multiclass_masked(
                     preds, target, valid, self.num_classes, average=self.average
                 )

@@ -95,6 +95,7 @@ class Metric(ABC):
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        self._cat_states: Dict[str, bool] = {}
         self._children: Dict[str, "Metric"] = {}
 
         self._is_synced = False
@@ -191,6 +192,14 @@ class Metric(ABC):
         self._defaults[name] = deepcopy(default)
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        # Explicit concat-semantics flag instead of reducer-identity checks:
+        # a custom reducer opts in by carrying a truthy ``cat_like`` attribute.
+        # (List states with a None reducer — gathered, NOT reduced, e.g.
+        # detection's per-image boxes — keep element identity and are not
+        # cat-like.)
+        self._cat_states[name] = dist_reduce_fx is dim_zero_cat or bool(
+            getattr(dist_reduce_fx, "cat_like", False)
+        )
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -295,8 +304,8 @@ class Metric(ABC):
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
 
-        for attr, reduction_fn in self._reductions.items():
-            if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
+        for attr in self._reductions:
+            if self._cat_states.get(attr) and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         import numpy as _np
@@ -454,17 +463,39 @@ class Metric(ABC):
             for k, v in old.items():
                 object.__setattr__(self, k, v)
 
-    def merge_states(self, a: Dict[str, StateValue], b: Dict[str, StateValue]) -> Dict[str, StateValue]:
-        """Merge two independently-accumulated states via each state's reducer."""
+    def merge_states(
+        self,
+        a: Dict[str, StateValue],
+        b: Dict[str, StateValue],
+        counts: Optional[Sequence[Union[int, float, Array]]] = None,
+    ) -> Dict[str, StateValue]:
+        """Merge two independently-accumulated states via each state's reducer.
+
+        ``counts`` — optional ``(n_a, n_b)`` update (or sample) counts for the
+        two states. Mean-reduced states are merged as the count-weighted
+        average ``(n_a*a + n_b*b) / (n_a + n_b)``; without ``counts`` they are
+        merged as the unweighted ``(a + b) / 2``, which matches the
+        reference's stack-then-mean sync convention but silently mis-averages
+        when the two sides accumulated different numbers of batches — pass
+        ``counts`` whenever the sides may be uneven.
+        """
+        if counts is not None and len(counts) != 2:
+            raise ValueError(f"`counts` must be a pair (n_a, n_b), got {len(counts)} entries")
         out: Dict[str, StateValue] = {}
         for name, red in self._reductions.items():
             va, vb = a[name], b[name]
-            if isinstance(va, list) or isinstance(vb, list) or red == dim_zero_cat:
+            if isinstance(va, list) or isinstance(vb, list) or self._cat_states.get(name):
                 la = va if isinstance(va, list) else [va]
                 lb = vb if isinstance(vb, list) else [vb]
                 out[name] = la + lb
             elif red == dim_zero_sum or red == dim_zero_mean:
-                out[name] = va + vb if red == dim_zero_sum else (va + vb) / 2
+                if red == dim_zero_sum:
+                    out[name] = va + vb
+                elif counts is not None:
+                    na, nb = (jnp.asarray(c, jnp.float32) for c in counts)
+                    out[name] = (na * va + nb * vb) / (na + nb)
+                else:
+                    out[name] = (va + vb) / 2
             elif red == dim_zero_max:
                 out[name] = jnp.maximum(va, vb)
             elif red == dim_zero_min:

@@ -8,3 +8,4 @@ from metrics_tpu.functional.audio.snr import (  # noqa: F401
     signal_noise_ratio,
 )
 from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility  # noqa: F401
+from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality  # noqa: F401

@@ -1,0 +1,63 @@
+"""Functional PESQ.
+
+Parity surface with /root/reference/torchmetrics/functional/audio/pesq.py
+(which validates fs/mode and loops the external ``pesq`` binding over the
+batch); here the scorer is the in-repo P.862 engine
+(:mod:`metrics_tpu.functional.audio._pesq_engine`) and no external package is
+required. A custom ``pesq_fn(ref, deg, fs, mode) -> float`` can still be
+injected (e.g. the ``pesq`` C binding for bit-exact ITU conformance).
+"""
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.audio._pesq_engine import pesq as _engine_pesq
+
+Array = jax.Array
+
+__all__ = ["perceptual_evaluation_speech_quality"]
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    pesq_fn: Optional[Callable] = None,
+) -> Array:
+    """PESQ MOS-LQO per utterance (host-side P.862 DSP, batch preserved).
+
+    Args:
+        preds: degraded speech ``[..., time]``.
+        target: clean reference speech, same shape.
+        fs: sampling frequency — 8000 (narrow-band) or 16000.
+        mode: ``"nb"`` or ``"wb"`` (wide-band requires fs=16000).
+        pesq_fn: optional scorer override ``(ref, deg, fs, mode) -> float``.
+
+    Returns:
+        Array of MOS-LQO scores with shape ``preds.shape[:-1]``.
+    """
+    # validate unconditionally (the default engine re-checks, but a custom
+    # scorer must not silently receive an invalid fs/mode combination)
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("nb", "wb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    if mode == "wb" and fs == 8000:
+        raise ValueError("Wide-band PESQ ('wb') requires fs=16000")
+    scorer = pesq_fn or _engine_pesq
+    preds_np = np.asarray(preds, np.float64)
+    target_np = np.asarray(target, np.float64)
+    if preds_np.shape != target_np.shape:
+        raise ValueError(
+            f"preds and target must have the same shape, got {preds_np.shape} and {target_np.shape}"
+        )
+    batch_shape = preds_np.shape[:-1]
+    preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+    target_np = target_np.reshape(-1, target_np.shape[-1])
+    scores = np.array(
+        [scorer(ref, deg, fs, mode) for ref, deg in zip(target_np, preds_np)], np.float32
+    )
+    return jnp.asarray(scores.reshape(batch_shape) if batch_shape else scores[0])

@@ -41,13 +41,17 @@ def curve_buffer_init(capacity: int) -> Dict[str, Array]:
 
 
 def curve_buffer_update(state: Dict[str, Array], preds: Array, target: Array) -> Dict[str, Array]:
-    """Append a batch at the current fill level (jit-safe).
+    """Append a batch into the first free slots (jit-safe).
 
+    The write positions come from the valid mask itself (first ``len(preds)``
+    unset slots), NOT from an offset at ``sum(valid)`` — so updating a buffer
+    produced by :func:`curve_buffer_merge` / an all_gather (partially-filled
+    shards concatenated, non-contiguous fill) never overwrites valid entries.
     Writes past capacity are dropped silently under jit (XLA scatter
     ``mode='drop'``); the stateful wrapper raises eagerly on overflow.
     """
-    count = jnp.sum(state["valid"]).astype(jnp.int32)
-    idx = count + jnp.arange(preds.shape[0], dtype=jnp.int32)
+    capacity = state["valid"].shape[0]
+    idx = jnp.nonzero(~state["valid"], size=preds.shape[0], fill_value=capacity)[0].astype(jnp.int32)
     return {
         "preds": state["preds"].at[idx].set(preds.astype(jnp.float32), mode="drop"),
         "target": state["target"].at[idx].set(target.astype(jnp.int32), mode="drop"),

@@ -73,6 +73,11 @@ def pack_queries(
     indexes = jnp.asarray(indexes).reshape(-1)
     preds = jnp.asarray(preds).reshape(-1)
     target = jnp.asarray(target).reshape(-1)
+    if indexes.size == 0:
+        raise ValueError(
+            "`indexes` is empty — the retrieval metric has no accumulated samples;"
+            " call `update` before `compute`."
+        )
 
     order, row, col = _segment_layout(indexes)
     # ONE device->host transfer for both static shapes (each separate scalar

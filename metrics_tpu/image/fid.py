@@ -123,6 +123,7 @@ class FrechetInceptionDistance(Metric):
             self.fake_features.append(features)
 
     def _compute(self) -> Array:
+        getattr(self.inception, "finalize", lambda: None)()  # flush async range check of the last batch
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)
         orig_dtype = real_features.dtype

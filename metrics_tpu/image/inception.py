@@ -63,6 +63,7 @@ class InceptionScore(Metric):
         self.features.append(features)
 
     def _compute(self) -> Tuple[Array, Array]:
+        getattr(self.inception, "finalize", lambda: None)()  # flush async range check of the last batch
         features = dim_zero_cat(self.features)
         idx = self._rng.permutation(features.shape[0])
         features = features[idx]

@@ -117,6 +117,7 @@ class KernelInceptionDistance(Metric):
             self.fake_features.append(features)
 
     def _compute(self) -> Tuple[Array, Array]:
+        getattr(self.inception, "finalize", lambda: None)()  # flush async range check of the last batch
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)
 

@@ -342,4 +342,15 @@ def build_fid_inception(
                 pending_max = jnp.max(imgs)
         return jitted(imgs)
 
+    def finalize() -> None:
+        """Flush the pending async range check (covers the LAST device batch
+        of a stream, which the one-batch-delayed check would otherwise skip).
+        FID/KID/IS call this at compute time."""
+        nonlocal pending_max
+        if pending_max is not None:
+            mx = float(pending_max)
+            pending_max = None
+            _validate_max(mx)
+
+    extract.finalize = finalize
     return extract

@@ -65,9 +65,22 @@ class CHRFScore(Metric):
             self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
 
     def _totals(self):
+        # one stacked device->host readback for all ~16 per-order scalars (a
+        # per-scalar float() would cost a blocking roundtrip each on
+        # tunneled/remote accelerators)
+        import numpy as np
+
+        layout = list(zip(_TOTAL_NAMES, _zero_totals(self.n_char_order, self.n_word_order)))
+        stacked = np.asarray(
+            jnp.stack(
+                [jnp.asarray(getattr(self, f"total_{name}_{n}grams"), jnp.float32) for name, orders in layout for n in orders]
+            )
+        )
         out = []
-        for name, orders in zip(_TOTAL_NAMES, _zero_totals(self.n_char_order, self.n_word_order)):
-            out.append({n: float(getattr(self, f"total_{name}_{n}grams")) for n in orders})
+        i = 0
+        for name, orders in layout:
+            out.append({n: float(stacked[i + j]) for j, n in enumerate(orders)})
+            i += len(orders)
         return tuple(out)
 
     def _store_totals(self, totals) -> None:

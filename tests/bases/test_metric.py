@@ -275,3 +275,71 @@ def test_shard_states_recurses_into_children():
     composed.shard_states(NamedSharding(mesh, P("rank", None)))
     assert composed.metric_a.confmat.sharding.spec == P("rank", None)
     assert composed.metric_b.confmat.sharding.spec == P("rank", None)
+
+
+def test_merge_states_weighted_mean():
+    """Mean-reduced states merge as a count-weighted average when counts are
+    given (core/metric.py merge_states); unweighted (a+b)/2 otherwise."""
+
+    class MeanStateMetric(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("m", jnp.array(0.0), dist_reduce_fx="mean")
+
+        def _update(self, x):
+            self.m = jnp.asarray(x, dtype=jnp.float32)
+
+        def _compute(self):
+            return self.m
+
+    m = MeanStateMetric()
+    a, b = {"m": jnp.array(1.0)}, {"m": jnp.array(4.0)}
+    assert float(m.merge_states(a, b)["m"]) == pytest.approx(2.5)
+    # side a saw 3 batches, side b saw 1: weighted mean, not midpoint
+    assert float(m.merge_states(a, b, counts=(3, 1))["m"]) == pytest.approx(1.75)
+    with pytest.raises(ValueError, match="pair"):
+        m.merge_states(a, b, counts=(1, 2, 3))
+
+
+def test_custom_cat_like_reducer_flag():
+    """A custom reducer marked ``cat_like=True`` gets concat semantics in
+    merge_states and the pre-cat optimization in _sync_dist (the contract is
+    the explicit flag, not function identity with dim_zero_cat)."""
+
+    def my_cat(x):
+        return jnp.concatenate(x) if isinstance(x, list) else x
+
+    my_cat.cat_like = True
+
+    class CustomCatMetric(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("vals", [], dist_reduce_fx=my_cat)
+
+        def _update(self, x):
+            self.vals.append(jnp.asarray(x, dtype=jnp.float32).reshape(-1))
+
+        def _compute(self):
+            return jnp.concatenate(self.vals) if isinstance(self.vals, list) else self.vals
+
+    seen = []
+
+    def spy_gather(x, group=None):
+        seen.append(x if isinstance(x, list) else [x])
+        return x if isinstance(x, list) else [x]
+
+    m = CustomCatMetric(dist_sync_fn=spy_gather)
+    assert m._cat_states["vals"] is True
+
+    # merge_states concatenates instead of raising "custom reduction"
+    a = {"vals": [jnp.array([1.0])]}
+    b = {"vals": [jnp.array([2.0])]}
+    assert len(m.merge_states(a, b)["vals"]) == 2
+
+    # sync: the two appended arrays are pre-concatenated into ONE gather call
+    m.update([1.0, 2.0])
+    m.update([3.0])
+    m.sync()
+    assert len(seen) == 1, "pre-cat optimization must collapse the list state to a single gather"
+    np.testing.assert_allclose(np.asarray(m._compute()), [1.0, 2.0, 3.0])
+    m.unsync()

@@ -254,7 +254,7 @@ def test_capacity_mode_ddp_sync():
     preds, target = _data(13, 64)
     m_other = AUROC(capacity=64)
     m_other.update(jnp.asarray(preds[32:]), jnp.asarray(target[32:]))
-    other_states = iter([m_other.preds, m_other.target, m_other.valid])
+    other_states = iter([m_other.preds, m_other.target, m_other.valid, m_other.overflow])
 
     m = AUROC(capacity=64, dist_sync_fn=lambda x, group=None: [x, next(other_states)])
     m.update(jnp.asarray(preds[:32]), jnp.asarray(target[:32]))
@@ -336,7 +336,43 @@ def test_auroc_multiclass_capacity_inside_jit_and_sync():
     # simulated 2-rank cat-sync over the [capacity, C] buffers
     other = AUROC(num_classes=c, capacity=64)
     other.update(jnp.asarray(preds_np[32:]), jnp.asarray(target_np[32:]))
-    states = iter([other.preds, other.target, other.valid])
+    states = iter([other.preds, other.target, other.valid, other.overflow])
     synced = AUROC(num_classes=c, capacity=64, dist_sync_fn=lambda x, group=None: [x, next(states)])
     synced.update(jnp.asarray(preds_np[:32]), jnp.asarray(target_np[:32]))
     np.testing.assert_allclose(float(synced.compute()), want, atol=1e-6)
+
+
+def test_buffer_update_after_merge_appends_into_free_slots():
+    """curve_buffer_update writes into the first FREE slots (mask-derived),
+    so updating a merged non-contiguous buffer never overwrites valid data."""
+    a = curve_buffer_init(8)
+    a = curve_buffer_update(a, jnp.asarray([0.1, 0.2]), jnp.asarray([0, 1]))
+    b = curve_buffer_init(8)
+    b = curve_buffer_update(b, jnp.asarray([0.3]), jnp.asarray([1]))
+    merged = curve_buffer_merge(a, b)  # valid: [T T F...|T F...] — non-contiguous
+    merged = curve_buffer_update(merged, jnp.asarray([0.4, 0.5]), jnp.asarray([0, 1]))
+    valid = np.asarray(merged["valid"])
+    assert valid.sum() == 5
+    got = sorted(np.asarray(merged["preds"])[valid].tolist())
+    np.testing.assert_allclose(got, [0.1, 0.2, 0.3, 0.4, 0.5], atol=1e-6)
+
+
+def test_capacity_overflow_under_jit_is_detected():
+    """Inside jit the fill count is traced and the eager raise cannot fire;
+    the overflow state must make the result detectable, not silently wrong."""
+    from metrics_tpu import AUROC
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    m = AUROC(capacity=8)
+    state = m.init_state()
+    upd = jax.jit(m.update_state)
+    p = jnp.linspace(0.05, 0.95, 6)
+    t = jnp.asarray([0, 1, 0, 1, 0, 1])
+    state = upd(state, p, t)
+    state = upd(state, p, t)  # 12 samples into capacity 8
+    assert int(state["overflow"]) > 0
+    # traced compute NaN-poisons
+    assert np.isnan(float(jax.jit(m.compute_state)(state)))
+    # eager compute raises a descriptive error
+    with pytest.raises(MetricsUserError, match="capacity overflow"):
+        m.compute_state(state)

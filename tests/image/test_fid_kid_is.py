@@ -391,3 +391,22 @@ def test_feature_argument_validation():
         KernelInceptionDistance(feature=[1, 2])
     with pytest.raises(ValueError, match="weights"):
         FrechetInceptionDistance(feature=2048)  # bundled net without weights
+
+
+def test_extractor_finalize_validates_last_batch(converted_pair, tmp_path):
+    """The async range check is one batch delayed; finalize() (called from
+    FID/KID/IS compute) must flush it so a mis-ranged FINAL batch still
+    raises instead of silently mis-scaling features."""
+    from metrics_tpu.models.inception import build_fid_inception
+
+    net, variables = converted_pair
+    path = tmp_path / "inception.npz"
+    np.savez(path, variables=np.asarray(variables, dtype=object))
+    extractor = build_fid_inception(64, str(path))
+
+    bad = jnp.asarray(np.random.RandomState(0).rand(2, 3, 299, 299).astype(np.float32) * 255.0)
+    extractor(bad)  # async check enqueued, not yet validated
+    with pytest.raises(ValueError, match="must be in"):
+        extractor.finalize()
+    # flushed: a second finalize is a no-op
+    extractor.finalize()

@@ -286,3 +286,14 @@ def test_skewed_groups_fall_back_to_host_loop():
     m = RetrievalMAP()
     m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     np.testing.assert_allclose(np.asarray(m._compute()), np.asarray(m._compute_host_loop()), atol=1e-6)
+
+
+def test_pack_queries_empty_raises_descriptive():
+    """compute-before-update must raise a clear message, not an IndexError
+    (functional/retrieval/padded.py pack_queries zero-length guard)."""
+    from metrics_tpu.functional.retrieval.padded import pack_queries
+
+    with pytest.raises(ValueError, match="no accumulated samples"):
+        pack_queries(
+            jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.float32)
+        )
