@@ -2,20 +2,18 @@
 
 Parity surface with /root/reference/torchmetrics/audio/pesq.py:25-118
 (fs/mode validation, per-utterance scoring, sum/count averaging states). The
-reference wraps the external ``pesq`` C binding; here the default scorer is
-the IN-REPO ITU-T P.862 engine
-(:mod:`metrics_tpu.functional.audio._pesq_engine`) — no external package is
-needed. ``pesq_fn`` stays injectable for bit-exact ITU conformance via the
-``pesq`` binding where it is installed.
+default scorer is the external ``pesq`` C binding when installed (bit-exact
+ITU conformance, what the reference wraps), otherwise the IN-REPO ITU-T
+P.862 engine (:mod:`metrics_tpu.functional.audio._pesq_engine`) — the metric
+always computes. ``pesq_fn`` stays injectable.
 """
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.audio._pesq_engine import pesq as _engine_pesq
+from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
 
 Array = jax.Array
 
@@ -27,7 +25,8 @@ class PerceptualEvaluationSpeechQuality(Metric):
         fs: sampling frequency (8000 for narrow-band, 16000 for wide-band).
         mode: 'nb' (narrow-band) or 'wb' (wide-band; requires fs=16000).
         pesq_fn: optional scorer override ``(ref, deg, fs, mode) -> float``;
-            defaults to the in-repo P.862 engine.
+            defaults to the ``pesq`` C binding when installed, else the
+            in-repo P.862 engine.
     """
 
     is_differentiable = False
@@ -44,21 +43,17 @@ class PerceptualEvaluationSpeechQuality(Metric):
         if mode == "wb" and fs == 8000:
             raise ValueError("Wide-band PESQ ('wb') requires fs=16000")
         self.mode = mode
-        self.pesq_fn = pesq_fn or _engine_pesq
+        self.pesq_fn = pesq_fn
 
         self.add_state("sum_pesq", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
     def _update(self, preds: Array, target: Array) -> None:
-        preds_np = np.asarray(preds, np.float64)
-        target_np = np.asarray(target, np.float64)
-        if preds_np.shape != target_np.shape:
-            raise ValueError("preds and target must have the same shape")
-        preds_np = preds_np.reshape(-1, preds_np.shape[-1])
-        target_np = target_np.reshape(-1, target_np.shape[-1])
-        for deg, ref in zip(preds_np, target_np):
-            self.sum_pesq = self.sum_pesq + float(self.pesq_fn(ref, deg, self.fs, self.mode))
-            self.total = self.total + 1
+        scores = perceptual_evaluation_speech_quality(
+            preds, target, self.fs, self.mode, self.pesq_fn
+        )
+        self.sum_pesq = self.sum_pesq + jnp.sum(scores)
+        self.total = self.total + scores.size
 
     def _compute(self) -> Array:
         return self.sum_pesq / self.total

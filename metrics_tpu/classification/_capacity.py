@@ -5,9 +5,12 @@ AUROC / AveragePrecision / PrecisionRecallCurve / ROC switches the unbounded
 cat-list states to a static ``[N]`` buffer triple (preds, target, valid) so
 the ENTIRE metric — update, compute, sync — is jit-traceable and mesh-
 syncable (SURVEY §7 design-3; kernels in
-functional/classification/exact_curve.py). Binary mode only: inputs must be
-1-D scores and binary integer targets (the shape/dtype case deduction of the
-unbounded path is host logic).
+functional/classification/exact_curve.py). The case must be declared
+statically (the shape/dtype case deduction of the unbounded path is host
+logic): binary is the default (1-D scores, 0/1 integer targets);
+``num_classes >= 2`` switches to ``[capacity, C]`` score rows with integer
+labels (multiclass one-vs-rest) or, with ``multilabel=True``, ``[capacity,
+C]`` indicator targets.
 """
 from typing import Optional
 
@@ -30,18 +33,28 @@ class CapacityCurveMixin:
 
     _capacity: Optional[int] = None
 
-    def _init_capacity(self, capacity: int, num_cols: Optional[int] = None) -> None:
+    def _init_capacity(
+        self, capacity: int, num_cols: Optional[int] = None, multilabel: bool = False
+    ) -> None:
         """Register the fixed-capacity buffer triple. ``num_cols`` switches the
         score buffer from ``[capacity]`` (binary) to ``[capacity, num_cols]``
-        (per-class score rows, the multiclass exact mode)."""
+        (per-class score rows, the multiclass exact mode); ``multilabel``
+        additionally widens the target buffer to ``[capacity, num_cols]``
+        per-class indicators."""
         if not (isinstance(capacity, int) and capacity > 0):
             raise ValueError(f"Argument `capacity` must be a positive int, got {capacity}")
+        if multilabel and num_cols is None:
+            raise ValueError("`multilabel` capacity mode requires `num_cols`")
         self._capacity = capacity
         self._capacity_cols = num_cols
+        self._capacity_multilabel = multilabel
         buf = curve_buffer_init(capacity)
         preds_default = buf["preds"] if num_cols is None else jnp.zeros((capacity, num_cols), jnp.float32)
+        target_default = (
+            jnp.zeros((capacity, num_cols), jnp.int32) if multilabel else buf["target"]
+        )
         self.add_state("preds", default=preds_default, dist_reduce_fx="cat")
-        self.add_state("target", default=buf["target"], dist_reduce_fx="cat")
+        self.add_state("target", default=target_default, dist_reduce_fx="cat")
         self.add_state("valid", default=buf["valid"], dist_reduce_fx="cat")
         # overflow tally: counts samples dropped by the `mode='drop'` scatter
         # when the fill count is traced (inside jit the eager raise below
@@ -52,11 +65,34 @@ class CapacityCurveMixin:
         self.__dict__["__jit_unsafe__"] = False
 
     _capacity_cols: Optional[int] = None
+    _capacity_multilabel: bool = False
+
+    def _init_capacity_case(
+        self, capacity: Optional[int], num_classes: Optional[int], multilabel: bool
+    ) -> None:
+        """Shared constructor dispatch for the curve classes: binary buffers
+        by default, ``[capacity, C]`` rows when ``num_classes >= 2``; validates
+        the ``multilabel``/``capacity`` combinations. No-op states are NOT
+        registered here when ``capacity`` is None — the caller keeps its
+        unbounded cat-state path."""
+        if capacity is None:
+            if multilabel:
+                raise ValueError("`multilabel` is a capacity-mode argument; pass `capacity` as well")
+            return
+        if num_classes is not None and num_classes >= 2:
+            self._init_capacity(capacity, num_cols=num_classes, multilabel=multilabel)
+        elif multilabel:
+            raise ValueError("`multilabel` capacity mode requires `num_classes >= 2`")
+        else:
+            self._init_capacity(capacity)
 
     def _capacity_update(self, preds, target, pos_label=None) -> None:
         num_cols = self._capacity_cols
+        multilabel = self._capacity_multilabel
         preds = jnp.asarray(preds)
-        target = jnp.asarray(target).reshape(-1)
+        target = jnp.asarray(target)
+        if not multilabel:
+            target = target.reshape(-1)
         if num_cols is None:
             preds = preds.reshape(-1)
             if preds.shape != target.shape:
@@ -66,6 +102,11 @@ class CapacityCurveMixin:
                 raise ValueError(
                     f"Expected `preds` of shape [N, {num_cols}] in multiclass capacity mode,"
                     f" got {preds.shape}"
+                )
+            if multilabel and preds.shape != target.shape:
+                raise ValueError(
+                    f"Expected `target` of shape [N, {num_cols}] in multilabel capacity mode,"
+                    f" got {target.shape}"
                 )
             if preds.shape[0] != target.shape[0]:
                 raise ValueError("preds and target must agree on the batch dimension")
@@ -77,12 +118,12 @@ class CapacityCurveMixin:
             # same binarization the unbounded path applies (target == pos_label)
             target = (target == pos_label).astype(jnp.int32)
         elif _is_concrete(target) and target.size:
-            upper = 1 if num_cols is None else num_cols - 1
+            upper = 1 if (num_cols is None or multilabel) else num_cols - 1
             if int(jnp.min(target)) < 0 or int(jnp.max(target)) > upper:
                 hint = (
                     "target must be binary (0/1); pass `pos_label` to select the positive class"
                     if num_cols is None
-                    else f"labels must be in [0, {upper}]"
+                    else ("multilabel indicators must be 0/1" if multilabel else f"labels must be in [0, {upper}]")
                 )
                 raise ValueError(f"target out of range in capacity mode; {hint}")
         count = jnp.sum(self.valid).astype(jnp.int32)
@@ -126,3 +167,15 @@ class CapacityCurveMixin:
         convention) flattens to the cross-rank union; locally it's a no-op."""
         valid = self._capacity_guard()
         return self.preds.reshape(-1), self.target.reshape(-1), valid
+
+    def _capacity_buffers_2d(self):
+        """Row-flattened (preds [N, C], target, valid) for the multiclass /
+        multilabel kernels; stacked post-sync states flatten along rows."""
+        num_cols = self._capacity_cols
+        valid = self._capacity_guard()
+        target = (
+            self.target.reshape(-1, num_cols)
+            if self._capacity_multilabel
+            else self.target.reshape(-1)
+        )
+        return self.preds.reshape(-1, num_cols), target, valid

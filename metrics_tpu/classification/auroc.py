@@ -114,10 +114,7 @@ class AUROC(CapacityCurveMixin, Metric):
     def _compute(self) -> Array:
         if self._capacity is not None:
             if self._multiclass_capacity:
-                # post-sync states may be stacked (num_process, ...): flatten
-                preds = self.preds.reshape(-1, self.num_classes)
-                target = self.target.reshape(-1)
-                valid = self._capacity_guard()
+                preds, target, valid = self._capacity_buffers_2d()
                 return auroc_rank_multiclass_masked(
                     preds, target, valid, self.num_classes, average=self.average
                 )

@@ -8,7 +8,10 @@ import jax
 
 from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.classification.exact_curve import binary_average_precision_fixed
+from metrics_tpu.functional.classification.exact_curve import (
+    binary_average_precision_fixed,
+    multiclass_average_precision_fixed,
+)
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
@@ -40,6 +43,7 @@ class AveragePrecision(CapacityCurveMixin, Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         capacity: Optional[int] = None,
+        multilabel: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -49,12 +53,12 @@ class AveragePrecision(CapacityCurveMixin, Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        if capacity is not None:
-            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
-            if num_classes not in (None, 1):
-                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
-            self._init_capacity(capacity)
-        else:
+        # TPU-native exact mode: static [capacity] buffers, fully jit-safe.
+        # Binary keeps the flat triple; num_classes >= 2 keeps [capacity, C]
+        # score rows (one-vs-rest AP per class); `multilabel=True`
+        # additionally stores [capacity, C] indicator targets.
+        self._init_capacity_case(capacity, num_classes, multilabel)
+        if capacity is None:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
@@ -72,6 +76,13 @@ class AveragePrecision(CapacityCurveMixin, Metric):
 
     def _compute(self) -> Union[Array, List[Array]]:
         if self._capacity is not None:
+            if self._capacity_cols is not None:
+                return multiclass_average_precision_fixed(
+                    *self._capacity_buffers_2d(),
+                    self.num_classes,
+                    average="none" if self.average is None else self.average,
+                    multilabel=self._capacity_multilabel,
+                )
             return binary_average_precision_fixed(*self._capacity_buffers())
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)

@@ -8,7 +8,10 @@ import jax
 
 from metrics_tpu.classification._capacity import CapacityCurveMixin
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.classification.exact_curve import binary_roc_fixed
+from metrics_tpu.functional.classification.exact_curve import (
+    binary_roc_fixed,
+    multiclass_roc_fixed,
+)
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
 from metrics_tpu.utils.data import dim_zero_cat
 
@@ -36,17 +39,18 @@ class ROC(CapacityCurveMixin, Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         capacity: Optional[int] = None,
+        multilabel: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        if capacity is not None:
-            # TPU-native exact mode: static [capacity] buffer, fully jit-safe
-            if num_classes not in (None, 1):
-                raise ValueError("`capacity` mode supports binary inputs only (num_classes=None)")
-            self._init_capacity(capacity)
-        else:
+        # TPU-native exact mode: static [capacity] buffers, fully jit-safe.
+        # Binary keeps the flat triple; num_classes >= 2 keeps [capacity, C]
+        # score rows (one-vs-rest curves per class); `multilabel=True`
+        # additionally stores [capacity, C] indicator targets.
+        self._init_capacity_case(capacity, num_classes, multilabel)
+        if capacity is None:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
 
@@ -68,7 +72,14 @@ class ROC(CapacityCurveMixin, Metric):
         Tuple[Array, Array, Array, Array],  # capacity mode: (fpr, tpr, thresholds, point_mask)
     ]:
         if self._capacity is not None:
-            # static-shape output: (fpr, tpr, thresholds, point_mask)
+            # static-shape output: (fpr, tpr, thresholds, point_mask);
+            # multiclass/multilabel rows are per-class one-vs-rest curves
+            if self._capacity_cols is not None:
+                return multiclass_roc_fixed(
+                    *self._capacity_buffers_2d(),
+                    self.num_classes,
+                    multilabel=self._capacity_multilabel,
+                )
             return binary_roc_fixed(*self._capacity_buffers())
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)

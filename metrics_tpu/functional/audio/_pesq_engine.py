@@ -79,10 +79,12 @@ def _hearing_threshold_db(f_hz: np.ndarray) -> np.ndarray:
 
 
 def _frame_params(fs: int) -> Tuple[int, int, int]:
-    """(frame length, hop, number of Bark bands) — 32 ms Hann frames."""
+    """(frame length, hop, number of Bark bands) — 32 ms Hann frames with
+    50% overlap (256/128 samples at 8 kHz, 512/256 at 16 kHz), the P.862
+    frame grid; 20-frame disturbance chunks then span 320 ms."""
     if fs == 8000:
-        return 512, 256, 42
-    return 1024, 512, 49
+        return 256, 128, 42
+    return 512, 256, 49
 
 
 def _band_edges(fs: int, n_fft: int, n_bands: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -208,17 +210,25 @@ def _fine_delay(ref_seg: np.ndarray, deg: np.ndarray, seg_start: int, crude: int
     radius = fs // 40
     lo = seg_start + crude - radius
     hi = seg_start + crude + len(ref_seg) + radius
-    pad_lo, pad_hi = max(0, -lo), max(0, hi - len(deg))
-    window = np.pad(deg[max(lo, 0): min(hi, len(deg))], (pad_lo, pad_hi))
+    window = _shifted(deg, 0, lo, hi)
     corr = np.correlate(window, ref_seg, mode="valid")
     return crude - radius + int(np.argmax(np.abs(corr)))
 
 
 def _shifted(deg: np.ndarray, delay: int, start: int, end: int) -> np.ndarray:
-    """``deg[start+delay : end+delay]`` zero-padded at the file boundaries."""
+    """``deg[start+delay : end+delay]`` zero-padded where outside the file.
+
+    Both slice bounds are clamped into ``[0, len(deg)]`` — a negative stop
+    must not re-index from the file end — so the result always has exactly
+    ``end - start`` samples even when the window lies entirely outside.
+    """
+    n = end - start
     src_lo, src_hi = start + delay, end + delay
-    pad_lo, pad_hi = max(0, -src_lo), max(0, src_hi - len(deg))
-    return np.pad(deg[max(src_lo, 0): min(src_hi, len(deg))], (pad_lo, pad_hi))
+    lo = min(max(src_lo, 0), len(deg))
+    hi = min(max(src_hi, lo), len(deg))
+    core = deg[lo:hi]
+    pad_lo = min(max(0, -src_lo), n)
+    return np.pad(core, (pad_lo, n - pad_lo - len(core)))
 
 
 def _align(ref: np.ndarray, deg: np.ndarray, fs: int) -> np.ndarray:

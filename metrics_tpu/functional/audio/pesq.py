@@ -14,10 +14,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.functional.audio._pesq_engine import pesq as _engine_pesq
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
 Array = jax.Array
 
 __all__ = ["perceptual_evaluation_speech_quality"]
+
+
+def _default_pesq_fn() -> Callable:
+    """Scorer used when no ``pesq_fn`` is injected: the external ``pesq`` C
+    binding when installed (bit-exact ITU-T conformance, and what the
+    reference wraps — torchmetrics/functional/audio/pesq.py), otherwise the
+    in-repo P.862 engine so the metric computes with zero dependencies."""
+    if _PESQ_AVAILABLE:
+        from pesq import pesq as pesq_backend
+
+        return lambda ref, deg, fs, mode: pesq_backend(fs, ref, deg, mode)
+    return _engine_pesq
 
 
 def perceptual_evaluation_speech_quality(
@@ -47,7 +60,7 @@ def perceptual_evaluation_speech_quality(
         raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
     if mode == "wb" and fs == 8000:
         raise ValueError("Wide-band PESQ ('wb') requires fs=16000")
-    scorer = pesq_fn or _engine_pesq
+    scorer = pesq_fn or _default_pesq_fn()
     preds_np = np.asarray(preds, np.float64)
     target_np = np.asarray(target, np.float64)
     if preds_np.shape != target_np.shape:

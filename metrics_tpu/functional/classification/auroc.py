@@ -195,11 +195,16 @@ def auroc_rank_multiclass_masked(
 
     if average in (None, "none", AverageMethod.NONE):
         return auc_per_class
+    # NaN (not 0) when NO class is defined — a blanked valid mask (overflow
+    # poisoning, or a never-updated buffer) must never yield a plausible value
+    any_defined = jnp.any(defined)
     if average == AverageMethod.MACRO:
-        return jnp.sum(jnp.where(defined, auc_per_class, 0.0)) / jnp.maximum(jnp.sum(defined), 1)
+        macro = jnp.sum(jnp.where(defined, auc_per_class, 0.0)) / jnp.maximum(jnp.sum(defined), 1)
+        return jnp.where(any_defined, macro, jnp.nan)
     if average == AverageMethod.WEIGHTED:
         w = jnp.where(defined, n_pos, 0.0)
-        return jnp.sum(jnp.where(defined, auc_per_class, 0.0) * w) / jnp.maximum(jnp.sum(w), 1.0)
+        weighted = jnp.sum(jnp.where(defined, auc_per_class, 0.0) * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return jnp.where(any_defined, weighted, jnp.nan)
     raise ValueError(f"Argument `average` expected to be one of ('macro', 'weighted', 'none') but got {average}")
 
 

@@ -174,3 +174,88 @@ def binary_precision_recall_curve_fixed(
     recall = jnp.where(total_pos > 0, tps / jnp.clip(total_pos, 1.0, None), jnp.nan)
     last_point = jnp.asarray([1.0, 0.0])
     return precision, recall, sorted_key, is_threshold, last_point
+
+
+# ---------------------------------------------------------------------------
+# multiclass / multilabel wrappers: one-vs-rest over class columns
+# ---------------------------------------------------------------------------
+
+
+def _per_class_scores_targets(
+    preds: Array, target: Array, num_classes: int, multilabel: bool
+) -> Tuple[Array, Array]:
+    """``([C, N] scores, [C, N] binary targets)`` for one-vs-rest kernels.
+
+    ``preds`` is the ``[N, C]`` score buffer; ``target`` is ``[N]`` integer
+    labels (multiclass) or ``[N, C]`` per-class indicators (multilabel).
+    """
+    scores = preds.astype(jnp.float32).T
+    if multilabel:
+        tgt = target.astype(jnp.int32).T
+    else:
+        tgt = (target[None, :] == jnp.arange(num_classes)[:, None]).astype(jnp.int32)
+    return scores, tgt
+
+
+def multiclass_roc_fixed(
+    preds: Array, target: Array, valid: Array, num_classes: int, multilabel: bool = False
+) -> Tuple[Array, Array, Array, Array]:
+    """One-vs-rest :func:`binary_roc_fixed` per class column (vmapped).
+
+    Returns ``(fpr, tpr, thresholds, point_mask)`` each ``[C, capacity + 1]``
+    — row ``c`` is the exact binary ROC of class ``c`` vs rest, matching the
+    reference's multiclass list-of-curves output
+    (functional/classification/roc.py) with static shapes.
+    """
+    scores, tgt = _per_class_scores_targets(preds, target, num_classes, multilabel)
+    return jax.vmap(binary_roc_fixed, in_axes=(0, 0, None))(scores, tgt, valid)
+
+
+def multiclass_precision_recall_curve_fixed(
+    preds: Array, target: Array, valid: Array, num_classes: int, multilabel: bool = False
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """One-vs-rest :func:`binary_precision_recall_curve_fixed` per class
+    column (vmapped); arrays ``[C, capacity]`` plus ``last_point [C, 2]``."""
+    scores, tgt = _per_class_scores_targets(preds, target, num_classes, multilabel)
+    return jax.vmap(binary_precision_recall_curve_fixed, in_axes=(0, 0, None))(scores, tgt, valid)
+
+
+def multiclass_average_precision_fixed(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_classes: int,
+    average: str = "macro",
+    multilabel: bool = False,
+) -> Array:
+    """Exact one-vs-rest average precision over a fixed-capacity buffer.
+
+    ``average``: ``'macro'`` / ``'weighted'`` average over classes with at
+    least one positive (undefined classes are EXCLUDED, the same convention
+    as the capacity-mode multiclass AUROC — unbiased on sharded eval batches
+    where tail classes may be absent); ``'micro'`` flattens scores against
+    the one-vs-rest indicator matrix (reference micro semantics);
+    ``'none'``/``None`` returns the per-class vector (NaN where undefined).
+    """
+    scores, tgt = _per_class_scores_targets(preds, target, num_classes, multilabel)
+    if average == "micro":
+        flat_valid = jnp.broadcast_to(valid[None, :], tgt.shape).reshape(-1)
+        return binary_average_precision_fixed(scores.reshape(-1), tgt.reshape(-1), flat_valid)
+    ap = jax.vmap(binary_average_precision_fixed, in_axes=(0, 0, None))(scores, tgt, valid)
+    if average in (None, "none"):
+        return ap
+    n_pos = jnp.sum(tgt * valid[None, :], axis=1).astype(jnp.float32)
+    defined = n_pos > 0
+    # NaN (not 0) when NO class is defined — a blanked valid mask (overflow
+    # poisoning, or a never-updated buffer) must never yield a plausible value
+    any_defined = jnp.any(defined)
+    if average == "macro":
+        macro = jnp.sum(jnp.where(defined, ap, 0.0)) / jnp.maximum(jnp.sum(defined), 1)
+        return jnp.where(any_defined, macro, jnp.nan)
+    if average == "weighted":
+        w = jnp.where(defined, n_pos, 0.0)
+        weighted = jnp.sum(jnp.where(defined, ap, 0.0) * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return jnp.where(any_defined, weighted, jnp.nan)
+    raise ValueError(
+        f"Argument `average` expected to be one of ('micro', 'macro', 'weighted', 'none') but got {average}"
+    )

@@ -194,7 +194,14 @@ def test_pesq_shell_wiring():
         PerceptualEvaluationSpeechQuality(fs=16000, mode="xb", pesq_fn=fake_pesq)
     with pytest.raises(ValueError, match="Wide-band"):
         PerceptualEvaluationSpeechQuality(fs=8000, mode="wb", pesq_fn=fake_pesq)
-    # without an injected scorer the in-repo P.862 engine is the default
+    # without an injected scorer the default resolves to the external `pesq`
+    # binding when installed (bit-exact), else the in-repo P.862 engine
     from metrics_tpu.functional.audio._pesq_engine import pesq as engine_pesq
+    from metrics_tpu.functional.audio.pesq import _default_pesq_fn
+    from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
-    assert PerceptualEvaluationSpeechQuality(fs=8000, mode="nb").pesq_fn is engine_pesq
+    assert PerceptualEvaluationSpeechQuality(fs=8000, mode="nb").pesq_fn is None
+    if _PESQ_AVAILABLE:
+        assert _default_pesq_fn() is not engine_pesq
+    else:
+        assert _default_pesq_fn() is engine_pesq
