@@ -215,9 +215,13 @@ def bench_map() -> None:
 
 
 def bench_retrieval() -> None:
-    """queries/sec through NDCG+MAP update+compute (BASELINE config 4,
-    MSLR-WEB30K-shaped: many queries, ~40-200 candidate docs each)."""
+    """queries/sec through an NDCG+MAP MetricCollection update+compute
+    (BASELINE config 4, MSLR-WEB30K-shaped: many queries, ~40-200 candidate
+    docs each). Both sides use their MetricCollection so both get their own
+    state-sharing machinery (compute groups); ours additionally shares the
+    device pack across the group's metrics (pack_queries_cached)."""
     import jax.numpy as jnp
+    from metrics_tpu import MetricCollection
     from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
 
     rng = np.random.RandomState(7)
@@ -231,12 +235,10 @@ def bench_retrieval() -> None:
     j_idx, j_preds, j_target = jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target)
 
     def run_once():
-        ndcg = RetrievalNormalizedDCG()
-        rmap = RetrievalMAP()
-        ndcg.update(j_preds, j_target, indexes=j_idx)
-        rmap.update(j_preds, j_target, indexes=j_idx)
+        col = MetricCollection([RetrievalNormalizedDCG(), RetrievalMAP()])
+        col.update(j_preds, j_target, indexes=j_idx)
         # scalar readbacks so the timed region includes kernel completion
-        return float(ndcg.compute()), float(rmap.compute())
+        return {k: float(v) for k, v in col.compute().items()}
 
     run_once()  # compile
     iters = 3
@@ -251,6 +253,7 @@ def bench_retrieval() -> None:
 
         _stub_pkg_resources()
         sys.path.insert(0, "/root/reference")
+        from torchmetrics import MetricCollection as TRefCollection
         from torchmetrics.retrieval import RetrievalMAP as TRefMAP
         from torchmetrics.retrieval import RetrievalNormalizedDCG as TRefNDCG
 
@@ -259,11 +262,9 @@ def bench_retrieval() -> None:
         t_target = torch.as_tensor(target)
 
         def ref_once():
-            ndcg = TRefNDCG()
-            rmap = TRefMAP()
-            ndcg.update(t_preds, t_target, indexes=t_idx)
-            rmap.update(t_preds, t_target, indexes=t_idx)
-            return ndcg.compute(), rmap.compute()
+            col = TRefCollection([TRefNDCG(), TRefMAP()])
+            col.update(t_preds, t_target, indexes=t_idx)
+            return col.compute()
 
         ref_once()
         t0 = time.perf_counter()
@@ -334,6 +335,153 @@ def bench_image() -> None:
     )
 
 
+def _ref_sync_worker(rank: int, world: int, port: int, warmup: int, iters: int, queue) -> None:
+    """torch.distributed gloo worker: times the reference gather_all_tensors
+    over the same state bundle the mesh bench syncs."""
+    import torch
+    import torch.distributed as dist
+
+    _stub_pkg_resources()
+    sys.path.insert(0, "/root/reference")
+    from torchmetrics.utilities.distributed import gather_all_tensors
+
+    dist.init_process_group(
+        "gloo", init_method=f"tcp://127.0.0.1:{port}", rank=rank, world_size=world
+    )
+    try:
+        g = torch.Generator().manual_seed(rank)
+        states = [
+            torch.rand((NUM_CLASSES, NUM_CLASSES), generator=g),  # confmat sum state
+            torch.rand((65536,), generator=g),                    # capacity preds
+            torch.randint(0, 2, (65536,), generator=g),           # capacity target
+            torch.zeros((65536,), dtype=torch.bool),              # capacity valid
+        ]
+        times = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            gathered = [gather_all_tensors(s) for s in states]
+            # same post-gather reduction work the Metric sync applies
+            total = torch.stack([t.float().sum() for gs in gathered for t in gs]).sum()
+            float(total)
+            if i >= warmup:
+                times.append(time.perf_counter() - t0)
+        if rank == 0:
+            queue.put(times)
+    finally:
+        dist.destroy_process_group()
+
+
+def bench_sync() -> None:
+    """p50/p95 latency of a FULL in-jit mesh state sync — the 'DDP-sync p50
+    latency' metric BASELINE.md declares. One jitted shard_map over an
+    8-device mesh syncs a representative state bundle (ConfusionMatrix
+    [1000,1000] sum state + a 64k-sample exact-curve capacity buffer triple
+    via the VMA-clean all-gather + overflow tally) and reduces it to one
+    scalar. Baseline: the reference's gather_all_tensors over the same bundle
+    on an 8-process gloo group (same world size; gloo is its CPU backend,
+    testers.py:59). Multi-chip TPU hardware is unavailable here, so the mesh
+    is 8 virtual CPU devices — this measures the sync machinery, not ICI
+    wire time."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_in_mesh
+
+    n_dev = 8
+    cap = 65536
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("rank",))
+    rng = np.random.RandomState(11)
+
+    confmat = jnp.asarray(rng.rand(n_dev, NUM_CLASSES, NUM_CLASSES).astype(np.float32))
+    preds = jnp.asarray(rng.rand(n_dev, cap).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (n_dev, cap)).astype(np.int32))
+    valid = jnp.asarray(np.ones((n_dev, cap), bool))
+    overflow = jnp.zeros((n_dev,), jnp.int32)
+
+    reductions = {"confmat": "sum", "preds": "cat", "target": "cat", "valid": "cat", "overflow": "sum"}
+
+    def step(confmat, preds, target, valid, overflow):
+        state = {
+            "confmat": confmat[0],
+            "preds": preds[0],
+            "target": target[0],
+            "valid": valid[0],
+            "overflow": overflow[0],
+        }
+        synced = sync_in_mesh(state, reductions, "rank")
+        total = sum(jnp.sum(v.astype(jnp.float32)) for v in synced.values())
+        return total[None]
+
+    fn = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P("rank"), P("rank"), P("rank"), P("rank"), P("rank")),
+            out_specs=P("rank"),
+        )
+    )
+
+    args = (confmat, preds, target, valid, overflow)
+    float(fn(*args)[0])  # compile
+    warmup, iters = 3, 50
+    for _ in range(warmup):
+        float(fn(*args)[0])
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn(*args)[0])  # scalar readback bounds the timed region
+        times.append(time.perf_counter() - t0)
+    p50 = float(np.percentile(times, 50) * 1e3)
+    p95 = float(np.percentile(times, 95) * 1e3)
+
+    ref_p50 = None
+    procs = []
+    try:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        queue = ctx.Queue()
+        port = 29571
+        world = n_dev
+        procs = [
+            ctx.Process(target=_ref_sync_worker, args=(r, world, port, 3, 20, queue))
+            for r in range(world)
+        ]
+        for p in procs:
+            p.start()
+        ref_times = queue.get(timeout=300)
+        for p in procs:
+            p.join(timeout=60)
+        ref_p50 = float(np.percentile(ref_times, 50) * 1e3)
+    except Exception:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+
+    print(
+        json.dumps(
+            {
+                "metric": "mesh_state_sync_latency_p50",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "p95_ms": round(p95, 3),
+                "ranks": n_dev,
+                "ref_gloo_p50_ms": round(ref_p50, 3) if ref_p50 else None,
+                "vs_baseline": round(ref_p50 / p50, 3) if ref_p50 else None,
+            }
+        )
+    )
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "map":
         bench_map()
@@ -343,6 +491,9 @@ def main() -> None:
         return
     if len(sys.argv) > 1 and sys.argv[1] == "image":
         bench_image()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "sync":
+        bench_sync()
         return
     tpu_sps = bench_tpu()
     try:

@@ -13,7 +13,9 @@ Row kernels replicate the single-query functional kernels' semantics exactly
 padded slots carry ``preds=-inf`` (sort last), ``target=0``, ``mask=False``.
 """
 import functools
-from typing import Callable, Optional, Tuple
+import weakref
+from collections import OrderedDict
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -88,6 +90,58 @@ def pack_queries(
     if max_expand is not None and num_queries * max_docs > max_expand * indexes.size:
         return None
     return _scatter_pack(preds, target, order, row, col, num_queries, max_docs)
+
+
+# ---------------------------------------------------------------------------
+# shared-pack cache: one pack feeds every metric over the same state
+# ---------------------------------------------------------------------------
+
+#: (state-array identities, max_expand) -> packed buffers. MetricCollection
+#: compute groups share their cat-list states BY REFERENCE across member
+#: metrics, and jax arrays are immutable, so object identity of every list
+#: element is a sound equality key — an NDCG+MAP collection then packs its
+#: (identical) ragged states once instead of once per metric. The cache does
+#: NOT keep the state arrays alive: a weakref finalizer on every keyed array
+#: purges the entry (and its packed buffers) the moment any of them is
+#: collected, so deleting/resetting the metric frees the device memory and a
+#: recycled id() can never produce a stale hit.
+_PACK_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_PACK_CACHE_MAX = 4
+
+
+def pack_queries_cached(
+    indexes_list: List[Array],
+    preds_list: List[Array],
+    target_list: List[Array],
+    max_expand: Optional[int] = None,
+) -> Optional[Tuple[Array, Array, Array]]:
+    """:func:`pack_queries` over cat-list states, memoized on array identity."""
+    arrays = (*indexes_list, *preds_list, *target_list)
+    key = (
+        tuple(map(id, indexes_list)),
+        tuple(map(id, preds_list)),
+        tuple(map(id, target_list)),
+        max_expand,
+    )
+    packed = _PACK_CACHE.get(key)
+    if packed is not None:
+        _PACK_CACHE.move_to_end(key)
+        return packed
+    indexes = jnp.concatenate([jnp.atleast_1d(x) for x in indexes_list]) if indexes_list else jnp.zeros((0,), jnp.int32)
+    preds = jnp.concatenate([jnp.atleast_1d(x) for x in preds_list]) if preds_list else jnp.zeros((0,))
+    target = jnp.concatenate([jnp.atleast_1d(x) for x in target_list]) if target_list else jnp.zeros((0,))
+    packed = pack_queries(indexes, preds, target, max_expand=max_expand)
+    try:
+        for a in arrays:
+            weakref.finalize(a, _PACK_CACHE.pop, key, None)
+    except TypeError:
+        # a non-weakref-able input (e.g. plain numpy scalar view): do not
+        # cache — correctness over reuse, the LRU cannot guard its key
+        return packed
+    _PACK_CACHE[key] = packed
+    while len(_PACK_CACHE) > _PACK_CACHE_MAX:
+        _PACK_CACHE.popitem(last=False)
+    return packed
 
 
 def _row_sort(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.retrieval.padded import _padded_compute_fn, pack_queries
+from metrics_tpu.functional.retrieval.padded import _padded_compute_fn, pack_queries_cached
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
@@ -98,15 +98,20 @@ class RetrievalMetric(Metric, ABC):
         """Device-resident compute over the packed [num_queries, max_docs]
         layout: pack (sort + scatter), per-query kernels, empty policy, and
         mean all run on device; only two static-shape scalars (and the error
-        flag when ``empty_target_action='error'``) cross to the host."""
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        flag when ``empty_target_action='error'``) cross to the host.
 
+        The pack is memoized on the identity of the state arrays
+        (``pack_queries_cached``): metrics sharing states through a
+        MetricCollection compute group — e.g. NDCG + MAP over one query
+        stream — pack once and each run only their own row kernel.
+        """
+        as_list = lambda s: s if isinstance(s, list) else [s]
         # heavily skewed query sizes make the [Q, Dmax] padding blow up (one
         # 50k-doc query among 100k small ones -> ~billions of padded slots);
         # past 16x expansion over the raw data the O(N) host loop wins
-        packed = pack_queries(indexes, preds, target, max_expand=16)
+        packed = pack_queries_cached(
+            as_list(self.indexes), as_list(self.preds), as_list(self.target), max_expand=16
+        )
         if packed is None:
             return self._compute_host_loop()
         padded_preds, padded_target, mask = packed

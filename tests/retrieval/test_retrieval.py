@@ -297,3 +297,65 @@ def test_pack_queries_empty_raises_descriptive():
         pack_queries(
             jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.float32)
         )
+
+
+def test_collection_shares_one_pack_across_metrics(monkeypatch):
+    """An NDCG+MAP MetricCollection forms one compute group (identical
+    states), and the padded path packs the ragged layout ONCE for both
+    metrics (pack_queries_cached keyed on the shared state arrays)."""
+    import metrics_tpu.functional.retrieval.padded as padded
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.retrieval import RetrievalNormalizedDCG
+
+    calls = {"n": 0}
+    orig = padded.pack_queries
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(padded, "pack_queries", counting)
+
+    rng = np.random.default_rng(9)
+    idx = np.repeat(np.arange(40), 10)
+    preds = rng.random(400).astype(np.float32)
+    target = rng.integers(0, 2, 400).astype(np.int32)
+
+    col = MetricCollection([RetrievalNormalizedDCG(), RetrievalMAP()])
+    col.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    out = col.compute()
+    assert calls["n"] == 1  # one pack for both metrics
+
+    # further updates change the state arrays -> cache miss, ONE repack
+    col.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    col.compute()
+    assert calls["n"] == 2
+
+    # parity vs an independent metric (its own state -> its own pack)
+    solo = RetrievalMAP()
+    solo.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(
+        np.asarray(out["RetrievalMAP"]), np.asarray(solo.compute()), atol=1e-6
+    )
+    assert calls["n"] == 3
+
+
+def test_pack_cache_entry_freed_with_its_arrays():
+    """The pack cache must not keep state (or packed) buffers alive after the
+    owning metric is gone — weakref finalizers purge the entry."""
+    import gc
+
+    import metrics_tpu.functional.retrieval.padded as padded
+
+    padded._PACK_CACHE.clear()
+    m = RetrievalMAP()
+    m.update(
+        jnp.asarray([0.3, 0.7, 0.2, 0.9]), jnp.asarray([0, 1, 1, 0]), indexes=jnp.asarray([0, 0, 1, 1])
+    )
+    m.compute()
+    assert len(padded._PACK_CACHE) == 1
+    m.compute()  # second compute on unchanged state hits the cache
+    assert len(padded._PACK_CACHE) == 1
+    del m
+    gc.collect()
+    assert len(padded._PACK_CACHE) == 0
