@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.utils.data import dim_zero_cat
+
 Array = jax.Array
 
 
@@ -107,6 +109,7 @@ def pack_queries(
 #: recycled id() can never produce a stale hit.
 _PACK_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
 _PACK_CACHE_MAX = 4
+_NO_PACK = object()  # cached "pack_queries returned None" (skew fallback)
 
 
 def pack_queries_cached(
@@ -123,14 +126,19 @@ def pack_queries_cached(
         tuple(map(id, target_list)),
         max_expand,
     )
-    packed = _PACK_CACHE.get(key)
-    if packed is not None:
+    hit = _PACK_CACHE.get(key)
+    if hit is not None:
         _PACK_CACHE.move_to_end(key)
-        return packed
-    indexes = jnp.concatenate([jnp.atleast_1d(x) for x in indexes_list]) if indexes_list else jnp.zeros((0,), jnp.int32)
-    preds = jnp.concatenate([jnp.atleast_1d(x) for x in preds_list]) if preds_list else jnp.zeros((0,))
-    target = jnp.concatenate([jnp.atleast_1d(x) for x in target_list]) if target_list else jnp.zeros((0,))
-    packed = pack_queries(indexes, preds, target, max_expand=max_expand)
+        return None if hit is _NO_PACK else hit
+    if not indexes_list:
+        raise ValueError(
+            "`indexes` is empty — the retrieval metric has no accumulated samples;"
+            " call `update` before `compute`."
+        )
+    packed = pack_queries(
+        dim_zero_cat(indexes_list), dim_zero_cat(preds_list), dim_zero_cat(target_list),
+        max_expand=max_expand,
+    )
     try:
         for a in arrays:
             weakref.finalize(a, _PACK_CACHE.pop, key, None)
@@ -138,7 +146,9 @@ def pack_queries_cached(
         # a non-weakref-able input (e.g. plain numpy scalar view): do not
         # cache — correctness over reuse, the LRU cannot guard its key
         return packed
-    _PACK_CACHE[key] = packed
+    # the skew fallback (None) is cached too, so repeated computes on the
+    # same state skip the device argsort + shape readback
+    _PACK_CACHE[key] = _NO_PACK if packed is None else packed
     while len(_PACK_CACHE) > _PACK_CACHE_MAX:
         _PACK_CACHE.popitem(last=False)
     return packed

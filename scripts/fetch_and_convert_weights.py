@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Fetch pretrained torch weights and convert them to this framework's Flax
+``.npz`` artifacts, with a checksummed manifest.
+
+The reference downloads torch weights at metric-construction time
+(/root/reference/torchmetrics/image/fid.py:26-57 pulls torch-fidelity's
+InceptionV3; /root/reference/torchmetrics/image/lpip.py:28-41 wraps the
+``lpips`` package nets; functional/text/bert.py:262-346 pulls HuggingFace
+encoders). This framework keeps metric construction offline-safe instead:
+run this script ONCE where network access exists, then point the metrics at
+the produced artifacts:
+
+    python scripts/fetch_and_convert_weights.py --dest ~/.cache/metrics_tpu/weights
+    export METRICS_TPU_WEIGHTS=~/.cache/metrics_tpu/weights
+
+    FrechetInceptionDistance(feature_extractor_weights_path=f"{dest}/inception_fid.npz")
+    LearnedPerceptualImagePatchSimilarity(net_type="alex",
+        net_weights_path=f"{dest}/lpips_alex.npz")
+    BERTScore(model_name_or_path=f"{dest}/bertscore/roberta-large")
+
+Every artifact is hashed into ``MANIFEST.json`` (sha256 + source), and the
+gated tests in ``tests/image/test_real_weights.py`` verify end-to-end parity
+against the torch originals wherever both the artifacts and the oracle
+packages exist.
+"""
+import argparse
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+# canonical FID weights (TF-Inception 2015-12-05 port) — the same network the
+# reference's torch-fidelity/pytorch-fid backends download
+PT_FID_INCEPTION_URL = (
+    "https://github.com/mseitzer/pytorch-fid/releases/download/fid_weights/"
+    "pt_inception-2015-12-05-6726825d.pth"
+)
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def fetch_inception(dest: Path, manifest: dict) -> None:
+    """torch-fidelity / pytorch-fid FID InceptionV3 -> inception_fid.npz."""
+    import numpy as np
+    import torch
+
+    from metrics_tpu.models.inception import convert_torch_fidelity_weights
+
+    state_dict = None
+    source = None
+    try:  # preferred: the torch-fidelity package the reference itself uses
+        from torch_fidelity.feature_extractor_inceptionv3 import FeatureExtractorInceptionV3
+
+        net = FeatureExtractorInceptionV3("inception-v3-compat", ["2048"])
+        state_dict = net.state_dict()
+        source = "torch_fidelity.FeatureExtractorInceptionV3"
+    except Exception:
+        pass
+    if state_dict is None:
+        state_dict = torch.hub.load_state_dict_from_url(
+            PT_FID_INCEPTION_URL, map_location="cpu", progress=True
+        )
+        source = PT_FID_INCEPTION_URL
+
+    variables = convert_torch_fidelity_weights(state_dict)
+    out = dest / "inception_fid.npz"
+    np.savez(out, variables=np.asarray(variables, dtype=object))
+    manifest["inception_fid.npz"] = {"sha256": _sha256(out), "source": source}
+    print(f"wrote {out} ({source})")
+
+
+def fetch_lpips(dest: Path, manifest: dict, nets=("alex", "vgg")) -> None:
+    """``lpips`` package nets (backbone + linear heads) -> lpips_<net>.npz."""
+    import numpy as np
+
+    try:
+        import lpips as lpips_pkg
+    except ImportError:
+        print("SKIP lpips: the `lpips` package is not installed (pip install lpips)")
+        return
+
+    from metrics_tpu.models.lpips import convert_lpips_weights
+
+    for net in nets:
+        sd = lpips_pkg.LPIPS(net=net).state_dict()
+        variables = convert_lpips_weights(sd, net_type=net)
+        out = dest / f"lpips_{net}.npz"
+        np.savez(out, variables=np.asarray(variables, dtype=object))
+        manifest[out.name] = {"sha256": _sha256(out), "source": f"lpips.LPIPS(net='{net}') v{lpips_pkg.__version__}"}
+        print(f"wrote {out}")
+
+
+def fetch_bert(dest: Path, manifest: dict, model_name: str) -> None:
+    """HuggingFace Flax encoder + tokenizer -> bertscore/<name>/ checkpoint."""
+    try:
+        from transformers import AutoTokenizer, FlaxAutoModel
+    except ImportError:
+        print("SKIP bert: `transformers` is not installed")
+        return
+
+    out = dest / "bertscore" / model_name.replace("/", "__")
+    out.mkdir(parents=True, exist_ok=True)
+    AutoTokenizer.from_pretrained(model_name).save_pretrained(out)
+    # from_pt=True converts torch-only checkpoints to Flax on the fly
+    try:
+        model = FlaxAutoModel.from_pretrained(model_name)
+    except Exception:
+        model = FlaxAutoModel.from_pretrained(model_name, from_pt=True)
+    model.save_pretrained(out)
+    weights = out / "flax_model.msgpack"
+    manifest[f"bertscore/{out.name}"] = {
+        "sha256": _sha256(weights) if weights.exists() else None,
+        "source": f"huggingface:{model_name}",
+    }
+    print(f"wrote {out}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dest", default="~/.cache/metrics_tpu/weights", help="artifact directory")
+    parser.add_argument(
+        "--only",
+        nargs="*",
+        choices=("inception", "lpips", "bert"),
+        default=("inception", "lpips", "bert"),
+    )
+    parser.add_argument(
+        "--bert-model",
+        default="roberta-large",
+        help="HF encoder to fetch (reference bert_score default: roberta-large)",
+    )
+    args = parser.parse_args()
+
+    dest = Path(args.dest).expanduser()
+    dest.mkdir(parents=True, exist_ok=True)
+    manifest_path = dest / "MANIFEST.json"
+    manifest = json.loads(manifest_path.read_text()) if manifest_path.exists() else {}
+
+    failures = []
+    for component, fn in (
+        ("inception", lambda: fetch_inception(dest, manifest)),
+        ("lpips", lambda: fetch_lpips(dest, manifest)),
+        ("bert", lambda: fetch_bert(dest, manifest, args.bert_model)),
+    ):
+        if component not in args.only:
+            continue
+        try:
+            fn()
+        except Exception as exc:  # keep going; report at the end
+            failures.append((component, exc))
+            print(f"FAILED {component}: {exc}")
+
+    manifest_path.write_text(json.dumps(manifest, indent=2, sort_keys=True) + "\n")
+    print(f"manifest: {manifest_path}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

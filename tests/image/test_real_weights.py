@@ -1,0 +1,149 @@
+"""Real-pretrained-weight parity tests — gated on artifact availability.
+
+These run wherever ``scripts/fetch_and_convert_weights.py`` has produced its
+artifacts (``METRICS_TPU_WEIGHTS`` env var, default ``~/.cache/metrics_tpu/
+weights``) AND the torch oracle packages are installed; everywhere else they
+skip. They close the loop the converter unit tests (random-initialized torch
+mirrors, tests/image/test_fid_kid_is.py) cannot: feature parity and metric
+parity from the ACTUAL published weights, the thing FID is famously
+sensitive to (reference image/fid.py:26-57, SURVEY hard-part 6).
+"""
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+WEIGHTS_DIR = Path(os.environ.get("METRICS_TPU_WEIGHTS", "~/.cache/metrics_tpu/weights")).expanduser()
+
+INCEPTION_NPZ = WEIGHTS_DIR / "inception_fid.npz"
+LPIPS_ALEX_NPZ = WEIGHTS_DIR / "lpips_alex.npz"
+
+
+def _require(path: Path) -> str:
+    if not path.exists():
+        pytest.skip(
+            f"weight artifact {path} not present — run scripts/fetch_and_convert_weights.py"
+        )
+    return str(path)
+
+
+def _torch_fid_inception():
+    """The torch FID InceptionV3 oracle, from whichever backend is installed."""
+    try:
+        from torch_fidelity.feature_extractor_inceptionv3 import FeatureExtractorInceptionV3
+
+        net = FeatureExtractorInceptionV3("inception-v3-compat", ["2048"])
+
+        def forward(x_uint8):  # [N,3,299,299] uint8 torch tensor -> [N,2048]
+            import torch
+
+            with torch.no_grad():
+                return net(x_uint8)[0].numpy()
+
+        return forward
+    except Exception:
+        pass
+    try:
+        from pytorch_fid.inception import InceptionV3
+
+        net = InceptionV3([3]).eval()
+
+        def forward(x_uint8):
+            import torch
+
+            with torch.no_grad():
+                out = net(x_uint8.float() / 255.0)[0]
+            return out.squeeze(-1).squeeze(-1).numpy()
+
+        return forward
+    except Exception:
+        pytest.skip("neither torch_fidelity nor pytorch_fid is installed for the oracle")
+
+
+def test_fid_real_weight_feature_parity():
+    """Converted Flax extractor matches the torch original's 2048-d features
+    on real weights (the converter unit test only proves random mirrors)."""
+    torch = pytest.importorskip("torch")
+    path = _require(INCEPTION_NPZ)
+    from metrics_tpu.models.inception import build_fid_inception
+
+    extract = build_fid_inception(2048, weights_path=path)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (4, 3, 299, 299), dtype=np.uint8)
+
+    ours = np.asarray(extract(jnp.asarray(imgs)))
+    oracle = _torch_fid_inception()(torch.as_tensor(imgs))
+    # bilinear-resize-free 299x299 path: same preprocessing, tight tolerance
+    np.testing.assert_allclose(ours, oracle, atol=2e-2, rtol=1e-3)
+    # and the statistics FID consumes agree much tighter than per-unit noise
+    np.testing.assert_allclose(ours.mean(0), oracle.mean(0), atol=2e-3)
+
+
+def test_fid_value_real_weights_vs_scipy_sqrtm():
+    """End-to-end FID from real weights vs the reference's f64 scipy sqrtm
+    computation on the same features."""
+    pytest.importorskip("torch")
+    scipy_linalg = pytest.importorskip("scipy.linalg")
+    path = _require(INCEPTION_NPZ)
+    from metrics_tpu.image.fid import FrechetInceptionDistance
+
+    rng = np.random.default_rng(1)
+    real = rng.integers(0, 256, (16, 3, 299, 299), dtype=np.uint8)
+    fake = rng.integers(0, 256, (16, 3, 299, 299), dtype=np.uint8)
+
+    fid = FrechetInceptionDistance(feature=2048, feature_extractor_weights_path=path)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    got = float(fid.compute())
+
+    feats_real = np.asarray(fid.inception(jnp.asarray(real)), np.float64)
+    feats_fake = np.asarray(fid.inception(jnp.asarray(fake)), np.float64)
+    mu1, mu2 = feats_real.mean(0), feats_fake.mean(0)
+    s1 = np.cov(feats_real, rowvar=False)
+    s2 = np.cov(feats_fake, rowvar=False)
+    covmean = scipy_linalg.sqrtm(s1 @ s2).real
+    want = float(((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-2)
+
+
+def test_lpips_real_weight_parity():
+    """Converted Flax LPIPS matches the lpips package on real weights."""
+    torch = pytest.importorskip("torch")
+    lpips_pkg = pytest.importorskip("lpips")
+    path = _require(LPIPS_ALEX_NPZ)
+    from metrics_tpu.models.lpips import build_lpips
+
+    scorer = build_lpips("alex", weights_path=path)
+    rng = np.random.default_rng(2)
+    a = rng.uniform(-1, 1, (4, 3, 64, 64)).astype(np.float32)
+    b = rng.uniform(-1, 1, (4, 3, 64, 64)).astype(np.float32)
+
+    ours = np.asarray(scorer(jnp.asarray(a), jnp.asarray(b)))
+    oracle_net = lpips_pkg.LPIPS(net="alex")
+    with torch.no_grad():
+        oracle = oracle_net(torch.as_tensor(a), torch.as_tensor(b)).squeeze().numpy()
+    np.testing.assert_allclose(ours, oracle, atol=1e-4, rtol=1e-3)
+
+
+def test_manifest_checksums_match_artifacts():
+    """MANIFEST.json sha256 entries must match the artifacts on disk."""
+    import hashlib
+    import json
+
+    manifest_path = WEIGHTS_DIR / "MANIFEST.json"
+    if not manifest_path.exists():
+        pytest.skip("no weight manifest present")
+    manifest = json.loads(manifest_path.read_text())
+    checked = 0
+    for name, entry in manifest.items():
+        target = WEIGHTS_DIR / name
+        if entry.get("sha256") is None or not target.is_file():
+            continue
+        h = hashlib.sha256(target.read_bytes()).hexdigest()
+        assert h == entry["sha256"], f"checksum mismatch for {name}"
+        checked += 1
+    if not checked:
+        pytest.skip("manifest present but no hashable artifacts")
