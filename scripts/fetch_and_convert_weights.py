@@ -114,7 +114,8 @@ def fetch_bert(dest: Path, manifest: dict, model_name: str) -> None:
         model = FlaxAutoModel.from_pretrained(model_name, from_pt=True)
     model.save_pretrained(out)
     weights = out / "flax_model.msgpack"
-    manifest[f"bertscore/{out.name}"] = {
+    # key by the hashed FILE so the checksum test can verify it directly
+    manifest[f"bertscore/{out.name}/flax_model.msgpack"] = {
         "sha256": _sha256(weights) if weights.exists() else None,
         "source": f"huggingface:{model_name}",
     }

@@ -71,3 +71,51 @@ _input_multilabel_multidim = Input(
     preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
     target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
 )
+
+# logits variants (reference inputs.py: _input_binary_logits etc.)
+_input_binary_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_multilabel_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_input_multiclass_logits = Input(
+    preds=(10 * _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))).astype(np.float32),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+# multilabel edge case where nothing matches (scores are undefined)
+_nm_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+_input_multilabel_no_match = Input(preds=_nm_preds, target=np.abs(_nm_preds - 1))
+
+
+def generate_plausible_inputs_multilabel(num_classes=NUM_CLASSES, num_batches=NUM_BATCHES, batch_size=BATCH_SIZE):
+    """Targets one-hot of a sampled class; preds biased toward the target
+    (reference inputs.py:100-113)."""
+    correct = _rng.integers(0, num_classes, (num_batches, batch_size))
+    preds = _rng.random((num_batches, batch_size, num_classes)).astype(np.float32)
+    targets = np.zeros_like(preds, dtype=np.int64)
+    np.put_along_axis(targets, correct[..., None], 1, axis=2)
+    preds = preds + _rng.random(preds.shape).astype(np.float32) * targets / 3
+    preds = preds / preds.sum(axis=2, keepdims=True)
+    return Input(preds=preds.astype(np.float32), target=targets)
+
+
+def generate_plausible_inputs_binary(num_batches=NUM_BATCHES, batch_size=BATCH_SIZE):
+    targets = _rng.integers(0, 2, (num_batches, batch_size))
+    preds = _rng.random((num_batches, batch_size)) + _rng.random((num_batches, batch_size)) * targets / 3
+    return Input(preds=(preds / (preds.max() + 0.01)).astype(np.float32), target=targets)
+
+
+_input_multilabel_prob_plausible = generate_plausible_inputs_multilabel()
+_input_binary_prob_plausible = generate_plausible_inputs_binary()
+
+# randomly remove one class from the input (reference inputs.py:121-127)
+_mc_missing = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_cls_remove, _cls_replace = _rng.choice(NUM_CLASSES, size=2, replace=False)
+_mc_missing[_mc_missing == _cls_remove] = _cls_replace
+_input_multiclass_with_missing_class = Input(_mc_missing.copy(), _mc_missing.copy())

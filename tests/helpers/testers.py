@@ -8,6 +8,7 @@ pickle round-trip, hashability. The reference's 2-process Gloo pool is
 replaced by (a) a virtual-rank merge check via the pure state API and (b)
 real-collective tests over an 8-virtual-device CPU mesh in tests/bases.
 """
+import functools
 from functools import partial
 import pickle
 from typing import Any, Callable, Dict, Optional, Sequence
@@ -59,6 +60,7 @@ def _class_test(
     check_merge: bool = True,
     check_jit: bool = True,
     check_pickle: bool = True,
+    dist_sync_on_step: bool = False,
     atol: float = 1e-8,
     fragment_kwargs: bool = False,
     **kwargs_update: Any,
@@ -120,9 +122,29 @@ def _class_test(
             for i in range(rank, num_batches, NUM_PROCESSES):
                 state = m.update_state(state, preds[i], target[i])
             states.append(state)
-        merged = metric.merge_states(states[0], states[1])
+        merged = functools.reduce(metric.merge_states, states)
         merged_result = metric.compute_state(merged)
         _assert_allclose(merged_result, sk_result, atol=atol)
+
+    # dist_sync_on_step semantics (reference testers.py:392-470 ddp x
+    # dist_sync_on_step grid): at every step each virtual rank contributes
+    # ONE batch, the per-step forward value is computed on the merged
+    # cross-rank batch state, and must equal the oracle over both ranks'
+    # batches concatenated. Uses the pure state API as the sync transport —
+    # the same merge path a mesh all_gather feeds.
+    if dist_sync_on_step and check_merge and not kwargs_update:
+        m = metric_class(**metric_args)
+        for step in range(num_batches // NUM_PROCESSES):
+            batch_states = []
+            for rank in range(NUM_PROCESSES):
+                i = step * NUM_PROCESSES + rank
+                batch_states.append(m.update_state(m.init_state(), preds[i], target[i]))
+            synced = functools.reduce(m.merge_states, batch_states)
+            step_result = m.compute_state(synced)
+            lo, hi = step * NUM_PROCESSES, step * NUM_PROCESSES + NUM_PROCESSES
+            step_preds = np.concatenate([np.asarray(preds[i]) for i in range(lo, hi)])
+            step_target = np.concatenate([np.asarray(target[i]) for i in range(lo, hi)])
+            _assert_allclose(step_result, sk_metric(step_preds, step_target), atol=atol)
 
     # jit-compilability of the pure update (replaces torchscript check)
     if check_jit and not getattr(metric_class, "__jit_unsafe__", False) and not kwargs_update:
@@ -194,6 +216,7 @@ class MetricTester:
             check_batch=check_batch,
             check_merge=check_merge,
             check_jit=check_jit,
+            dist_sync_on_step=dist_sync_on_step,
             atol=self.atol if atol is None else atol,
             **kwargs_update,
         )
