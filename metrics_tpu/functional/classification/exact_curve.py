@@ -170,6 +170,18 @@ def binary_precision_recall_curve_fixed(
     idx = jnp.arange(sorted_key.shape[0])
     is_threshold = (run_end == idx) & sorted_valid
 
+    # reference/sklearn truncation: once a threshold point achieves full
+    # recall, every LOWER threshold adds no recall and is dropped
+    # (reference precision_recall_curve.py `last_ind = where(tps == tps[-1])[0]`).
+    # A run is kept iff full recall was not yet reached strictly BEFORE it;
+    # with zero positives the reference convention degenerates to keeping
+    # only the first (highest) threshold, which the `run_start == 0` arm
+    # reproduces (prev_end_tps < 0 is never true).
+    is_run_first = jnp.concatenate([jnp.ones(1, bool), sorted_key[1:] != sorted_key[:-1]])
+    run_start = jax.lax.cummax(jnp.where(is_run_first, idx, 0))
+    prev_end_tps = jnp.where(run_start > 0, tps[jnp.maximum(run_start - 1, 0)], 0.0)
+    is_threshold = is_threshold & ((prev_end_tps < total_pos) | (run_start == 0))
+
     precision = tps / jnp.clip(tps + fps, 1.0, None)
     recall = jnp.where(total_pos > 0, tps / jnp.clip(total_pos, 1.0, None), jnp.nan)
     last_point = jnp.asarray([1.0, 0.0])

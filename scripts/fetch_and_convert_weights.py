@@ -115,6 +115,8 @@ def fetch_bert(dest: Path, manifest: dict, model_name: str) -> None:
     model.save_pretrained(out)
     weights = out / "flax_model.msgpack"
     # key by the hashed FILE so the checksum test can verify it directly
+    # (drop the directory-keyed entry older manifests may carry)
+    manifest.pop(f"bertscore/{out.name}", None)
     manifest[f"bertscore/{out.name}/flax_model.msgpack"] = {
         "sha256": _sha256(weights) if weights.exists() else None,
         "source": f"huggingface:{model_name}",

@@ -1,0 +1,227 @@
+"""Capacity-mode vs unbounded-mode consistency across the curve family.
+
+TPU-native invariant with no reference analog: for every curve metric, the
+static-capacity exact mode (jit-safe buffers, classification/_capacity.py)
+must produce the SAME values as the unbounded cat-state mode on identical
+data — across binary/multiclass/multilabel cases, averaging modes, tied
+scores, uneven batch splits, and merge/sync layouts. sklearn parity for both
+modes individually lives in test_exact_curve.py / test_curves.py; this grid
+pins the two implementations against each other so they can never drift.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import AUROC, AveragePrecision, PrecisionRecallCurve, ROC
+from tests.helpers.testers import NUM_CLASSES
+
+_rng = np.random.default_rng(77)
+N = 160
+
+
+def _binary_data(ties):
+    preds = _rng.random(N).astype(np.float32)
+    if ties:
+        preds = np.round(preds * 8) / 8
+    target = (_rng.random(N) < 0.45).astype(np.int32)
+    target[:2] = [0, 1]  # both classes present
+    return preds, target
+
+
+def _multiclass_data(ties):
+    preds = _rng.random((N, NUM_CLASSES)).astype(np.float32)
+    if ties:
+        preds = np.round(preds * 8) / 8
+    target = _rng.integers(0, NUM_CLASSES, N).astype(np.int32)
+    target[:NUM_CLASSES] = np.arange(NUM_CLASSES)  # every class present
+    return preds, target
+
+
+def _multilabel_data(ties):
+    preds = _rng.random((N, NUM_CLASSES)).astype(np.float32)
+    if ties:
+        preds = np.round(preds * 8) / 8
+    target = (_rng.random((N, NUM_CLASSES)) < 0.4).astype(np.int32)
+    target[0] = 1
+    target[1] = 0
+    return preds, target
+
+
+def _update_in_batches(metric, preds, target, splits):
+    lo = 0
+    for hi in splits + [len(preds)]:
+        metric.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+        lo = hi
+    return metric
+
+
+@pytest.mark.parametrize("ties", [False, True], ids=["unique", "ties"])
+@pytest.mark.parametrize("splits", [[], [37], [10, 100]], ids=["one", "two", "three"])
+class TestBinaryCapacityConsistency:
+    def test_auroc(self, ties, splits):
+        preds, target = _binary_data(ties)
+        unbounded = _update_in_batches(AUROC(), preds, target, splits)
+        capacity = _update_in_batches(AUROC(capacity=2 * N), preds, target, splits)
+        np.testing.assert_allclose(
+            float(capacity.compute()), float(unbounded.compute()), atol=1e-5
+        )
+
+    def test_average_precision(self, ties, splits):
+        preds, target = _binary_data(ties)
+        unbounded = _update_in_batches(AveragePrecision(pos_label=1), preds, target, splits)
+        capacity = _update_in_batches(AveragePrecision(capacity=2 * N), preds, target, splits)
+        np.testing.assert_allclose(
+            float(capacity.compute()), float(unbounded.compute()), atol=1e-5
+        )
+
+    def test_roc_points(self, ties, splits):
+        preds, target = _binary_data(ties)
+        unbounded = _update_in_batches(ROC(pos_label=1), preds, target, splits)
+        capacity = _update_in_batches(ROC(capacity=2 * N), preds, target, splits)
+        u_fpr, u_tpr, u_thr = (np.asarray(v) for v in unbounded.compute())
+        fpr, tpr, thr, mask = (np.asarray(v) for v in capacity.compute())
+        np.testing.assert_allclose(fpr[mask], u_fpr, atol=1e-6)
+        np.testing.assert_allclose(tpr[mask], u_tpr, atol=1e-6)
+        np.testing.assert_allclose(thr[mask][1:], u_thr[1:], atol=1e-6)
+
+    def test_prc_points(self, ties, splits):
+        preds, target = _binary_data(ties)
+        unbounded = _update_in_batches(PrecisionRecallCurve(pos_label=1), preds, target, splits)
+        capacity = _update_in_batches(PrecisionRecallCurve(capacity=2 * N), preds, target, splits)
+        u_prec, u_rec, u_thr = (np.asarray(v) for v in unbounded.compute())
+        prec, rec, thr, mask, last = (np.asarray(v) for v in capacity.compute())
+        np.testing.assert_allclose(np.concatenate([prec[mask][::-1], [last[0]]]), u_prec, atol=1e-6)
+        np.testing.assert_allclose(np.concatenate([rec[mask][::-1], [last[1]]]), u_rec, atol=1e-6)
+        np.testing.assert_allclose(thr[mask][::-1], u_thr, atol=1e-6)
+
+
+@pytest.mark.parametrize("ties", [False, True], ids=["unique", "ties"])
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+class TestMulticlassCapacityConsistency:
+    def test_auroc(self, ties, average):
+        preds, target = _multiclass_data(ties)
+        unbounded = AUROC(num_classes=NUM_CLASSES, average=average)
+        unbounded.update(jnp.asarray(preds), jnp.asarray(target))
+        capacity = AUROC(num_classes=NUM_CLASSES, average=average, capacity=2 * N)
+        capacity.update(jnp.asarray(preds[:50]), jnp.asarray(target[:50]))
+        capacity.update(jnp.asarray(preds[50:]), jnp.asarray(target[50:]))
+        np.testing.assert_allclose(
+            np.asarray(capacity.compute()), np.asarray(unbounded.compute()), atol=1e-5
+        )
+
+    def test_average_precision(self, ties, average):
+        preds, target = _multiclass_data(ties)
+        unbounded = AveragePrecision(num_classes=NUM_CLASSES, average=None)
+        unbounded.update(jnp.asarray(preds), jnp.asarray(target))
+        capacity = AveragePrecision(num_classes=NUM_CLASSES, average="none", capacity=2 * N)
+        capacity.update(jnp.asarray(preds), jnp.asarray(target))
+        got = np.asarray(capacity.compute())
+        want = np.asarray([np.asarray(v) for v in unbounded.compute()])
+        # unbounded 'none' may score absent classes 0 where capacity uses NaN;
+        # every class is present here so values must agree exactly
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_roc_per_class(self, ties, average):
+        if average != "macro":
+            pytest.skip("curve points are average-independent")
+        preds, target = _multiclass_data(ties)
+        unbounded = ROC(num_classes=NUM_CLASSES)
+        unbounded.update(jnp.asarray(preds), jnp.asarray(target))
+        capacity = ROC(num_classes=NUM_CLASSES, capacity=2 * N)
+        capacity.update(jnp.asarray(preds), jnp.asarray(target))
+        u_fpr, u_tpr, u_thr = unbounded.compute()
+        fpr, tpr, thr, mask = (np.asarray(v) for v in capacity.compute())
+        for k in range(NUM_CLASSES):
+            np.testing.assert_allclose(fpr[k][mask[k]], np.asarray(u_fpr[k]), atol=1e-6)
+            np.testing.assert_allclose(tpr[k][mask[k]], np.asarray(u_tpr[k]), atol=1e-6)
+
+    def test_prc_per_class(self, ties, average):
+        if average != "macro":
+            pytest.skip("curve points are average-independent")
+        preds, target = _multiclass_data(ties)
+        unbounded = PrecisionRecallCurve(num_classes=NUM_CLASSES)
+        unbounded.update(jnp.asarray(preds), jnp.asarray(target))
+        capacity = PrecisionRecallCurve(num_classes=NUM_CLASSES, capacity=2 * N)
+        capacity.update(jnp.asarray(preds), jnp.asarray(target))
+        u_prec, u_rec, u_thr = unbounded.compute()
+        prec, rec, thr, mask, last = (np.asarray(v) for v in capacity.compute())
+        for k in range(NUM_CLASSES):
+            np.testing.assert_allclose(
+                np.concatenate([prec[k][mask[k]][::-1], [last[k, 0]]]),
+                np.asarray(u_prec[k]),
+                atol=1e-6,
+            )
+            np.testing.assert_allclose(
+                np.concatenate([rec[k][mask[k]][::-1], [last[k, 1]]]),
+                np.asarray(u_rec[k]),
+                atol=1e-6,
+            )
+
+
+@pytest.mark.parametrize("ties", [False, True], ids=["unique", "ties"])
+class TestMultilabelCapacityConsistency:
+    def test_roc_and_prc(self, ties):
+        preds, target = _multilabel_data(ties)
+        u_roc = ROC(num_classes=NUM_CLASSES)
+        u_roc.update(jnp.asarray(preds), jnp.asarray(target))
+        c_roc = ROC(num_classes=NUM_CLASSES, capacity=2 * N, multilabel=True)
+        c_roc.update(jnp.asarray(preds), jnp.asarray(target))
+        u_fpr, u_tpr, _ = u_roc.compute()
+        fpr, tpr, thr, mask = (np.asarray(v) for v in c_roc.compute())
+        for k in range(NUM_CLASSES):
+            np.testing.assert_allclose(fpr[k][mask[k]], np.asarray(u_fpr[k]), atol=1e-6)
+            np.testing.assert_allclose(tpr[k][mask[k]], np.asarray(u_tpr[k]), atol=1e-6)
+
+    def test_average_precision_macro(self, ties):
+        preds, target = _multilabel_data(ties)
+        per_class = []
+        from sklearn.metrics import average_precision_score
+
+        for k in range(NUM_CLASSES):
+            per_class.append(average_precision_score(target[:, k], preds[:, k]))
+        capacity = AveragePrecision(
+            num_classes=NUM_CLASSES, capacity=2 * N, multilabel=True, average="macro"
+        )
+        capacity.update(jnp.asarray(preds), jnp.asarray(target))
+        np.testing.assert_allclose(float(capacity.compute()), np.mean(per_class), atol=1e-5)
+
+
+def test_capacity_state_dict_roundtrip_consistency():
+    """A capacity-mode metric saved and restored mid-accumulation continues
+    to agree with the unbounded metric."""
+    preds, target = _binary_data(False)
+    unbounded = AUROC()
+    unbounded.update(jnp.asarray(preds), jnp.asarray(target))
+
+    m = AUROC(capacity=2 * N)
+    m.update(jnp.asarray(preds[:80]), jnp.asarray(target[:80]))
+    restored = AUROC(capacity=2 * N)
+    restored.load_state_dict(m.state_dict())
+    restored.update(jnp.asarray(preds[80:]), jnp.asarray(target[80:]))
+    np.testing.assert_allclose(
+        float(restored.compute()), float(unbounded.compute()), atol=1e-5
+    )
+
+
+def test_capacity_jit_epoch_equals_unbounded():
+    """A whole scanned epoch in one jit (the TPU deployment shape) matches
+    the eager unbounded metric."""
+    preds, target = _multiclass_data(False)
+    m = AUROC(num_classes=NUM_CLASSES, capacity=N)
+
+    n_steps, bs = 8, N // 8
+
+    @jax.jit
+    def epoch(p, t):
+        def step(state, i):
+            return m.update_state(state, jax.lax.dynamic_slice_in_dim(p, i * bs, bs), jax.lax.dynamic_slice_in_dim(t, i * bs, bs)), 0.0
+
+        state, _ = jax.lax.scan(step, m.init_state(), jnp.arange(n_steps))
+        return m.compute_state(state)
+
+    got = float(epoch(jnp.asarray(preds), jnp.asarray(target)))
+    unbounded = AUROC(num_classes=NUM_CLASSES)
+    unbounded.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(got, float(unbounded.compute()), atol=1e-5)

@@ -1,0 +1,195 @@
+"""Reference-parity sweep for the curve family's argument corners.
+
+Breadth parity with /root/reference/tests/classification/test_{auroc,
+average_precision,roc,precision_recall_curve}.py: multilabel AUROC, AUROC
+max_fpr x input cases, AveragePrecision average modes, multiclass/multilabel
+ROC and PRC list outputs — with the reference implementation as oracle
+(sklearn ground-truths for these live in test_curves.py; this grid pins the
+canonicalization and averaging corners).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import AUROC, AveragePrecision, PrecisionRecallCurve, ROC
+from metrics_tpu.functional import auroc as mt_auroc
+from metrics_tpu.functional import average_precision as mt_average_precision
+from metrics_tpu.functional import precision_recall_curve as mt_prc
+from metrics_tpu.functional import roc as mt_roc
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_binary_prob_plausible,
+    _input_multiclass_prob,
+    _input_multidim_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.reference import assert_accumulated_parity, ref_oracle as _ref_oracle
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+torch = pytest.importorskip("torch")
+
+
+# ---------------------------------------------------------------------------
+# AUROC: multilabel modes + max_fpr sweep + weighted/none averages
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+class TestAurocMultilabelReferenceGrid(MetricTester):
+    atol = 1e-5
+
+    def test_auroc_multilabel(self, average):
+        fixture = _input_multilabel_prob
+        args = {"num_classes": NUM_CLASSES, "average": average}
+        self.run_class_metric_test(
+            preds=fixture.preds,
+            target=fixture.target,
+            metric_class=AUROC,
+            sk_metric=_ref_oracle("auroc", **args),
+            metric_args=args,
+            check_merge=False,  # cat-list state merge covered by capacity tests
+            check_jit=False,
+            check_batch=False,  # batch AUROC can be degenerate per batch
+        )
+
+    def test_auroc_multilabel_functional(self, average):
+        fixture = _input_multilabel_prob
+        args = {"num_classes": NUM_CLASSES, "average": average}
+        self.run_functional_metric_test(
+            preds=fixture.preds,
+            target=fixture.target,
+            metric_functional=mt_auroc,
+            sk_metric=_ref_oracle("auroc", **args),
+            metric_args=args,
+            atol=1e-5,
+        )
+
+
+@pytest.mark.parametrize("max_fpr", [0.1, 0.5, 0.9, None])
+@pytest.mark.parametrize(
+    "fixture", [_input_binary_prob, _input_binary_prob_plausible], ids=["prob", "plausible"]
+)
+def test_auroc_max_fpr_reference_grid(max_fpr, fixture):
+    args = {"max_fpr": max_fpr}
+    assert_accumulated_parity(AUROC(**args), fixture, _ref_oracle("auroc", **args), atol=1e-5)
+
+
+def test_auroc_multiclass_none_average_per_class():
+    fixture = _input_multiclass_prob
+    args = {"num_classes": NUM_CLASSES, "average": "none"}
+    assert_accumulated_parity(
+        AUROC(**args), fixture, _ref_oracle("auroc", num_classes=NUM_CLASSES, average=None), atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# AveragePrecision: average modes over multiclass + mdmc
+# ---------------------------------------------------------------------------
+
+
+def test_average_precision_micro_multiclass_raises():
+    """`micro` with label targets is rejected (reference average_precision.py
+    raises the identical error)."""
+    with pytest.raises(ValueError, match="Cannot use `micro` average with multi-class"):
+        mt_average_precision(
+            jnp.asarray(_input_multiclass_prob.preds[0]),
+            jnp.asarray(_input_multiclass_prob.target[0]),
+            num_classes=NUM_CLASSES,
+            average="micro",
+        )
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", None])
+@pytest.mark.parametrize(
+    "fixture, nc",
+    [(_input_multiclass_prob, NUM_CLASSES), (_input_multidim_multiclass_prob, NUM_CLASSES)],
+    ids=["multiclass", "mdmc"],
+)
+def test_average_precision_averages_reference_grid(average, fixture, nc):
+    args = {"num_classes": nc, "average": average}
+    assert_accumulated_parity(
+        AveragePrecision(**args), fixture, _ref_oracle("average_precision", **args), atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# ROC / PRC: multiclass and multilabel list outputs
+# ---------------------------------------------------------------------------
+
+
+def _assert_curves_equal(got, want, atol=1e-5):
+    assert len(got) == len(want)
+    for g_arr, w_arr in zip(got, want):
+        if isinstance(g_arr, list):
+            _assert_curves_equal(g_arr, w_arr, atol=atol)
+        else:
+            np.testing.assert_allclose(np.asarray(g_arr), np.asarray(w_arr), atol=atol)
+
+
+@pytest.mark.parametrize(
+    "metric_class, functional, ref_name",
+    [(ROC, mt_roc, "roc"), (PrecisionRecallCurve, mt_prc, "precision_recall_curve")],
+    ids=["roc", "prc"],
+)
+def test_curve_multiclass_list_outputs(metric_class, functional, ref_name):
+    fixture = _input_multiclass_prob
+    args = {"num_classes": NUM_CLASSES}
+    oracle = _ref_oracle(ref_name, **args)
+    m = metric_class(**args)
+    for i in range(fixture.preds.shape[0]):
+        m.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]))
+    want = oracle(
+        fixture.preds.reshape(-1, NUM_CLASSES), fixture.target.reshape(-1)
+    )
+    _assert_curves_equal(list(m.compute()), list(want))
+
+    got_fn = functional(
+        jnp.asarray(fixture.preds[0]), jnp.asarray(fixture.target[0]), **args
+    )
+    want_fn = oracle(fixture.preds[0], fixture.target[0])
+    _assert_curves_equal(list(got_fn), list(want_fn))
+
+
+@pytest.mark.parametrize(
+    "metric_class, ref_name",
+    [(ROC, "roc"), (PrecisionRecallCurve, "precision_recall_curve")],
+    ids=["roc", "prc"],
+)
+def test_curve_multilabel_list_outputs(metric_class, ref_name):
+    fixture = _input_multilabel_prob
+    args = {"num_classes": NUM_CLASSES}
+    oracle = _ref_oracle(ref_name, **args)
+    m = metric_class(**args)
+    for i in range(fixture.preds.shape[0]):
+        m.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]))
+    want = oracle(
+        fixture.preds.reshape(-1, NUM_CLASSES),
+        fixture.target.reshape(-1, NUM_CLASSES),
+    )
+    _assert_curves_equal(list(m.compute()), list(want))
+
+
+@pytest.mark.parametrize("pos_label", [0, 1])
+def test_curve_binary_pos_label(pos_label):
+    fixture = _input_binary_prob
+    for metric_class, ref_name in ((ROC, "roc"), (PrecisionRecallCurve, "precision_recall_curve")):
+        args = {"pos_label": pos_label}
+        oracle = _ref_oracle(ref_name, **args)
+        m = metric_class(**args)
+        for i in range(fixture.preds.shape[0]):
+            m.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]))
+        want = oracle(fixture.preds.reshape(-1), fixture.target.reshape(-1))
+        _assert_curves_equal(list(m.compute()), list(want))
+
+
+def test_average_precision_pos_label_zero():
+    fixture = _input_binary_prob
+    args = {"pos_label": 0}
+    oracle = _ref_oracle("average_precision", **args)
+    assert_accumulated_parity(AveragePrecision(**args), fixture, oracle, atol=1e-5)
+    want = oracle(fixture.preds.reshape(-1), fixture.target.reshape(-1))
+    got_fn = mt_average_precision(
+        jnp.asarray(fixture.preds.reshape(-1)), jnp.asarray(fixture.target.reshape(-1)), **args
+    )
+    np.testing.assert_allclose(np.asarray(got_fn), want, atol=1e-5)

@@ -33,6 +33,19 @@ from metrics_tpu.functional.classification.exact_curve import (
 CAPACITY = 512
 
 
+def _sk_prc_ref(target, preds):
+    """sklearn PRC re-truncated to the REFERENCE convention: modern sklearn
+    (>=1.x) keeps every trailing full-recall point, while the reference
+    (functional/classification/precision_recall_curve.py:146-147) keeps only
+    the first threshold achieving full recall — drop the extra leading
+    (recall==1) entries from sklearn's decreasing-recall output."""
+    prec, rec, thr = sk_prc(target, preds)
+    k = 0
+    while k + 1 < len(rec) and rec[k + 1] == 1.0:
+        k += 1
+    return prec[k:], rec[k:], thr[k:]
+
+
 def _data(seed, n, ties=False):
     rng = np.random.default_rng(seed)
     preds = rng.random(n).astype(np.float32)
@@ -105,7 +118,7 @@ def test_prc_points_match_sklearn(ties):
     # reference order: reversed valid points, then the appended (1, 0)
     got_prec = np.concatenate([precision[mask][::-1], [last[0]]])
     got_rec = np.concatenate([recall[mask][::-1], [last[1]]])
-    sk_prec, sk_rec, sk_thr = sk_prc(target, preds)
+    sk_prec, sk_rec, sk_thr = _sk_prc_ref(target, preds)
     np.testing.assert_allclose(got_prec, sk_prec, atol=1e-6)
     np.testing.assert_allclose(got_rec, sk_rec, atol=1e-6)
     np.testing.assert_allclose(thr[mask][::-1], sk_thr, atol=1e-6)
@@ -222,7 +235,7 @@ def test_roc_prc_class_capacity_mode():
     prc = PrecisionRecallCurve(capacity=128)
     prc.update(jnp.asarray(preds), jnp.asarray(target))
     precision, recall, thr, mask, last = (np.asarray(v) for v in prc.compute())
-    sk_prec, sk_rec, _ = sk_prc(target, preds)
+    sk_prec, sk_rec, _ = _sk_prc_ref(target, preds)
     np.testing.assert_allclose(np.concatenate([precision[mask][::-1], [last[0]]]), sk_prec, atol=1e-6)
     np.testing.assert_allclose(np.concatenate([recall[mask][::-1], [last[1]]]), sk_rec, atol=1e-6)
 
@@ -381,7 +394,7 @@ def test_multiclass_roc_prc_capacity_match_sklearn(ties):
         np.testing.assert_allclose(fpr[k][mask[k]], sk_fpr, atol=1e-6)
         np.testing.assert_allclose(tpr[k][mask[k]], sk_tpr, atol=1e-6)
 
-        sk_prec, sk_rec, _ = sk_prc(tgt_k, preds[:, k])
+        sk_prec, sk_rec, _ = _sk_prc_ref(tgt_k, preds[:, k])
         got_prec = np.concatenate([precision[k][pmask[k]][::-1], [last[k, 0]]])
         got_rec = np.concatenate([recall[k][pmask[k]][::-1], [last[k, 1]]])
         np.testing.assert_allclose(got_prec, sk_prec, atol=1e-6)
@@ -440,7 +453,7 @@ def test_multilabel_capacity_curves_and_ap():
         sk_fpr, sk_tpr, _ = sk_roc(target[:, k], preds[:, k], drop_intermediate=False)
         np.testing.assert_allclose(fpr[k][mask[k]], sk_fpr, atol=1e-6)
         np.testing.assert_allclose(tpr[k][mask[k]], sk_tpr, atol=1e-6)
-        sk_prec, sk_rec, _ = sk_prc(target[:, k], preds[:, k])
+        sk_prec, sk_rec, _ = _sk_prc_ref(target[:, k], preds[:, k])
         np.testing.assert_allclose(
             np.concatenate([precision[k][pmask[k]][::-1], [last[k, 0]]]), sk_prec, atol=1e-6
         )

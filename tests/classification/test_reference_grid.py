@@ -37,26 +37,10 @@ from tests.classification.inputs import (
     _input_multilabel_prob,
     _input_multilabel_prob_plausible,
 )
-from tests.helpers.reference import load_reference_module
+from tests.helpers.reference import assert_accumulated_parity, ref_oracle as _ref_oracle
 from tests.helpers.testers import NUM_CLASSES, MetricTester
 
 torch = pytest.importorskip("torch")
-
-
-def _ref_fn(name):
-    return getattr(load_reference_module("torchmetrics.functional"), name)
-
-
-def _ref_oracle(name, **ref_kwargs):
-    """Oracle adapter: numpy batch -> reference torchmetrics functional."""
-
-    fn = _ref_fn(name)
-
-    def oracle(preds, target, **_):
-        out = fn(torch.as_tensor(np.asarray(preds)), torch.as_tensor(np.asarray(target)), **ref_kwargs)
-        return out.numpy()
-
-    return oracle
 
 
 # every input case in the reference grid, with the arguments its shape needs
@@ -210,15 +194,7 @@ class TestAccuracyReferenceGrid(MetricTester):
 @pytest.mark.parametrize("average", AVERAGES)
 def test_accuracy_topk_reference_grid(top_k, average):
     args = {"top_k": top_k, "average": average, "num_classes": NUM_CLASSES}
-    oracle = _ref_oracle("accuracy", **args)
-    fixture = _input_multiclass_prob
-    m = Accuracy(**args)
-    for i in range(fixture.preds.shape[0]):
-        m.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]))
-    want = oracle(
-        fixture.preds.reshape(-1, NUM_CLASSES), fixture.target.reshape(-1)
-    )
-    np.testing.assert_allclose(np.asarray(m.compute()), want, atol=1e-6)
+    assert_accumulated_parity(Accuracy(**args), _input_multiclass_prob, _ref_oracle("accuracy", **args))
 
 
 @pytest.mark.parametrize("metric_class, ref_name", [(Precision, "precision"), (Recall, "recall")])
@@ -260,11 +236,4 @@ def test_ignore_index_parity(metric_class, ref_name, average):
     ref_kwargs = dict(args)
     if ref_name == "fbeta_score":
         ref_kwargs["beta"] = 0.5
-    oracle = _ref_oracle(ref_name, **ref_kwargs)
-    m = metric_class(**args)
-    for i in range(fixture.preds.shape[0]):
-        m.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]))
-    want = oracle(
-        fixture.preds.reshape(-1, NUM_CLASSES), fixture.target.reshape(-1)
-    )
-    np.testing.assert_allclose(np.asarray(m.compute()), want, atol=1e-6)
+    assert_accumulated_parity(metric_class(**args), fixture, _ref_oracle(ref_name, **ref_kwargs))

@@ -35,3 +35,47 @@ def load_reference_module(dotted: str):
     except Exception as err:  # pragma: no cover
         pytest.skip(f"reference torchmetrics unavailable: {err}")
     return sys.modules[dotted]
+
+
+def ref_oracle(name: str, **ref_kwargs):
+    """Oracle adapter: numpy batch -> reference torchmetrics functional.
+
+    Handles list outputs (curve metrics return per-class lists) by mapping
+    the tensor->numpy conversion over them.
+    """
+    import numpy as np
+    import torch
+
+    fn = getattr(load_reference_module("torchmetrics.functional"), name)
+
+    def _to_np(out):
+        if isinstance(out, (list, tuple)):
+            return [_to_np(o) for o in out]
+        return out.numpy()
+
+    def oracle(preds, target, **_):
+        return _to_np(
+            fn(torch.as_tensor(np.asarray(preds)), torch.as_tensor(np.asarray(target)), **ref_kwargs)
+        )
+
+    return oracle
+
+
+def assert_accumulated_parity(metric, fixture, oracle, atol=1e-6):
+    """Update per batch, then compare the accumulated compute against the
+    oracle on the batch-flattened data (the shared shape of the targeted
+    argument-corner tests in the reference grids)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    for i in range(fixture.preds.shape[0]):
+        metric.update(jnp.asarray(fixture.preds[i]), jnp.asarray(fixture.target[i]))
+    flat_p = fixture.preds.reshape(-1, *fixture.preds.shape[2:])
+    flat_t = fixture.target.reshape(-1, *fixture.target.shape[2:])
+    want = oracle(flat_p, flat_t)
+    got = metric.compute()
+    if isinstance(got, (list, tuple)):
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=atol)
+    else:
+        np.testing.assert_allclose(np.asarray(got), want, atol=atol)
