@@ -75,13 +75,14 @@ def curve_buffer_merge(*states: Dict[str, Array]) -> Dict[str, Array]:
 
 def _masked_sorted_cumulants(
     preds: Array, target: Array, valid: Array
-) -> Tuple[Array, Array, Array, Array, Array]:
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
     """Sort by descending score (invalid last) and return run-end cumulants.
 
-    Returns ``(sorted_key, sorted_valid, tps, fps, run_end)`` where ``tps``/
-    ``fps`` are cumulative counts and ``run_end[i]`` is the index of the last
-    position sharing ``sorted_key[i]`` — the threshold point that position
-    belongs to.
+    Returns ``(sorted_key, sorted_valid, tps, fps, run_end, run_start)``
+    where ``tps``/``fps`` are cumulative counts and ``run_end[i]`` /
+    ``run_start[i]`` are the last/first index sharing ``sorted_key[i]`` —
+    the tie run that position belongs to. The run boundaries are derived
+    ONCE here; every tie/key convention lives in this helper.
     """
     key = jnp.where(valid, preds.astype(jnp.float32), -jnp.inf)
     order = jnp.argsort(-key, stable=True)
@@ -94,9 +95,12 @@ def _masked_sorted_cumulants(
 
     n = sorted_key.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    is_run_last = jnp.concatenate([sorted_key[1:] != sorted_key[:-1], jnp.ones(1, bool)])
+    boundary = sorted_key[1:] != sorted_key[:-1]
+    is_run_last = jnp.concatenate([boundary, jnp.ones(1, bool)])
+    is_run_first = jnp.concatenate([jnp.ones(1, bool), boundary])
     run_end = jax.lax.cummin(jnp.where(is_run_last, idx, n - 1)[::-1])[::-1]
-    return sorted_key, sorted_valid, tps, fps, run_end
+    run_start = jax.lax.cummax(jnp.where(is_run_first, idx, 0))
+    return sorted_key, sorted_valid, tps, fps, run_end, run_start
 
 
 def binary_average_precision_fixed(preds: Array, target: Array, valid: Array) -> Array:
@@ -107,7 +111,7 @@ def binary_average_precision_fixed(preds: Array, target: Array, valid: Array) ->
     contributes the precision at the END of its tie run. NaN when there are
     no positive targets (reference 0/0 semantics).
     """
-    _, sorted_valid, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    _, sorted_valid, tps, fps, run_end, _ = _masked_sorted_cumulants(preds, target, valid)
     total_pos = tps[-1]
     precision = tps / jnp.clip(tps + fps, 1.0, None)
     contributions = jnp.diff(tps, prepend=0.0) * precision[run_end] * sorted_valid
@@ -122,7 +126,7 @@ def binary_auroc_fixed(preds: Array, target: Array, valid: Array) -> Array:
     the result equals the deduped-threshold integral. NaN when either class
     is absent.
     """
-    _, _, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    _, _, tps, fps, run_end, _ = _masked_sorted_cumulants(preds, target, valid)
     total_pos, total_neg = tps[-1], fps[-1]
     tpr = tps[run_end] / jnp.clip(total_pos, 1.0, None)
     fpr = fps[run_end] / jnp.clip(total_neg, 1.0, None)
@@ -142,7 +146,7 @@ def binary_roc_fixed(
     (reference functional/classification/roc.py), then one point per distinct
     threshold in descending-score order. Padded slots repeat the final point.
     """
-    sorted_key, sorted_valid, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    sorted_key, sorted_valid, tps, fps, run_end, _ = _masked_sorted_cumulants(preds, target, valid)
     total_pos, total_neg = tps[-1], fps[-1]
     idx = jnp.arange(sorted_key.shape[0])
     is_threshold = (run_end == idx) & sorted_valid
@@ -165,7 +169,7 @@ def binary_precision_recall_curve_fixed(
     points REVERSED with ``(precision=1, recall=0)`` appended — returned
     separately as ``last_point`` so the caller keeps static shapes.
     """
-    sorted_key, sorted_valid, tps, fps, run_end = _masked_sorted_cumulants(preds, target, valid)
+    sorted_key, sorted_valid, tps, fps, run_end, run_start = _masked_sorted_cumulants(preds, target, valid)
     total_pos = tps[-1]
     idx = jnp.arange(sorted_key.shape[0])
     is_threshold = (run_end == idx) & sorted_valid
@@ -177,8 +181,6 @@ def binary_precision_recall_curve_fixed(
     # with zero positives the reference convention degenerates to keeping
     # only the first (highest) threshold, which the `run_start == 0` arm
     # reproduces (prev_end_tps < 0 is never true).
-    is_run_first = jnp.concatenate([jnp.ones(1, bool), sorted_key[1:] != sorted_key[:-1]])
-    run_start = jax.lax.cummax(jnp.where(is_run_first, idx, 0))
     prev_end_tps = jnp.where(run_start > 0, tps[jnp.maximum(run_start - 1, 0)], 0.0)
     is_threshold = is_threshold & ((prev_end_tps < total_pos) | (run_start == 0))
 

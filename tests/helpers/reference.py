@@ -75,6 +75,7 @@ def assert_accumulated_parity(metric, fixture, oracle, atol=1e-6):
     want = oracle(flat_p, flat_t)
     got = metric.compute()
     if isinstance(got, (list, tuple)):
+        assert len(got) == len(want), f"length mismatch: {len(got)} vs {len(want)}"
         for g, w in zip(got, want):
             np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=atol)
     else:
