@@ -1,0 +1,200 @@
+"""Reference-parity sweep for the retrieval domain.
+
+Breadth parity with /root/reference/tests/retrieval/ (the
+RetrievalMetricTester parametrization, helpers.py:410-530): every metric x
+k x empty_target_action over a shared ragged fixture that contains
+empty-target queries, graded targets for NDCG, single-doc queries, and an
+argument-validation sweep — with the reference implementation as oracle so
+the empty-query policies and @k edge rules are pinned behaviorally.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from tests.helpers.reference import load_reference_module
+
+torch = pytest.importorskip("torch")
+
+
+# ragged fixture: 24 queries, 1-15 docs each, ~1/4 with no positive target,
+# one single-doc query, one all-positive query
+_rng = np.random.default_rng(55)
+_idx_parts, _preds_parts, _target_parts = [], [], []
+for q in range(24):
+    n = int(_rng.integers(1, 16)) if q != 3 else 1
+    t = (_rng.random(n) < 0.35).astype(np.int64)
+    if q % 4 == 0:
+        t[:] = 0  # empty-target query
+    if q == 7:
+        t[:] = 1  # all-positive query (FallOut's empty case)
+    _idx_parts.append(np.full(n, q))
+    _preds_parts.append(_rng.random(n).astype(np.float32))
+    _target_parts.append(t)
+IDX = np.concatenate(_idx_parts)
+PREDS = np.concatenate(_preds_parts)
+TARGET = np.concatenate(_target_parts)
+
+# graded-relevance variant for NDCG
+TARGET_GRADED = np.where(TARGET > 0, _rng.integers(1, 5, len(TARGET)), 0).astype(np.int64)
+
+
+METRICS = [
+    ("RetrievalMAP", RetrievalMAP, {}, False),
+    ("RetrievalMRR", RetrievalMRR, {}, False),
+    ("RetrievalRPrecision", RetrievalRPrecision, {}, False),
+    ("RetrievalPrecision", RetrievalPrecision, {"k": 1}, False),
+    ("RetrievalPrecision", RetrievalPrecision, {"k": 3}, False),
+    ("RetrievalPrecision", RetrievalPrecision, {}, False),
+    ("RetrievalRecall", RetrievalRecall, {"k": 1}, False),
+    ("RetrievalRecall", RetrievalRecall, {"k": 3}, False),
+    ("RetrievalHitRate", RetrievalHitRate, {"k": 1}, False),
+    ("RetrievalHitRate", RetrievalHitRate, {"k": 3}, False),
+    ("RetrievalFallOut", RetrievalFallOut, {"k": 3}, False),
+    ("RetrievalNormalizedDCG", RetrievalNormalizedDCG, {"k": 3}, False),
+    ("RetrievalNormalizedDCG", RetrievalNormalizedDCG, {}, True),
+]
+METRIC_IDS = [
+    f"{name}{'-k' + str(args['k']) if 'k' in args else ''}{'-graded' if graded else ''}"
+    for name, _, args, graded in METRICS
+]
+
+
+def _ref_retrieval(name, **kwargs):
+    mod = load_reference_module("torchmetrics.retrieval")
+    return getattr(mod, name)(**kwargs)
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+@pytest.mark.parametrize("name, cls, args, graded", METRICS, ids=METRIC_IDS)
+def test_retrieval_reference_parity(name, cls, args, graded, action):
+    """Accumulated value matches the reference metric with identical
+    arguments, across every empty-query policy, fed in two uneven batches
+    that split mid-query."""
+    target = TARGET_GRADED if graded else TARGET
+    ours = cls(empty_target_action=action, **args)
+    ref = _ref_retrieval(name, empty_target_action=action, **args)
+
+    half = len(PREDS) // 2
+    for lo, hi in ((0, half), (half, len(PREDS))):
+        ours.update(
+            jnp.asarray(PREDS[lo:hi]), jnp.asarray(target[lo:hi]), indexes=jnp.asarray(IDX[lo:hi])
+        )
+        ref.update(
+            torch.as_tensor(PREDS[lo:hi]),
+            torch.as_tensor(target[lo:hi]),
+            indexes=torch.as_tensor(IDX[lo:hi]),
+        )
+    np.testing.assert_allclose(
+        float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=f"{name} {args} {action}"
+    )
+
+
+@pytest.mark.parametrize("name, cls, args, graded", METRICS[:4], ids=METRIC_IDS[:4])
+def test_retrieval_error_action_raises_like_reference(name, cls, args, graded):
+    ours = cls(empty_target_action="error", **args)
+    ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX))
+    with pytest.raises(ValueError):
+        ours.compute()
+
+    ref = _ref_retrieval(name, empty_target_action="error", **args)
+    ref.update(torch.as_tensor(PREDS), torch.as_tensor(TARGET), indexes=torch.as_tensor(IDX))
+    with pytest.raises(ValueError):
+        ref.compute()
+
+
+@pytest.mark.parametrize("ignore_index", [-100, 0])
+def test_retrieval_ignore_index_parity(ignore_index):
+    target = TARGET.copy()
+    target[::7] = ignore_index  # sprinkle ignored positions
+    ours = RetrievalMAP(ignore_index=ignore_index, empty_target_action="skip")
+    ref = _ref_retrieval("RetrievalMAP", ignore_index=ignore_index, empty_target_action="skip")
+    ours.update(jnp.asarray(PREDS), jnp.asarray(target), indexes=jnp.asarray(IDX))
+    ref.update(torch.as_tensor(PREDS), torch.as_tensor(target), indexes=torch.as_tensor(IDX))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# argument-validation sweep (RetrievalMetricTester's "arguments" checks)
+# ---------------------------------------------------------------------------
+
+ALL_CLASSES = sorted(
+    {cls for _, cls, _, _ in METRICS}, key=lambda c: c.__name__
+)
+
+
+@pytest.mark.parametrize("cls", ALL_CLASSES, ids=[c.__name__ for c in ALL_CLASSES])
+def test_retrieval_argument_validation(cls):
+    with pytest.raises(ValueError, match="empty_target_action"):
+        cls(empty_target_action="casual_argument")
+    with pytest.raises(ValueError, match="ignore_index"):
+        cls(ignore_index="not an int")
+
+    m = cls()
+    # indexes are required
+    with pytest.raises(ValueError, match="`indexes`"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 1]), indexes=None)
+    # shape mismatch
+    with pytest.raises(ValueError, match="same shape"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 1, 1]), indexes=jnp.asarray([0, 0, 0]))
+    # float indexes rejected
+    with pytest.raises(ValueError, match="long integers"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 1]), indexes=jnp.asarray([0.0, 0.0]))
+    # integer preds rejected
+    with pytest.raises(ValueError, match="float"):
+        m.update(jnp.asarray([1, 0]), jnp.asarray([0, 1]), indexes=jnp.asarray([0, 0]))
+
+
+@pytest.mark.parametrize(
+    "cls", [RetrievalPrecision, RetrievalRecall, RetrievalHitRate, RetrievalFallOut, RetrievalNormalizedDCG]
+)
+def test_retrieval_k_validation(cls):
+    with pytest.raises(ValueError, match="`k`"):
+        cls(k=-1)
+    with pytest.raises(ValueError, match="`k`"):
+        cls(k=0)
+    with pytest.raises(ValueError, match="`k`"):
+        cls(k=1.5)
+
+
+def test_retrieval_non_binary_target_rejected_where_disallowed():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="binary"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 3]), indexes=jnp.asarray([0, 0]))
+    # NDCG allows graded targets
+    ndcg = RetrievalNormalizedDCG()
+    ndcg.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 3]), indexes=jnp.asarray([0, 0]))
+    assert float(ndcg.compute()) >= 0.0
+
+
+def test_retrieval_single_query_single_doc():
+    """Degenerate layouts: one query, one doc (positive and negative)."""
+    pos = RetrievalMAP()
+    pos.update(jnp.asarray([0.5]), jnp.asarray([1]), indexes=jnp.asarray([0]))
+    assert float(pos.compute()) == 1.0
+    neg = RetrievalMAP(empty_target_action="neg")
+    neg.update(jnp.asarray([0.5]), jnp.asarray([0]), indexes=jnp.asarray([0]))
+    assert float(neg.compute()) == 0.0
+
+
+def test_retrieval_nonconsecutive_query_ids():
+    """Query ids need not be dense/consecutive (reference get_group_indexes
+    contract): sparse ids give the same result as densified ones."""
+    sparse = jnp.asarray([100, 100, 7, 7, 9000])
+    dense = jnp.asarray([0, 0, 1, 1, 2])
+    preds = jnp.asarray([0.9, 0.1, 0.8, 0.3, 0.7])
+    target = jnp.asarray([1, 0, 0, 1, 1])
+    a, b = RetrievalMAP(), RetrievalMAP()
+    a.update(preds, target, indexes=sparse)
+    b.update(preds, target, indexes=dense)
+    np.testing.assert_allclose(float(a.compute()), float(b.compute()), atol=1e-6)

