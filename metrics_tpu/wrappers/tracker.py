@@ -3,7 +3,7 @@
 Behavior parity with /root/reference/torchmetrics/wrappers/tracker.py:24-185.
 """
 from copy import deepcopy
-from typing import Any, Dict, List, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -90,12 +90,14 @@ class MetricTracker:
     def best_metric(
         self, return_step: bool = False
     ) -> Union[
-        float,
-        Tuple[int, float],
+        Optional[float],
+        Tuple[Optional[float], Optional[int]],
         Dict[str, Union[float, None]],
-        Tuple[Dict[str, Union[int, None]], Dict[str, Union[float, None]]],
+        Tuple[Dict[str, Union[float, None]], Dict[str, Union[int, None]]],
     ]:
-        """The best observed value (and optionally the step it occurred at)."""
+        """The best observed value (and, with ``return_step``, the step it
+        occurred at, as ``(value, step)``). ``None`` (per entry) when the
+        tracked values are non-scalar and have no total order."""
         res = self.compute_all()
         if isinstance(res, dict):
             maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
@@ -117,11 +119,28 @@ class MetricTracker:
                 return value, idx
             return value
 
-        f = jnp.argmax if self.maximize else jnp.argmin
-        idx_best = int(f(res))
+        try:
+            f = jnp.argmax if self.maximize else jnp.argmin
+            idx_best = int(f(res))
+            # reshape(()) accepts size-1 per-step values (e.g. a (steps, 1)
+            # multioutput history, where torch .item() would also succeed)
+            # and raises for genuinely non-scalar ones
+            value = float(jnp.asarray(res[idx_best]).reshape(()))
+        except (ValueError, TypeError):
+            # non-scalar per-step values (e.g. a tracked ConfusionMatrix)
+            # have no total order; warn and return None — the same contract
+            # as the collection branch above (the reference instead fails
+            # with an opaque tensor-conversion error here)
+            rank_zero_warn(
+                "Encountered an error when trying to get the best metric:"
+                " this is probably due to the 'best' not being defined for this metric."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            value, idx_best = None, None
         if return_step:
-            return float(res[idx_best]), idx_best
-        return float(res[idx_best])
+            return value, idx_best
+        return value
 
     def _check_for_increment(self, method: str) -> None:
         if not self._increment_called:
