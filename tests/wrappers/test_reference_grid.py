@@ -33,11 +33,6 @@ PREDS /= PREDS.sum(-1, keepdims=True)
 TARGET = _rng.integers(0, NC, (STEPS, 24))
 
 
-def _ref(attr, *args, **kwargs):
-    mod = load_reference_module("torchmetrics")
-    return getattr(mod, attr)(*args, **kwargs)
-
-
 def test_classwise_wrapper_reference_parity():
     ref_tm = load_reference_module("torchmetrics")
     ours = ClasswiseWrapper(Accuracy(num_classes=NC, average="none"), labels=["a", "b", "c", "d"])
