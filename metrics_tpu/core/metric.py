@@ -83,6 +83,15 @@ class Metric(ABC):
                 " returns the batch value.",
                 DeprecationWarning,
             )
+        # constructor-kwarg validation parity with reference metric.py:137-147
+        if not isinstance(dist_sync_on_step, bool):
+            raise ValueError(
+                f"Expected keyword argument `dist_sync_on_step` to be an `bool` but got {dist_sync_on_step}"
+            )
+        if dist_sync_fn is not None and not callable(dist_sync_fn):
+            raise ValueError(
+                f"Expected keyword argument `dist_sync_fn` to be an callable function but got {dist_sync_fn}"
+            )
         self.dist_sync_on_step = dist_sync_on_step
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn

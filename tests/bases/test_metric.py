@@ -343,3 +343,66 @@ def test_custom_cat_like_reducer_flag():
     assert len(seen) == 1, "pre-cat optimization must collapse the list state to a single gather"
     np.testing.assert_allclose(np.asarray(m._compute()), [1.0, 2.0, 3.0])
     m.unsync()
+
+
+def test_error_on_wrong_constructor_input():
+    """Constructor-kwarg validation parity (reference test_metric.py:31-37)."""
+    with pytest.raises(ValueError, match="`dist_sync_on_step` to be an `bool`"):
+        DummyMetric(dist_sync_on_step=None)
+    with pytest.raises(ValueError, match="`dist_sync_fn` to be an callable"):
+        DummyMetric(dist_sync_fn=[2, 3])
+
+
+def test_error_on_not_implemented_methods():
+    """A subclass must implement _update and _compute; instantiating an
+    incomplete subclass fails (ABC enforcement — the jax-idiomatic analog of
+    the reference's NotImplementedError checks)."""
+    from metrics_tpu.core.metric import Metric
+
+    class OnlyCompute(Metric):
+        def _compute(self):
+            return None
+
+    class OnlyUpdate(Metric):
+        def _update(self):
+            pass
+
+    with pytest.raises(TypeError, match="_update"):
+        OnlyCompute()
+    with pytest.raises(TypeError, match="_compute"):
+        OnlyUpdate()
+
+
+def test_forward_cache_reset():
+    """reset() clears the forward cache (reference test_metric.py:330-337)."""
+    m = DummyMetric()
+    m(jnp.asarray(2.0))
+    assert float(m._forward_cache) == 2.0
+    m.reset()
+    assert m._forward_cache is None
+
+
+def test_persistent_flag_toggles_all_states():
+    m = DummyMetric()
+    assert m._persistent["x"] is False
+    m.persistent(True)
+    assert m._persistent["x"] is True
+    # states are present in the checkpointable pytree regardless (state_dict
+    # here is the orbax-compatible full pytree, not a torch buffer registry)
+    assert "x" in m.state_dict()
+
+
+def test_child_metric_state_dict_prefixing():
+    """States of nested child metrics appear under a dotted prefix
+    (reference test_metric.py:259-277 via nn.Module nesting)."""
+    from metrics_tpu.wrappers import MinMaxMetric
+
+    wrapped = MinMaxMetric(DummyMetric())
+    wrapped.update(jnp.asarray(3.0))
+    sd = wrapped.state_dict()
+    assert any(k.endswith(".x") for k in sd), sd.keys()
+    restored = MinMaxMetric(DummyMetric())
+    restored.load_state_dict(sd)
+    np.testing.assert_allclose(
+        float(restored.compute()["raw"]), float(wrapped.compute()["raw"]), atol=1e-6
+    )
