@@ -22,22 +22,26 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo 
 
 import os
 
-if "XLA_FLAGS" not in os.environ:
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# 8-virtual-CPU-device bootstrap (same recipe as tests/helpers/force_cpu.py:
+# append the device-count flag to any existing XLA_FLAGS and re-force the
+# cpu platform via jax.config, which wins over sitecustomize-pinned hardware
+# plugins as long as it runs before the first backend query). Multi-chip TPU
+# users: delete this block to run on the real mesh.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 
 import jax
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # some environments pin a hardware plugin from sitecustomize; re-force
-    # cpu before the first backend query so the virtual mesh is honored
-    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.parallel.distributed import sync_in_mesh
 
 
 def main() -> None:
@@ -82,13 +86,12 @@ def main() -> None:
             )
             (s_auroc, s_ap), _ = jax.lax.scan(step, init, (p_steps[1:], t_steps[1:]))
 
-            def gather(s):
-                g = {k: jax.lax.all_gather(v, "dp") for k, v in s.items()}
-                return {k: v.reshape((-1,) + v.shape[2:]) for k, v in g.items()}
-
+            # the library's one-call mesh sync: each state's declared reducer
+            # picks its collective (cat buffers all_gather, the overflow
+            # tally psums)
             return (
-                auroc.compute_state(gather(s_auroc))[None],
-                ap.compute_state(gather(s_ap))[None],
+                auroc.compute_state(sync_in_mesh(s_auroc, auroc.state_reductions(), "dp"))[None],
+                ap.compute_state(sync_in_mesh(s_ap, ap.state_reductions(), "dp"))[None],
             )
 
         return jax.shard_map(
