@@ -60,6 +60,33 @@ assert abs(val - (4.0 + 36.0) / 5.0) < 1e-6, val
 # local state restored after the sync context
 assert float(m.total) == (2 if rank == 0 else 3)
 
+# capacity-mode AUROC: the fixed [capacity] buffer triple (cat states +
+# summed overflow tally) syncs across REAL processes; every rank computes
+# the exact global value
+from metrics_tpu import AUROC
+from metrics_tpu.functional.classification.exact_curve import binary_auroc_fixed
+
+rng = np.random.default_rng(7)
+preds_all = rng.random(12).astype(np.float32)
+target_all = (rng.random(12) < 0.5).astype(np.int32)
+target_all[:2] = [0, 1]  # both classes present
+lo, hi = (0, 6) if rank == 0 else (6, 12)
+cap_m = AUROC(capacity=16)  # partially filled: padding participates in the gather
+cap_m.update(jnp.asarray(preds_all[lo:hi]), jnp.asarray(target_all[lo:hi]))
+got = float(cap_m.compute())
+want = float(binary_auroc_fixed(
+    jnp.asarray(preds_all), jnp.asarray(target_all), jnp.ones(12, bool)
+))
+assert abs(got - want) < 1e-6, (got, want)
+# local (pre-sync) buffer restored afterwards
+assert int(jnp.sum(cap_m.valid)) == 6
+
+# unbounded list-state AUROC: the pre-cat + all-gather path across processes
+unb = AUROC()
+unb.update(jnp.asarray(preds_all[lo:hi]), jnp.asarray(target_all[lo:hi]))
+got_unb = float(unb.compute())
+assert abs(got_unb - want) < 1e-6, (got_unb, want)
+
 print(f"RANK{rank}_OK")
 """
 
