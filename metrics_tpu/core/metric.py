@@ -44,6 +44,7 @@ from metrics_tpu.utils.data import (
     dim_zero_mean,
     dim_zero_min,
     dim_zero_sum,
+    torch_to_numpy,
 )
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -52,6 +53,21 @@ from metrics_tpu.parallel.distributed import gather_all_arrays
 
 Array = jax.Array
 StateValue = Union[Array, List[Array]]
+
+
+def _coerce_foreign(obj: Any) -> Any:
+    """Convert foreign array types (torch tensors — the reference's native
+    inputs) to jax arrays, recursing through lists/tuples/dicts; everything
+    else (jax/numpy arrays, strings, scalars) passes through unchanged."""
+    if hasattr(obj, "detach") and hasattr(obj, "cpu") and hasattr(obj, "numpy"):
+        return jnp.asarray(torch_to_numpy(obj))
+    if isinstance(obj, tuple):
+        return tuple(_coerce_foreign(o) for o in obj)
+    if isinstance(obj, list):
+        return [_coerce_foreign(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _coerce_foreign(v) for k, v in obj.items()}
+    return obj
 
 
 class Metric(ABC):
@@ -232,11 +248,18 @@ class Metric(ABC):
         return contextlib.nullcontext()
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Accumulate into global state. Parity with reference metric.py:421-428,460-463."""
+        """Accumulate into global state. Parity with reference metric.py:421-428,460-463.
+
+        Inputs are coerced at this boundary: torch tensors (the reference's
+        native input type) convert to jax arrays host-side, recursively
+        through lists/tuples/dicts (detection-style structured inputs), so
+        reference users can switch frameworks without touching their data
+        pipeline. Strings and other non-array leaves pass through untouched.
+        """
         self._computed = None
         self._update_called = True
         with self._trace("update"):
-            self._update(*args, **kwargs)
+            self._update(*_coerce_foreign(args), **_coerce_foreign(kwargs))
 
     def compute(self) -> Any:
         """Compute (and cache) the metric from accumulated state, syncing across
@@ -292,7 +315,10 @@ class Metric(ABC):
         return self._forward_cache
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
-        return self.forward(*args, **kwargs)
+        # coerce torch inputs ONCE here so forward's double update (and any
+        # wrapper forward that slices raw args) sees jax arrays; update()'s
+        # own coercion then finds nothing left to convert
+        return self.forward(*_coerce_foreign(args), **_coerce_foreign(kwargs))
 
     def reset(self) -> None:
         """Restore every state to its default. Parity with reference metric.py:491-506."""

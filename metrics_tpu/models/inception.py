@@ -240,10 +240,10 @@ def convert_torch_fidelity_weights(state_dict: Any) -> dict:
     """
     import numpy as np
 
+    from metrics_tpu.utils.data import torch_to_numpy
+
     def _np(t: Any) -> np.ndarray:
-        if hasattr(t, "detach"):
-            t = t.detach().cpu().numpy()
-        return np.asarray(t, dtype=np.float32)
+        return np.asarray(torch_to_numpy(t), dtype=np.float32)
 
     sd = dict(state_dict)
     # tolerate a uniform key prefix (e.g. "model." or "inception.")

@@ -109,10 +109,10 @@ def convert_lpips_weights(state_dict: Any, net_type: str = "alex") -> dict:
     """
     import numpy as np
 
+    from metrics_tpu.utils.data import torch_to_numpy
+
     def _np(t: Any) -> np.ndarray:
-        if hasattr(t, "detach"):
-            t = t.detach().cpu().numpy()
-        return np.asarray(t, dtype=np.float32)
+        return np.asarray(torch_to_numpy(t), dtype=np.float32)
 
     sd = {k.replace("module.", ""): v for k, v in dict(state_dict).items()}
     stages = _NET_STAGES[net_type]

@@ -45,6 +45,19 @@ def _flatten(x: Sequence) -> list:
     return [item for sublist in x for item in sublist]
 
 
+def torch_to_numpy(t: Any) -> np.ndarray:
+    """Convert a torch tensor (duck-typed: detach/cpu/numpy) to a numpy
+    array; anything else goes through ``np.asarray``. Handles dtypes numpy
+    cannot express (torch.bfloat16) by round-tripping through float32."""
+    if hasattr(t, "detach") and hasattr(t, "cpu") and hasattr(t, "numpy"):
+        detached = t.detach().cpu()
+        try:
+            return detached.numpy()
+        except Exception:
+            return detached.float().numpy()
+    return np.asarray(t)
+
+
 def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
     """Convert integer labels ``(N, ...)`` to one-hot ``(N, C, ...)``.
 

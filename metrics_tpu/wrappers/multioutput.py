@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.metric import Metric, _coerce_foreign
 from metrics_tpu.utils.data import apply_to_collection
 
 Array = jax.Array
@@ -61,6 +61,11 @@ class MultioutputWrapper(Metric):
         self.squeeze_outputs = squeeze_outputs
 
     def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[list, dict]]:
+        # this wrapper slices raw inputs BEFORE any child update runs, so the
+        # torch-input coercion must happen here too (a direct .forward() call
+        # bypasses __call__'s pass; coercion is a no-op on jax arrays)
+        args = _coerce_foreign(args)
+        kwargs = _coerce_foreign(kwargs)
         args_kwargs_by_output = []
         for i in range(len(self.metrics)):
             def select(x, idx=i):
