@@ -164,23 +164,29 @@ def _positions(d: int) -> Array:
     return jnp.arange(1, d + 1, dtype=jnp.float32)
 
 
-def average_precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    """functional/retrieval/average_precision.py semantics on a padded row."""
-    st, _ = _row_sort(preds, target, mask)
+# ---------------------------------------------------------------------------
+# sorted-row kernels: the math AFTER the shared per-row argsort. Each public
+# row kernel wraps one of these; the collection compute path sorts ONCE per
+# pack (sorted_row_layout below) and feeds every metric's sorted kernel —
+# an NDCG+MAP collection then pays one argsort, not one per metric.
+# `st` = target by descending score, `sm` = mask likewise, `ideal` = target
+# sorted descending by itself (NDCG's ideal ranking).
+# ---------------------------------------------------------------------------
+
+
+def _ap_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     num_pos = jnp.sum(st)
     terms = st * jnp.cumsum(st) / _positions(st.shape[0])
     return jnp.where(num_pos > 0, jnp.sum(terms) / jnp.maximum(num_pos, 1.0), 0.0)
 
 
-def reciprocal_rank_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    st, _ = _row_sort(preds, target, mask)
+def _rr_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     num_pos = jnp.sum(st)
     first = jnp.argmax(st > 0)
     return jnp.where(num_pos > 0, 1.0 / (first + 1.0), 0.0)
 
 
-def precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    st, sm = _row_sort(preds, target, mask)
+def _precision_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     num_pos = jnp.sum(st)
     if k is None:
         # k defaults to the per-query document count (reference precision.py)
@@ -190,39 +196,31 @@ def precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = N
     return jnp.where(num_pos > 0, jnp.sum(st * in_k) / k, 0.0)
 
 
-def recall_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    st, _ = _row_sort(preds, target, mask)
+def _recall_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     num_pos = jnp.sum(st)
     in_k = _positions(st.shape[0]) <= (k if k is not None else st.shape[0])
     return jnp.where(num_pos > 0, jnp.sum(st * in_k) / jnp.maximum(num_pos, 1.0), 0.0)
 
 
-def r_precision_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    st, _ = _row_sort(preds, target, mask)
+def _r_precision_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     num_pos = jnp.sum(st)
     in_r = _positions(st.shape[0]) <= num_pos
     return jnp.where(num_pos > 0, jnp.sum(st * in_r) / jnp.maximum(num_pos, 1.0), 0.0)
 
 
-def hit_rate_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    st, _ = _row_sort(preds, target, mask)
+def _hit_rate_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     in_k = _positions(st.shape[0]) <= (k if k is not None else st.shape[0])
     return (jnp.sum(st * in_k) > 0).astype(jnp.float32)
 
 
-def fall_out_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    """Top-k fraction of NON-relevant docs; padding must not count as negative."""
-    st, sm = _row_sort(preds, target, mask)
+def _fall_out_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     neg = (1.0 - st) * sm
     num_neg = jnp.sum(neg)
     in_k = _positions(st.shape[0]) <= (k if k is not None else st.shape[0])
     return jnp.where(num_neg > 0, jnp.sum(neg * in_k) / jnp.maximum(num_neg, 1.0), 0.0)
 
 
-def ndcg_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
-    """Graded-target nDCG@k (functional/retrieval/ndcg.py semantics)."""
-    st, _ = _row_sort(preds, target, mask)
-    ideal = -jnp.sort(-target)  # padding zeros sort last; contribute nothing
+def _ndcg_sorted(st: Array, sm: Array, ideal: Array, k: Optional[int] = None) -> Array:
     pos = _positions(st.shape[0])
     in_k = pos <= (k if k is not None else st.shape[0])
     discount = jnp.log2(pos + 1.0)
@@ -231,24 +229,139 @@ def ndcg_row(preds: Array, target: Array, mask: Array, k: Optional[int] = None) 
     return jnp.where(ideal_dcg > 0, target_dcg / jnp.maximum(ideal_dcg, 1e-38), 0.0)
 
 
+_ndcg_sorted.needs_ideal = True  # the only kernel consuming the ideal ranking
+
+
+def _make_row_kernel(name: str, sorted_fn: Callable, doc: str) -> Callable:
+    needs_ideal = getattr(sorted_fn, "needs_ideal", False)
+
+    def kernel(preds: Array, target: Array, mask: Array, k: Optional[int] = None) -> Array:
+        st, sm = _row_sort(preds, target, mask)
+        # padding zeros sort last in the ideal ranking; only NDCG consumes it
+        ideal = -jnp.sort(-target) if needs_ideal else st
+        return sorted_fn(st, sm, ideal, k)
+
+    kernel.__name__ = kernel.__qualname__ = name
+    kernel.__doc__ = doc
+    kernel.sorted_fn = sorted_fn  # the shared-sort path dispatches on this
+    return kernel
+
+
+average_precision_row = _make_row_kernel(
+    "average_precision_row",
+    _ap_sorted,
+    "functional/retrieval/average_precision.py semantics on a padded row.",
+)
+reciprocal_rank_row = _make_row_kernel("reciprocal_rank_row", _rr_sorted, "MRR on a padded row.")
+precision_row = _make_row_kernel("precision_row", _precision_sorted, "Precision@k on a padded row.")
+recall_row = _make_row_kernel("recall_row", _recall_sorted, "Recall@k on a padded row.")
+r_precision_row = _make_row_kernel(
+    "r_precision_row", _r_precision_sorted, "R-precision on a padded row."
+)
+hit_rate_row = _make_row_kernel("hit_rate_row", _hit_rate_sorted, "HitRate@k on a padded row.")
+fall_out_row = _make_row_kernel(
+    "fall_out_row",
+    _fall_out_sorted,
+    "Top-k fraction of NON-relevant docs; padding must not count as negative.",
+)
+ndcg_row = _make_row_kernel(
+    "ndcg_row", _ndcg_sorted, "Graded-target nDCG@k (functional/retrieval/ndcg.py semantics)."
+)
+
+
+#: (identity of every input array) -> cached device result; entries die with
+#: their arrays (weakref finalizers), mirroring _PACK_CACHE's contract
+_SORT_CACHE: "OrderedDict[tuple, Tuple[Array, Array]]" = OrderedDict()
+_SORT_CACHE_MAX = 4
+
+
+@jax.jit
+def _sorted_layout(padded_preds: Array, padded_target: Array, mask: Array):
+    return jax.vmap(_row_sort)(padded_preds, padded_target, mask)
+
+
+def _memoized(cache: "OrderedDict", key_arrays: tuple, compute: Callable):
+    key = tuple(map(id, key_arrays))
+    hit = cache.get(key)
+    if hit is not None:
+        cache.move_to_end(key)
+        return hit
+    result = compute()
+    try:
+        for a in key_arrays:
+            weakref.finalize(a, cache.pop, key, None)
+    except TypeError:
+        return result
+    cache[key] = result
+    while len(cache) > _SORT_CACHE_MAX:
+        cache.popitem(last=False)
+    return result
+
+
+def sorted_row_layout(
+    padded_preds: Array, padded_target: Array, mask: Array
+) -> Tuple[Array, Array]:
+    """``(sorted_target, sorted_mask)`` — the one per-row argsort every
+    retrieval kernel shares, memoized on the identity of ALL THREE pack
+    arrays: metrics computing over the same padded buffers (a compute-group
+    collection) sort once and each run only their own sorted kernel."""
+    return _memoized(
+        _SORT_CACHE,
+        (padded_preds, padded_target, mask),
+        lambda: _sorted_layout(padded_preds, padded_target, mask),
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _padded_compute_fn(kernel: Callable, k: Optional[int], empty_target_action: str):
-    """One jitted function: vmapped per-query kernel + empty policy + mean."""
+    """One jitted function: vmapped per-query SORTED kernel + empty policy +
+    mean, over the shared sorted layout. Kernels that consume the ideal
+    ranking (NDCG) derive it INSIDE this jit from the raw padded target —
+    lazy for the seven kernels that never read it, and no extra device
+    launch for the one that does."""
+    sorted_fn = getattr(kernel, "sorted_fn", None)
+
+    if getattr(sorted_fn, "needs_ideal", False):
+
+        @jax.jit
+        def run(st: Array, sm: Array, padded_target: Array, empty: Array) -> Array:
+            ideal = -jnp.sort(-padded_target, axis=-1)
+            vals = jax.vmap(lambda a, b, c: sorted_fn(a, b, c, k))(st, sm, ideal)
+            return _reduce_with_empty_policy(vals, empty, empty_target_action)
+
+    else:
+
+        @jax.jit
+        def run(st: Array, sm: Array, _unused: Array, empty: Array) -> Array:
+            vals = jax.vmap(lambda a, b: sorted_fn(a, b, a, k))(st, sm)
+            return _reduce_with_empty_policy(vals, empty, empty_target_action)
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _padded_compute_fn_raw(kernel: Callable, k: Optional[int], empty_target_action: str):
+    """Legacy path for user-supplied row kernels without a sorted variant:
+    vmapped raw kernel over the padded buffers."""
 
     @jax.jit
     def run(padded_preds: Array, padded_target: Array, mask: Array, empty: Array) -> Array:
         vals = jax.vmap(lambda p, t, m: kernel(p, t, m, k))(padded_preds, padded_target, mask)
-        if empty_target_action == "pos":
-            vals = jnp.where(empty, 1.0, vals)
-            weights = jnp.ones_like(vals)
-        elif empty_target_action == "neg":
-            vals = jnp.where(empty, 0.0, vals)
-            weights = jnp.ones_like(vals)
-        elif empty_target_action == "skip":
-            weights = (~empty).astype(vals.dtype)
-        else:  # "error" is raised host-side before this runs
-            weights = jnp.ones_like(vals)
-        total = jnp.sum(weights)
-        return jnp.where(total > 0, jnp.sum(vals * weights) / jnp.maximum(total, 1.0), 0.0)
+        return _reduce_with_empty_policy(vals, empty, empty_target_action)
 
     return run
+
+
+def _reduce_with_empty_policy(vals: Array, empty: Array, empty_target_action: str) -> Array:
+    if empty_target_action == "pos":
+        vals = jnp.where(empty, 1.0, vals)
+        weights = jnp.ones_like(vals)
+    elif empty_target_action == "neg":
+        vals = jnp.where(empty, 0.0, vals)
+        weights = jnp.ones_like(vals)
+    elif empty_target_action == "skip":
+        weights = (~empty).astype(vals.dtype)
+    else:  # "error" is raised host-side before this runs
+        weights = jnp.ones_like(vals)
+    total = jnp.sum(weights)
+    return jnp.where(total > 0, jnp.sum(vals * weights) / jnp.maximum(total, 1.0), 0.0)

@@ -20,7 +20,12 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.retrieval.padded import _padded_compute_fn, pack_queries_cached
+from metrics_tpu.functional.retrieval.padded import (
+    _padded_compute_fn,
+    _padded_compute_fn_raw,
+    pack_queries_cached,
+    sorted_row_layout,
+)
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
@@ -119,7 +124,19 @@ class RetrievalMetric(Metric, ABC):
         if self.empty_target_action == "error" and bool(jnp.any(empty)):
             raise ValueError(self._empty_error_message())
 
-        run = _padded_compute_fn(type(self)._padded_metric, self._padded_k, self.empty_target_action)
+        kernel = type(self)._padded_metric
+        sorted_fn = getattr(kernel, "sorted_fn", None)
+        if sorted_fn is not None:
+            # shared-sort path: the per-row argsort is memoized per pack, so
+            # every metric over this pack (a compute-group collection) sorts
+            # once and runs only its own sorted kernel; NDCG's ideal ranking
+            # is derived inside its compute jit from the raw target (the
+            # other kernels' jits never touch that input)
+            st, sm = sorted_row_layout(padded_preds, padded_target, mask)
+            run = _padded_compute_fn(kernel, self._padded_k, self.empty_target_action)
+            return run(st, sm, padded_target, jnp.asarray(empty))
+        # user-supplied padded kernels without a sorted variant
+        run = _padded_compute_fn_raw(kernel, self._padded_k, self.empty_target_action)
         return run(padded_preds, padded_target, mask, jnp.asarray(empty))
 
     def _compute_host_loop(self) -> Array:

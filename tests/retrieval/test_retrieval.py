@@ -359,3 +359,57 @@ def test_pack_cache_entry_freed_with_its_arrays():
     del m
     gc.collect()
     assert len(padded._PACK_CACHE) == 0
+
+
+def test_collection_shares_one_row_sort(monkeypatch):
+    """Metrics over the same pack share ONE per-row argsort
+    (sorted_row_layout memoized per pack) and still match the host loop."""
+    import metrics_tpu.functional.retrieval.padded as padded
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.retrieval import RetrievalNormalizedDCG
+
+    calls = {"n": 0}
+    orig = padded._sorted_layout
+
+    def counting(*args):
+        calls["n"] += 1
+        return orig(*args)
+
+    monkeypatch.setattr(padded, "_sorted_layout", counting)
+
+    rng = np.random.default_rng(11)
+    idx = np.repeat(np.arange(30), 8)
+    preds = rng.random(240).astype(np.float32)
+    target = rng.integers(0, 2, 240).astype(np.int32)
+
+    col = MetricCollection([RetrievalNormalizedDCG(), RetrievalMAP()])
+    col.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    out = col.compute()
+    assert calls["n"] == 1  # one argsort for both metrics
+
+    solo = RetrievalMAP()
+    solo.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(
+        np.asarray(out["RetrievalMAP"]), np.asarray(solo._compute_host_loop()), atol=1e-6
+    )
+
+
+def test_custom_padded_kernel_without_sorted_variant_still_works():
+    """User-supplied row kernels (no sorted_fn attribute) run through the
+    legacy raw path."""
+    from metrics_tpu.retrieval.base import RetrievalMetric
+
+    def max_pos_score_row(preds, target, mask, k=None):
+        return jnp.max(jnp.where((target > 0) & mask, preds, -jnp.inf))
+
+    class MaxPosScore(RetrievalMetric):
+        _padded_metric = staticmethod(max_pos_score_row)
+
+        def _metric(self, preds, target):
+            return jnp.max(jnp.where(target > 0, preds, -jnp.inf))
+
+    m = MaxPosScore()
+    m.update(
+        jnp.asarray([0.2, 0.9, 0.5, 0.4]), jnp.asarray([1, 0, 1, 1]), indexes=jnp.asarray([0, 0, 1, 1])
+    )
+    np.testing.assert_allclose(float(m.compute()), (0.2 + 0.5) / 2, atol=1e-6)
