@@ -118,40 +118,32 @@ def pack_queries_cached(
     target_list: List[Array],
     max_expand: Optional[int] = None,
 ) -> Optional[Tuple[Array, Array, Array]]:
-    """:func:`pack_queries` over cat-list states, memoized on array identity."""
-    arrays = (*indexes_list, *preds_list, *target_list)
-    key = (
-        tuple(map(id, indexes_list)),
-        tuple(map(id, preds_list)),
-        tuple(map(id, target_list)),
-        max_expand,
-    )
-    hit = _PACK_CACHE.get(key)
-    if hit is not None:
-        _PACK_CACHE.move_to_end(key)
-        return None if hit is _NO_PACK else hit
+    """:func:`pack_queries` over cat-list states, memoized on array identity
+    (the shared ``_memoized`` contract; the skew fallback ``None`` is cached
+    under a sentinel so repeated computes on the same state skip the device
+    argsort + shape readback)."""
     if not indexes_list:
         raise ValueError(
             "`indexes` is empty — the retrieval metric has no accumulated samples;"
             " call `update` before `compute`."
         )
-    packed = pack_queries(
-        dim_zero_cat(indexes_list), dim_zero_cat(preds_list), dim_zero_cat(target_list),
-        max_expand=max_expand,
+
+    def compute():
+        packed = pack_queries(
+            dim_zero_cat(indexes_list), dim_zero_cat(preds_list), dim_zero_cat(target_list),
+            max_expand=max_expand,
+        )
+        return _NO_PACK if packed is None else packed
+
+    result = _memoized(
+        _PACK_CACHE,
+        (*indexes_list, *preds_list, *target_list),
+        compute,
+        # list lengths disambiguate which list each id belongs to
+        extra_key=(len(indexes_list), len(preds_list), max_expand),
+        max_entries=_PACK_CACHE_MAX,
     )
-    try:
-        for a in arrays:
-            weakref.finalize(a, _PACK_CACHE.pop, key, None)
-    except TypeError:
-        # a non-weakref-able input (e.g. plain numpy scalar view): do not
-        # cache — correctness over reuse, the LRU cannot guard its key
-        return packed
-    # the skew fallback (None) is cached too, so repeated computes on the
-    # same state skip the device argsort + shape readback
-    _PACK_CACHE[key] = _NO_PACK if packed is None else packed
-    while len(_PACK_CACHE) > _PACK_CACHE_MAX:
-        _PACK_CACHE.popitem(last=False)
-    return packed
+    return None if result is _NO_PACK else result
 
 
 def _row_sort(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
@@ -272,7 +264,6 @@ ndcg_row = _make_row_kernel(
 #: (identity of every input array) -> cached device result; entries die with
 #: their arrays (weakref finalizers), mirroring _PACK_CACHE's contract
 _SORT_CACHE: "OrderedDict[tuple, Tuple[Array, Array]]" = OrderedDict()
-_SORT_CACHE_MAX = 4
 
 
 @jax.jit
@@ -280,8 +271,14 @@ def _sorted_layout(padded_preds: Array, padded_target: Array, mask: Array):
     return jax.vmap(_row_sort)(padded_preds, padded_target, mask)
 
 
-def _memoized(cache: "OrderedDict", key_arrays: tuple, compute: Callable):
-    key = tuple(map(id, key_arrays))
+def _memoized(
+    cache: "OrderedDict", key_arrays: tuple, compute: Callable, extra_key: tuple = (), max_entries: int = 4
+):
+    """Identity-keyed device-result memoization: the key is the id() of every
+    input array (immutable jax arrays; weakref finalizers purge the entry —
+    and make id recycling impossible — the moment any of them is collected),
+    plus any hashable ``extra_key``. Non-weakref-able inputs skip caching."""
+    key = tuple(map(id, key_arrays)) + extra_key
     hit = cache.get(key)
     if hit is not None:
         cache.move_to_end(key)
@@ -293,7 +290,7 @@ def _memoized(cache: "OrderedDict", key_arrays: tuple, compute: Callable):
     except TypeError:
         return result
     cache[key] = result
-    while len(cache) > _SORT_CACHE_MAX:
+    while len(cache) > max_entries:
         cache.popitem(last=False)
     return result
 
