@@ -170,3 +170,76 @@ def test_empty_and_identical_edge_cases():
     perfect = BLEUScore()
     perfect.update(["the cat"], [["the cat"]])
     assert 0.0 <= float(perfect.compute()) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# corpus-level parametrization (reference tests/text/inputs.py style): the
+# same metric x argument grid over structurally different corpora
+# ---------------------------------------------------------------------------
+
+_CORPORA = {
+    "short": (
+        ["a", "b c", ""],
+        [["a"], ["b d"], ["non empty"]],
+    ),
+    "long_multi_ref": (
+        [
+            "the quick brown fox jumps over the lazy dog " * 5,
+            "pack my box with five dozen liquor jugs and then some more words",
+        ],
+        [
+            ["the quick brown fox jumped over the lazy dog " * 5, "a fox jumps over a dog " * 4],
+            ["pack my box with five dozen liquor jugs", "pack a box with liquor jugs quickly"],
+        ],
+    ),
+    "unicode": (
+        ["schrodinger's 猫 ist très muñeca", "ασπίδα και δόρυ"],
+        [["schrodinger's 猫 ist tres muñeca"], ["ασπίδα και δόρατα"]],
+    ),
+}
+
+
+@pytest.mark.parametrize("corpus", list(_CORPORA), ids=list(_CORPORA))
+@pytest.mark.parametrize(
+    "cls, name, args",
+    [
+        (BLEUScore, "BLEUScore", {"n_gram": 2}),
+        (SacreBLEUScore, "SacreBLEUScore", {"tokenize": "13a"}),
+        (SacreBLEUScore, "SacreBLEUScore", {"tokenize": "intl"}),
+        (CHRFScore, "CHRFScore", {}),
+        (TranslationEditRate, "TranslationEditRate", {}),
+    ],
+    ids=["bleu2", "sacre13a", "sacreintl", "chrf", "ter"],
+)
+def test_corpus_grid_multi_reference(cls, name, args, corpus):
+    preds, targets = _CORPORA[corpus]
+    ours, ref = cls(**args), _ref_cls(name, **args)
+    # one-at-a-time updates exercise per-sentence accumulation
+    for p, t in zip(preds, targets):
+        ours.update([p], [t])
+        ref.update([p], [t])
+    np.testing.assert_allclose(
+        float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=f"{name} {corpus}"
+    )
+
+
+@pytest.mark.parametrize("corpus", list(_CORPORA), ids=list(_CORPORA))
+@pytest.mark.parametrize(
+    "cls, name",
+    [
+        (WordErrorRate, "WordErrorRate"),
+        (CharErrorRate, "CharErrorRate"),
+        (MatchErrorRate, "MatchErrorRate"),
+        (WordInfoLost, "WordInfoLost"),
+    ],
+    ids=["wer", "cer", "mer", "wil"],
+)
+def test_corpus_grid_single_reference(cls, name, corpus):
+    preds, targets = _CORPORA[corpus]
+    flat_targets = [t[0] for t in targets]  # WER family takes single references
+    ours, ref = cls(), _ref_cls(name)
+    ours.update(preds, flat_targets)
+    ref.update(preds, flat_targets)
+    np.testing.assert_allclose(
+        float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=f"{name} {corpus}"
+    )
