@@ -231,8 +231,9 @@ def test_corpus_grid_multi_reference(cls, name, args, corpus):
         (CharErrorRate, "CharErrorRate"),
         (MatchErrorRate, "MatchErrorRate"),
         (WordInfoLost, "WordInfoLost"),
+        (WordInfoPreserved, "WordInfoPreserved"),
     ],
-    ids=["wer", "cer", "mer", "wil"],
+    ids=["wer", "cer", "mer", "wil", "wip"],
 )
 def test_corpus_grid_single_reference(cls, name, corpus):
     preds, targets = _CORPORA[corpus]
