@@ -68,3 +68,139 @@ def test_constant_scores_give_half_auroc(n):
     )
     auroc = float(binary_auroc_fixed(state["preds"], state["target"], state["valid"]))
     np.testing.assert_allclose(auroc, 0.5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# multiclass / multilabel one-vs-rest kernels
+# ---------------------------------------------------------------------------
+
+from sklearn.metrics import precision_recall_curve as sk_prc
+
+from metrics_tpu.functional.classification.exact_curve import (
+    binary_precision_recall_curve_fixed,
+    multiclass_average_precision_fixed,
+    multiclass_roc_fixed,
+)
+
+# fixed buffer capacity so every Hypothesis example hits the same compiled
+# kernel shapes (only the class count, 2-5, varies the shape — without this
+# each example pays a fresh XLA compile and the suite takes minutes)
+_CAP = 64
+
+
+def _pad_rows(scores, labels):
+    n, c = scores.shape
+    preds_buf = np.zeros((_CAP, c), np.float32)
+    preds_buf[:n] = scores
+    target_buf = np.zeros((_CAP,) + labels.shape[1:], labels.dtype)
+    target_buf[:n] = labels
+    valid = np.zeros(_CAP, bool)
+    valid[:n] = True
+    return jnp.asarray(preds_buf), jnp.asarray(target_buf), jnp.asarray(valid)
+
+
+@st.composite
+def _multiclass_data(draw):
+    n = draw(st.integers(6, 48))
+    c = draw(st.integers(2, 5))
+    quant = draw(st.sampled_from([None, 4]))  # tie-heavy variant
+    rng = np.random.default_rng(draw(st.integers(0, 2**31)))
+    scores = rng.random((n, c)).astype(np.float32)
+    if quant:
+        scores = np.round(scores * quant) / quant
+    labels = rng.integers(0, c, n).astype(np.int32)
+    return scores, labels, c
+
+
+@given(_multiclass_data())
+@_settings
+def test_multiclass_ap_matches_sklearn_where_defined(data):
+    """Per-class AP equals sklearn for present classes and is NaN for absent
+    ones; macro averages exactly the defined classes."""
+    scores, labels, c = data
+    jp, jt, jv = _pad_rows(scores, labels)
+    per_class = np.asarray(
+        multiclass_average_precision_fixed(jp, jt, jv, c, average="none")
+    )
+    onehot = np.eye(c, dtype=int)[labels]
+    defined = onehot.sum(0) > 0
+    for k in range(c):
+        if defined[k]:
+            np.testing.assert_allclose(
+                per_class[k], average_precision_score(onehot[:, k], scores[:, k]), atol=1e-6
+            )
+        else:
+            assert np.isnan(per_class[k])
+    macro = float(multiclass_average_precision_fixed(jp, jt, jv, c, average="macro"))
+    np.testing.assert_allclose(macro, np.nanmean(np.where(defined, per_class, np.nan)), atol=1e-6)
+    # weighted: defined classes weighted by positive count
+    weighted = float(multiclass_average_precision_fixed(jp, jt, jv, c, average="weighted"))
+    w = np.where(defined, onehot.sum(0), 0).astype(float)
+    want_w = np.sum(np.where(defined, per_class, 0.0) * w) / max(w.sum(), 1.0)
+    np.testing.assert_allclose(weighted, want_w, atol=1e-6)
+    # micro: flattened one-vs-rest indicator problem
+    micro = float(multiclass_average_precision_fixed(jp, jt, jv, c, average="micro"))
+    np.testing.assert_allclose(
+        micro, average_precision_score(onehot.ravel(), scores.ravel()), atol=1e-6
+    )
+
+
+@given(_multiclass_data())
+@_settings
+def test_multiclass_padded_roc_matches_sklearn(data):
+    """Per-class ROC points from the padded buffer (invalid rows masked)
+    match sklearn's one-vs-rest curves exactly."""
+    from sklearn.metrics import roc_curve as sk_roc
+
+    scores, labels, c = data
+    jp, jt, jv = _pad_rows(scores, labels)
+    fpr, tpr, _, mask = multiclass_roc_fixed(jp, jt, jv, c)
+    for k in range(c):
+        tgt_k = (labels == k).astype(int)
+        if 0 < tgt_k.sum() < len(tgt_k):
+            sk_fpr, sk_tpr, _ = sk_roc(tgt_k, scores[:, k], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fpr[k])[np.asarray(mask[k])], sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tpr[k])[np.asarray(mask[k])], sk_tpr, atol=1e-6)
+
+
+@given(_multiclass_data())
+@_settings
+def test_multilabel_indicator_targets_match_multiclass_onehot(data):
+    """multilabel=True with the one-hot indicator matrix must equal the
+    multiclass label path — the two target layouts describe the same data."""
+    scores, labels, c = data
+    onehot = np.eye(c, dtype=np.int32)[labels]
+    jp, jt_ml, jv = _pad_rows(scores, onehot)
+    _, jt_mc, _ = _pad_rows(scores, labels)
+    for avg in ("none", "macro", "micro"):
+        ml = np.asarray(
+            multiclass_average_precision_fixed(jp, jt_ml, jv, c, average=avg, multilabel=True)
+        )
+        mc = np.asarray(multiclass_average_precision_fixed(jp, jt_mc, jv, c, average=avg))
+        np.testing.assert_allclose(ml, mc, atol=1e-7, equal_nan=True)
+
+
+@given(_scored_labels())
+@_settings
+def test_prc_truncation_matches_reference_convention(data):
+    """The PRC point set equals sklearn's re-truncated to the reference
+    convention (exactly one leading full-recall point) for ANY input mix —
+    the property form of the review-found truncation fix."""
+    scores, labels = data
+    assume(0 < labels.sum() < len(labels))
+    state = curve_buffer_update(curve_buffer_init(128), jnp.asarray(scores), jnp.asarray(labels))
+    precision, recall, thr, mask, last = (
+        np.asarray(v)
+        for v in binary_precision_recall_curve_fixed(
+            state["preds"], state["target"], state["valid"]
+        )
+    )
+    got_rec = np.concatenate([recall[mask][::-1], [last[1]]])
+    sk_p, sk_r, _ = sk_prc(labels, scores)
+    k = 0
+    while k + 1 < len(sk_r) and sk_r[k + 1] == 1.0:
+        k += 1
+    np.testing.assert_allclose(got_rec, sk_r[k:], atol=1e-6)
+    got_prec = np.concatenate([precision[mask][::-1], [last[0]]])
+    np.testing.assert_allclose(got_prec, sk_p[k:], atol=1e-6)
+    assert (got_rec[:-1] == 1.0).sum() == 1  # exactly one full-recall point kept
