@@ -353,3 +353,75 @@ def test_stat_scores_ignore_index(ignore_index):
     fixture = _input_multiclass_prob
     args = {"num_classes": NUM_CLASSES, "reduce": "macro", "ignore_index": ignore_index}
     assert_accumulated_parity(StatScores(**args), fixture, _ref_oracle("stat_scores", **args))
+
+
+# ---------------------------------------------------------------------------
+# KLDivergence: log_prob x reduction grid (reference test_kl_divergence.py)
+# ---------------------------------------------------------------------------
+
+_KL_RNG = np.random.default_rng(61)
+_KL_P = _KL_RNG.random((3, 16, 6)).astype(np.float32) + 1e-3
+_KL_P /= _KL_P.sum(-1, keepdims=True)
+_KL_Q = _KL_RNG.random((3, 16, 6)).astype(np.float32) + 1e-3
+_KL_Q /= _KL_Q.sum(-1, keepdims=True)
+
+
+@pytest.mark.parametrize("log_prob", [False, True])
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_kl_divergence_reference_grid(log_prob, reduction):
+    from metrics_tpu.classification import KLDivergence
+
+    p = np.log(_KL_P) if log_prob else _KL_P
+    q = np.log(_KL_Q) if log_prob else _KL_Q
+    args = {"log_prob": log_prob, "reduction": reduction}
+    ours = KLDivergence(**args)
+    oracle = _ref_oracle("kl_divergence", **args)
+    for i in range(p.shape[0]):
+        ours.update(jnp.asarray(p[i]), jnp.asarray(q[i]))
+    want = oracle(p.reshape(-1, 6), q.reshape(-1, 6))
+    np.testing.assert_allclose(np.asarray(ours.compute()), want, rtol=1e-4, atol=1e-6)
+
+
+def test_kl_divergence_shape_errors_match_reference():
+    from metrics_tpu.classification import KLDivergence
+
+    m = KLDivergence()
+    with pytest.raises((ValueError, RuntimeError)):
+        m.update(jnp.zeros((4, 3)), jnp.zeros((4, 5)))  # mismatched shapes
+    with pytest.raises(ValueError):
+        m.update(jnp.zeros((4,)), jnp.zeros((4,)))  # 1-D rejected (2-D contract)
+
+
+# ---------------------------------------------------------------------------
+# CalibrationError: norm x n_bins vs the reference (the sklearn-free corner;
+# the hand-rolled oracle sweep lives in test_confusion_family.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("n_bins", [5, 15])
+def test_calibration_error_reference_grid(norm, n_bins):
+    from metrics_tpu.classification import CalibrationError
+
+    fixture = _input_multiclass_prob
+    args = {"norm": norm, "n_bins": n_bins}
+    assert_accumulated_parity(
+        CalibrationError(**args), fixture, _ref_oracle("calibration_error", **args)
+    )
+
+
+# ---------------------------------------------------------------------------
+# HingeLoss: squared x multiclass_mode over probability inputs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("squared", [False, True])
+@pytest.mark.parametrize("multiclass_mode", [None, "crammer-singer", "one-vs-all"])
+def test_hinge_reference_grid(squared, multiclass_mode):
+    from metrics_tpu.classification import HingeLoss
+
+    fixture = _input_multiclass_logits
+    args = {"squared": squared, "multiclass_mode": multiclass_mode}
+    assert_accumulated_parity(
+        HingeLoss(**args), fixture, _ref_oracle("hinge_loss", **args), atol=1e-4
+    )
