@@ -57,6 +57,16 @@ class AveragePrecision(CapacityCurveMixin, Metric):
         # Binary keeps the flat triple; num_classes >= 2 keeps [capacity, C]
         # score rows (one-vs-rest AP per class); `multilabel=True`
         # additionally stores [capacity, C] indicator targets.
+        if (
+            capacity is not None
+            and num_classes is not None
+            and num_classes >= 2
+            and not multilabel
+            and average == "micro"
+        ):
+            # parity with the unbounded path and capacity-mode AUROC
+            # (reference avg_precision.py raises for micro + multi-class input)
+            raise ValueError("Cannot use `micro` average with multi-class input")
         self._init_capacity_case(capacity, num_classes, multilabel)
         if capacity is None:
             self.add_state("preds", default=[], dist_reduce_fx="cat")

@@ -277,22 +277,41 @@ def _memoized(
     """Identity-keyed device-result memoization: the key is the id() of every
     input array (immutable jax arrays; weakref finalizers purge the entry —
     and make id recycling impossible — the moment any of them is collected),
-    plus any hashable ``extra_key``. Non-weakref-able inputs skip caching."""
+    plus any hashable ``extra_key``. Non-weakref-able inputs skip caching.
+
+    Entries are stored as ``(result, finalizer_handles)`` and every eviction
+    path — LRU cap, array collection, explicit pop — detaches the entry's
+    finalizers, so an evicted-then-recomputed key never accumulates orphan
+    registrations on long-lived arrays."""
     key = tuple(map(id, key_arrays)) + extra_key
     hit = cache.get(key)
     if hit is not None:
         cache.move_to_end(key)
-        return hit
+        return hit[0]
     result = compute()
+    finalizers = []
     try:
         for a in key_arrays:
-            weakref.finalize(a, cache.pop, key, None)
+            finalizers.append(weakref.finalize(a, _evict, cache, key))
     except TypeError:
+        for f in finalizers:
+            f.detach()
         return result
-    cache[key] = result
+    cache[key] = (result, finalizers)
     while len(cache) > max_entries:
-        cache.popitem(last=False)
+        _, (_, old_fins) = cache.popitem(last=False)
+        for f in old_fins:
+            f.detach()
     return result
+
+
+def _evict(cache: "OrderedDict", key: tuple) -> None:
+    """Finalizer callback: drop the entry and detach its sibling finalizers
+    (detaching the already-fired one is a documented no-op)."""
+    entry = cache.pop(key, None)
+    if entry is not None:
+        for f in entry[1]:
+            f.detach()
 
 
 def sorted_row_layout(

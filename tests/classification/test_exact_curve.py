@@ -407,6 +407,14 @@ def test_multiclass_average_precision_capacity_match_sklearn(average):
 
     n, c = 100, 5
     preds, target = _mc_data(31, n, c)
+    if average == "micro":
+        # parity with the unbounded path, capacity-mode AUROC, and the
+        # reference: micro is rejected for integer-label multiclass input
+        # (the functional kernel keeps the OVR-micro definition for the
+        # multilabel capacity mode, tested below)
+        with pytest.raises(ValueError, match="micro"):
+            AveragePrecision(num_classes=c, capacity=128, average=average)
+        return
     m = AveragePrecision(num_classes=c, capacity=128, average=average)
     assert not m.__jit_unsafe__
     m.update(jnp.asarray(preds[:60]), jnp.asarray(target[:60]))
@@ -421,8 +429,6 @@ def test_multiclass_average_precision_capacity_match_sklearn(average):
         want = per_class.mean()
     elif average == "weighted":
         want = np.average(per_class, weights=np.bincount(target, minlength=c))
-    elif average == "micro":
-        want = average_precision_score(onehot.ravel(), preds.ravel())
     else:
         want = per_class
     np.testing.assert_allclose(got, want, atol=1e-6)
@@ -442,6 +448,16 @@ def test_multilabel_capacity_curves_and_ap():
     ap.update(jnp.asarray(preds), jnp.asarray(target))
     want = np.mean([average_precision_score(target[:, k], preds[:, k]) for k in range(c)])
     np.testing.assert_allclose(float(ap.compute()), want, atol=1e-6)
+
+    # micro stays supported for multilabel capacity mode (well-defined over
+    # the indicator matrix) and must match sklearn's flattened AP — this
+    # value-checks the valid-mask broadcast in the micro flatten path with a
+    # PARTIALLY-filled buffer (capacity > n), where a wrong broadcast would
+    # pull zero-padded rows into the flattened score set
+    ap_micro = AveragePrecision(num_classes=c, capacity=128, multilabel=True, average="micro")
+    ap_micro.update(jnp.asarray(preds), jnp.asarray(target))
+    want_micro = average_precision_score(target.ravel(), preds.ravel())
+    np.testing.assert_allclose(float(ap_micro.compute()), want_micro, atol=1e-6)
 
     roc = ROC(num_classes=c, capacity=128, multilabel=True)
     roc.update(jnp.asarray(preds), jnp.asarray(target))
