@@ -9,6 +9,7 @@ numbers of its own — BASELINE.md — so it is measured live from
 /root/reference).
 """
 import json
+import os
 import sys
 import time
 
@@ -180,8 +181,6 @@ def bench_map() -> None:
     ref_ips = None
     try:
         import torch
-
-        import os
 
         sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
         from detection.test_map import _load_reference_map
@@ -492,19 +491,196 @@ def bench_sync() -> None:
     )
 
 
+def bench_inference() -> None:
+    """Inference-metric extractor throughput (BASELINE config 5b): the Flax
+    InceptionV3 FID feature path and the BERTScore Flax encoder, on the
+    accelerator, vs the torch-CPU mirrors of the same architectures.
+
+    Random weights — THROUGHPUT only (numeric parity is pinned separately by
+    tests/image + the gated real-weight tests). Device work mirrors what the
+    metrics run per update: Inception forward + FID's running feature-sum /
+    Gram accumulation; BERT forward + bert_score's L2-normalize + greedy
+    cosine matching. Both run as one jitted lax.scan epoch over distinct
+    batches (the jitted-eval-loop shape; see bench_tpu's rationale) ending in
+    a scalar readback."""
+    import jax
+    import jax.numpy as jnp
+    from metrics_tpu.models.inception import InceptionV3FID
+
+    rng = np.random.RandomState(0)
+
+    # --- FID extractor: uint8 COCO/ImageNet-shaped batches ---
+    model = InceptionV3FID()
+    fb, fnb = 64, 8
+    imgs = jnp.asarray(rng.randint(0, 256, (fnb, fb, 3, 299, 299), dtype=np.uint8))
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 299, 299), jnp.float32))
+
+    @jax.jit
+    def fid_epoch(variables, imgs):
+        def step(carry, batch):
+            feats = model.apply(variables, batch)  # [fb, 2048]
+            return (carry[0] + feats.sum(0), carry[1] + feats.T @ feats), ()
+
+        init = (jnp.zeros((2048,)), jnp.zeros((2048, 2048)))
+        (s, g), _ = jax.lax.scan(step, init, imgs)
+        return s.sum() + g.sum()
+
+    float(fid_epoch(variables, imgs))  # compile
+    for _ in range(2):
+        float(fid_epoch(variables, imgs))
+    t0 = time.perf_counter()
+    float(fid_epoch(variables, imgs))
+    fid_ips = fb * fnb / (time.perf_counter() - t0)
+
+    fid_ref_ips = None
+    try:
+        import torch
+
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+        from image.test_fid_kid_is import TorchFIDInception
+
+        rb = 32
+        t_imgs = (
+            torch.from_numpy(rng.randint(0, 256, (rb, 3, 299, 299), dtype=np.uint8)).float() / 255.0
+        )
+        net = TorchFIDInception().eval()
+        with torch.no_grad():
+            net(t_imgs[:2])  # warmup
+            t0 = time.perf_counter()
+            feats = net(t_imgs)
+            s = feats.sum(0)
+            g = feats.T @ feats
+            float(s.sum() + g.sum())
+            fid_ref_ips = rb / (time.perf_counter() - t0)
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "fid_inception_extractor_throughput",
+                "value": round(fid_ips, 1),
+                "unit": "images/sec",
+                "vs_baseline": round(fid_ips / fid_ref_ips, 3) if fid_ref_ips else None,
+            }
+        )
+    )
+
+    # --- BERTScore encoder: BERT-base-shaped, seq len 128 ---
+    from transformers import BertConfig, FlaxBertModel
+
+    cfg = BertConfig()
+    bmodel = FlaxBertModel(cfg, seed=0, dtype=jnp.float32)
+    sb, sl, snb = 64, 128, 8
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (snb, sb, sl)).astype(np.int32))
+    mask = jnp.ones((snb, sb, sl), jnp.int32)
+    params = bmodel.params
+
+    @jax.jit
+    def bert_epoch(params, ids, mask):
+        def step(carry, xs):
+            i, m = xs
+            h = bmodel.module.apply(
+                {"params": params}, input_ids=i, attention_mask=m
+            ).last_hidden_state
+            h = h / jnp.linalg.norm(h, axis=-1, keepdims=True)
+            sim = jnp.einsum("bld,bmd->blm", h, h)
+            return carry + sim.max(-1).mean(), ()
+
+        tot, _ = jax.lax.scan(step, jnp.asarray(0.0), (ids, mask))
+        return tot
+
+    float(bert_epoch(params, ids, mask))  # compile
+    for _ in range(2):
+        float(bert_epoch(params, ids, mask))
+    t0 = time.perf_counter()
+    float(bert_epoch(params, ids, mask))
+    bert_sps = sb * snb / (time.perf_counter() - t0)
+
+    bert_ref_sps = None
+    try:
+        import torch
+        from transformers import BertModel
+
+        bm = BertModel(cfg).eval()
+        t_ids = torch.from_numpy(rng.randint(0, cfg.vocab_size, (sb, sl)).astype(np.int64))
+        t_mask = torch.ones(sb, sl, dtype=torch.int64)
+        with torch.no_grad():
+            bm(input_ids=t_ids[:4], attention_mask=t_mask[:4])  # warmup
+            t0 = time.perf_counter()
+            h = bm(input_ids=t_ids, attention_mask=t_mask).last_hidden_state
+            h = h / h.norm(dim=-1, keepdim=True)
+            sim = torch.einsum("bld,bmd->blm", h, h)
+            float(sim.max(-1).values.mean())
+            bert_ref_sps = sb / (time.perf_counter() - t0)
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "bertscore_encoder_throughput",
+                "value": round(bert_sps, 1),
+                "unit": "sentences/sec",
+                "vs_baseline": round(bert_sps / bert_ref_sps, 3) if bert_ref_sps else None,
+            }
+        )
+    )
+
+
+SUBCOMMANDS = {
+    "map": bench_map,
+    "retrieval": bench_retrieval,
+    "image": bench_image,
+    "sync": bench_sync,
+    "inference": bench_inference,
+}
+
+
 def main() -> None:
-    if len(sys.argv) > 1 and sys.argv[1] == "map":
-        bench_map()
+    if len(sys.argv) > 1:
+        fn = SUBCOMMANDS.get(sys.argv[1])
+        if fn is None:
+            raise SystemExit(f"unknown bench subcommand {sys.argv[1]!r}; one of {sorted(SUBCOMMANDS)}")
+        fn()
         return
-    if len(sys.argv) > 1 and sys.argv[1] == "retrieval":
-        bench_retrieval()
-        return
-    if len(sys.argv) > 1 and sys.argv[1] == "image":
-        bench_image()
-        return
-    if len(sys.argv) > 1 and sys.argv[1] == "sync":
-        bench_sync()
-        return
+
+    # No args (the driver's invocation): emit EVERY measured BASELINE config
+    # as its own JSON line so per-round regressions in any path are visible,
+    # with the headline config LAST (the driver parses the final line). Each
+    # config runs in a subprocess: bench_sync must force an 8-virtual-device
+    # CPU platform, which would poison the TPU benches if run in-process, and
+    # a crash in one config must not take down the rest.
+    import subprocess
+
+    for name in ("map", "retrieval", "image", "inference", "sync"):
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), name],
+                capture_output=True,
+                text=True,
+                timeout=1200,
+            )
+            emitted = 0
+            for line in out.stdout.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    emitted += 1
+            # a crashed or silent config must surface as an error line, not
+            # silently vanish from the round record
+            if out.returncode != 0 or not emitted:
+                print(
+                    json.dumps(
+                        {
+                            "metric": f"bench_{name}",
+                            "error": f"rc={out.returncode}: {out.stderr.strip()[-200:]}",
+                        }
+                    ),
+                    flush=True,
+                )
+        except Exception as err:  # noqa: BLE001 — a failed config is reported, not fatal
+            print(json.dumps({"metric": f"bench_{name}", "error": str(err)[:200]}), flush=True)
+
     tpu_sps = bench_tpu()
     try:
         ref_sps = bench_reference()

@@ -54,6 +54,11 @@ from metrics_tpu.parallel.distributed import gather_all_arrays
 Array = jax.Array
 StateValue = Union[Array, List[Array]]
 
+#: auto-registered update counter accompanying any mean-reduced state — the
+#: default weights for `merge_states` on uneven accumulations (sum-reduced,
+#: so cross-rank syncs and pairwise merges compose)
+_AUTO_COUNT = "_n_updates"
+
 
 def _coerce_foreign(obj: Any) -> Any:
     """Convert foreign array types (torch tensors — the reference's native
@@ -225,6 +230,12 @@ class Metric(ABC):
         self._cat_states[name] = dist_reduce_fx is dim_zero_cat or bool(
             getattr(dist_reduce_fx, "cat_like", False)
         )
+        # Mean-reduced states have no information-preserving pairwise merge
+        # without knowing how many updates each side absorbed, so the first
+        # mean state auto-registers a sum-reduced update counter that
+        # `merge_states` uses as the default weights (see merge_states).
+        if dist_reduce_fx is dim_zero_mean and _AUTO_COUNT not in self._defaults:
+            self.add_state(_AUTO_COUNT, default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -247,6 +258,12 @@ class Metric(ABC):
             return jax.profiler.TraceAnnotation(f"{self.__class__.__name__}.{phase}")
         return contextlib.nullcontext()
 
+    def _bump_auto_count(self) -> None:
+        """Increment the auto-registered mean-merge update counter (a no-op
+        for metrics without mean-reduced states); jit-safe (int32 + 1)."""
+        if _AUTO_COUNT in self._defaults:
+            object.__setattr__(self, _AUTO_COUNT, getattr(self, _AUTO_COUNT) + 1)
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate into global state. Parity with reference metric.py:421-428,460-463.
 
@@ -260,6 +277,7 @@ class Metric(ABC):
         self._update_called = True
         with self._trace("update"):
             self._update(*_coerce_foreign(args), **_coerce_foreign(kwargs))
+        self._bump_auto_count()
 
     def compute(self) -> Any:
         """Compute (and cache) the metric from accumulated state, syncing across
@@ -484,7 +502,17 @@ class Metric(ABC):
         old = self._bind(state)
         try:
             self._update(*args, **kwargs)
-            return {k: getattr(self, k) for k in self._defaults}
+            # bump the mean-merge counter only when the INPUT state carries it:
+            # a pre-counter state (old checkpoint, hand-built dict) must stay
+            # counter-less so merge_states keeps its documented unweighted
+            # fallback instead of trusting a counter that missed its history
+            if _AUTO_COUNT in state:
+                self._bump_auto_count()
+            return {
+                k: getattr(self, k)
+                for k in self._defaults
+                if k != _AUTO_COUNT or k in state
+            }
         finally:
             for k, v in old.items():
                 object.__setattr__(self, k, v)
@@ -508,16 +536,23 @@ class Metric(ABC):
 
         ``counts`` — optional ``(n_a, n_b)`` update (or sample) counts for the
         two states. Mean-reduced states are merged as the count-weighted
-        average ``(n_a*a + n_b*b) / (n_a + n_b)``; without ``counts`` they are
-        merged as the unweighted ``(a + b) / 2``, which matches the
-        reference's stack-then-mean sync convention but silently mis-averages
-        when the two sides accumulated different numbers of batches — pass
-        ``counts`` whenever the sides may be uneven.
+        average ``(n_a*a + n_b*b) / (n_a + n_b)``. Without ``counts``, the
+        weights default to the auto-registered per-state update counters
+        (every metric with a mean-reduced state tracks one; see
+        ``add_state``), so uneven accumulations merge correctly out of the
+        box; the unweighted ``(a + b) / 2`` — the reference's stack-then-mean
+        sync convention — is only the last resort for states that predate the
+        counter (e.g. restored from an old checkpoint), since it silently
+        mis-averages uneven sides.
         """
         if counts is not None and len(counts) != 2:
             raise ValueError(f"`counts` must be a pair (n_a, n_b), got {len(counts)} entries")
+        if counts is None and _AUTO_COUNT in a and _AUTO_COUNT in b:
+            counts = (a[_AUTO_COUNT], b[_AUTO_COUNT])
         out: Dict[str, StateValue] = {}
         for name, red in self._reductions.items():
+            if name == _AUTO_COUNT and (name not in a or name not in b):
+                continue  # hand-built / pre-counter states; weights fell back above
             va, vb = a[name], b[name]
             if isinstance(va, list) or isinstance(vb, list) or self._cat_states.get(name):
                 la = va if isinstance(va, list) else [va]
@@ -528,7 +563,14 @@ class Metric(ABC):
                     out[name] = va + vb
                 elif counts is not None:
                     na, nb = (jnp.asarray(c, jnp.float32) for c in counts)
-                    out[name] = (na * va + nb * vb) / (na + nb)
+                    total = na + nb
+                    # never-updated pairs (both counters 0) fall back to the
+                    # unweighted mean of the defaults instead of 0/0
+                    out[name] = jnp.where(
+                        total > 0,
+                        (na * va + nb * vb) / jnp.maximum(total, 1.0),
+                        (va + vb) / 2,
+                    )
                 else:
                     out[name] = (va + vb) / 2
             elif red == dim_zero_max:
@@ -589,15 +631,23 @@ class Metric(ABC):
 
     @property
     def device(self):
+        """Device of the first placed state array; ``None`` only when the
+        metric has no array states or they are tracers (inside jit, where
+        placement is undecided). Any OTHER failure to resolve placement
+        propagates — masking it would hide real multi-device placement bugs."""
+        from jax.errors import ConcretizationTypeError, TracerArrayConversionError
+
         for name in self._defaults:
             val = getattr(self, name)
             if isinstance(val, list):
                 if val:
-                    return list(val[0].devices())[0]
-            elif isinstance(val, jnp.ndarray):
+                    val = val[0]
+                else:
+                    continue
+            if isinstance(val, jnp.ndarray):
                 try:
-                    return list(val.devices())[0]
-                except Exception:
+                    return next(iter(val.devices()))
+                except (ConcretizationTypeError, TracerArrayConversionError):
                     return None
         return None
 

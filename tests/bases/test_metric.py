@@ -51,8 +51,49 @@ def test_add_state_validation():
         m.add_state("bad", [jnp.array(1.0)], dist_reduce_fx="sum")
     with pytest.raises(ValueError):
         m.add_state("bad2", jnp.array(0.0), dist_reduce_fx="not_a_reduction")
+    with pytest.raises(ValueError):
+        m.add_state("bad3", jnp.array(0.0), dist_reduce_fx=42)  # non-callable non-string
+    with pytest.raises(ValueError):
+        m.add_state("bad4", object(), dist_reduce_fx="sum")  # non-arrayable default
     m.add_state("ok", jnp.zeros(3), dist_reduce_fx="mean")
     assert "ok" in m._defaults
+
+
+def test_add_state_registers_working_reducers():
+    """The registered string reducers actually reduce (reference
+    test_metric.py:63-92), and a custom callable is kept as-is."""
+    m = DummyMetric()
+    m.add_state("a", jnp.array(0), dist_reduce_fx="sum")
+    assert float(m._reductions["a"](jnp.asarray([1, 1]))) == 2
+    m.add_state("b", jnp.array(0.0), dist_reduce_fx="mean")
+    assert float(m._reductions["b"](jnp.asarray([1.0, 2.0]))) == pytest.approx(1.5)
+    m.add_state("c", jnp.array(0), dist_reduce_fx="cat")
+    assert m._reductions["c"]([jnp.asarray([1]), jnp.asarray([1])]).shape == (2,)
+    m.add_state("mx", jnp.array(0), dist_reduce_fx="max")
+    assert float(m._reductions["mx"](jnp.asarray([1, 7, 3]))) == 7
+    m.add_state("mn", jnp.array(0), dist_reduce_fx="min")
+    assert float(m._reductions["mn"](jnp.asarray([4, 2, 9]))) == 2
+
+    def custom_fx(_):
+        return -1
+
+    m.add_state("e", jnp.array(0), dist_reduce_fx=custom_fx)
+    assert m._reductions["e"](jnp.asarray([1, 1])) == -1
+
+
+def test_warning_on_compute_before_update():
+    """compute() before any update warns but still returns the
+    default-state value (reference test_metric.py:301-321)."""
+    m = DummyMetric()
+    with pytest.warns(UserWarning, match="before the ``update`` method"):
+        assert float(m.compute()) == 0.0
+    # after an update, no warning
+    m.update(2.0)
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        assert float(m.compute()) == 2.0
 
 
 def test_update_and_compute():
@@ -293,12 +334,41 @@ def test_merge_states_weighted_mean():
             return self.m
 
     m = MeanStateMetric()
+    # hand-built states without the auto counter: unweighted fallback
     a, b = {"m": jnp.array(1.0)}, {"m": jnp.array(4.0)}
     assert float(m.merge_states(a, b)["m"]) == pytest.approx(2.5)
     # side a saw 3 batches, side b saw 1: weighted mean, not midpoint
     assert float(m.merge_states(a, b, counts=(3, 1))["m"]) == pytest.approx(1.75)
     with pytest.raises(ValueError, match="pair"):
         m.merge_states(a, b, counts=(1, 2, 3))
+
+    # full-lifecycle states carry the auto-registered update counter, so
+    # uneven accumulations weight themselves without explicit counts
+    sa = m.init_state()
+    assert "_n_updates" in sa
+    for x in (1.0, 1.0, 1.0):
+        sa = m.update_state(sa, x)  # overwrite-style update; 3 updates
+    sb = m.update_state(m.init_state(), 4.0)  # 1 update
+    merged = m.merge_states(sa, sb)
+    assert float(merged["m"]) == pytest.approx(1.75)
+    assert int(merged["_n_updates"]) == 4  # counter itself sum-merges
+    # explicit counts still win over the auto counter
+    assert float(m.merge_states(sa, sb, counts=(1, 1))["m"]) == pytest.approx(2.5)
+    # two never-updated states merge to the default, not 0/0
+    fresh = m.merge_states(m.init_state(), m.init_state())
+    assert float(fresh["m"]) == pytest.approx(0.0)
+    # the counter increments under jit too
+    sj = jax.jit(m.update_state)(m.init_state(), 2.0)
+    assert int(sj["_n_updates"]) == 1
+
+    # a pre-counter state (old checkpoint / hand-built dict) passed through
+    # update_state stays counter-less — it must NOT acquire a fresh counter
+    # that missed its accumulation history, so merges keep the documented
+    # unweighted fallback instead of confidently wrong weights
+    legacy = {"m": jnp.array(10.0)}
+    legacy2 = m.update_state(legacy, 10.0)
+    assert "_n_updates" not in legacy2
+    assert float(m.merge_states(legacy2, {"m": jnp.array(0.0)})["m"]) == pytest.approx(5.0)
 
 
 def test_custom_cat_like_reducer_flag():

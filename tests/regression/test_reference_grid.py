@@ -131,6 +131,82 @@ def test_regression_validation_errors():
         m.update(jnp.zeros(3), jnp.zeros(4))
 
 
+# every regression module metric raises the reference's shape-mismatch error
+# (the per-file `test_error_on_different_shape` the reference repeats in each
+# of tests/regression/test_*.py)
+_ALL_REGRESSION = [
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+]
+
+
+@pytest.mark.parametrize("cls", _ALL_REGRESSION, ids=[c.__name__ for c in _ALL_REGRESSION])
+def test_error_on_different_shape(cls):
+    m = cls()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        m.update(jnp.ones(50) * 0.5, jnp.ones(100) * 0.5)
+
+
+@pytest.mark.parametrize("cls", [PearsonCorrCoef, SpearmanCorrCoef])
+def test_error_on_multidim_correlation(cls):
+    """Pearson/Spearman accept 1-D series only (reference test_pearson.py:92,
+    test_spearman.py:114)."""
+    m = cls()
+    with pytest.raises(ValueError, match="Expected both predictions and target to be 1 dimensional tensors."):
+        m.update(jnp.ones((5, 2)) * 0.5, jnp.ones((5, 2)) * 0.5)
+
+
+def test_r2_error_and_warning_matrix():
+    """R2's full edge matrix (reference test_r2.py:127-163): >2-D inputs
+    rejected, <2 samples rejected, and the two adjusted-fallback warnings."""
+    m = R2Score()
+    with pytest.raises(ValueError, match="1D or 2D"):
+        m.update(jnp.ones((2, 2, 2)), jnp.ones((2, 2, 2)))
+    few = R2Score()
+    few.update(jnp.asarray([0.5]), jnp.asarray([0.7]))
+    with pytest.raises(ValueError, match="Needs at least two samples to calculate r2 score."):
+        few.compute()
+
+    x = jnp.asarray(_rng.standard_normal(10).astype(np.float32))
+    with pytest.warns(UserWarning, match="More independent regressions than data points"):
+        R2Score(adjusted=10)(x, x + 0.1)
+    y = jnp.asarray(_rng.standard_normal(11).astype(np.float32))
+    with pytest.warns(UserWarning, match="Division by zero in adjusted r2 score"):
+        R2Score(adjusted=10)(y, y + 0.1)
+
+
+def test_tweedie_input_domain_errors():
+    """Runtime input-domain validation per power (reference
+    test_tweedie_deviance.py:120-139), both argument positions."""
+    neg = jnp.asarray([-1.0, 2.0, 3.0])
+    pos = jnp.asarray(_rng.random(3).astype(np.float32) + 0.05)
+
+    m1 = TweedieDevianceScore(power=1)
+    with pytest.raises(
+        ValueError, match="For power=1, 'preds' has to be strictly positive and 'targets' cannot be negative."
+    ):
+        m1(neg, pos)
+    with pytest.raises(
+        ValueError, match="For power=1, 'preds' has to be strictly positive and 'targets' cannot be negative."
+    ):
+        m1(pos, neg)
+
+    m2 = TweedieDevianceScore(power=2)
+    with pytest.raises(ValueError, match="For power=2, both 'preds' and 'targets' have to be strictly positive."):
+        m2(neg, pos)
+    with pytest.raises(ValueError, match="For power=2, both 'preds' and 'targets' have to be strictly positive."):
+        m2(pos, neg)
+
+
 def test_mape_zero_target_epsilon_matches_reference():
     """MAPE clamps |target| from below with the reference epsilon rather
     than dividing by zero."""

@@ -100,17 +100,46 @@ def test_retrieval_reference_parity(name, cls, args, graded, action):
     )
 
 
-@pytest.mark.parametrize("name, cls, args, graded", METRICS[:4], ids=METRIC_IDS[:4])
+@pytest.mark.parametrize("name, cls, args, graded", METRICS, ids=METRIC_IDS)
 def test_retrieval_error_action_raises_like_reference(name, cls, args, graded):
+    """`empty_target_action='error'` raises on both sides with the SAME
+    message (reference helpers.py `_errors_test_class_metric_parameters_no_
+    pos_target` / `_no_neg_target`): 'no positive target' for the standard
+    metrics, 'no negative target' for FallOut (its empty case is inverted —
+    the fixture's all-positive query 7 triggers it)."""
+    expected = (
+        "no negative target" if cls is RetrievalFallOut else "no positive target"
+    )
     ours = cls(empty_target_action="error", **args)
     ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX))
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match=expected):
         ours.compute()
 
     ref = _ref_retrieval(name, empty_target_action="error", **args)
     ref.update(torch.as_tensor(PREDS), torch.as_tensor(TARGET), indexes=torch.as_tensor(IDX))
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match=expected):
         ref.compute()
+
+
+@pytest.mark.parametrize("action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("name, cls, args, graded", METRICS, ids=METRIC_IDS)
+def test_retrieval_ignore_index_action_k_parity(name, cls, args, graded, action):
+    """The full empty_target_action x ignore_index x k cross-product the
+    reference's RetrievalMetricTester sweeps (tests/retrieval/test_*.py
+    `test_class_metric_ignore_index`): every metric (incl. each k variant)
+    with ignore_index=-100 over a fixture where ignored positions erase
+    ENTIRE queries (so the policy actually fires on post-filter-empty
+    queries), against the reference with identical arguments."""
+    target = (TARGET_GRADED if graded else TARGET).copy()
+    target[::7] = -100  # sprinkle ignored positions...
+    target[IDX == 5] = -100  # ...and erase one whole query
+    ours = cls(ignore_index=-100, empty_target_action=action, **args)
+    ref = _ref_retrieval(name, ignore_index=-100, empty_target_action=action, **args)
+    ours.update(jnp.asarray(PREDS), jnp.asarray(target), indexes=jnp.asarray(IDX))
+    ref.update(torch.as_tensor(PREDS), torch.as_tensor(target), indexes=torch.as_tensor(IDX))
+    np.testing.assert_allclose(
+        float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=f"{name} {args} {action}"
+    )
 
 
 @pytest.mark.parametrize("ignore_index", [-100, 0])
@@ -153,6 +182,59 @@ def test_retrieval_argument_validation(cls):
     # integer preds rejected
     with pytest.raises(ValueError, match="float"):
         m.update(jnp.asarray([1, 0]), jnp.asarray([0, 1]), indexes=jnp.asarray([0, 0]))
+    # empty tensors rejected (reference: "must be non-empty and non-scalar")
+    with pytest.raises(ValueError, match="non-empty"):
+        m.update(
+            jnp.zeros((0,), jnp.float32),
+            jnp.zeros((0,), jnp.int32),
+            indexes=jnp.zeros((0,), jnp.int32),
+        )
+    # ignore_index erasing EVERYTHING leaves empty tensors -> same error
+    with pytest.raises(ValueError, match="non-empty"):
+        me = cls(ignore_index=-100)
+        me.update(
+            jnp.asarray([0.1, 0.2]), jnp.asarray([-100, -100]), indexes=jnp.asarray([0, 0])
+        )
+
+
+FUNCTIONALS = [
+    ("retrieval_average_precision", False, False),
+    ("retrieval_reciprocal_rank", False, False),
+    ("retrieval_r_precision", False, False),
+    ("retrieval_precision", True, False),
+    ("retrieval_recall", True, False),
+    ("retrieval_hit_rate", True, False),
+    ("retrieval_fall_out", True, False),
+    ("retrieval_normalized_dcg", True, True),
+]
+
+
+@pytest.mark.parametrize("fname, has_k, graded_ok", FUNCTIONALS, ids=[f[0] for f in FUNCTIONALS])
+def test_retrieval_functional_error_matrix(fname, has_k, graded_ok):
+    """The reference's `_errors_test_functional_metric_parameters_default` /
+    `_with_nonbinary` / `_k` matrices (tests/retrieval/helpers.py:131-163)
+    across all 8 functional kernels: shape mismatch, empty input, non-float
+    preds, non-binary target (where disallowed), and invalid k (where
+    accepted) — with the reference's error messages."""
+    import metrics_tpu.functional.retrieval as F
+
+    fn = getattr(F, fname)
+    good_p, good_t = jnp.asarray([0.2, 0.7, 0.4]), jnp.asarray([0, 1, 1])
+
+    with pytest.raises(ValueError, match="same shape"):
+        fn(good_p, jnp.asarray([0, 1]))
+    with pytest.raises(ValueError, match="non-empty and non-scalar"):
+        fn(jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32))
+    with pytest.raises(ValueError, match="`preds` must be a tensor of floats"):
+        fn(jnp.asarray([True, False, True]), good_t)
+    if not graded_ok:
+        with pytest.raises(ValueError, match="binary"):
+            fn(good_p, jnp.asarray([0, 3, 1]))
+    if has_k:
+        with pytest.raises(ValueError, match="positive integer or None"):
+            fn(good_p, good_t, k=-10)
+        with pytest.raises(ValueError, match="positive integer or None"):
+            fn(good_p, good_t, k=4.0)
 
 
 @pytest.mark.parametrize(
