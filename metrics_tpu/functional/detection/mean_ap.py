@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.functional.detection.box_ops import box_area, box_iou
+from metrics_tpu.functional.detection.box_ops import box_area
+from metrics_tpu.ops.box_iou_pallas import box_iou_dispatch
 
 Array = jax.Array
 
@@ -79,7 +80,9 @@ def _match_units_kernel(
     det_areas = box_area(det_boxes)  # [U, D]
     det_area_out = (det_areas[:, None, :] < lo) | (det_areas[:, None, :] > hi)  # [U, A, D]
 
-    ious = box_iou(det_boxes, gt_boxes)  # [U, D, G]
+    # measured dispatch (ops/box_iou_pallas.py): the batched Pallas unit-tile
+    # kernel when unit density earns it on TPU, the XLA broadcast otherwise
+    ious = box_iou_dispatch(det_boxes, gt_boxes)  # [U, D, G]
     ious = ious * (det_valid[:, :, None] & gt_valid[:, None, :])
 
     def body(d: int, carry: Tuple[Array, Array]) -> Tuple[Array, Array]:

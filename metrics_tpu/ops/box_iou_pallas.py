@@ -83,26 +83,92 @@ def box_iou_tiled(boxes1: ArrayLike, boxes2: ArrayLike, interpret: bool = False)
     return iou[:n, :m]
 
 
+def _iou_unit_kernel(b1_ref, b2_ref, out_ref):
+    """One unit's [D_pad, G_pad] IoU tile from [1, 4, D_pad]/[1, 4, G_pad]
+    coordinate blocks (the batched grid walks units)."""
+    x11, y11, x12, y12 = (b1_ref[0, i, :][:, None] for i in range(4))  # [D_pad, 1]
+    x21, y21, x22, y22 = (b2_ref[0, i, :][None, :] for i in range(4))  # [1, G_pad]
+
+    inter_w = jnp.maximum(jnp.minimum(x12, x22) - jnp.maximum(x11, x21), 0.0)
+    inter_h = jnp.maximum(jnp.minimum(y12, y22) - jnp.maximum(y11, y21), 0.0)
+    inter = inter_w * inter_h
+    area1 = (x12 - x11) * (y12 - y11)
+    area2 = (x22 - x21) * (y22 - y21)
+    union = area1 + area2 - inter
+    out_ref[0, :, :] = jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def box_iou_batched_tiled(boxes1: ArrayLike, boxes2: ArrayLike, interpret: bool = False) -> Array:
+    """Batched pairwise IoU ``[U, D, 4] x [U, G, 4] -> [U, D, G]``.
+
+    The detection matching kernel's shape (functional/detection/mean_ap.py):
+    one grid step per (image, class) unit, coordinates staged as
+    ``[1, 4, D_pad]`` VMEM blocks, D/G padded to the f32 VPU lane tiling
+    (8, 128). COCO-scale units (D<=128, G<=32) fit one tile each.
+    """
+    boxes1 = jnp.asarray(boxes1, jnp.float32)
+    boxes2 = jnp.asarray(boxes2, jnp.float32)
+    u, d, g = boxes1.shape[0], boxes1.shape[1], boxes2.shape[1]
+    # sublane x lane tiling: pad D (second-minor) to 8, G (minor) to 128
+    d_pad = -(-max(d, 1) // 8) * 8
+    g_pad = -(-max(g, 1) // 128) * 128
+
+    b1 = jnp.zeros((u, 4, d_pad), jnp.float32).at[:, :, :d].set(jnp.swapaxes(boxes1, 1, 2))
+    b2 = jnp.zeros((u, 4, g_pad), jnp.float32).at[:, :, :g].set(jnp.swapaxes(boxes2, 1, 2))
+
+    ms = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
+    iou = pl.pallas_call(
+        _iou_unit_kernel,
+        out_shape=jax.ShapeDtypeStruct((u, d_pad, g_pad), jnp.float32),
+        grid=(u,),
+        in_specs=[
+            pl.BlockSpec((1, 4, d_pad), lambda i: (i, 0, 0), **ms),
+            pl.BlockSpec((1, 4, g_pad), lambda i: (i, 0, 0), **ms),
+        ],
+        out_specs=pl.BlockSpec((1, d_pad, g_pad), lambda i: (i, 0, 0), **ms),
+        interpret=interpret,
+    )(b1, b2)
+    return iou[:, :d, :g]
+
+
 def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 << 20) -> Array:
     """Pick the Pallas tile kernel on TPU for large problems, else jnp.
 
-    Measured on-chip: the tile kernel is bit-exact vs the jnp broadcast and
-    performs on par with it (XLA already fuses the broadcast chain into one
-    kernel, so there are no HBM intermediates to save at these sizes). The
-    dispatch exists for the cases where the IoU feeds further fused
-    per-tile work (e.g. thresholding/matching) that XLA cannot fuse across.
+    Measured on-chip (see BASELINE.md "Pallas box-IoU A/B"): for the 2-D
+    [N, 4] x [M, 4] case the tile kernel is bit-exact vs the jnp broadcast
+    and performs on par with it (XLA already fuses the broadcast chain into
+    one kernel, so there are no HBM intermediates to save at these sizes).
+    For the BATCHED [U, D, 4] x [U, G, 4] case — the detection matching
+    kernel's shape — the unit-grid Pallas kernel avoids the [U, D, G, 4]
+    broadcast intermediates; the dispatch routes to it above ``min_elems``
+    output elements, where the measured win holds.
     """
     from metrics_tpu.functional.detection.box_ops import box_iou as _jnp_box_iou
 
     boxes1 = jnp.asarray(boxes1)
     boxes2 = jnp.asarray(boxes2)
     on_tpu = jax.default_backend() == "tpu"
+    # IoU is a ratio: both paths produce floating point. Match the jnp
+    # fallback's promotion (true division promotes ints to float) so the
+    # dispatch threshold never changes dtype or values.
+    out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype, jnp.float32)
+    if not jnp.issubdtype(out_dtype, jnp.floating):
+        out_dtype = jnp.float32
     if on_tpu and boxes1.ndim == 2 and boxes2.ndim == 2 and boxes1.shape[0] * boxes2.shape[0] >= min_elems:
-        # IoU is a ratio: both paths produce floating point. Match the jnp
-        # fallback's promotion (true division promotes ints to float) so the
-        # dispatch threshold never changes dtype or values.
-        out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype, jnp.float32)
-        if not jnp.issubdtype(out_dtype, jnp.floating):
-            out_dtype = jnp.float32
         return box_iou_tiled(boxes1, boxes2).astype(out_dtype)
+    if (
+        on_tpu
+        and boxes1.ndim == 3
+        and boxes2.ndim == 3
+        and boxes1.shape[0] == boxes2.shape[0]
+        and boxes1.shape[0] * boxes1.shape[1] * boxes2.shape[1] >= min_elems
+        # the unit tile pads G to 128 lanes and D to 8 sublanes; the measured
+        # on-chip win (BASELINE.md) holds when the lane padding waste is
+        # <= 4x (G >= 32): 1.13x at [4096, 128, 32], 1.54x at [1024, 128,
+        # 128], but 0.48x at [16384, 64, 16] where 8x lane waste dominates
+        and boxes2.shape[1] >= 32
+        and boxes1.shape[1] >= 8
+    ):
+        return box_iou_batched_tiled(boxes1, boxes2).astype(out_dtype)
     return _jnp_box_iou(boxes1, boxes2)

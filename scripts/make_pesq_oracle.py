@@ -1,0 +1,59 @@
+"""Generate the stored PESQ oracle fixtures for tests/audio/test_pesq_engine.py.
+
+Run from the repo root:
+
+    python scripts/make_pesq_oracle.py
+
+Always (re)writes ``tests/audio/fixtures/pesq_engine_scores.csv`` — the
+in-repo engine's scores over the deterministic corpus, asserted
+unconditionally as a drift pin. When the official ``pesq`` C binding
+(https://pypi.org/project/pesq/, the reference's scorer —
+/root/reference/torchmetrics/functional/audio/pesq.py) is importable, also
+writes ``pesq_official_scores.csv``; the fixture test then bounds
+|engine − official| per item from the stored values, unconditionally, in
+every environment from then on.
+"""
+import csv
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+from audio.pesq_corpus import score_with  # noqa: E402
+
+FIXDIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "audio", "fixtures"
+)
+
+
+def _write(path: str, scores: dict) -> None:
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["item_id", "score"])
+        for k in sorted(scores):
+            w.writerow([k, f"{scores[k]:.6f}"])
+    print(f"wrote {path} ({len(scores)} items)")
+
+
+def main() -> None:
+    os.makedirs(FIXDIR, exist_ok=True)
+
+    from metrics_tpu.functional.audio._pesq_engine import pesq as engine_pesq
+
+    _write(os.path.join(FIXDIR, "pesq_engine_scores.csv"), score_with(engine_pesq))
+
+    try:
+        import pesq as pesq_binding
+    except ImportError:
+        print("official `pesq` binding not installed — pesq_official_scores.csv not written")
+        return
+
+    def official(ref, deg, fs, mode):
+        return pesq_binding.pesq(fs, ref, deg, mode)
+
+    _write(os.path.join(FIXDIR, "pesq_official_scores.csv"), score_with(official))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Deterministic PESQ oracle corpus — shared by the stored-score fixture
+test (tests/audio/test_pesq_engine.py) and the oracle generator
+(scripts/make_pesq_oracle.py).
+
+The corpus is fully seeded so the SAME (ref, deg) pairs are reproducible in
+any environment: an environment with the official ``pesq`` C binding runs
+``python scripts/make_pesq_oracle.py`` once to store official scores next to
+the engine scores, and the fixture test then bounds |engine − official|
+unconditionally from the stored csv (the BERTScore baseline-csv pattern).
+"""
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def _speechlike(rng: np.random.Generator, n: int, fs: int) -> np.ndarray:
+    """Seeded speech-shaped test signal: 2.5 Hz syllabic envelope over a
+    four-partial harmonic carrier plus a low noise floor."""
+    t = np.arange(n) / fs
+    envelope = np.clip(np.sin(2 * np.pi * 2.5 * t), 0, None)
+    carrier = sum(
+        np.sin(2 * np.pi * f0 * t + rng.uniform(0, 6)) for f0 in (220, 450, 900, 1800)
+    )
+    return ((envelope * carrier + 0.01 * rng.standard_normal(n)) * 0.1).astype(np.float64)
+
+
+def _with_snr(clean: np.ndarray, rng: np.random.Generator, snr_db: float) -> np.ndarray:
+    noise = rng.standard_normal(len(clean))
+    noise *= np.sqrt(np.mean(clean**2) / (np.mean(noise**2) * 10 ** (snr_db / 10)))
+    return clean + noise
+
+
+def build_corpus() -> List[Tuple[str, int, str, np.ndarray, np.ndarray]]:
+    """Return [(item_id, fs, mode, ref, deg)]: 3 (fs, mode) configs x 5
+    degradation classes, all seeded."""
+    items = []
+    for fs, mode in ((8000, "nb"), (16000, "nb"), (16000, "wb")):
+        rng = np.random.default_rng(1234 + fs + (100 if mode == "wb" else 0))
+        clean = _speechlike(rng, 3 * fs, fs)
+        degradations = {
+            "clean": clean.copy(),
+            "snr20": _with_snr(clean, rng, 20.0),
+            "snr10": _with_snr(clean, rng, 10.0),
+            "snr05": _with_snr(clean, rng, 5.0),
+            # constant 25 ms delay + mild noise: exercises time alignment
+            "delay": np.concatenate(
+                [np.zeros(fs // 40), _with_snr(clean, rng, 15.0)[: -fs // 40]]
+            ),
+        }
+        for name, deg in degradations.items():
+            items.append((f"{mode}{fs}_{name}", fs, mode, clean, deg))
+    return items
+
+
+def score_with(fn) -> Dict[str, float]:
+    """Score the whole corpus with ``fn(ref, deg, fs, mode) -> float``."""
+    return {item_id: float(fn(ref, deg, fs, mode)) for item_id, fs, mode, ref, deg in build_corpus()}

@@ -8,6 +8,8 @@ invariants the spec mandates (SNR monotonicity, level/delay invariance from
 the alignment stages, score range), batched/class wiring, and a gated
 bit-parity sweep against the ``pesq`` binding wherever it is installed.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -102,6 +104,54 @@ def test_functional_batched_and_class_average():
 
     with pytest.raises(ValueError, match="shape"):
         perceptual_evaluation_speech_quality(jnp.zeros((2, 4000)), jnp.zeros((3, 4000)), fs, "nb")
+
+
+_FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _read_scores(name):
+    import csv
+
+    path = os.path.join(_FIXDIR, name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return {row["item_id"]: float(row["score"]) for row in csv.DictReader(fh)}
+
+
+def test_stored_corpus_fixture():
+    """UNCONDITIONAL stored-oracle fixture (the BERTScore baseline-csv
+    pattern, scripts/make_pesq_oracle.py) over the deterministic 15-item
+    corpus in tests/audio/pesq_corpus.py:
+
+    1. the engine's scores are pinned to the committed csv (drift pin: any
+       numeric change to the engine fails here and must regenerate the
+       fixture deliberately);
+    2. ordering/range contracts hold on every (fs, mode) config;
+    3. when ``pesq_official_scores.csv`` exists (written by the generator
+       in any environment with the official binding), every item must agree
+       with the official implementation within 0.5 MOS and the corpus mean
+       within 0.25 — asserted from the stored values, no binding needed.
+    """
+    from tests.audio.pesq_corpus import score_with
+
+    got = score_with(engine_pesq)
+    pinned = _read_scores("pesq_engine_scores.csv")
+    assert pinned is not None, "run scripts/make_pesq_oracle.py to create the fixture"
+    assert set(got) == set(pinned)
+    for item, score in got.items():
+        assert score == pytest.approx(pinned[item], abs=1e-4), item
+
+    for prefix in ("nb8000", "nb16000", "wb16000"):
+        order = [got[f"{prefix}_{d}"] for d in ("clean", "snr20", "snr10", "snr05")]
+        assert order == sorted(order, reverse=True), (prefix, order)
+        assert all(1.0 <= s <= 4.7 for s in order), (prefix, order)
+
+    official = _read_scores("pesq_official_scores.csv")
+    if official is not None:
+        diffs = [abs(got[item] - official[item]) for item in sorted(official)]
+        assert max(diffs) <= 0.5, dict(zip(sorted(official), diffs))
+        assert float(np.mean(diffs)) <= 0.25, diffs
 
 
 def test_parity_vs_pesq_binding():

@@ -1,0 +1,211 @@
+"""Per-step sync semantics across EVERY domain.
+
+The reference parametrizes each domain tester over ddp x dist_sync_on_step
+(/root/reference/tests/helpers/testers.py:392-470): with per-step sync, the
+step value is the metric computed over ALL ranks' current batches. Here each
+domain's representative metrics run that contract through the pure state API
+(the same merge path a mesh all_gather feeds): every virtual rank
+accumulates its own batch, the rank states merge, and the merged compute
+must equal a single-process metric fed all ranks' batches — for sum states,
+cat/list states, gathered-not-reduced detection states, and string-consuming
+text states alike. The accumulated (post-epoch) value must also be
+unaffected by having computed per-step values along the way.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+RANKS = 2
+STEPS = 2
+
+_rng = np.random.default_rng(77)
+
+
+def _cls_batches():
+    return [
+        (
+            jnp.asarray(_rng.random((16, 4)).astype(np.float32)),
+            jnp.asarray(_rng.integers(0, 4, 16)),
+        )
+        for _ in range(RANKS * STEPS)
+    ]
+
+
+def _reg_batches():
+    return [
+        (
+            jnp.asarray(_rng.random(24).astype(np.float32)),
+            jnp.asarray(_rng.random(24).astype(np.float32)),
+        )
+        for _ in range(RANKS * STEPS)
+    ]
+
+
+def _img_batches():
+    a = _rng.random((RANKS * STEPS, 2, 3, 24, 24)).astype(np.float32)
+    b = np.clip(a + 0.1 * _rng.standard_normal(a.shape).astype(np.float32), 0, 1)
+    return [(jnp.asarray(x), jnp.asarray(y)) for x, y in zip(a, b)]
+
+
+def _audio_batches():
+    return [
+        (
+            jnp.asarray(_rng.standard_normal((2, 1200)).astype(np.float32)),
+            jnp.asarray(_rng.standard_normal((2, 1200)).astype(np.float32)),
+        )
+        for _ in range(RANKS * STEPS)
+    ]
+
+
+def _text_batches():
+    corpus = [
+        (["the cat sat on the mat", "hello world"], ["the cat sat on a mat", "hello there world"]),
+        (["a quick brown fox", "jumps over dogs"], ["the quick brown fox", "jumps over the dog"]),
+        (["to be or not to be", "that is the question"], ["to be or to be", "this is a question"]),
+        (["all good things", "come to an end"], ["all bad things", "came to the end"]),
+    ]
+    return corpus[: RANKS * STEPS]
+
+
+def _retrieval_batches():
+    out = []
+    for _ in range(RANKS * STEPS):
+        idx = np.repeat(np.arange(3), 5)
+        preds = _rng.random(15).astype(np.float32)
+        target = (_rng.random(15) < 0.4).astype(np.int64)
+        target[::5] = 1  # every query keeps a positive
+        out.append(
+            ((jnp.asarray(preds), jnp.asarray(target)), {"indexes": jnp.asarray(idx)})
+        )
+    return out
+
+
+def _det_batches():
+    def boxes(n):
+        x1 = _rng.uniform(0, 60, n).astype(np.float32)
+        y1 = _rng.uniform(0, 60, n).astype(np.float32)
+        w = _rng.uniform(4, 30, n).astype(np.float32)
+        h = _rng.uniform(4, 30, n).astype(np.float32)
+        return np.stack([x1, y1, x1 + w, y1 + h], 1)
+
+    out = []
+    for _ in range(RANKS * STEPS):
+        preds = [
+            dict(
+                boxes=boxes(5),
+                scores=_rng.random(5).astype(np.float32),
+                labels=_rng.integers(0, 3, 5).astype(np.int64),
+            )
+        ]
+        target = [dict(boxes=boxes(3), labels=_rng.integers(0, 3, 3).astype(np.int64))]
+        out.append(((preds, target), {}))
+    return out
+
+
+def _normalize(batches):
+    return [(b, {}) if not (isinstance(b, tuple) and len(b) == 2 and isinstance(b[1], dict)) else b for b in batches]
+
+
+def _make_cases():
+    from metrics_tpu.audio import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+    from metrics_tpu.classification import Accuracy, ConfusionMatrix, F1Score
+    from metrics_tpu.detection import MeanAveragePrecision
+    from metrics_tpu.image import PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure
+    from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError, PearsonCorrCoef
+    from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+    from metrics_tpu.text import BLEUScore, CharErrorRate, WordErrorRate
+
+    cls_b = [(b, {}) for b in _cls_batches()]
+    reg_b = [(b, {}) for b in _reg_batches()]
+    img_b = [(b, {}) for b in _img_batches()]
+    aud_b = [(b, {}) for b in _audio_batches()]
+    txt_b = [(b, {}) for b in _text_batches()]
+    return [
+        ("classification-Accuracy", lambda: Accuracy(num_classes=4), cls_b, 1e-6),
+        ("classification-F1-macro", lambda: F1Score(num_classes=4, average="macro"), cls_b, 1e-6),
+        ("classification-ConfusionMatrix", lambda: ConfusionMatrix(num_classes=4), cls_b, 1e-6),
+        ("regression-MSE", MeanSquaredError, reg_b, 1e-6),
+        ("regression-MAE", MeanAbsoluteError, reg_b, 1e-6),
+        ("regression-Pearson", PearsonCorrCoef, reg_b, 1e-5),
+        ("image-PSNR", lambda: PeakSignalNoiseRatio(data_range=1.0), img_b, 1e-5),
+        (
+            "image-SSIM",
+            lambda: StructuralSimilarityIndexMeasure(data_range=1.0),
+            img_b,
+            1e-5,
+        ),
+        ("audio-SNR", SignalNoiseRatio, aud_b, 1e-5),
+        ("audio-SI-SNR", ScaleInvariantSignalNoiseRatio, aud_b, 1e-5),
+        ("text-WER", WordErrorRate, txt_b, 1e-6),
+        ("text-CER", CharErrorRate, txt_b, 1e-6),
+        ("text-BLEU", BLEUScore, [((p, [[t] for t in ts]), {}) for (p, ts) in _text_batches()], 1e-6),
+        ("retrieval-MAP", RetrievalMAP, _retrieval_batches(), 1e-6),
+        ("retrieval-NDCG", RetrievalNormalizedDCG, _retrieval_batches(), 1e-6),
+        (
+            "detection-mAP",
+            lambda: MeanAveragePrecision(iou_thresholds=[0.5]),
+            _det_batches(),
+            1e-6,
+        ),
+    ]
+
+
+_CASES = _make_cases()
+
+
+@pytest.mark.parametrize("name, ctor, batches, atol", _CASES, ids=[c[0] for c in _CASES])
+def test_dist_sync_on_step_semantics(name, ctor, batches, atol):
+    """Each step: RANKS ranks update fresh states with their own batch, the
+    merged cross-rank compute must equal a single-process metric fed the
+    same batches (the reference's ddp+dist_sync_on_step step contract)."""
+    m = ctor()
+    for step in range(STEPS):
+        step_batches = batches[step * RANKS : (step + 1) * RANKS]
+        rank_states = [
+            m.update_state(m.init_state(), *args, **kwargs) for args, kwargs in step_batches
+        ]
+        synced = functools.reduce(m.merge_states, rank_states)
+        step_val = m.compute_state(synced)
+
+        oracle = ctor()
+        for args, kwargs in step_batches:
+            oracle.update(*args, **kwargs)
+        _assert_close(step_val, oracle.compute(), atol, f"{name} step {step}")
+
+
+@pytest.mark.parametrize("name, ctor, batches, atol", _CASES, ids=[c[0] for c in _CASES])
+def test_epoch_accumulation_matches_across_rank_split(name, ctor, batches, atol):
+    """The post-epoch value from rank-wise accumulation + one final merge
+    equals single-process accumulation over all batches (the
+    dist_sync_on_step=False column of the reference grid)."""
+    m = ctor()
+    rank_states = []
+    for rank in range(RANKS):
+        state = m.init_state()
+        for step in range(STEPS):
+            args, kwargs = batches[step * RANKS + rank]
+            state = m.update_state(state, *args, **kwargs)
+        rank_states.append(state)
+    merged = functools.reduce(m.merge_states, rank_states)
+    merged_val = m.compute_state(merged)
+
+    oracle = ctor()
+    for args, kwargs in batches:
+        oracle.update(*args, **kwargs)
+    _assert_close(merged_val, oracle.compute(), atol, name)
+
+
+def _assert_close(got, want, atol, msg):
+    if isinstance(got, dict):
+        for k in got:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=atol, rtol=1e-5, err_msg=f"{msg}:{k}"
+            )
+    elif isinstance(got, (list, tuple)):
+        for g, w in zip(got, want):
+            _assert_close(g, w, atol, msg)
+    else:
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=atol, rtol=1e-5, err_msg=msg)

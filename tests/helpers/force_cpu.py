@@ -13,6 +13,11 @@ VIRTUAL_DEVICES = 8
 
 
 def setup_forced_cpu() -> None:
+    if os.environ.get("METRICS_TPU_TEST_ON_TPU"):
+        # escape hatch for the on-hardware runs (compiled Pallas tests in
+        # tests/ops, spot parity checks): keep the real backend. The
+        # device-count assert in tests/conftest.py is skipped accordingly.
+        return
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
