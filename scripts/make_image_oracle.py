@@ -1,0 +1,192 @@
+"""Generate stored oracle fixtures for the image inference metrics.
+
+Run from the repo root:
+
+    python scripts/make_image_oracle.py [--weights-dir DIR]
+
+Always (re)writes ``tests/image/fixtures/image_engine_scores.csv`` — FID,
+KID mean, and Inception Score computed over the deterministic corpus
+(tests/image/inference_corpus.py) with a SEED-0 random-weight extractor.
+Random weights make the absolute values meaningless as image-quality
+numbers, but the scores are fully deterministic, so the csv pins the whole
+statistic machinery (feature plumbing, f64 eigh trace-sqrtm, MMD, entropy
+splits) against numeric drift, unconditionally, in every environment.
+
+With ``--weights-dir`` pointing at the npz artifacts produced by
+``scripts/fetch_and_convert_weights.py`` (a networked environment), also
+writes ``image_real_weight_scores.csv`` (ours, pretrained weights) and —
+when ``torch_fidelity`` is importable — ``image_official_scores.csv``
+(the official implementations on the same corpus). The fixture test then
+bounds |ours − official| from the stored csvs in every environment.
+"""
+import argparse
+import csv
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+# the engine drift pin must be bit-comparable to the test suite's runs, so
+# use the suite's exact backend config (8-virtual-device forced CPU);
+# conv accumulation order shifts the float32 scores ~1e-3 across device
+# configs otherwise
+from tests.helpers.force_cpu import setup_forced_cpu  # noqa: E402
+
+setup_forced_cpu()
+
+FIXDIR = os.path.join(ROOT, "tests", "image", "fixtures")
+
+
+def _write(path, scores):
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["metric", "value"])
+        for k in sorted(scores):
+            w.writerow([k, f"{scores[k]:.6f}"])
+    print(f"wrote {path} ({len(scores)} values)")
+
+
+def compute_ours(weights_path=None, lpips_weights_path=None):
+    """FID/KID/IS — plus LPIPS when ``lpips_weights_path`` is given — over
+    the corpus with our metrics; ``weights_path=None`` uses the seed-0
+    random-init extractor."""
+    import jax
+    import jax.numpy as jnp
+
+    from image.inference_corpus import fid_sets, lpips_pairs
+    from metrics_tpu.image import (
+        FrechetInceptionDistance,
+        InceptionScore,
+        KernelInceptionDistance,
+    )
+    from metrics_tpu.models.inception import InceptionV3FID
+
+    real, fake = fid_sets()
+
+    if weights_path is None:
+        model = InceptionV3FID()
+        # init through the logits head so every submodule's params exist
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 3, 299, 299), jnp.float32),
+            feature="logits_unbiased",
+        )
+        # With random weights the deep taps (768/2048) collapse to
+        # near-constant features (measured: std 2e-4 at 2048 vs 0.07 at
+        # 192), which would pin nothing. The SHALLOW taps stay
+        # discriminative, so the drift pin runs FID/KID through feature=192
+        # and IS through softmax over the 64-channel tap — exercising the
+        # stem forward plus the full statistic machinery (f64 eigh
+        # trace-sqrtm, MMD subsets, entropy splits) deterministically.
+        feat = jax.jit(
+            lambda imgs: model.apply(variables, imgs.astype(jnp.float32) / 255.0, feature=192)
+        )
+        logits = jax.jit(
+            lambda imgs: model.apply(variables, imgs.astype(jnp.float32) / 255.0, feature=64)
+        )
+    else:
+        from metrics_tpu.models.inception import build_fid_inception
+
+        feat = build_fid_inception(2048, weights_path)
+        logits = build_fid_inception("logits_unbiased", weights_path)
+
+    fid = FrechetInceptionDistance(feature=feat)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+
+    # seed: the subset permutations must be deterministic for the pin
+    kid = KernelInceptionDistance(feature=feat, subset_size=10, subsets=4, seed=123)
+    kid.update(jnp.asarray(real), real=True)
+    kid.update(jnp.asarray(fake), real=False)
+    kid_mean, _ = kid.compute()
+
+    inception = InceptionScore(feature=logits, splits=2, seed=123)
+    inception.update(jnp.asarray(fake))
+    is_mean, is_std = inception.compute()
+
+    out = {
+        "fid": float(fid.compute()),
+        "kid_mean": float(kid_mean),
+        "is_mean": float(is_mean),
+        "is_std": float(is_std),
+    }
+
+    if lpips_weights_path is not None:
+        from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+        a, b = lpips_pairs()
+        lp = LearnedPerceptualImagePatchSimilarity(
+            net_type="alex", net_weights_path=lpips_weights_path
+        )
+        lp.update(jnp.asarray(a), jnp.asarray(b))
+        out["lpips_alex"] = float(lp.compute())
+    return out
+
+
+def compute_official():
+    """Official-implementation scores over the same corpus (requires
+    torch_fidelity, which drives its own pretrained InceptionV3): saves the
+    corpus as PNG folders and runs ``calculate_metrics`` with the exact
+    flags the reference metrics correspond to."""
+    import tempfile
+
+    import torch_fidelity
+    from PIL import Image
+
+    from image.inference_corpus import fid_sets
+
+    real, fake = fid_sets()
+    with tempfile.TemporaryDirectory() as tmp:
+        dirs = {}
+        for name, imgs in (("real", real), ("fake", fake)):
+            d = os.path.join(tmp, name)
+            os.makedirs(d)
+            for i, img in enumerate(imgs):
+                Image.fromarray(img.transpose(1, 2, 0)).save(os.path.join(d, f"{i:03d}.png"))
+            dirs[name] = d
+        out = torch_fidelity.calculate_metrics(
+            input1=dirs["fake"],
+            input2=dirs["real"],
+            fid=True,
+            kid=True,
+            isc=True,
+            kid_subset_size=10,
+            kid_subsets=4,
+            isc_splits=2,
+            verbose=False,
+        )
+    return {
+        "fid": float(out["frechet_inception_distance"]),
+        "kid_mean": float(out["kernel_inception_distance_mean"]),
+        "is_mean": float(out["inception_score_mean"]),
+        "is_std": float(out["inception_score_std"]),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--weights-dir", default=None)
+    args = ap.parse_args()
+
+    os.makedirs(FIXDIR, exist_ok=True)
+    _write(os.path.join(FIXDIR, "image_engine_scores.csv"), compute_ours(None))
+
+    if args.weights_dir:
+        npz = os.path.join(args.weights_dir, "inception_fid.npz")
+        lpips_npz = os.path.join(args.weights_dir, "lpips_alex.npz")
+        _write(
+            os.path.join(FIXDIR, "image_real_weight_scores.csv"),
+            compute_ours(npz, lpips_npz if os.path.exists(lpips_npz) else None),
+        )
+        try:
+            import torch_fidelity  # noqa: F401
+        except ImportError:
+            print("torch_fidelity not installed — image_official_scores.csv not written")
+        else:
+            _write(os.path.join(FIXDIR, "image_official_scores.csv"), compute_official())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Deterministic image corpus for the inference-metric oracle fixtures —
+shared by the stored-score test (tests/image/test_inference_fixture.py) and
+the generator (scripts/make_image_oracle.py).
+
+Fully seeded: any environment reproduces the SAME image sets, so scores
+stored by one environment (e.g. one with network access, pretrained
+weights, and the torch-fidelity / official LPIPS packages) pin every other
+environment unconditionally — the PESQ stored-corpus pattern
+(tests/audio/pesq_corpus.py) applied to FID/KID/IS and LPIPS.
+"""
+from typing import Tuple
+
+import numpy as np
+
+N_IMAGES = 20
+HW = 96
+
+
+def _structured(rng: np.random.Generator, n: int) -> np.ndarray:
+    """Smooth, structured uint8 images: soft blobs + gradients (the 'real'
+    distribution)."""
+    yy, xx = np.mgrid[0:HW, 0:HW].astype(np.float32) / HW
+    imgs = []
+    for _ in range(n):
+        base = np.zeros((HW, HW, 3), np.float32)
+        for _ in range(4):
+            cx, cy, r = rng.uniform(0.2, 0.8, 3)
+            col = rng.uniform(0.3, 1.0, 3)
+            blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / (0.05 + 0.1 * r)))
+            base += blob[..., None] * col[None, None, :]
+        base += 0.3 * np.stack([xx, yy, 1 - xx], -1)
+        base /= max(base.max(), 1e-6)
+        imgs.append((base * 255).astype(np.uint8))
+    return np.stack(imgs).transpose(0, 3, 1, 2)  # NCHW uint8
+
+
+def _textured(rng: np.random.Generator, n: int) -> np.ndarray:
+    """Noise-textured variants (the 'fake' distribution): structured base
+    plus strong high-frequency noise."""
+    base = _structured(rng, n).astype(np.float32)
+    noise = rng.integers(-60, 60, base.shape).astype(np.float32)
+    return np.clip(base + noise, 0, 255).astype(np.uint8)
+
+
+def fid_sets() -> Tuple[np.ndarray, np.ndarray]:
+    """(real, fake) uint8 NCHW image sets for FID/KID/IS."""
+    rng = np.random.default_rng(2024)
+    return _structured(rng, N_IMAGES), _textured(rng, N_IMAGES)
+
+
+def lpips_pairs() -> Tuple[np.ndarray, np.ndarray]:
+    """(img1, img2) float NCHW pairs in [-1, 1] for LPIPS."""
+    rng = np.random.default_rng(4048)
+    a = _structured(rng, 8).astype(np.float32) / 127.5 - 1.0
+    jitter = rng.normal(0, 0.15, a.shape).astype(np.float32)
+    b = np.clip(a + jitter, -1, 1)
+    return a, b
