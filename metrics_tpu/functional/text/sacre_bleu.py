@@ -26,8 +26,17 @@ _UCODE_RANGES = (
     ("\uf900", "\ufa2d"),  # CJK Compatibility Ideographs
     ("\ufa30", "\ufa6a"),
     ("\ufa70", "\ufad9"),
-    ("\U00020000", "\U0002a6d6"),  # CJK Unified Ideographs Extension B
-    ("\U0002f800", "\U0002fa1d"),  # CJK Compatibility Supplement
+    # CJK Extension B / Compatibility Supplement — written with the 4-digit
+    # \u escape exactly as sacrebleu (and the reference) write them, which
+    # Python parses as TWO-char strings (' ' + '0', ...): the
+    # lexicographic comparison then ALSO classifies single chars in
+    # (U+2000, U+2A6D] and (U+2F80, U+2FA1] — general punctuation and
+    # currency symbols like '€' — as Chinese in zh mode. Deliberately
+    # reproduced for observable tokenizer parity with sacrebleu/the
+    # reference (the unicode-correct \U00020000 form diverges from both;
+    # pinned by tests/text/test_stored_oracle.py's zh grid rows).
+    ("\u2000" "0", "\u2a6d" "6"),
+    ("\u2f80" "0", "\u2fa1" "d"),
     ("\uff00", "\uffef"),  # full-width ASCII / half-width kana
     ("\u2e80", "\u2eff"),  # CJK Radicals Supplement
     ("\u3000", "\u303f"),  # CJK punctuation
@@ -109,7 +118,11 @@ class _SacreBLEUTokenizer:
             line = line.replace("&amp;", "&")
             line = line.replace("&lt;", "<")
             line = line.replace("&gt;", ">")
-        return cls._tokenize_regex(line)
+        # mteval v13a applies the punctuation regexes to the SPACE-PADDED
+        # line (sacrebleu Tokenizer13a: `self._post_tokenizer(f' {line} ')`),
+        # so a sentence-final period after a digit still splits: '04.' ->
+        # '04 .'. The zh tokenizer shares the regexes but does NOT pad.
+        return cls._tokenize_regex(f" {line} ")
 
     @classmethod
     def _tokenize_zh(cls, line: str) -> str:

@@ -50,68 +50,21 @@ def _write(path, scores):
 
 def compute_ours(weights_path=None, lpips_weights_path=None):
     """FID/KID/IS — plus LPIPS when ``lpips_weights_path`` is given — over
-    the corpus with our metrics; ``weights_path=None`` uses the seed-0
-    random-init extractor."""
-    import jax
+    the corpus with our metrics; ``weights_path=None`` uses the shared
+    seed-0 drift-pin extractors (tests/image/inference_corpus.py, the ONE
+    definition the fixture test also uses)."""
     import jax.numpy as jnp
 
-    from image.inference_corpus import fid_sets, lpips_pairs
-    from metrics_tpu.image import (
-        FrechetInceptionDistance,
-        InceptionScore,
-        KernelInceptionDistance,
-    )
-    from metrics_tpu.models.inception import InceptionV3FID
-
-    real, fake = fid_sets()
+    from image.inference_corpus import engine_scores, lpips_pairs
 
     if weights_path is None:
-        model = InceptionV3FID()
-        # init through the logits head so every submodule's params exist
-        variables = model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((1, 3, 299, 299), jnp.float32),
-            feature="logits_unbiased",
-        )
-        # With random weights the deep taps (768/2048) collapse to
-        # near-constant features (measured: std 2e-4 at 2048 vs 0.07 at
-        # 192), which would pin nothing. The SHALLOW taps stay
-        # discriminative, so the drift pin runs FID/KID through feature=192
-        # and IS through softmax over the 64-channel tap — exercising the
-        # stem forward plus the full statistic machinery (f64 eigh
-        # trace-sqrtm, MMD subsets, entropy splits) deterministically.
-        feat = jax.jit(
-            lambda imgs: model.apply(variables, imgs.astype(jnp.float32) / 255.0, feature=192)
-        )
-        logits = jax.jit(
-            lambda imgs: model.apply(variables, imgs.astype(jnp.float32) / 255.0, feature=64)
-        )
+        out = engine_scores()
     else:
         from metrics_tpu.models.inception import build_fid_inception
 
         feat = build_fid_inception(2048, weights_path)
         logits = build_fid_inception("logits_unbiased", weights_path)
-
-    fid = FrechetInceptionDistance(feature=feat)
-    fid.update(jnp.asarray(real), real=True)
-    fid.update(jnp.asarray(fake), real=False)
-
-    # seed: the subset permutations must be deterministic for the pin
-    kid = KernelInceptionDistance(feature=feat, subset_size=10, subsets=4, seed=123)
-    kid.update(jnp.asarray(real), real=True)
-    kid.update(jnp.asarray(fake), real=False)
-    kid_mean, _ = kid.compute()
-
-    inception = InceptionScore(feature=logits, splits=2, seed=123)
-    inception.update(jnp.asarray(fake))
-    is_mean, is_std = inception.compute()
-
-    out = {
-        "fid": float(fid.compute()),
-        "kid_mean": float(kid_mean),
-        "is_mean": float(is_mean),
-        "is_std": float(is_std),
-    }
+        out = engine_scores(feat=feat, logits=logits)
 
     if lpips_weights_path is not None:
         from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity

@@ -1,0 +1,141 @@
+"""Generate stored oracle fixtures for the text engines and the SDR solver.
+
+Run from the repo root:
+
+    python scripts/make_text_audio_oracle.py
+
+Always (re)writes the ENGINE csvs — our scores over deterministic corpora:
+
+- ``tests/text/fixtures/text_engine_scores.csv``: SacreBLEU across the full
+  tokenize x lowercase grid, TER across its argument cube, chrF/chrF++ and
+  EED variants, over the committed MT corpus (tests/text/inputs.py). These
+  pin the most intricate hand-built engines (Tercom shift DP, chrF n-gram
+  F-scores, sacre tokenizers) against numeric drift, unconditionally.
+- ``tests/audio/fixtures/sdr_engine_scores.csv``: SDR (dense + CG solve)
+  and SI-SDR over a seeded corpus — pinning the Toeplitz solver path.
+
+When the official oracle packages are importable (sacrebleu,
+fast_bss_eval — a networked environment), also writes the
+``*_official_scores.csv`` counterparts; the fixture tests then bound
+|engine − official| from storage in every environment afterwards.
+"""
+import csv
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+# drift pins must be bit-comparable to the suite's runs: use its exact
+# backend config (8-virtual-device forced CPU) — float accumulation differs
+# ~1e-5 (BLEU) / ~0.03 dB (SDR) between CPU and the TPU backend otherwise
+from tests.helpers.force_cpu import setup_forced_cpu  # noqa: E402
+
+setup_forced_cpu()
+
+import numpy as np  # noqa: E402
+
+
+def _write(path, scores):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["case", "score"])
+        for k in sorted(scores):
+            w.writerow([k, f"{scores[k]:.6f}"])
+    print(f"wrote {path} ({len(scores)} values)")
+
+
+def _flat_corpus():
+    from tests.text.oracle_corpus import flat_corpus
+
+    return flat_corpus()
+
+
+def text_engine_scores():
+    from tests.text.oracle_corpus import engine_scores
+
+    return engine_scores()
+
+
+def text_official_scores():
+    """sacrebleu-package scores over the same corpus (BLEU, TER, CHRF)."""
+    from sacrebleu.metrics import BLEU, CHRF, TER
+
+    preds, targets = _flat_corpus()
+    n_refs = len(targets[0])
+    targets_t = [[t[i] for t in targets] for i in range(n_refs)]
+
+    out = {}
+    for tokenize in ("none", "13a", "zh", "intl", "char"):
+        for lowercase in (False, True):
+            bleu = BLEU(tokenize=tokenize, lowercase=lowercase)
+            out[f"sacrebleu_{tokenize}_lc{int(lowercase)}"] = (
+                bleu.corpus_score(preds, targets_t).score / 100
+            )
+    for normalize in (False, True):
+        for no_punct in (False, True):
+            for lowercase in (False, True):
+                ter = TER(normalized=normalize, no_punct=no_punct, case_sensitive=not lowercase)
+                key = f"ter_norm{int(normalize)}_nopunct{int(no_punct)}_lc{int(lowercase)}"
+                out[key] = ter.corpus_score(preds, targets_t).score / 100
+    out["chrf"] = CHRF(word_order=0).corpus_score(preds, targets_t).score / 100
+    out["chrfpp"] = CHRF(word_order=2).corpus_score(preds, targets_t).score / 100
+    out["chrf_lc"] = CHRF(word_order=0, lowercase=True).corpus_score(preds, targets_t).score / 100
+    return out
+
+
+def _sdr_corpus():
+    from tests.audio.sdr_corpus import sdr_corpus
+
+    return sdr_corpus()
+
+
+def sdr_engine_scores():
+    from tests.audio.sdr_corpus import engine_scores
+
+    return engine_scores()
+
+
+def sdr_official_scores():
+    import fast_bss_eval
+    import torch
+
+    preds, target = _sdr_corpus()
+    tp, tt = torch.as_tensor(preds), torch.as_tensor(target)
+    out = {}
+    vals = fast_bss_eval.sdr(tt, tp)
+    out["sdr_ch0"], out["sdr_ch1"] = float(vals[0]), float(vals[1])
+    vals_cg = fast_bss_eval.sdr(tt, tp, use_cg_iter=10)
+    out["sdr_cg_ch0"], out["sdr_cg_ch1"] = float(vals_cg[0]), float(vals_cg[1])
+    return out
+
+
+def main():
+    _write(os.path.join(ROOT, "tests", "text", "fixtures", "text_engine_scores.csv"), text_engine_scores())
+    _write(os.path.join(ROOT, "tests", "audio", "fixtures", "sdr_engine_scores.csv"), sdr_engine_scores())
+
+    try:
+        import sacrebleu  # noqa: F401
+    except ImportError:
+        print("sacrebleu not installed — text_official_scores.csv not written")
+    else:
+        _write(
+            os.path.join(ROOT, "tests", "text", "fixtures", "text_official_scores.csv"),
+            text_official_scores(),
+        )
+
+    try:
+        import fast_bss_eval  # noqa: F401
+    except ImportError:
+        print("fast_bss_eval not installed — sdr_official_scores.csv not written")
+    else:
+        _write(
+            os.path.join(ROOT, "tests", "audio", "fixtures", "sdr_official_scores.csv"),
+            sdr_official_scores(),
+        )
+
+
+if __name__ == "__main__":
+    main()

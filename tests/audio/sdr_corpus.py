@@ -1,0 +1,46 @@
+"""Seeded SDR corpus — shared by the stored-oracle generator
+(scripts/make_text_audio_oracle.py) and tests/audio/test_sdr_stored_oracle.py
+(the tests/audio/pesq_corpus.py pattern)."""
+import numpy as np
+
+
+def sdr_corpus():
+    """(preds, target) float64 [2, time]: harmonic + square-wave targets,
+    estimates = short-FIR-filtered targets plus seeded noise."""
+    rng = np.random.default_rng(31337)
+    n = 4000
+    t = np.arange(n) / 8000.0
+    target = np.stack(
+        [
+            np.sin(2 * np.pi * 440 * t) + 0.5 * np.sin(2 * np.pi * 880 * t),
+            np.sign(np.sin(2 * np.pi * 220 * t)) * 0.7,
+        ]
+    ).astype(np.float64)
+    kernel = np.array([0.9, 0.3, -0.1, 0.05])
+    filtered = np.stack([np.convolve(ch, kernel, mode="same") for ch in target])
+    preds = filtered + 0.05 * rng.standard_normal(filtered.shape)
+    return preds, target
+
+
+def engine_scores():
+    """Our SDR/SI-SDR over the corpus — the ONE definition of the swept
+    variants, shared by the fixture generator and the drift-pin test."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.audio import (
+        scale_invariant_signal_distortion_ratio,
+        signal_distortion_ratio,
+    )
+
+    preds, target = sdr_corpus()
+    jp, jt = jnp.asarray(preds), jnp.asarray(target)
+    out = {}
+    vals = np.asarray(signal_distortion_ratio(jp, jt))
+    out["sdr_ch0"], out["sdr_ch1"] = float(vals[0]), float(vals[1])
+    vals_cg = np.asarray(signal_distortion_ratio(jp, jt, use_cg_iter=10))
+    out["sdr_cg_ch0"], out["sdr_cg_ch1"] = float(vals_cg[0]), float(vals_cg[1])
+    vals_zm = np.asarray(signal_distortion_ratio(jp, jt, zero_mean=True))
+    out["sdr_zm_ch0"], out["sdr_zm_ch1"] = float(vals_zm[0]), float(vals_zm[1])
+    si = np.asarray(scale_invariant_signal_distortion_ratio(jp, jt))
+    out["sisdr_ch0"], out["sisdr_ch1"] = float(si[0]), float(si[1])
+    return out

@@ -55,3 +55,73 @@ def lpips_pairs() -> Tuple[np.ndarray, np.ndarray]:
     jitter = rng.normal(0, 0.15, a.shape).astype(np.float32)
     b = np.clip(a + jitter, -1, 1)
     return a, b
+
+
+def seed0_extractors():
+    """The drift-pin extractor pair — seed-0 random-weight InceptionV3
+    through the SHALLOW taps (the deep taps collapse to near-constant
+    features under random weights: measured std 2e-4 at depth 2048 vs 0.07
+    at 192). ONE definition shared by the fixture generator
+    (scripts/make_image_oracle.py) and tests/image/test_inference_fixture.py
+    so the pinned configuration cannot drift between them.
+
+    Returns ``(feat, logits)``: jitted ``imgs -> [N, 192]`` features for
+    FID/KID and ``imgs -> [N, 64]`` pseudo-logits for IS.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.inception import InceptionV3FID
+
+    model = InceptionV3FID()
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 3, 299, 299), jnp.float32), feature="logits_unbiased"
+    )
+    feat = jax.jit(
+        lambda imgs: model.apply(variables, imgs.astype(jnp.float32) / 255.0, feature=192)
+    )
+    logits = jax.jit(
+        lambda imgs: model.apply(variables, imgs.astype(jnp.float32) / 255.0, feature=64)
+    )
+    return feat, logits
+
+
+#: KID subset permutations and IS splits must be seeded for the drift pin
+KID_KWARGS = dict(subset_size=10, subsets=4, seed=123)
+IS_KWARGS = dict(splits=2, seed=123)
+
+
+def engine_scores(feat=None, logits=None):
+    """FID/KID/IS over the corpus — the ONE scoring definition shared by
+    generator and test. Default extractors are the seed-0 drift-pin pair."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.image import (
+        FrechetInceptionDistance,
+        InceptionScore,
+        KernelInceptionDistance,
+    )
+
+    if feat is None or logits is None:
+        feat, logits = seed0_extractors()
+    real, fake = fid_sets()
+
+    fid = FrechetInceptionDistance(feature=feat)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+
+    kid = KernelInceptionDistance(feature=feat, **KID_KWARGS)
+    kid.update(jnp.asarray(real), real=True)
+    kid.update(jnp.asarray(fake), real=False)
+    kid_mean, _ = kid.compute()
+
+    inception = InceptionScore(feature=logits, **IS_KWARGS)
+    inception.update(jnp.asarray(fake))
+    is_mean, is_std = inception.compute()
+
+    return {
+        "fid": float(fid.compute()),
+        "kid_mean": float(kid_mean),
+        "is_mean": float(is_mean),
+        "is_std": float(is_std),
+    }

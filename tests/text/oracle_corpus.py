@@ -1,0 +1,88 @@
+"""Corpus for the text stored-oracle fixtures — shared by the generator
+(scripts/make_text_audio_oracle.py) and tests/text/test_stored_oracle.py.
+
+Extends the MT fixture corpus (tests/text/inputs.py) with sentences that
+make EVERY swept argument axis discriminative — the base corpus is
+all-lowercase punctuation-free ASCII, on which tokenizer choice, lowercase,
+no_punctuation, and normalize are all no-ops and would pin nothing:
+
+- mixed case (lowercase axis),
+- punctuation incl. attached/detached variants (13a/intl tokenizers, TER
+  no_punctuation and normalize),
+- non-ASCII accents and CJK (zh vs intl vs none tokenizers),
+- numbers with separators (13a vs intl number handling).
+"""
+from tests.text.inputs import _inputs_multiple_references
+
+_EXTRA = [
+    (
+        'The Quick-Witted Fox said: "Hello, World!" — twice.',
+        [
+            'the quick-witted fox said "hello, world" twice.',
+            "The Quick-Witted Fox said: 'hello, world!' - twice.",
+        ],
+    ),
+    (
+        "Dr. Müller paid 1,234.56 € for the café's naïve décor on 2021-03-04.",
+        [
+            "Dr. Müller paid 1,234.56 euros for the cafe's naive decor on 2021-03-04.",
+            "doctor müller paid €1234.56 for the café's naïve décor.",
+        ],
+    ),
+    (
+        "他说这个模型很快, and I Agree 100%!",
+        [
+            "他说这个模型非常快, and i agree 100%.",
+            "He said this model is very fast, and I agree 100%!",
+        ],
+    ),
+]
+
+
+def flat_corpus():
+    """(preds, targets): the flattened base MT corpus plus the
+    axis-discriminative extension sentences."""
+    preds = [p for batch in _inputs_multiple_references.preds for p in batch]
+    targets = [t for batch in _inputs_multiple_references.targets for t in batch]
+    for hyp, refs in _EXTRA:
+        preds.append(hyp)
+        targets.append(refs)
+    return preds, targets
+
+
+def engine_scores():
+    """Our engines over the corpus — the ONE definition of the swept grid,
+    shared by the fixture generator (scripts/make_text_audio_oracle.py) and
+    the drift-pin test so the two cannot diverge."""
+    from metrics_tpu.functional.text import (
+        chrf_score,
+        extended_edit_distance,
+        sacre_bleu_score,
+        translation_edit_rate,
+    )
+
+    preds, targets = flat_corpus()
+    out = {}
+    for tokenize in ("none", "13a", "zh", "intl", "char"):
+        for lowercase in (False, True):
+            out[f"sacrebleu_{tokenize}_lc{int(lowercase)}"] = float(
+                sacre_bleu_score(preds, targets, tokenize=tokenize, lowercase=lowercase)
+            )
+    for normalize in (False, True):
+        for no_punct in (False, True):
+            for lowercase in (False, True):
+                key = f"ter_norm{int(normalize)}_nopunct{int(no_punct)}_lc{int(lowercase)}"
+                out[key] = float(
+                    translation_edit_rate(
+                        preds,
+                        targets,
+                        normalize=normalize,
+                        no_punctuation=no_punct,
+                        lowercase=lowercase,
+                    )
+                )
+    out["chrf"] = float(chrf_score(preds, targets, n_word_order=0))
+    out["chrfpp"] = float(chrf_score(preds, targets))
+    out["chrf_lc"] = float(chrf_score(preds, targets, n_word_order=0, lowercase=True))
+    out["eed"] = float(extended_edit_distance(preds, targets))
+    return out

@@ -1,0 +1,64 @@
+"""Stored-oracle fixtures for the text engines
+(scripts/make_text_audio_oracle.py — the PESQ/FID stored-corpus pattern).
+
+Two layers, both asserted UNCONDITIONALLY from committed csvs:
+
+1. engine drift pin — our SacreBLEU/TER/chrF/EED scores over the committed
+   MT corpus must match the stored values exactly (any numeric change to
+   the Tercom shift DP, the chrF n-gram F machinery, or a sacre tokenizer
+   fails here and must regenerate the fixture deliberately);
+2. official comparison from storage — the sacrebleu-package scores stored
+   beside them bound |ours − official| per family without importing
+   sacrebleu at test time. SacreBLEU and chrF agree with sacrebleu to
+   ~1e-6 across the full grid. TER and chrF++ hold REFERENCE-faithful
+   divergences from modern sacrebleu (verified three-way in round 5:
+   ours is bit-identical to the reference implementation; sacrebleu
+   differs by up to ~0.03 on TER corpus aggregation and ~1e-5 on chrF++
+   — see docs/differences.md), so their bounds pin the divergence as
+   KNOWN AND STABLE rather than asserting equality.
+"""
+import csv
+import os
+
+import pytest
+
+from tests.text.oracle_corpus import engine_scores
+
+_FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _read(name):
+    path = os.path.join(_FIXDIR, name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return {row["case"]: float(row["score"]) for row in csv.DictReader(fh)}
+
+
+def test_engine_drift_pin():
+    pinned = _read("text_engine_scores.csv")
+    assert pinned is not None, "run scripts/make_text_audio_oracle.py"
+    got = engine_scores()  # the generator's own scoring definition
+    assert set(got) == set(pinned)
+    for key, val in got.items():
+        assert val == pytest.approx(pinned[key], abs=1e-5), key
+
+
+def test_official_scores_from_storage():
+    ours = _read("text_engine_scores.csv")
+    official = _read("text_official_scores.csv")
+    assert ours is not None and official is not None, "run scripts/make_text_audio_oracle.py"
+
+    for key, off in official.items():
+        diff = abs(ours[key] - off)
+        if key.startswith("sacrebleu_") or key in ("chrf", "chrf_lc"):
+            assert diff <= 2e-4, (key, ours[key], off)
+        elif key == "chrfpp":
+            # reference-faithful chrF++ word-ngram divergence vs sacrebleu
+            assert diff <= 5e-4, (key, ours[key], off)
+        elif key.startswith("ter_"):
+            # reference-faithful multi-reference corpus aggregation
+            # divergence vs sacrebleu TER; bound pins it as stable
+            assert diff <= 0.06, (key, ours[key], off)
+        else:  # pragma: no cover — unknown rows would mean fixture drift
+            raise AssertionError(f"unexpected fixture row {key}")
