@@ -21,12 +21,17 @@ WARMUP = 3
 ITERS = 10
 
 
-def _make_data():
+def _make_data(n_batches=None):
+    """Seed-42 softmax fixture; ``n_batches`` stacks independent batches
+    (the TPU scan epoch) — one flat batch otherwise (the torch reference),
+    both from the ONE generator so the two sides measure the same
+    distribution."""
     rng = np.random.RandomState(42)
-    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32) * 4
-    preds = np.exp(logits - logits.max(axis=1, keepdims=True))
-    preds /= preds.sum(axis=1, keepdims=True)
-    target = rng.randint(0, NUM_CLASSES, size=(BATCH,)).astype(np.int64)
+    shape = (BATCH, NUM_CLASSES) if n_batches is None else (n_batches, BATCH, NUM_CLASSES)
+    logits = rng.rand(*shape).astype(np.float32) * 4
+    preds = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    preds /= preds.sum(axis=-1, keepdims=True)
+    target = rng.randint(0, NUM_CLASSES, size=shape[:-1]).astype(np.int64)
     return preds, target
 
 
@@ -38,41 +43,42 @@ def bench_tpu() -> float:
     execution rather than per-step host dispatch (which, over the tunneled
     accelerator transport used here, costs ~200 ms per launch and
     block_until_ready does not wait; the timed region ends with a scalar
-    device->host readback instead).
+    device->host readback instead). The scan consumes ITERS PRE-STACKED
+    INDEPENDENT batches: the earlier rolled-view variant let XLA share work
+    between steps once the rank kernel moved to a class-major fused sort
+    (rolls permute the sort axis, so per-step sorts are recombinable) and
+    measured ~29% too fast vs independent data — caught and corrected in
+    round 5.
     """
     import jax
     import jax.numpy as jnp
     from metrics_tpu.classification import ConfusionMatrix
     from metrics_tpu.functional.classification.auroc import auroc_rank_multiclass
 
-    preds_np, target_np = _make_data()
-    preds = jnp.asarray(preds_np)
-    target = jnp.asarray(target_np, dtype=jnp.int32)
+    preds_np, target_np = _make_data(n_batches=ITERS)
+    preds_all = jnp.asarray(preds_np)
+    target_all = jnp.asarray(target_np, dtype=jnp.int32)
 
     confmat = ConfusionMatrix(num_classes=NUM_CLASSES)
 
     @jax.jit
-    def epoch(state, preds, target):
-        def step(state, shift):
-            # every step consumes a DIFFERENT batch (rolled views) so XLA's
-            # loop-invariant code motion cannot hoist the kernels out of the
-            # scan and the timing covers ITERS real steps
-            preds_i = jnp.roll(preds, shift, axis=0)
-            target_i = jnp.roll(target, shift)
+    def epoch(state, preds_all, target_all):
+        def step(state, xs):
+            preds_i, target_i = xs
             new_state = confmat.update_state(state, preds_i, target_i)
             auc = auroc_rank_multiclass(preds_i, target_i, NUM_CLASSES, average="macro")
             return new_state, auc
-        state, aucs = jax.lax.scan(step, state, jnp.arange(ITERS))
+        state, aucs = jax.lax.scan(step, state, (preds_all, target_all))
         return state, aucs[-1]
 
-    state, auc = epoch(confmat.init_state(), preds, target)  # compile
+    state, auc = epoch(confmat.init_state(), preds_all, target_all)  # compile
     float(auc)
     for _ in range(WARMUP):
-        state, auc = epoch(confmat.init_state(), preds, target)
+        state, auc = epoch(confmat.init_state(), preds_all, target_all)
     float(auc)
 
     t0 = time.perf_counter()
-    state, auc = epoch(confmat.init_state(), preds, target)
+    state, auc = epoch(confmat.init_state(), preds_all, target_all)
     float(auc)
     dt = time.perf_counter() - t0
     return BATCH * ITERS / dt

@@ -140,19 +140,23 @@ def _auroc_compute(
 
 
 def _sorted_mean_ranks(sorted_x: Array) -> Array:
-    """Tie-averaged 1-based ranks of an ALREADY column-sorted ``[N, C]``.
+    """Tie-averaged 1-based ranks of an ALREADY row-sorted ``[C, N]``
+    (ascending along the LAST axis).
 
     The mean rank of a tie group is (first + last position)/2 + 1, computed
     from run boundaries with cummax/cummin — no vmapped scatters or
-    segment-sums (those serialize per column on TPU).
+    segment-sums (those serialize per class on TPU). The rank axis is the
+    MINOR one: XLA's TPU sort and these cumulative scans both want the
+    batched dimension major, which is where the 6x win over the
+    column-layout version came from (round-5 on-chip A/B).
     """
-    n, c = sorted_x.shape
-    pos = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], sorted_x.shape)
-    change = sorted_x[1:] != sorted_x[:-1]
-    is_start = jnp.concatenate([jnp.ones((1, c), bool), change])
-    is_last = jnp.concatenate([change, jnp.ones((1, c), bool)])
-    start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=0)
-    end = jax.lax.cummin(jnp.where(is_last, pos, n - 1), axis=0, reverse=True)
+    c, n = sorted_x.shape
+    pos = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], sorted_x.shape)
+    change = sorted_x[:, 1:] != sorted_x[:, :-1]
+    is_start = jnp.concatenate([jnp.ones((c, 1), bool), change], axis=1)
+    is_last = jnp.concatenate([change, jnp.ones((c, 1), bool)], axis=1)
+    start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=1)
+    end = jax.lax.cummin(jnp.where(is_last, pos, n - 1), axis=1, reverse=True)
     return (start + end).astype(jnp.float32) / 2 + 1
 
 
@@ -176,19 +180,23 @@ def auroc_rank_multiclass_masked(
         raise ValueError(f"Expected `preds` of shape [capacity, {num_classes}], got {preds.shape}")
 
     n = preds.shape[0]
-    scores = jnp.where(valid[:, None], preds.astype(jnp.float32), -jnp.inf)
-    idx = jnp.argsort(scores, axis=0)
-    mean_rank_sorted = _sorted_mean_ranks(jnp.take_along_axis(scores, idx, axis=0))
-
+    # class-major [C, N] layout with ONE multi-operand lax.sort along the
+    # minor axis, carrying the positive mask through the permutation —
+    # replaces argsort + two axis-0 gathers (6x slower on-chip: TPU sort
+    # and the midrank scans want the batch dimension major)
+    scores_t = jnp.where(valid[None, :], preds.astype(jnp.float32).T, -jnp.inf)  # [C, N]
     masked_target = jnp.where(valid, target, -1)
-    tgt_sorted = masked_target[idx]  # [N, C]
-    pos_mask = (tgt_sorted == jnp.arange(num_classes)[None, :]).astype(jnp.float32)
-    n_pos = jnp.sum(pos_mask, axis=0)
+    pos_in = (masked_target[None, :] == jnp.arange(num_classes)[:, None]).astype(jnp.float32)
+    sorted_scores, pos_sorted = jax.lax.sort((scores_t, pos_in), dimension=1, num_keys=1)
+    # within-tie permutation is free: midranks are constant across a tie run
+    mean_rank_sorted = _sorted_mean_ranks(sorted_scores)  # [C, N]
+
+    n_pos = jnp.sum(pos_in, axis=1)
     n_valid = jnp.sum(valid).astype(jnp.float32)
     n_invalid = n - n_valid
     n_neg = n_valid - n_pos
 
-    rank_sum_pos = jnp.sum(mean_rank_sorted * pos_mask, axis=0) - n_pos * n_invalid
+    rank_sum_pos = jnp.sum(mean_rank_sorted * pos_sorted, axis=1) - n_pos * n_invalid
     u = rank_sum_pos - n_pos * (n_pos + 1) / 2
     defined = (n_pos > 0) & (n_neg > 0)
     auc_per_class = jnp.where(defined, u / jnp.where(defined, n_pos * n_neg, 1.0), jnp.nan)
