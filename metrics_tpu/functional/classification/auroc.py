@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.classification.auc import _auc_compute_without_check
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.utils.checks import _input_format_classification
-from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.data import _bincount, stable_sort_with_payloads
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
 Array = jax.Array
@@ -187,7 +187,7 @@ def auroc_rank_multiclass_masked(
     scores_t = jnp.where(valid[None, :], preds.astype(jnp.float32).T, -jnp.inf)  # [C, N]
     masked_target = jnp.where(valid, target, -1)
     pos_in = (masked_target[None, :] == jnp.arange(num_classes)[:, None]).astype(jnp.float32)
-    sorted_scores, pos_sorted = jax.lax.sort((scores_t, pos_in), dimension=1, num_keys=1)
+    sorted_scores, pos_sorted = stable_sort_with_payloads(scores_t, pos_in)
     # within-tie permutation is free: midranks are constant across a tie run
     mean_rank_sorted = _sorted_mean_ranks(sorted_scores)  # [C, N]
 

@@ -149,6 +149,31 @@ def _bincount(x: Array, minlength: int) -> Array:
     return jnp.bincount(jnp.asarray(x).reshape(-1), length=minlength)
 
 
+def stable_sort_with_payloads(
+    key: Array, *payloads: Array, descending: bool = False
+) -> Tuple[Array, ...]:
+    """Stable sort of ``key`` along its MINOR axis, carrying ``payloads``
+    through the permutation in the SAME ``lax.sort`` call.
+
+    The TPU sort-layout convention shared by the rank/curve/retrieval
+    kernels (one multi-operand sort instead of argsort + per-payload
+    gathers — measured 3-6x faster on-chip, round 5): descending order is a
+    key negation (identical permutation to ``argsort(-key, stable=True)``),
+    and bool payloads ride as int32 (lax.sort operand dtype restriction)
+    and come back as bool. Returns ``(sorted_key, *sorted_payloads)``.
+    """
+    work_key = -key if descending else key
+    is_bool = [p.dtype == jnp.bool_ for p in payloads]
+    ops = (work_key,) + tuple(
+        p.astype(jnp.int32) if b else p for p, b in zip(payloads, is_bool)
+    )
+    out = jax.lax.sort(ops, dimension=key.ndim - 1, num_keys=1)
+    sorted_key = -out[0] if descending else out[0]
+    return (sorted_key,) + tuple(
+        o.astype(jnp.bool_) if b else o for o, b in zip(out[1:], is_bool)
+    )
+
+
 def _squeeze_if_scalar(data: Any) -> Any:
     """Recursively squeeze single-element arrays to 0-d.
 
