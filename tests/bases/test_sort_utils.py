@@ -1,0 +1,59 @@
+"""Unit contract for ``stable_sort_with_payloads`` (utils/data.py) — the
+shared TPU sort-layout convention behind the AUROC rank kernel, the
+retrieval row sort, and the exact-curve cumulants. Pinned here once so the
+three call sites can rely on one tested definition."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.utils.data import stable_sort_with_payloads
+
+
+def test_ascending_matches_stable_argsort():
+    rng = np.random.default_rng(0)
+    key = np.round(rng.random(64), 1).astype(np.float32)  # heavy ties
+    payload = rng.random(64).astype(np.float32)
+    sk, sp = stable_sort_with_payloads(jnp.asarray(key), jnp.asarray(payload))
+    order = np.argsort(key, kind="stable")
+    np.testing.assert_array_equal(np.asarray(sk), key[order])
+    np.testing.assert_array_equal(np.asarray(sp), payload[order])
+
+
+def test_descending_matches_stable_argsort_of_negated_key():
+    rng = np.random.default_rng(1)
+    key = np.round(rng.random(64), 1).astype(np.float32)
+    payload = np.arange(64, dtype=np.int32)
+    sk, sp = stable_sort_with_payloads(
+        jnp.asarray(key), jnp.asarray(payload), descending=True
+    )
+    order = np.argsort(-key, kind="stable")
+    np.testing.assert_array_equal(np.asarray(sk), key[order])
+    # within ties the ORIGINAL order is preserved (stability), visible in
+    # the index payload
+    np.testing.assert_array_equal(np.asarray(sp), payload[order])
+
+
+def test_bool_payloads_round_trip_and_minor_axis_batching():
+    rng = np.random.default_rng(2)
+    key = rng.random((5, 32)).astype(np.float32)
+    flag = rng.random((5, 32)) < 0.5
+    sk, sf = stable_sort_with_payloads(
+        jnp.asarray(key), jnp.asarray(flag), descending=True
+    )
+    assert sf.dtype == jnp.bool_
+    for r in range(5):
+        order = np.argsort(-key[r], kind="stable")
+        np.testing.assert_array_equal(np.asarray(sk)[r], key[r][order])
+        np.testing.assert_array_equal(np.asarray(sf)[r], flag[r][order])
+
+
+def test_multiple_payloads_and_inf_padding():
+    key = jnp.asarray([0.5, -jnp.inf, 0.9, -jnp.inf, 0.1])
+    a = jnp.asarray([0, 1, 2, 3, 4])
+    b = jnp.asarray([True, False, True, False, True])
+    sk, sa, sb = stable_sort_with_payloads(key, a, b, descending=True)
+    np.testing.assert_array_equal(np.asarray(sa), [2, 0, 4, 1, 3])  # -inf last, stable
+    np.testing.assert_array_equal(np.asarray(sb), [True, True, True, False, False])
+    assert np.asarray(sk)[0] == pytest.approx(0.9)
+    assert np.isneginf(np.asarray(sk)[-1])
