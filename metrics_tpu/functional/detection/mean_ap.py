@@ -391,21 +391,24 @@ def _calculate_precision_recall(
                 tps = np.cumsum(matches & ~ignore, axis=1, dtype=np.float64)
                 fps = np.cumsum(~matches & ~ignore, axis=1, dtype=np.float64)
 
+                # all T thresholds at once: the per-t arithmetic and the
+                # right-to-left running max (== the reference's iterative
+                # zigzag removal, map.py:657-662, at its fixed point)
+                # vectorize over the leading axis; only searchsorted stays
+                # per-t (each row has its own sorted recall grid)
+                rc_all = tps / npig  # [T, nd]
+                pr_all = tps / (fps + tps + _F64_EPS)
+                recall[:, k, a, mi] = rc_all[:, -1] if nd else 0
+                pr_all = np.maximum.accumulate(pr_all[:, ::-1], axis=1)[:, ::-1]
                 for t in range(T):
-                    tp, fp = tps[t], fps[t]
-                    rc = tp / npig
-                    pr = tp / (fp + tp + _F64_EPS)
-                    recall[t, k, a, mi] = rc[-1] if nd else 0
-                    # right-to-left running max == the reference's iterative
-                    # zigzag removal (map.py:657-662) at its fixed point
-                    pr = np.maximum.accumulate(pr[::-1])[::-1]
+                    rc = rc_all[t]
                     r_inds = np.searchsorted(rc, rec_thrs, side="left")
                     # first-out-of-bounds truncation (map.py:664-666); when
                     # nd == 0 all r_inds are 0 >= nd so num == 0 and the
                     # precision row stays all-zero, exactly as the reference
                     num = int(r_inds.argmax()) if r_inds.max() >= nd else R
                     prec_row = np.zeros((R,))
-                    prec_row[:num] = pr[r_inds[:num]]
+                    prec_row[:num] = pr_all[t, r_inds[:num]]
                     precision[t, :, k, a, mi] = prec_row
     return precision, recall
 
