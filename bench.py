@@ -212,6 +212,12 @@ def bench_map() -> None:
         ref_ips = n_imgs / (time.perf_counter() - t0)
     except Exception:
         pass
+    except BaseException as err:
+        # _load_reference_map raises pytest's Skipped — a BaseException —
+        # when the reference checkout is absent; that must degrade to
+        # vs_baseline=None like every other config, not kill the bench
+        if type(err).__name__ != "Skipped":
+            raise
 
     print(
         json.dumps(
@@ -641,21 +647,81 @@ def bench_inference() -> None:
     )
 
 
+def bench_telemetry() -> None:
+    """Micro-bench for the telemetry zero-overhead-when-disabled contract:
+    per-call wall cost of ``Metric.update`` with the recorder disabled vs
+    enabled. The disabled path must be indistinguishable from no telemetry
+    at all (its only cost is one bool check, no event allocation); the
+    enabled figure is the price of turning collection on."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.observability import get_recorder
+
+    m = SumMetric()
+    x = jnp.asarray(1.0)
+    m.update(x)  # warm caches / first dispatch
+    rec = get_recorder()
+    n = 3000
+
+    def time_updates() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            m.update(x)
+        return (time.perf_counter() - t0) / n * 1e9
+
+    was_enabled = rec.enabled
+    rec.disable()
+    disabled_ns = time_updates()
+    rec.enable()
+    enabled_ns = time_updates()
+    rec.disable()
+    # drop the synthetic events so an env-driven artifact isn't flooded
+    rec.reset()
+    if was_enabled:
+        rec.enable()
+
+    print(
+        json.dumps(
+            {
+                "metric": "telemetry_disabled_update_overhead",
+                "value": round(disabled_ns, 1),
+                "unit": "ns/call",
+                "enabled_ns_per_call": round(enabled_ns, 1),
+            }
+        )
+    )
+
+
 SUBCOMMANDS = {
     "map": bench_map,
     "retrieval": bench_retrieval,
     "image": bench_image,
     "sync": bench_sync,
     "inference": bench_inference,
+    "telemetry": bench_telemetry,
 }
 
 
 def main() -> None:
-    if len(sys.argv) > 1:
-        fn = SUBCOMMANDS.get(sys.argv[1])
+    argv = sys.argv[1:]
+    has_flag = any(arg.split("=", 1)[0] == "--telemetry" for arg in argv)
+    telemetry_active = has_flag or bool(os.environ.get("METRICS_TPU_TELEMETRY"))
+    if telemetry_active:
+        # only a telemetry run pays the metrics_tpu import in the driver
+        # parent; the plain full-emission driver stays stdlib-only until its
+        # subprocesses do the work
+        from metrics_tpu.observability import activate_telemetry, maybe_export_env
+
+        _, argv = activate_telemetry(argv, default_path="BENCH_telemetry.jsonl")
+
+    if argv:
+        fn = SUBCOMMANDS.get(argv[0])
         if fn is None:
-            raise SystemExit(f"unknown bench subcommand {sys.argv[1]!r}; one of {sorted(SUBCOMMANDS)}")
+            raise SystemExit(f"unknown bench subcommand {argv[0]!r}; one of {sorted(SUBCOMMANDS)}")
         fn()
+        if telemetry_active:
+            maybe_export_env()
         return
 
     # No args (the driver's invocation): emit EVERY measured BASELINE config
@@ -666,7 +732,7 @@ def main() -> None:
     # a crash in one config must not take down the rest.
     import subprocess
 
-    for name in ("map", "retrieval", "image", "inference", "sync"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "telemetry"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],
@@ -699,6 +765,11 @@ def main() -> None:
         ref_sps = bench_reference()
     except Exception:
         ref_sps = None
+
+    # the parent's own events (headline config) land in the same artifact the
+    # per-config subprocesses appended to
+    if telemetry_active:
+        maybe_export_env()
 
     print(
         json.dumps(

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -114,7 +115,14 @@ class MetricCollection:
         if self._groups_checked:
             for cg in self._groups.values():
                 m0 = self._metrics[cg[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
+                if _TELEMETRY.enabled and len(cg) > 1:
+                    # compute-group attribution: the leader's single update
+                    # event carries the member names it serves, so shared
+                    # updates are counted once and attributed, not per-member
+                    with _TELEMETRY.group_attribution(cg):
+                        m0.update(*args, **m0._filter_kwargs(**kwargs))
+                else:
+                    m0.update(*args, **m0._filter_kwargs(**kwargs))
         else:
             for m in self._metrics.values():
                 m.update(*args, **m._filter_kwargs(**kwargs))
@@ -272,6 +280,24 @@ class MetricCollection:
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         for name, m in self._metrics.items():
             m.load_state_dict(state_dict, prefix=f"{name}.")
+
+    def state_footprint(self) -> Dict[str, Dict[str, int]]:
+        """Per-metric state footprints (name -> ``Metric.state_footprint()``).
+
+        NOTE: metrics sharing a compute group report the same logical state;
+        :meth:`total_state_bytes` is the deduplicated total.
+        """
+        return {name: m.state_footprint() for name, m in self._metrics.items()}
+
+    def total_state_bytes(self) -> int:
+        """Total UNIQUE state bytes: once compute groups are discovered, only
+        each group's leader contributes (members borrow the leader's arrays
+        at compute time, so counting them would double-book the memory)."""
+        if self._enable_compute_groups and self._groups_checked:
+            names = [cg[0] for cg in self._groups.values()]
+        else:
+            names = list(self._metrics)
+        return sum(self._metrics[name].total_state_bytes() for name in names)
 
     def to_device(self, device: Any) -> "MetricCollection":
         for m in self._metrics.values():

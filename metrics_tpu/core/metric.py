@@ -26,6 +26,7 @@ from __future__ import annotations
 import functools
 import inspect
 import operator
+import time
 from abc import ABC, abstractmethod
 import contextlib
 from contextlib import contextmanager
@@ -48,16 +49,33 @@ from metrics_tpu.utils.data import (
 )
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
+from metrics_tpu.observability.recorder import _nbytes
 from metrics_tpu.parallel.distributed import distributed_available as _dist_available
 from metrics_tpu.parallel.distributed import gather_all_arrays
+from metrics_tpu.parallel.distributed import world_size as _world_size
 
 Array = jax.Array
 StateValue = Union[Array, List[Array]]
 
 #: auto-registered update counter accompanying any mean-reduced state — the
-#: default weights for `merge_states` on uneven accumulations (sum-reduced,
-#: so cross-rank syncs and pairwise merges compose)
+#: default weights for `merge_states` on uneven accumulations (sum-reduced
+#: with negative-sentinel propagation, so cross-rank syncs and pairwise
+#: merges compose)
 _AUTO_COUNT = "_n_updates"
+
+
+def _sentinel_count_sum(x: "Array") -> "Array":
+    """Dim-zero sum of per-rank `_n_updates` counters that PROPAGATES the
+    pre-counter-checkpoint sentinel: if any rank's counter is negative
+    ("history unknown", see ``load_state_dict``), the reduced counter is -1
+    instead of a confident wrong sum — a plain sum would launder the
+    sentinel into a positive count missing that rank's accumulation, and
+    ``merge_states`` would then trust it as a weight. Used by both the
+    host-level ``_sync_dist`` gather-reduce and (as the callable-reducer
+    path of ``sync_in_mesh``) in-jit mesh syncs."""
+    x = jnp.asarray(x)
+    return jnp.where(jnp.all(x >= 0), jnp.sum(x, axis=0), jnp.asarray(-1, x.dtype))
 
 
 def _coerce_foreign(obj: Any) -> Any:
@@ -235,7 +253,7 @@ class Metric(ABC):
         # mean state auto-registers a sum-reduced update counter that
         # `merge_states` uses as the default weights (see merge_states).
         if dist_reduce_fx is dim_zero_mean and _AUTO_COUNT not in self._defaults:
-            self.add_state(_AUTO_COUNT, default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+            self.add_state(_AUTO_COUNT, default=jnp.asarray(0, jnp.int32), dist_reduce_fx=_sentinel_count_sum)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -260,9 +278,15 @@ class Metric(ABC):
 
     def _bump_auto_count(self) -> None:
         """Increment the auto-registered mean-merge update counter (a no-op
-        for metrics without mean-reduced states); jit-safe (int32 + 1)."""
+        for metrics without mean-reduced states); jit-safe. A negative
+        counter is the pre-counter-checkpoint sentinel (see
+        ``load_state_dict``) and must STAY negative: updates after such a
+        restore would otherwise rebuild a small positive count that misses
+        the restored accumulation history, and ``merge_states`` would trust
+        it as a confident underweight."""
         if _AUTO_COUNT in self._defaults:
-            object.__setattr__(self, _AUTO_COUNT, getattr(self, _AUTO_COUNT) + 1)
+            count = getattr(self, _AUTO_COUNT)
+            object.__setattr__(self, _AUTO_COUNT, jnp.where(count < 0, count, count + 1))
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate into global state. Parity with reference metric.py:421-428,460-463.
@@ -275,9 +299,18 @@ class Metric(ABC):
         """
         self._computed = None
         self._update_called = True
+        if not _TELEMETRY.enabled:  # disabled telemetry costs this ONE check
+            with self._trace("update"):
+                self._update(*_coerce_foreign(args), **_coerce_foreign(kwargs))
+            self._bump_auto_count()
+            return
+        t0 = time.perf_counter()
         with self._trace("update"):
             self._update(*_coerce_foreign(args), **_coerce_foreign(kwargs))
         self._bump_auto_count()
+        _TELEMETRY.record_call("update", self, time.perf_counter() - t0, args, kwargs)
+        if _TELEMETRY.footprint_warn_bytes is not None:
+            _TELEMETRY.record_footprint(self, self.state_footprint())
 
     def compute(self) -> Any:
         """Compute (and cache) the metric from accumulated state, syncing across
@@ -291,6 +324,10 @@ class Metric(ABC):
         if self._computed is not None:
             return self._computed
 
+        # capture the gate once: a recorder enabled mid-call must not record
+        # a duration measured against the 0.0 placeholder
+        rec = _TELEMETRY if _TELEMETRY.enabled else None
+        t0 = time.perf_counter() if rec is not None else 0.0
         with self.sync_context(
             dist_sync_fn=self.dist_sync_fn,
             should_sync=self._to_sync,
@@ -299,6 +336,8 @@ class Metric(ABC):
             with self._trace("compute"):
                 value = self._compute()
             self._computed = _squeeze_if_scalar(value)
+        if rec is not None:
+            rec.record_call("compute", self, time.perf_counter() - t0)
         return self._computed
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
@@ -312,6 +351,8 @@ class Metric(ABC):
             raise MetricsUserError(
                 "The Metric shouldn't be synced when performing ``update``. HINT: Did you forget to call ``unsync``?."
             )
+        rec = _TELEMETRY if _TELEMETRY.enabled else None
+        t0 = time.perf_counter() if rec is not None else 0.0
 
         self.update(*args, **kwargs)
 
@@ -330,6 +371,11 @@ class Metric(ABC):
         self._to_sync = True
         self._update_called = True
 
+        if rec is not None:
+            # the forward event's duration covers the WHOLE double-update
+            # cycle; the two inner update events it contains are also in the
+            # stream, making the double-update overhead directly visible
+            rec.record_call("forward", self, time.perf_counter() - t0, args, kwargs)
         return self._forward_cache
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -402,8 +448,26 @@ class Metric(ABC):
             dist_sync_fn = gather_all_arrays
 
         self._cache = {attr: getattr(self, attr) for attr in self._defaults}
+        if not _TELEMETRY.enabled:
+            self._sync_dist(dist_sync_fn, process_group=process_group)
+            self._is_synced = True
+            return
+        t0 = time.perf_counter()
+        state_bytes = sum(self.state_footprint(include_children=False).values())
         self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
+        # lifecycle-level event: metric attribution + duration + LOCAL state
+        # bytes, under its OWN type tag — "sync" events are the transport's
+        # (gather_all_arrays / sync_in_mesh), which own the gather-byte and
+        # pad-waste accounting, so totals are never double-counted and
+        # type=="sync" consumers always find the gather_bytes schema
+        _TELEMETRY.record_event(
+            "metric_sync",
+            metric=type(self).__name__,
+            local_state_bytes=state_bytes,
+            world_size=_world_size(process_group or self.process_group),
+            dur_ms=round((time.perf_counter() - t0) * 1e3, 4),
+        )
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local states. Parity with reference metric.py:365-385."""
@@ -544,6 +608,12 @@ class Metric(ABC):
         sync convention — is only the last resort for states that predate the
         counter (e.g. restored from an old checkpoint), since it silently
         mis-averages uneven sides.
+
+        A NEGATIVE count on either side is the "history unknown" sentinel
+        (``load_state_dict`` sets ``-1`` when restoring a pre-counter
+        checkpoint): the merge falls back to the unweighted mean, and the
+        merged counter stays ``-1`` so the uncertainty propagates through
+        chained merges instead of resetting to a small confident count.
         """
         if counts is not None and len(counts) != 2:
             raise ValueError(f"`counts` must be a pair (n_a, n_b), got {len(counts)} entries")
@@ -554,7 +624,11 @@ class Metric(ABC):
             if name == _AUTO_COUNT and (name not in a or name not in b):
                 continue  # hand-built / pre-counter states; weights fell back above
             va, vb = a[name], b[name]
-            if isinstance(va, list) or isinstance(vb, list) or self._cat_states.get(name):
+            if name == _AUTO_COUNT:
+                # sentinel propagation: merging an unknown-history side keeps
+                # the result's counter unknown
+                out[name] = jnp.where((va >= 0) & (vb >= 0), va + vb, -1)
+            elif isinstance(va, list) or isinstance(vb, list) or self._cat_states.get(name):
                 la = va if isinstance(va, list) else [va]
                 lb = vb if isinstance(vb, list) else [vb]
                 out[name] = la + lb
@@ -565,9 +639,13 @@ class Metric(ABC):
                     na, nb = (jnp.asarray(c, jnp.float32) for c in counts)
                     total = na + nb
                     # never-updated pairs (both counters 0) fall back to the
-                    # unweighted mean of the defaults instead of 0/0
+                    # unweighted mean of the defaults instead of 0/0, and a
+                    # negative (sentinel) counter on either side means the
+                    # weights are unknown — unweighted fallback, never a
+                    # zero/negative weight that discards a side's data
+                    weighted_ok = (na >= 0) & (nb >= 0) & (total > 0)
                     out[name] = jnp.where(
-                        total > 0,
+                        weighted_ok,
                         (na * va + nb * vb) / jnp.maximum(total, 1.0),
                         (va + vb) / 2,
                     )
@@ -585,6 +663,36 @@ class Metric(ABC):
             else:
                 raise MetricsUserError(f"Cannot merge state {name!r} with custom reduction")
         return out
+
+    # ------------------------------------------------------------------
+    # state memory accounting (observability; no reference analog)
+    # ------------------------------------------------------------------
+    def state_footprint(self, include_children: bool = True) -> Dict[str, int]:
+        """Per-state device-memory footprint in bytes.
+
+        Keys are state names (child metrics' states under dotted prefixes);
+        list states report the sum over their elements — the number that
+        grows without bound for cat-accumulating curve metrics (AUROC/ROC/
+        PRC), which is exactly what the telemetry high-water-mark warning
+        watches. ``sum(m.state_footprint().values())`` (or
+        :meth:`total_state_bytes`) is the metric's total state memory.
+        """
+        out: Dict[str, int] = {}
+        for name in self._defaults:
+            val = getattr(self, name)
+            if isinstance(val, list):
+                out[name] = int(sum(_nbytes(v) for v in val))
+            else:
+                out[name] = _nbytes(val)
+        if include_children:
+            for cname, child in self._iter_child_metrics():
+                for key, nb in child.state_footprint().items():
+                    out[f"{cname}.{key}"] = nb
+        return out
+
+    def total_state_bytes(self) -> int:
+        """Total bytes held by this metric's (and its children's) states."""
+        return sum(self.state_footprint().values())
 
     # ------------------------------------------------------------------
     # persistence
@@ -610,7 +718,20 @@ class Metric(ABC):
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
-        """Restore states saved by ``state_dict``. Parity with metric.py:624-642."""
+        """Restore states saved by ``state_dict``. Parity with metric.py:624-642.
+
+        Pre-counter checkpoints: when real states are restored but the
+        auto-registered ``_n_updates`` counter is absent (an old, pre-0.5
+        snapshot), the counter is set to the sentinel ``-1`` instead of
+        staying at its default ``0`` — a 0 would weight this side's
+        accumulated mean to ZERO in the next count-weighted
+        ``merge_states``, silently discarding its data. A negative counter
+        makes ``merge_states`` fall back to the unweighted mean and
+        survives both further updates (``_bump_auto_count``) and
+        re-snapshotting, so the "history unknown" mark cannot be laundered
+        into a confident wrong weight.
+        """
+        restored_real_state = False
         for name in self._defaults:
             key = prefix + name
             if key in state_dict:
@@ -619,6 +740,14 @@ class Metric(ABC):
                     object.__setattr__(self, name, [jnp.asarray(v) for v in val])
                 else:
                     object.__setattr__(self, name, jnp.asarray(val))
+                if name != _AUTO_COUNT:
+                    restored_real_state = True
+        if (
+            restored_real_state
+            and _AUTO_COUNT in self._defaults
+            and prefix + _AUTO_COUNT not in state_dict
+        ):
+            object.__setattr__(self, _AUTO_COUNT, jnp.asarray(-1, jnp.int32))
         for cname, child in self._iter_child_metrics():
             child.load_state_dict(state_dict, prefix=f"{prefix}{cname}.")
 

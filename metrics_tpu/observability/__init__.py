@@ -1,0 +1,113 @@
+"""metrics_tpu.observability — structured telemetry for the metric runtime.
+
+A process-local :class:`MetricRecorder` registry collects typed events
+(``update``/``compute``/``forward``/``sync``) from the core runtime, detects
+silent XLA recompiles via per-entry-point signature counting, accounts
+cross-device sync traffic (gather bytes, world size, pad waste), and tracks
+state-memory high-water marks. Exporters render the stream as a JSONL event
+log, a Prometheus text page, or a human summary table.
+
+Everything is OFF by default; the disabled hot-path cost is one bool check
+(no event allocation). Enable with::
+
+    from metrics_tpu.observability import get_recorder
+    get_recorder().enable(recompile_threshold=8)
+    ...  # run your eval loop
+    get_recorder().export_jsonl("telemetry.jsonl")
+
+or set ``METRICS_TPU_TELEMETRY=/path/to/telemetry.jsonl`` in the
+environment, which auto-enables the default recorder and lets entry points
+(``bench.py --telemetry``, ``__graft_entry__.py --telemetry``) append their
+events to that one artifact across subprocesses. See docs/observability.md.
+"""
+import os
+from typing import Dict
+
+from metrics_tpu.observability.exporters import export_jsonl, render_prometheus, summary
+from metrics_tpu.observability.recorder import (
+    _DEFAULT_RECORDER,
+    EVENT_TYPES,
+    TELEMETRY_ENV_VAR,
+    MetricRecorder,
+)
+
+__all__ = [
+    "MetricRecorder",
+    "EVENT_TYPES",
+    "TELEMETRY_ENV_VAR",
+    "activate_telemetry",
+    "get_recorder",
+    "recorders",
+    "telemetry_enabled",
+    "maybe_export_env",
+    "export_jsonl",
+    "render_prometheus",
+    "summary",
+]
+
+_RECORDERS: Dict[str, MetricRecorder] = {"default": _DEFAULT_RECORDER}
+
+
+def get_recorder(name: str = "default") -> MetricRecorder:
+    """The process-local recorder registry. ``"default"`` is the instance
+    wired into the runtime hot paths; named instances are for ad-hoc user
+    instrumentation (they share nothing with the default one)."""
+    rec = _RECORDERS.get(name)
+    if rec is None:
+        rec = _RECORDERS[name] = MetricRecorder(name)
+    return rec
+
+
+def recorders() -> Dict[str, MetricRecorder]:
+    """Snapshot of the registry (name -> recorder)."""
+    return dict(_RECORDERS)
+
+
+def telemetry_enabled() -> bool:
+    """Whether the default recorder is currently collecting."""
+    return _DEFAULT_RECORDER.enabled
+
+
+def activate_telemetry(argv, default_path: str = "telemetry.jsonl"):
+    """The one ``--telemetry[=path]`` activation sequence shared by the
+    entry points (``bench.py``, ``__graft_entry__.py``): parse the flag out
+    of ``argv``; when present, enable the default recorder, pin the
+    ``METRICS_TPU_TELEMETRY`` env var so spawned subprocesses inherit the
+    artifact (they append via ``maybe_export_env``), and truncate the
+    artifact file. An empty ``--telemetry=`` value falls back to
+    ``default_path``. Returns ``(abs_path_or_None, remaining_argv)``."""
+    path = None
+    rest = []
+    for arg in argv:
+        if arg == "--telemetry":
+            path = default_path
+        elif arg.startswith("--telemetry="):
+            path = arg.split("=", 1)[1] or default_path
+        else:
+            rest.append(arg)
+    if path is not None:
+        path = os.path.abspath(path)
+        os.environ[TELEMETRY_ENV_VAR] = path
+        _DEFAULT_RECORDER.enable()
+        open(path, "w").close()  # truncate: this run's processes append
+    return path, rest
+
+
+def maybe_export_env() -> str:
+    """Append the default recorder's events to the ``METRICS_TPU_TELEMETRY``
+    path if that env var is set and anything was recorded; returns the path
+    written or ``""``. Safe to call unconditionally at entry-point exit —
+    the mechanism bench.py/__graft_entry__.py subprocesses use to land their
+    events in the parent's artifact."""
+    path = os.environ.get(TELEMETRY_ENV_VAR)
+    if path and _DEFAULT_RECORDER.enabled and _DEFAULT_RECORDER.events():
+        export_jsonl(path, recorder=_DEFAULT_RECORDER, append=True)
+        _DEFAULT_RECORDER.reset()
+        return path
+    return ""
+
+
+# env-var activation: lets subprocess entry points (and users who cannot
+# edit the launch script) turn collection on without a code change
+if os.environ.get(TELEMETRY_ENV_VAR):
+    _DEFAULT_RECORDER.enable()

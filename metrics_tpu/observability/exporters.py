@@ -1,0 +1,144 @@
+"""Telemetry exporters: JSONL event log, Prometheus text exposition, and a
+human summary table.
+
+All three are rank-zero-gated (multi-host jobs emit one copy) and read a
+consistent snapshot of the recorder, so they can run concurrently with
+metric updates.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from metrics_tpu.utils.prints import _process_index
+
+
+def _resolve(recorder: Optional[Any]) -> Any:
+    if recorder is None:
+        from metrics_tpu.observability.recorder import _DEFAULT_RECORDER
+
+        return _DEFAULT_RECORDER
+    return recorder
+
+
+def export_jsonl(path: str, recorder: Optional[Any] = None, append: bool = False) -> Optional[str]:
+    """Write every recorded event as one JSON object per line.
+
+    Returns the path written, or ``None`` on non-zero ranks (rank-zero
+    gated). Events are plain dicts of JSON scalars/lists, so the artifact
+    round-trips through ``json.loads`` line by line.
+    """
+    if _process_index() != 0:
+        return None
+    rec = _resolve(recorder)
+    mode = "a" if append else "w"
+    with open(path, mode) as fh:
+        for event in rec.events():
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_prometheus(recorder: Optional[Any] = None) -> str:
+    """Prometheus text-format rendering of the aggregate counters/gauges.
+
+    Meant for a scrape endpoint or a textfile-collector drop: call counts
+    and cumulative wall time per (metric, phase), sync/gather byte totals,
+    distinct-signature gauges (the recompile detector's raw data), and
+    state-footprint high-water marks. Returns ``""`` on non-zero ranks.
+    """
+    if _process_index() != 0:
+        return ""
+    rec = _resolve(recorder)
+    counts = rec.call_counts()
+    times = rec.call_times()
+    sync = rec.sync_totals()
+    sigs = rec.signature_counts()
+    hwm = rec.footprint_high_water_marks()
+
+    lines = []
+    lines.append("# HELP metrics_tpu_calls_total Metric lifecycle calls by metric and phase.")
+    lines.append("# TYPE metrics_tpu_calls_total counter")
+    for (metric, phase), n in sorted(counts.items()):
+        lines.append(
+            f'metrics_tpu_calls_total{{metric="{_escape_label(metric)}",phase="{_escape_label(phase)}"}} {n}'
+        )
+    lines.append("# HELP metrics_tpu_call_seconds_total Cumulative wall time by metric and phase.")
+    lines.append("# TYPE metrics_tpu_call_seconds_total counter")
+    for (metric, phase), t in sorted(times.items()):
+        lines.append(
+            f'metrics_tpu_call_seconds_total{{metric="{_escape_label(metric)}",phase="{_escape_label(phase)}"}} {t:.6f}'
+        )
+    lines.append("# HELP metrics_tpu_sync_events_total Cross-device/process state synchronizations.")
+    lines.append("# TYPE metrics_tpu_sync_events_total counter")
+    lines.append(f"metrics_tpu_sync_events_total {sync['sync_events']}")
+    lines.append("# HELP metrics_tpu_gather_bytes_total Bytes of synced state received per participant.")
+    lines.append("# TYPE metrics_tpu_gather_bytes_total counter")
+    lines.append(f"metrics_tpu_gather_bytes_total {sync['gather_bytes']}")
+    lines.append("# HELP metrics_tpu_pad_waste_bytes_total Pad-to-max padding bytes moved by uneven gathers.")
+    lines.append("# TYPE metrics_tpu_pad_waste_bytes_total counter")
+    lines.append(f"metrics_tpu_pad_waste_bytes_total {sync['pad_waste_bytes']}")
+    lines.append("# HELP metrics_tpu_distinct_signatures Distinct (shape, dtype) call signatures per entry point.")
+    lines.append("# TYPE metrics_tpu_distinct_signatures gauge")
+    for entry, n in sorted(sigs.items()):
+        lines.append(f'metrics_tpu_distinct_signatures{{entry="{_escape_label(entry)}"}} {n}')
+    lines.append("# HELP metrics_tpu_state_bytes_hwm State-footprint high-water mark per metric.")
+    lines.append("# TYPE metrics_tpu_state_bytes_hwm gauge")
+    for metric, nbytes in sorted(hwm.items()):
+        lines.append(f'metrics_tpu_state_bytes_hwm{{metric="{_escape_label(metric)}"}} {nbytes}')
+    lines.append("# HELP metrics_tpu_dropped_events_total Events discarded past the buffer cap.")
+    lines.append("# TYPE metrics_tpu_dropped_events_total counter")
+    lines.append(f"metrics_tpu_dropped_events_total {rec.dropped_events()}")
+    return "\n".join(lines) + "\n"
+
+
+def summary(recorder: Optional[Any] = None) -> str:
+    """Human-readable summary table of where metric time went.
+
+    Returns ``""`` on non-zero ranks.
+    """
+    if _process_index() != 0:
+        return ""
+    rec = _resolve(recorder)
+    counts = rec.call_counts()
+    times = rec.call_times()
+    sync = rec.sync_totals()
+    sigs = rec.signature_counts()
+    hwm = rec.footprint_high_water_marks()
+
+    rows = []
+    for (metric, phase), n in sorted(counts.items(), key=lambda kv: -times.get(kv[0], 0.0)):
+        total_ms = times.get((metric, phase), 0.0) * 1e3
+        rows.append((metric, phase, n, total_ms, total_ms / max(n, 1)))
+
+    width = max([len(r[0]) for r in rows], default=6)
+    lines = [
+        f"telemetry summary (recorder `{rec.name}`)",
+        f"{'metric':<{width}}  {'phase':<8} {'calls':>7} {'total_ms':>10} {'mean_ms':>9}",
+    ]
+    for metric, phase, n, total_ms, mean_ms in rows:
+        lines.append(f"{metric:<{width}}  {phase:<8} {n:>7} {total_ms:>10.3f} {mean_ms:>9.4f}")
+    if not rows:
+        lines.append("(no lifecycle calls recorded)")
+    lines.append(
+        f"sync: {sync['sync_events']} events, {sync['gather_bytes']} gather bytes,"
+        f" {sync['pad_waste_bytes']} pad-waste bytes"
+    )
+    dropped = rec.dropped_events()
+    if dropped:
+        lines.append(
+            f"WARNING: {dropped} events dropped past the buffer cap"
+            " (aggregate counters above still include them)"
+        )
+    if sigs:
+        lines.append("distinct call signatures per entry point:")
+        for entry, n in sorted(sigs.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {entry}: {n}")
+    if hwm:
+        lines.append("state-footprint high-water marks:")
+        for metric, nbytes in sorted(hwm.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {metric}: {nbytes} bytes")
+    return "\n".join(lines)

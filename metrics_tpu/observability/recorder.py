@@ -1,0 +1,427 @@
+"""Process-local metric telemetry: typed events, recompile detection,
+sync/comm accounting, and state-memory high-water marks.
+
+The ROADMAP north-star (a production system serving heavy traffic) needs to
+know *where metric time goes*. Three failure modes are invisible without
+instrumentation until a pod job is slow:
+
+* **Silent XLA recompiles** — an unpadded batch pipeline feeds a new
+  ``(shape, dtype)`` signature every step and each one retriggers
+  compilation (the SNIPPETS pjit reference's call-site-mesh trap). The
+  recorder tracks distinct argument signatures per entry point and warns
+  once when a configurable threshold is crossed.
+* **Host<->device syncs** — every cross-process ``gather_all_arrays`` and
+  in-mesh ``sync_in_mesh`` records gather bytes, world size, and the pad
+  waste of the pad-to-max uneven-shape contract.
+* **Unbounded cat-state growth** — AUROC/ROC/PRC-style list states grow
+  per update; ``Metric.state_footprint()`` plus the opt-in
+  ``footprint_warn_bytes`` high-water-mark warning make the growth visible
+  before it OOMs a host.
+
+Zero-overhead contract: when the recorder is disabled (the default), the
+only cost on the metric hot path is ONE attribute/bool check
+(``_TELEMETRY.enabled``) — no event objects are allocated, no timestamps
+taken, no locks touched. Verified by ``bench.py telemetry``.
+
+All warning/export paths are rank-zero-gated through
+``metrics_tpu.utils.prints`` so multi-host jobs emit one copy.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+#: environment variable holding a JSONL path; when set, the default recorder
+#: auto-enables at import and entry points append their events to that path
+#: (see ``maybe_export_env``) — how ``bench.py``/``__graft_entry__.py``
+#: thread one artifact through their subprocesses
+TELEMETRY_ENV_VAR = "METRICS_TPU_TELEMETRY"
+
+#: core lifecycle event types; auxiliary events ("recompile_warning",
+#: "footprint", "tracker_increment") ride the same stream
+EVENT_TYPES = ("update", "compute", "forward", "sync")
+
+
+def _signature_of(args: Any, kwargs: Any) -> Tuple:
+    """The ``(shape, dtype)`` signature of every array leaf in a call's
+    arguments — exactly the key XLA's jit cache discriminates on, so a
+    growing set of signatures at one entry point means recompiles."""
+    parts: List[Tuple] = []
+
+    def walk(obj: Any) -> None:
+        shape = getattr(obj, "shape", None)
+        dtype = getattr(obj, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append((tuple(shape), str(dtype)))
+        elif isinstance(obj, (list, tuple)):
+            for o in obj:
+                walk(o)
+        elif isinstance(obj, dict):
+            try:
+                items = sorted(obj.items())
+            except TypeError:
+                items = list(obj.items())
+            for _, o in items:
+                walk(o)
+
+    walk(args)
+    if kwargs:
+        walk(kwargs)
+    return tuple(parts)
+
+
+def _nbytes(value: Any) -> int:
+    """Best-effort nbytes of an array (works on tracers: static shape*itemsize)."""
+    nb = getattr(value, "nbytes", None)
+    if isinstance(nb, int):
+        return nb
+    size = getattr(value, "size", None)
+    dtype = getattr(value, "dtype", None)
+    if size is not None and dtype is not None:
+        try:
+            return int(size) * int(dtype.itemsize)
+        except (TypeError, AttributeError):
+            return 0
+    return 0
+
+
+class MetricRecorder:
+    """Collects typed telemetry events from the metric runtime.
+
+    Not a per-metric object: ONE recorder observes every metric in the
+    process (the registry in ``metrics_tpu.observability`` hands out named
+    instances; the ``"default"`` one is wired into the runtime hot paths).
+
+    The public surface intended for users is ``enable()``/``disable()``/
+    ``reset()``, the read accessors (``events``/``call_counts``/
+    ``signature_counts``/``sync_totals``), and the exporters
+    (``export_jsonl``/``render_prometheus``/``summary``). The ``record_*``
+    methods are the runtime's hook points; callers must check ``.enabled``
+    first — that check IS the zero-overhead gate.
+    """
+
+    DEFAULT_RECOMPILE_THRESHOLD = 8
+    MAX_EVENTS = 200_000
+
+    def __init__(
+        self,
+        name: str = "default",
+        recompile_threshold: int = DEFAULT_RECOMPILE_THRESHOLD,
+        footprint_warn_bytes: Optional[int] = None,
+    ) -> None:
+        self.name = name
+        self.enabled = False
+        self.recompile_threshold = recompile_threshold
+        self.footprint_warn_bytes = footprint_warn_bytes
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._times: Dict[Tuple[str, str], float] = {}
+        self._signatures: Dict[str, set] = {}
+        self._recompile_warned: set = set()
+        self._footprint_warned: set = set()
+        self._footprint_hwm: Dict[str, int] = {}
+        self._sync_bytes = 0
+        self._pad_waste_bytes = 0
+        self._sync_events = 0
+        # per-thread compute-group attribution: a shared field would let
+        # concurrent MetricCollection.update calls cross-attribute events
+        self._group_local = threading.local()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def enable(
+        self,
+        recompile_threshold: Optional[int] = None,
+        footprint_warn_bytes: Optional[int] = None,
+    ) -> "MetricRecorder":
+        if recompile_threshold is not None:
+            self.recompile_threshold = recompile_threshold
+        if footprint_warn_bytes is not None:
+            self.footprint_warn_bytes = footprint_warn_bytes
+        self.enabled = True
+        return self
+
+    def disable(self) -> "MetricRecorder":
+        self.enabled = False
+        return self
+
+    def reset(self) -> "MetricRecorder":
+        with self._lock:
+            self._t0 = time.time()
+            self._events = []
+            self._dropped = 0
+            self._counts = {}
+            self._times = {}
+            self._signatures = {}
+            self._recompile_warned = set()
+            self._footprint_warned = set()
+            self._footprint_hwm = {}
+            self._sync_bytes = 0
+            self._pad_waste_bytes = 0
+            self._sync_events = 0
+            self._group_local = threading.local()
+        return self
+
+    # ------------------------------------------------------------------
+    # read accessors
+    # ------------------------------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def call_counts(self) -> Dict[Tuple[str, str], int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def call_times(self) -> Dict[Tuple[str, str], float]:
+        with self._lock:
+            return dict(self._times)
+
+    def signature_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: len(v) for k, v in self._signatures.items()}
+
+    def sync_totals(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "sync_events": self._sync_events,
+                "gather_bytes": self._sync_bytes,
+                "pad_waste_bytes": self._pad_waste_bytes,
+            }
+
+    def footprint_high_water_marks(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._footprint_hwm)
+
+    def dropped_events(self) -> int:
+        """Events discarded after the MAX_EVENTS buffer cap (aggregate
+        counters still include them; the JSONL stream does not)."""
+        with self._lock:
+            return self._dropped
+
+    # ------------------------------------------------------------------
+    # hook points (callers check ``.enabled`` first)
+    # ------------------------------------------------------------------
+    def _append(self, event: Dict[str, Any]) -> None:
+        # caller holds the lock
+        if len(self._events) >= self.MAX_EVENTS:
+            self._dropped += 1
+            if self._dropped == 1:
+                # surface the cap the moment it first bites — a silently
+                # truncated JSONL artifact would misread as complete coverage
+                rank_zero_warn(
+                    f"Telemetry: the event buffer reached its {self.MAX_EVENTS}-event"
+                    " cap; further events are dropped (aggregate counters keep"
+                    " counting). Export and reset() periodically for long runs."
+                    " The dropped count is reported by dropped_events(), summary(),"
+                    " and the Prometheus page.",
+                    UserWarning,
+                )
+            return
+        self._events.append(event)
+
+    def record_call(
+        self,
+        phase: str,
+        metric: Any,
+        duration_s: float,
+        args: Tuple = (),
+        kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record one update/compute/forward lifecycle call with its wall
+        time and argument signature (and feed recompile detection)."""
+        label = type(metric).__name__
+        sig = _signature_of(args, kwargs) if (args or kwargs) else ()
+        with self._lock:
+            key = (label, phase)
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._times[key] = self._times.get(key, 0.0) + duration_s
+            event: Dict[str, Any] = {
+                "type": phase,
+                "metric": label,
+                "t": round(time.time() - self._t0, 6),
+                "dur_ms": round(duration_s * 1e3, 4),
+                "n_calls": self._counts[key],
+            }
+            if sig:
+                # events store at most 8 leaves (detection-style structured
+                # inputs carry thousands); recompile detection below keys on
+                # the FULL tuple regardless
+                event["signature"] = [[list(shape), dtype] for shape, dtype in sig[:8]]
+                if len(sig) > 8:
+                    event["signature_leaves"] = len(sig)
+            group = getattr(self._group_local, "group", None)
+            if group is not None:
+                event["compute_group"] = list(group)
+            self._append(event)
+        if sig and phase in ("update", "forward"):
+            self.track_signature(f"{label}.{phase}", signature=sig)
+
+    def track_signature(self, entry: str, *args: Any, signature: Optional[Tuple] = None, **kwargs: Any) -> None:
+        """Note one call signature for a jitted entry point; warn (once per
+        entry, rank-zero) when the distinct-signature count crosses
+        ``recompile_threshold`` — the classic "unpadded batch -> recompile
+        every step" bug. Functional/jit users can call this directly with
+        their traced arguments."""
+        sig = signature if signature is not None else _signature_of(args, kwargs)
+        with self._lock:
+            seen = self._signatures.setdefault(entry, set())
+            before = len(seen)
+            seen.add(sig)
+            crossed = (
+                len(seen) > before
+                and len(seen) > self.recompile_threshold
+                and entry not in self._recompile_warned
+            )
+            if crossed:
+                self._recompile_warned.add(entry)
+                n = len(seen)
+                self._append(
+                    {
+                        "type": "recompile_warning",
+                        "entry": entry,
+                        "distinct_signatures": n,
+                        "threshold": self.recompile_threshold,
+                        "t": round(time.time() - self._t0, 6),
+                    }
+                )
+        if crossed:
+            rank_zero_warn(
+                f"Telemetry: entry point `{entry}` has now seen {n} distinct"
+                f" (shape, dtype) argument signatures (threshold"
+                f" {self.recompile_threshold}). Every new signature retriggers XLA"
+                " compilation for jitted metric code — pad or bucket your batches"
+                " to a fixed shape, or raise the threshold via"
+                " `get_recorder().enable(recompile_threshold=...)` if the shapes"
+                " are genuinely static-bounded.",
+                UserWarning,
+            )
+
+    def record_sync(
+        self,
+        source: str,
+        gather_bytes: int,
+        world_size: int,
+        pad_waste_bytes: int = 0,
+        **extra: Any,
+    ) -> None:
+        """Record one cross-device/cross-process state synchronization.
+
+        ``gather_bytes`` is the bytes of synced state received per
+        participant (concat/gather states count ``world_size`` shards;
+        all-reduced states count one payload). ``pad_waste_bytes`` is the
+        portion of those bytes that is pad-to-max padding, not data.
+        """
+        with self._lock:
+            self._sync_events += 1
+            self._sync_bytes += int(gather_bytes)
+            self._pad_waste_bytes += int(pad_waste_bytes)
+            event = {
+                "type": "sync",
+                "source": source,
+                "gather_bytes": int(gather_bytes),
+                "world_size": int(world_size),
+                "pad_waste_bytes": int(pad_waste_bytes),
+                "t": round(time.time() - self._t0, 6),
+            }
+            event.update(extra)
+            self._append(event)
+
+    def record_footprint(self, metric: Any, footprint: Dict[str, int], **extra: Any) -> None:
+        """Record a state-memory snapshot and maintain the per-metric high
+        water mark; warn once (rank-zero) when ``footprint_warn_bytes`` is
+        configured and crossed — the unbounded-cat-state guard."""
+        label = type(metric).__name__
+        total = int(sum(footprint.values()))
+        with self._lock:
+            if total > self._footprint_hwm.get(label, -1):
+                self._footprint_hwm[label] = total
+            event = {
+                "type": "footprint",
+                "metric": label,
+                "total_bytes": total,
+                "t": round(time.time() - self._t0, 6),
+            }
+            event.update(extra)
+            self._append(event)
+            warn = (
+                self.footprint_warn_bytes is not None
+                and total > self.footprint_warn_bytes
+                and label not in self._footprint_warned
+            )
+            if warn:
+                self._footprint_warned.add(label)
+        if warn:
+            rank_zero_warn(
+                f"Telemetry: metric `{label}` state footprint is {total} bytes,"
+                f" above the configured high-water mark of"
+                f" {self.footprint_warn_bytes} bytes. Unbounded list ('cat')"
+                " states (AUROC/ROC/PRC-style curve accumulators) grow with"
+                " every update — consider the fixed-capacity exact-curve mode"
+                " or more frequent compute()+reset() cycles.",
+                UserWarning,
+            )
+
+    def record_event(self, etype: str, **fields: Any) -> None:
+        """Record a free-form auxiliary event (e.g. ``tracker_increment``)."""
+        with self._lock:
+            event: Dict[str, Any] = {"type": etype, "t": round(time.time() - self._t0, 6)}
+            event.update(fields)
+            self._append(event)
+
+    # ------------------------------------------------------------------
+    # compute-group attribution (MetricCollection)
+    # ------------------------------------------------------------------
+    def group_attribution(self, members: List[str]) -> "_GroupContext":
+        """Context manager: lifecycle events recorded inside are annotated
+        with the compute-group members sharing the leader's update, so group
+        updates are attributed once instead of double-counted per member."""
+        return _GroupContext(self, tuple(members))
+
+    # ------------------------------------------------------------------
+    # exporters (delegating to metrics_tpu.observability.exporters)
+    # ------------------------------------------------------------------
+    def export_jsonl(self, path: str, append: bool = False) -> Optional[str]:
+        from metrics_tpu.observability.exporters import export_jsonl
+
+        return export_jsonl(path, recorder=self, append=append)
+
+    def render_prometheus(self) -> str:
+        from metrics_tpu.observability.exporters import render_prometheus
+
+        return render_prometheus(recorder=self)
+
+    def summary(self) -> str:
+        from metrics_tpu.observability.exporters import summary
+
+        return summary(recorder=self)
+
+
+class _GroupContext:
+    def __init__(self, recorder: MetricRecorder, members: Tuple[str, ...]) -> None:
+        self._recorder = recorder
+        self._members = members
+        self._prev: Optional[Tuple[str, ...]] = None
+
+    def __enter__(self) -> "_GroupContext":
+        local = self._recorder._group_local
+        self._prev = getattr(local, "group", None)
+        local.group = self._members
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._recorder._group_local.group = self._prev
+
+
+#: THE process-local default recorder — the instance the runtime hot paths
+#: (core/metric.py, collections.py, parallel/distributed.py,
+#: wrappers/tracker.py) check. Import the OBJECT, never copy its ``enabled``
+#: flag.
+_DEFAULT_RECORDER = MetricRecorder("default")

@@ -155,10 +155,22 @@ def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 <<
     out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype, jnp.float32)
     if not jnp.issubdtype(out_dtype, jnp.floating):
         out_dtype = jnp.float32
-    if on_tpu and boxes1.ndim == 2 and boxes2.ndim == 2 and boxes1.shape[0] * boxes2.shape[0] >= min_elems:
+    # the Pallas kernels compute in float32; under x64 a float64 result would
+    # silently lose precision vs the jnp fallback, so f64 problems (both the
+    # 2-D and batched shapes) always take the fallback — values AND dtype are
+    # dispatch-invariant
+    pallas_ok = out_dtype != jnp.float64
+    if (
+        on_tpu
+        and pallas_ok
+        and boxes1.ndim == 2
+        and boxes2.ndim == 2
+        and boxes1.shape[0] * boxes2.shape[0] >= min_elems
+    ):
         return box_iou_tiled(boxes1, boxes2).astype(out_dtype)
     if (
         on_tpu
+        and pallas_ok
         and boxes1.ndim == 3
         and boxes2.ndim == 3
         and boxes1.shape[0] == boxes2.shape[0]

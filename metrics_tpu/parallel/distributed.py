@@ -28,7 +28,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
+from metrics_tpu.observability.recorder import _nbytes
+
 Array = jax.Array
+
+# suppresses double counting while sync_in_mesh (which records its own
+# aggregate sync event) calls all_gather_replicated internally; per-thread
+# so concurrent traces can neither cross-suppress nor leak events
+import threading as _threading
+
+_MESH_SYNC_LOCAL = _threading.local()
 
 
 def distributed_available() -> bool:
@@ -78,8 +88,16 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     if not distributed_available():
         return [result]
 
+    world = world_size(group)
+    itemsize = jnp.dtype(result.dtype).itemsize
+
     if result.ndim == 0:
-        return _process_allgather(result)
+        gathered = _process_allgather(result)
+        if _TELEMETRY.enabled:
+            _TELEMETRY.record_sync(
+                "gather_all_arrays", gather_bytes=itemsize * world, world_size=world
+            )
+        return gathered
 
     # exchange shapes host-side, pad to elementwise max, gather, trim
     local_shape = np.asarray(result.shape, dtype=np.int64)
@@ -88,11 +106,29 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     max_shape = np.max(np.stack(all_shapes), axis=0)
 
     if all((s == all_shapes[0]).all() for s in all_shapes):
-        return _process_allgather(result)
+        gathered = _process_allgather(result)
+        if _TELEMETRY.enabled:
+            _TELEMETRY.record_sync(
+                "gather_all_arrays",
+                gather_bytes=int(result.size) * itemsize * world,
+                world_size=world,
+            )
+        return gathered
 
     pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
     padded = jnp.pad(result, pad_width)
     gathered = _process_allgather(padded)
+    if _TELEMETRY.enabled:
+        # the uneven contract moves world_size pad-to-max slabs; the padding
+        # beyond each rank's true shape is pure waste the accounting exposes
+        moved = int(padded.size) * itemsize * world
+        true_bytes = int(sum(int(np.prod(s)) for s in all_shapes)) * itemsize
+        _TELEMETRY.record_sync(
+            "gather_all_arrays",
+            gather_bytes=moved,
+            world_size=world,
+            pad_waste_bytes=moved - true_bytes,
+        )
     return [g[tuple(slice(0, int(d)) for d in shp)] for g, shp in zip(gathered, all_shapes)]
 
 
@@ -117,6 +153,16 @@ def all_gather_replicated(x: Array, axis_name: str, tiled: bool = True) -> Array
     """
     x = jnp.asarray(x)
     n = _axis_size(axis_name)
+    if _TELEMETRY.enabled and not getattr(_MESH_SYNC_LOCAL, "active", False):
+        # recorded at TRACE time (once per compilation, not per step): the
+        # shapes are static so the byte accounting is exact
+        _TELEMETRY.record_sync(
+            "all_gather_replicated",
+            gather_bytes=_nbytes(x) * n,
+            world_size=n,
+            axis=axis_name,
+            in_jit=True,
+        )
     idx = jax.lax.axis_index(axis_name)
     work_dtype = jnp.int32 if x.dtype == jnp.bool_ else x.dtype
     buf = jnp.zeros((n,) + x.shape, work_dtype).at[idx].set(x.astype(work_dtype))
@@ -138,31 +184,62 @@ def sync_in_mesh(
     ``"sum"/"mean"/"max"/"min"`` states use the matching XLA all-reduce;
     ``"cat"`` (and list) states use a tiled ``all_gather``. Use inside
     ``shard_map``/``pmap`` bodies where ``axis_name`` is bound.
+
+    With telemetry enabled, one ``sync`` event per TRACE (shapes are static,
+    so once per compilation — not per executed step) records the per-state
+    and total gather bytes over the mesh axis: gathered states count
+    ``world_size`` shards, all-reduced states one payload.
     """
-    out: Dict[str, Union[Array, list]] = {}
-    for name, value in state.items():
-        red = reductions.get(name)
-        if isinstance(value, list):
-            cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
-            out[name] = [all_gather_replicated(cat, axis_name, tiled=True)]
-            continue
-        if red is None:
-            # "gathered, not reduced" parity: stack per-rank values along a new dim 0
-            out[name] = all_gather_replicated(value, axis_name, tiled=False)
-        elif red == "sum":
-            out[name] = jax.lax.psum(value, axis_name)
-        elif red == "mean":
-            out[name] = jax.lax.pmean(value, axis_name)
-        elif red == "max":
-            out[name] = jax.lax.pmax(value, axis_name)
-        elif red == "min":
-            out[name] = jax.lax.pmin(value, axis_name)
-        elif red == "cat":
-            out[name] = all_gather_replicated(value, axis_name, tiled=True)
-        elif callable(red):
-            out[name] = red(all_gather_replicated(value, axis_name, tiled=False))
-        else:
-            raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
+    record = _TELEMETRY.enabled
+    per_state_bytes: Dict[str, int] = {}
+    if record:
+        world = _axis_size(axis_name)
+        for name, value in state.items():
+            red = reductions.get(name)
+            if isinstance(value, list):
+                nb = sum(_nbytes(v) for v in value)
+            else:
+                nb = _nbytes(value)
+            gathered = red == "cat" or red is None or callable(red) or isinstance(value, list)
+            per_state_bytes[name] = nb * world if gathered else nb
+        _MESH_SYNC_LOCAL.active = True
+    try:
+        out: Dict[str, Union[Array, list]] = {}
+        for name, value in state.items():
+            red = reductions.get(name)
+            if isinstance(value, list):
+                cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
+                out[name] = [all_gather_replicated(cat, axis_name, tiled=True)]
+                continue
+            if red is None:
+                # "gathered, not reduced" parity: stack per-rank values along a new dim 0
+                out[name] = all_gather_replicated(value, axis_name, tiled=False)
+            elif red == "sum":
+                out[name] = jax.lax.psum(value, axis_name)
+            elif red == "mean":
+                out[name] = jax.lax.pmean(value, axis_name)
+            elif red == "max":
+                out[name] = jax.lax.pmax(value, axis_name)
+            elif red == "min":
+                out[name] = jax.lax.pmin(value, axis_name)
+            elif red == "cat":
+                out[name] = all_gather_replicated(value, axis_name, tiled=True)
+            elif callable(red):
+                out[name] = red(all_gather_replicated(value, axis_name, tiled=False))
+            else:
+                raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
+    finally:
+        if record:
+            _MESH_SYNC_LOCAL.active = False
+    if record:
+        _TELEMETRY.record_sync(
+            "sync_in_mesh",
+            gather_bytes=sum(per_state_bytes.values()),
+            world_size=world,
+            axis=axis_name,
+            in_jit=True,
+            state_bytes=per_state_bytes,
+        )
     return out
 
 

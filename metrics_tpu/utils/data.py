@@ -161,7 +161,24 @@ def stable_sort_with_payloads(
     key negation (identical permutation to ``argsort(-key, stable=True)``),
     and bool payloads ride as int32 (lax.sort operand dtype restriction)
     and come back as bool. Returns ``(sorted_key, *sorted_payloads)``.
+
+    Dtype contract for ``descending=True``: the key must be floating or
+    signed-integer — negation is meaningless for unsigned keys (wraps
+    modulo 2**n) and raises here. Two data-dependent caveats negation
+    cannot guard statically: a signed-int key containing ``INT_MIN``
+    overflows (``-INT_MIN == INT_MIN``) and would sort first instead of
+    last, and ``-0.0``/``+0.0`` float keys swap relative to a true
+    descending comparator (they compare equal everywhere else, so only
+    sign-bit-sensitive consumers would notice).
     """
+    if descending and not (
+        jnp.issubdtype(key.dtype, jnp.floating) or jnp.issubdtype(key.dtype, jnp.signedinteger)
+    ):
+        raise ValueError(
+            "stable_sort_with_payloads(descending=True) requires a floating or"
+            f" signed-integer key (negation-based descending order); got dtype {key.dtype}."
+            " Cast unsigned/bool keys to a signed or floating dtype first."
+        )
     work_key = -key if descending else key
     is_bool = [p.dtype == jnp.bool_ for p in payloads]
     ops = (work_key,) + tuple(

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.collections import MetricCollection
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
@@ -52,6 +53,25 @@ class MetricTracker:
         self._increment_called = True
         self._steps.append(deepcopy(self._base_metric))
         self._steps[-1].reset()
+        if _TELEMETRY.enabled:
+            # every increment deep-copies the base metric and KEEPS the old
+            # step — the tracker is a per-step memory multiplier, so the
+            # event stream carries the running total
+            _TELEMETRY.record_event(
+                "tracker_increment",
+                n_steps=len(self._steps),
+                total_state_bytes=self.total_state_bytes(),
+            )
+
+    def state_footprint(self) -> Dict[str, Any]:
+        """Per-step state footprints (``step0`` ... ``stepN`` keys), one
+        entry per retained snapshot — the tracker holds EVERY step's states
+        alive, which is the growth this exposes."""
+        return {f"step{i}": m.state_footprint() for i, m in enumerate(self._steps)}
+
+    def total_state_bytes(self) -> int:
+        """Total bytes held across all retained steps."""
+        return sum(m.total_state_bytes() for m in self._steps)
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         self._check_for_increment("forward")

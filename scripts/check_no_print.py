@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Fail if any ``metrics_tpu/`` module calls ``print()`` directly.
+
+All user-facing output from library code must route through the rank-zero
+helpers in ``metrics_tpu/utils/prints.py`` (``rank_zero_print`` /
+``rank_zero_info`` / ``rank_zero_warn``) so multi-host jobs emit one copy
+and logging stays filterable. A raw ``print()`` in library code spams every
+process in a pod job.
+
+AST-based: only real ``print(...)`` call sites count — doctest examples and
+other string content never false-positive. Exit status 0 when clean, 1 with
+a ``path:line`` listing otherwise. Run from anywhere:
+
+    python scripts/check_no_print.py
+"""
+import ast
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "metrics_tpu"
+
+# the one module allowed to touch print: it defines the gated helpers
+ALLOWED = {PACKAGE / "utils" / "prints.py"}
+
+
+def print_call_lines(path: pathlib.Path):
+    """Line numbers of every ``print(...)`` call expression in ``path``."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    return [
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ]
+
+
+def main() -> int:
+    offenders = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path in ALLOWED:
+            continue
+        for lineno in print_call_lines(path):
+            offenders.append(f"{path.relative_to(REPO_ROOT)}:{lineno}")
+    if offenders:
+        sys.stderr.write(
+            "raw print() calls found in metrics_tpu/ — use the rank-zero helpers"
+            " from metrics_tpu/utils/prints.py instead:\n"
+        )
+        for offender in offenders:
+            sys.stderr.write(f"  {offender}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

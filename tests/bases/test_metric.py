@@ -476,3 +476,118 @@ def test_child_metric_state_dict_prefixing():
     np.testing.assert_allclose(
         float(restored.compute()["raw"]), float(wrapped.compute()["raw"]), atol=1e-6
     )
+
+
+def test_load_state_dict_pre_counter_checkpoint_uses_unweighted_merge():
+    """Restoring an old (pre-counter) checkpoint must not leave _n_updates
+    at 0: a 0 weights that side's accumulated mean to ZERO in merge_states,
+    silently discarding its data (ADVICE round 5 medium). load_state_dict
+    sets the sentinel -1, merges fall back to the unweighted mean, and the
+    sentinel survives bumps, snapshots, and chained merges."""
+
+    class MeanStateMetric(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("m", jnp.array(0.0), dist_reduce_fx="mean")
+
+        def _update(self, x):
+            self.m = jnp.asarray(x, dtype=jnp.float32)
+
+        def _compute(self):
+            return self.m
+
+    # an old checkpoint: real state present, no _n_updates key
+    old_ckpt = {"m": jnp.array(6.0)}
+    restored = MeanStateMetric()
+    restored.load_state_dict(old_ckpt)
+    assert int(getattr(restored, "_n_updates")) == -1
+
+    # its re-snapshot carries the sentinel, and merging with a counted side
+    # (2 updates) gives the unweighted mean — NOT the 0-weighted 1.0 the
+    # stale counter produced before the fix, and not (2*2+0*6)/2 either
+    snap = restored.state_dict()
+    assert int(snap["_n_updates"]) == -1
+    counted = MeanStateMetric()
+    s = counted.update_state(counted.init_state(), 2.0)
+    s = counted.update_state(s, 2.0)
+    merged = restored.merge_states(dict(snap), s)
+    assert float(merged["m"]) == pytest.approx((6.0 + 2.0) / 2)
+    assert int(merged["_n_updates"]) == -1  # uncertainty propagates
+
+    # updates after the restore keep the sentinel (a rebuilt small count
+    # would miss the restored history and be trusted as a wrong weight)
+    restored.update(4.0)
+    assert int(getattr(restored, "_n_updates")) == -1
+
+    # counter PRESENT in the checkpoint: weighted merge still works
+    good = MeanStateMetric()
+    g = good.update_state(good.init_state(), 8.0)
+    merged2 = good.merge_states(g, s)  # 1 update of 8.0 vs 2 updates of 2.0
+    assert float(merged2["m"]) == pytest.approx((8.0 + 2 * 2.0) / 3)
+
+    # a checkpoint with NO real states restored leaves the counter alone
+    fresh = MeanStateMetric()
+    fresh.load_state_dict({})
+    assert int(getattr(fresh, "_n_updates")) == 0
+
+
+def test_auto_counter_sentinel_survives_distributed_sum():
+    """The -1 'history unknown' sentinel must survive cross-rank counter
+    reductions — a plain sum would launder it into a confident positive
+    count that merge_states then trusts as a weight. Covers both the
+    host-level gather-reduce (_sync_dist) and the in-mesh callable-reducer
+    path (sync_in_mesh via state_reductions)."""
+    from metrics_tpu.core.metric import _sentinel_count_sum
+
+    assert int(_sentinel_count_sum(jnp.asarray([3, 4], jnp.int32))) == 7
+    assert int(_sentinel_count_sum(jnp.asarray([-1, 5], jnp.int32))) == -1
+
+    class MeanStateMetric(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("m", jnp.array(0.0), dist_reduce_fx="mean")
+
+        def _update(self, x):
+            self.m = jnp.asarray(x, dtype=jnp.float32)
+
+        def _compute(self):
+            return self.m
+
+    # host-level: this rank restored a pre-counter checkpoint (sentinel -1),
+    # the simulated peer rank has 5 counted updates
+    m = MeanStateMetric()
+    m.load_state_dict({"m": jnp.asarray(6.0)})
+
+    def fake_gather(x, group=None):
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return [x, jnp.asarray(5, jnp.int32)]
+        return [x, jnp.asarray(2.0)]
+
+    m.sync(dist_sync_fn=fake_gather)
+    assert float(getattr(m, "m")) == pytest.approx(4.0)  # stack-then-mean
+    assert int(getattr(m, "_n_updates")) == -1  # NOT 4
+    m.unsync()
+
+    # in-mesh: the counter's reducer rides sync_in_mesh's callable branch
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_in_mesh
+
+    import numpy as _np
+
+    mesh = Mesh(_np.array(jax.devices()[:2]), ("r",))
+    counters = jnp.asarray([-1, 5], jnp.int32)
+    means = jnp.asarray([6.0, 2.0], jnp.float32)
+
+    def body(c, v):
+        s = sync_in_mesh({"m": v[0], "_n_updates": c[0]}, m.state_reductions(), "r")
+        return jnp.stack([s["m"], s["_n_updates"].astype(jnp.float32)])[None]
+
+    out = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(P("r"), P("r")), out_specs=P("r"))
+    )(counters, means)
+    _np.testing.assert_allclose(_np.asarray(out), [[4.0, -1.0], [4.0, -1.0]])

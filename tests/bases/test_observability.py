@@ -1,0 +1,243 @@
+"""Telemetry subsystem tests: recorder on/off invariants, recompile-signature
+warnings, mesh sync byte accounting, state footprints, and exporter round
+trips (ISSUE 1 tentpole)."""
+import json
+import subprocess
+import sys
+import warnings
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MetricCollection, Precision, Recall
+from metrics_tpu.aggregation import MeanMetric, SumMetric
+from metrics_tpu.classification import ROC
+from metrics_tpu.observability import (
+    export_jsonl,
+    get_recorder,
+    render_prometheus,
+    summary,
+    telemetry_enabled,
+)
+from metrics_tpu.wrappers import MetricTracker
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+@pytest.fixture
+def recorder():
+    """The default recorder, enabled for one test and ALWAYS disabled+reset
+    after — the session-level conftest asserts nothing leaks."""
+    rec = get_recorder()
+    rec.reset()
+    rec.enable(recompile_threshold=rec.DEFAULT_RECOMPILE_THRESHOLD, footprint_warn_bytes=None)
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.footprint_warn_bytes = None
+        rec.recompile_threshold = rec.DEFAULT_RECOMPILE_THRESHOLD
+        rec.reset()
+
+
+def test_disabled_by_default_and_zero_event_invariant():
+    """The on/off overhead invariant's observable half: with the recorder
+    disabled (the default), NO events, counts, or signatures accumulate no
+    matter how much metric traffic runs — the hot path allocates nothing."""
+    rec = get_recorder()
+    assert not rec.enabled
+    assert not telemetry_enabled()
+    m = MeanMetric()
+    for i in range(1, 20):
+        m.update(jnp.ones((i,)))  # shape-varying: would trip every subsystem
+    float(m.compute())
+    m2 = SumMetric()
+    m2(jnp.asarray(2.0))  # forward path
+    assert rec.events() == []
+    assert rec.call_counts() == {}
+    assert rec.signature_counts() == {}
+    assert rec.sync_totals() == {"sync_events": 0, "gather_bytes": 0, "pad_waste_bytes": 0}
+
+
+def test_enabled_records_typed_lifecycle_events(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    float(m.compute())
+    m(jnp.ones((4,)))  # forward: own event + its double update's events
+    types = [e["type"] for e in recorder.events()]
+    assert "update" in types and "compute" in types and "forward" in types
+    update_events = [e for e in recorder.events() if e["type"] == "update"]
+    assert update_events[0]["metric"] == "MeanMetric"
+    assert update_events[0]["dur_ms"] >= 0
+    assert update_events[0]["signature"] == [[[4], "float32"]]
+    counts = recorder.call_counts()
+    assert counts[("MeanMetric", "update")] == 3  # 1 direct + forward's double update
+    assert counts[("MeanMetric", "forward")] == 1
+
+
+def test_recompile_signature_warning_fires_exactly_once(recorder):
+    """A shape-varying update loop (the unpadded-batch recompile bug) must
+    warn exactly once per entry point when crossing the threshold."""
+    recorder.recompile_threshold = 3
+    m = MeanMetric()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for n in range(1, 10):  # 9 distinct (shape, dtype) signatures
+            m.update(jnp.ones((n,)))
+    recompile_warnings = [w for w in caught if "distinct (shape, dtype)" in str(w.message)]
+    assert len(recompile_warnings) == 1
+    assert "MeanMetric.update" in str(recompile_warnings[0].message)
+    assert recorder.signature_counts()["MeanMetric.update"] == 9
+    events = [e for e in recorder.events() if e["type"] == "recompile_warning"]
+    assert len(events) == 1
+    assert events[0]["distinct_signatures"] == 4  # fired when crossing 3
+    # a stable-shape loop on another metric must NOT warn
+    m2 = SumMetric()
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        for _ in range(20):
+            m2.update(jnp.ones((4,)))
+    assert not [w for w in caught2 if "distinct (shape, dtype)" in str(w.message)]
+
+
+def test_sync_byte_accounting_on_mesh(recorder):
+    """sync_in_mesh on the 8-virtual-device mesh records exact gather bytes:
+    cat states count world_size shards, reduced states one payload."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_in_mesh
+
+    n_dev = 8
+    per_dev = 16
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("rank",))
+    xs = jnp.arange(n_dev * per_dev, dtype=jnp.float32)
+
+    def body(x):
+        synced = sync_in_mesh({"v": x, "s": jnp.sum(x)}, {"v": "cat", "s": "sum"}, "rank")
+        return jnp.sum(synced["v"]) + synced["s"]
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("rank"),), out_specs=P()))
+    expected = float(np.sum(np.arange(n_dev * per_dev)) * 2)
+    assert float(fn(xs)) == pytest.approx(expected)
+    float(fn(xs))  # second execution: cached compile, no second trace event
+
+    sync_events = [e for e in recorder.events() if e["type"] == "sync"]
+    assert len(sync_events) == 1  # one per TRACE, not per step
+    ev = sync_events[0]
+    assert ev["source"] == "sync_in_mesh"
+    assert ev["world_size"] == n_dev
+    assert ev["axis"] == "rank"
+    # v: 16 f32 per device gathered from 8 ranks; s: one f32 all-reduced
+    assert ev["state_bytes"] == {"v": per_dev * 4 * n_dev, "s": 4}
+    assert ev["gather_bytes"] == per_dev * 4 * n_dev + 4
+    totals = recorder.sync_totals()
+    assert totals["gather_bytes"] == ev["gather_bytes"]
+    assert totals["sync_events"] == 1
+
+
+def test_state_footprint_growth_and_high_water_warning(recorder):
+    """Cat-state curve metrics grow per update; state_footprint sees it and
+    the opt-in high-water mark warns once."""
+    roc = ROC()
+    fp0 = sum(roc.state_footprint().values())
+    roc.update(jnp.asarray([0.2, 0.8, 0.5]), jnp.asarray([0, 1, 1]))
+    fp1 = sum(roc.state_footprint().values())
+    roc.update(jnp.asarray([0.3, 0.9]), jnp.asarray([1, 0]))
+    fp2 = sum(roc.state_footprint().values())
+    assert fp0 < fp1 < fp2
+    assert roc.total_state_bytes() == fp2
+    per_state = roc.state_footprint()
+    assert per_state["preds"] == per_state["target"] > 0
+
+    recorder.footprint_warn_bytes = 1  # opt in: any growth crosses it
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        roc.update(jnp.asarray([0.1]), jnp.asarray([1]))
+        roc.update(jnp.asarray([0.7]), jnp.asarray([0]))
+    hwm_warnings = [w for w in caught if "state footprint" in str(w.message)]
+    assert len(hwm_warnings) == 1  # once per metric, not per update
+    assert recorder.footprint_high_water_marks()["ROC"] >= fp2
+
+
+def test_collection_footprint_and_group_attribution(recorder):
+    """Compute-group members share state: the dedup total counts leaders
+    once, and leader update events carry the group attribution."""
+    col = MetricCollection(
+        [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+    )
+    preds = jnp.asarray([2, 1, 2, 0])
+    target = jnp.asarray([0, 2, 0, 2])
+    col.update(preds, target)  # discovery pass: both metrics update
+    assert len(col.compute_groups) == 1  # Precision/Recall share tp/fp/tn/fn
+    naive = sum(sum(fp.values()) for fp in col.state_footprint().values())
+    assert col.total_state_bytes() * 2 == naive  # leader counted once
+
+    col.update(preds, target)  # grouped pass: leader only, attributed
+    grouped = [e for e in recorder.events() if e.get("compute_group")]
+    assert len(grouped) == 1
+    assert sorted(grouped[0]["compute_group"]) == ["Precision", "Recall"]
+
+
+def test_tracker_increment_events_and_footprint(recorder):
+    tracker = MetricTracker(SumMetric())
+    for epoch in range(3):
+        tracker.increment()
+        tracker.update(jnp.asarray(float(epoch)))
+    incs = [e for e in recorder.events() if e["type"] == "tracker_increment"]
+    assert [e["n_steps"] for e in incs] == [1, 2, 3]
+    assert tracker.total_state_bytes() == sum(
+        sum(fp.values()) for fp in tracker.state_footprint().values()
+    )
+    assert set(tracker.state_footprint()) == {"step0", "step1", "step2"}
+
+
+def test_jsonl_round_trip_and_text_exporters(tmp_path, recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    float(m.compute())
+    recorder.record_sync("gather_all_arrays", gather_bytes=1024, world_size=4, pad_waste_bytes=128)
+
+    path = tmp_path / "telemetry.jsonl"
+    assert export_jsonl(str(path), recorder) == str(path)
+    lines = path.read_text().splitlines()
+    events = [json.loads(line) for line in lines]  # every line round-trips
+    assert len(events) == len(recorder.events())
+    assert {"update", "compute", "sync"} <= {e["type"] for e in events}
+    sync = [e for e in events if e["type"] == "sync"][0]
+    assert sync["gather_bytes"] == 1024 and sync["pad_waste_bytes"] == 128
+
+    # append mode (the subprocess artifact contract) extends, not truncates
+    export_jsonl(str(path), recorder, append=True)
+    assert len(path.read_text().splitlines()) == 2 * len(lines)
+
+    prom = render_prometheus(recorder)
+    assert 'metrics_tpu_calls_total{metric="MeanMetric",phase="update"} 1' in prom
+    assert "metrics_tpu_gather_bytes_total 1024" in prom
+    assert "metrics_tpu_pad_waste_bytes_total 128" in prom
+
+    text = summary(recorder)
+    assert "MeanMetric" in text and "1024 gather bytes" in text
+
+
+def test_named_recorders_are_independent(recorder):
+    other = get_recorder("side-channel")
+    assert other is not recorder
+    assert not other.enabled  # enabling the default does not enable others
+    assert get_recorder("side-channel") is other
+
+
+def test_no_raw_print_in_package():
+    """CI guard: library code must use the rank-zero print helpers."""
+    result = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "check_no_print.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr

@@ -57,3 +57,22 @@ def test_multiple_payloads_and_inf_padding():
     np.testing.assert_array_equal(np.asarray(sb), [True, True, True, False, False])
     assert np.asarray(sk)[0] == pytest.approx(0.9)
     assert np.isneginf(np.asarray(sk)[-1])
+
+
+def test_descending_rejects_unsigned_and_bool_keys():
+    """Negation-based descending order is undefined for unsigned keys (wraps
+    modulo 2**n); the dtype guard must reject them up front instead of
+    silently mis-sorting (ADVICE round 5)."""
+    payload = jnp.arange(4)
+    for bad in (jnp.asarray([1, 2, 3, 0], jnp.uint32), jnp.asarray([True, False, True, False])):
+        with pytest.raises(ValueError, match="signed-integer"):
+            stable_sort_with_payloads(bad, payload, descending=True)
+    # ascending keeps accepting any sortable dtype
+    sk, _ = stable_sort_with_payloads(jnp.asarray([3, 1, 2], jnp.uint32), jnp.arange(3))
+    np.testing.assert_array_equal(np.asarray(sk), [1, 2, 3])
+    # signed ints (sans INT_MIN, per the documented contract) stay supported
+    sk, sp = stable_sort_with_payloads(
+        jnp.asarray([3, -5, 2], jnp.int32), jnp.arange(3), descending=True
+    )
+    np.testing.assert_array_equal(np.asarray(sk), [3, 2, -5])
+    np.testing.assert_array_equal(np.asarray(sp), [0, 2, 1])

@@ -12,3 +12,30 @@ import jax  # noqa: E402
 
 if not os.environ.get("METRICS_TPU_TEST_ON_TPU"):
     assert jax.device_count() >= 8, f"expected >=8 virtual devices, got {jax.device_count()}"
+
+# telemetry hermeticity: a METRICS_TPU_TELEMETRY in the inherited environment
+# would auto-enable collection and write artifacts from library code under
+# test — strip it so tier-1 always exercises the disabled-by-default path
+os.environ.pop("METRICS_TPU_TELEMETRY", None)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 invariant: telemetry stays DISABLED by default.
+
+    No test may leave the default recorder enabled (the observability tests
+    enable it inside try/finally fixtures), and no default JSONL artifact
+    may have appeared — both would mean library code was silently paying
+    telemetry costs, or writing files, during an ordinary test run.
+    """
+    from metrics_tpu.observability import get_recorder
+
+    assert not get_recorder().enabled, (
+        "the default MetricRecorder was left ENABLED after the test session —"
+        " telemetry must stay off by default (some test is missing its"
+        " disable/reset teardown)"
+    )
+    for stray in ("telemetry.jsonl", "BENCH_telemetry.jsonl"):
+        assert not os.path.exists(stray), (
+            f"a telemetry artifact ({stray}) appeared during the test run —"
+            " telemetry must not write files unless explicitly enabled"
+        )

@@ -108,3 +108,28 @@ def test_compiled_batched_on_tpu():
     np.testing.assert_allclose(
         via_dispatch, np.asarray(box_iou(big1, big2)), atol=1e-5
     )
+
+
+def test_dispatch_routes_float64_to_jnp_fallback(monkeypatch):
+    """Under x64, float64 boxes must take the jnp fallback on BOTH dispatch
+    shapes — the Pallas kernels compute in f32 and would silently downgrade
+    precision (ADVICE round 5). The fake-TPU backend proves the routing: if
+    the f64 guard were missing, the dispatch would attempt a real TPU
+    pallas_call on CPU and crash."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.default_rng(7)
+        b1 = jnp.asarray(_boxes(rng, 16), jnp.float64)
+        b2 = jnp.asarray(_boxes(rng, 8), jnp.float64)
+        got = box_iou_dispatch(b1, b2, min_elems=1)  # 2-D path, above threshold
+        assert got.dtype == jnp.float64
+        np.testing.assert_allclose(np.asarray(got), np.asarray(box_iou(b1, b2)))
+
+        bb1 = jnp.asarray(_batched_boxes(rng, 4, 16), jnp.float64)
+        bb2 = jnp.asarray(_batched_boxes(rng, 4, 64), jnp.float64)
+        got_b = box_iou_dispatch(bb1, bb2, min_elems=1)  # batched path
+        assert got_b.dtype == jnp.float64
+        np.testing.assert_allclose(np.asarray(got_b), np.asarray(box_iou(bb1, bb2)))
+    finally:
+        jax.config.update("jax_enable_x64", False)
