@@ -27,6 +27,42 @@ WARMUP = 3
 ITERS = 50
 
 
+#: env channel for the ``--cost-analysis`` flag: the full-emission driver
+#: runs each config in a subprocess, so the flag must survive the hop
+COST_ENV_VAR = "METRICS_TPU_BENCH_COST"
+
+
+def _compiled_cost_payload(fn, *args, **kwargs):
+    """Compiler-estimated cost of a benched jitted entry point, for the
+    ``--cost-analysis`` flag: FLOPs / bytes accessed plus the
+    trace/lower/compile wall breakdown. Returns ``None`` when the flag is
+    off or the backend reports no estimate — the bench line then simply
+    carries no ``cost_analysis`` key (older artifacts stay comparable)."""
+    if not os.environ.get(COST_ENV_VAR):
+        return None
+    try:
+        from metrics_tpu.observability.profiling import compiled_cost
+
+        report = compiled_cost(fn, *args, **kwargs)
+        if report["flops"] is None and report["bytes_accessed"] is None:
+            return None
+        return {
+            "flops": report["flops"],
+            "bytes_accessed": report["bytes_accessed"],
+            "trace_s": report["trace_s"],
+            "lower_s": report["lower_s"],
+            "compile_s": report["compile_s"],
+        }
+    except Exception:
+        return None
+
+
+def _with_cost(record, cost):
+    if cost is not None:
+        record["cost_analysis"] = cost
+    return record
+
+
 def _make_data(n_batches=None):
     """Seed-42 softmax fixture; ``n_batches`` stacks independent batches
     (the TPU scan epoch) — one flat batch otherwise (the torch reference),
@@ -41,8 +77,9 @@ def _make_data(n_batches=None):
     return preds, target
 
 
-def bench_tpu() -> float:
-    """Samples/sec through a jitted AUROC+ConfusionMatrix epoch on device.
+def bench_tpu() -> tuple:
+    """(Samples/sec, cost payload or None) through a jitted
+    AUROC+ConfusionMatrix epoch on device.
 
     ITERS update+AUROC steps run inside ONE jitted lax.scan — the shape a
     real jitted TPU training loop has — so the measurement captures device
@@ -87,7 +124,8 @@ def bench_tpu() -> float:
     state, auc = epoch(confmat.init_state(), preds_all, target_all)
     float(auc)
     dt = time.perf_counter() - t0
-    return BATCH * ITERS / dt
+    cost = _compiled_cost_payload(epoch, confmat.init_state(), preds_all, target_all)
+    return BATCH * ITERS / dt, cost
 
 
 def _stub_pkg_resources() -> None:
@@ -333,6 +371,7 @@ def bench_image() -> None:
         v = fn(ja, jb)
     float(v)
     ours = n * iters / (time.perf_counter() - t0)
+    cost = _compiled_cost_payload(fn, ja, jb)
 
     ref_ips = None
     try:
@@ -352,12 +391,15 @@ def bench_image() -> None:
 
     print(
         json.dumps(
-            {
-                "metric": "ssim_update_compute_throughput",
-                "value": round(ours, 1),
-                "unit": "images/sec",
-                "vs_baseline": round(ours / ref_ips, 3) if ref_ips else None,
-            }
+            _with_cost(
+                {
+                    "metric": "ssim_update_compute_throughput",
+                    "value": round(ours, 1),
+                    "unit": "images/sec",
+                    "vs_baseline": round(ours / ref_ips, 3) if ref_ips else None,
+                },
+                cost,
+            )
         )
     )
 
@@ -458,6 +500,7 @@ def bench_sync() -> None:
     )
 
     args = (confmat, preds, target, valid, overflow)
+    cost = _compiled_cost_payload(fn, *args)
     float(fn(*args)[0])  # compile
     warmup, iters = 3, 50
     for _ in range(warmup):
@@ -496,15 +539,18 @@ def bench_sync() -> None:
 
     print(
         json.dumps(
-            {
-                "metric": "mesh_state_sync_latency_p50",
-                "value": round(p50, 3),
-                "unit": "ms",
-                "p95_ms": round(p95, 3),
-                "ranks": n_dev,
-                "ref_gloo_p50_ms": round(ref_p50, 3) if ref_p50 else None,
-                "vs_baseline": round(ref_p50 / p50, 3) if ref_p50 else None,
-            }
+            _with_cost(
+                {
+                    "metric": "mesh_state_sync_latency_p50",
+                    "value": round(p50, 3),
+                    "unit": "ms",
+                    "p95_ms": round(p95, 3),
+                    "ranks": n_dev,
+                    "ref_gloo_p50_ms": round(ref_p50, 3) if ref_p50 else None,
+                    "vs_baseline": round(ref_p50 / p50, 3) if ref_p50 else None,
+                },
+                cost,
+            )
         )
     )
 
@@ -550,6 +596,7 @@ def bench_inference() -> None:
     t0 = time.perf_counter()
     float(fid_epoch(variables, imgs))
     fid_ips = fb * fnb / (time.perf_counter() - t0)
+    fid_cost = _compiled_cost_payload(fid_epoch, variables, imgs)
 
     fid_ref_ips = None
     try:
@@ -576,12 +623,15 @@ def bench_inference() -> None:
 
     print(
         json.dumps(
-            {
-                "metric": "fid_inception_extractor_throughput",
-                "value": round(fid_ips, 1),
-                "unit": "images/sec",
-                "vs_baseline": round(fid_ips / fid_ref_ips, 3) if fid_ref_ips else None,
-            }
+            _with_cost(
+                {
+                    "metric": "fid_inception_extractor_throughput",
+                    "value": round(fid_ips, 1),
+                    "unit": "images/sec",
+                    "vs_baseline": round(fid_ips / fid_ref_ips, 3) if fid_ref_ips else None,
+                },
+                fid_cost,
+            )
         )
     )
 
@@ -615,6 +665,7 @@ def bench_inference() -> None:
     t0 = time.perf_counter()
     float(bert_epoch(params, ids, mask))
     bert_sps = sb * snb / (time.perf_counter() - t0)
+    bert_cost = _compiled_cost_payload(bert_epoch, params, ids, mask)
 
     bert_ref_sps = None
     try:
@@ -637,12 +688,15 @@ def bench_inference() -> None:
 
     print(
         json.dumps(
-            {
-                "metric": "bertscore_encoder_throughput",
-                "value": round(bert_sps, 1),
-                "unit": "sentences/sec",
-                "vs_baseline": round(bert_sps / bert_ref_sps, 3) if bert_ref_sps else None,
-            }
+            _with_cost(
+                {
+                    "metric": "bertscore_encoder_throughput",
+                    "value": round(bert_sps, 1),
+                    "unit": "sentences/sec",
+                    "vs_baseline": round(bert_sps / bert_ref_sps, 3) if bert_ref_sps else None,
+                },
+                bert_cost,
+            )
         )
     )
 
@@ -703,8 +757,48 @@ SUBCOMMANDS = {
 }
 
 
+def _check_against_baseline(records, baseline_path) -> None:
+    """The ``--baseline`` flag: diff this run's emitted records against a
+    committed bench artifact via scripts/check_cost_regression.py and emit
+    the verdict as one JSON line. Report-only here — the standalone script
+    is the exiting CI gate — so a perf regression cannot mask the bench
+    numbers themselves."""
+    import importlib.util
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts", "check_cost_regression.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_cost_regression", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    current = {r["metric"]: r for r in records if r.get("metric")}
+    regressions, _ = mod.compare(current, mod.load_records(baseline_path))
+    print(
+        json.dumps(
+            {
+                "metric": "cost_regression_check",
+                "ok": not regressions,
+                "baseline": baseline_path,
+                "regressions": regressions,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     argv = sys.argv[1:]
+    baseline_path = None
+    rest = []
+    for arg in argv:
+        if arg == "--cost-analysis":
+            # env channel: the per-config subprocesses must inherit the flag
+            os.environ[COST_ENV_VAR] = "1"
+        elif arg.startswith("--baseline="):
+            baseline_path = arg.split("=", 1)[1]
+        else:
+            rest.append(arg)
+    argv = rest
     has_flag = any(arg.split("=", 1)[0] == "--telemetry" for arg in argv)
     telemetry_active = has_flag or bool(os.environ.get("METRICS_TPU_TELEMETRY"))
     if telemetry_active:
@@ -716,6 +810,15 @@ def main() -> None:
         _, argv = activate_telemetry(argv, default_path="BENCH_telemetry.jsonl")
 
     if argv:
+        if baseline_path:
+            # the baseline diff needs the full emitted record set, which
+            # only the no-args full-emission run collects; silently
+            # skipping the check would let CI believe the gate ran
+            raise SystemExit(
+                "--baseline requires the full bench run (no subcommand);"
+                " for a single config, diff artifacts with"
+                " scripts/check_cost_regression.py directly"
+            )
         fn = SUBCOMMANDS.get(argv[0])
         if fn is None:
             raise SystemExit(f"unknown bench subcommand {argv[0]!r}; one of {sorted(SUBCOMMANDS)}")
@@ -732,6 +835,7 @@ def main() -> None:
     # a crash in one config must not take down the rest.
     import subprocess
 
+    records = []  # every emitted JSON object, for the --baseline check
     for name in ("map", "retrieval", "image", "inference", "sync", "telemetry"):
         try:
             out = subprocess.run(
@@ -745,6 +849,10 @@ def main() -> None:
                 if line.startswith("{"):
                     print(line, flush=True)
                     emitted += 1
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
             # a crashed or silent config must surface as an error line, not
             # silently vanish from the round record
             if out.returncode != 0 or not emitted:
@@ -760,7 +868,7 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001 — a failed config is reported, not fatal
             print(json.dumps({"metric": f"bench_{name}", "error": str(err)[:200]}), flush=True)
 
-    tpu_sps = bench_tpu()
+    tpu_sps, tpu_cost = bench_tpu()
     try:
         ref_sps = bench_reference()
     except Exception:
@@ -771,16 +879,26 @@ def main() -> None:
     if telemetry_active:
         maybe_export_env()
 
-    print(
-        json.dumps(
-            {
-                "metric": "imagenet1k_auroc_confmat_throughput",
-                "value": round(tpu_sps, 1),
-                "unit": "samples/sec",
-                "vs_baseline": round(tpu_sps / ref_sps, 3) if ref_sps else None,
-            }
-        )
+    headline = _with_cost(
+        {
+            "metric": "imagenet1k_auroc_confmat_throughput",
+            "value": round(tpu_sps, 1),
+            "unit": "samples/sec",
+            "vs_baseline": round(tpu_sps / ref_sps, 3) if ref_sps else None,
+        },
+        tpu_cost,
     )
+    records.append(headline)
+
+    # the regression verdict prints BEFORE the headline: the driver parses
+    # the final stdout line as the headline metric
+    if baseline_path:
+        try:
+            _check_against_baseline(records, baseline_path)
+        except Exception as err:  # noqa: BLE001 — a broken baseline must not kill the bench
+            print(json.dumps({"metric": "cost_regression_check", "error": str(err)[:200]}), flush=True)
+
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -10,7 +10,6 @@ tracing imputes via ``where`` with the aggregator's identity element
 ``MeanMetric`` filters value and weight jointly (the reference filters
 them independently, which desyncs their shapes).
 """
-import warnings
 from typing import Any, Callable, List, Union
 
 import jax
@@ -18,6 +17,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.utils.checks import _is_concrete
+from metrics_tpu.utils.prints import rank_zero_warn as _rank_zero_warn
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
@@ -63,7 +63,7 @@ class BaseAggregator(Metric):
                 if self.nan_strategy == "error":
                     raise RuntimeError("Encounted `nan` values in tensor")
                 if self.nan_strategy == "warn":
-                    warnings.warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+                    _rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
                     x = x[~nans]
                 elif self.nan_strategy == "ignore":
                     x = x[~nans]
@@ -182,7 +182,7 @@ class MeanMetric(BaseAggregator):
                 if self.nan_strategy == "error":
                     raise RuntimeError("Encounted `nan` values in tensor")
                 if self.nan_strategy == "warn":
-                    warnings.warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+                    _rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
                     value, weight = value[~nans], weight[~nans]
                 elif self.nan_strategy == "ignore":
                     value, weight = value[~nans], weight[~nans]

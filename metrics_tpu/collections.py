@@ -20,6 +20,7 @@ import numpy as np
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
+from metrics_tpu.observability.trace import span as _span
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -103,6 +104,12 @@ class MetricCollection:
     # ------------------------------------------------------------------
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward for each metric; kwargs are filtered per metric."""
+        if not _TELEMETRY.enabled:
+            return self._forward_impl(*args, **kwargs)
+        with _span("MetricCollection.forward", n_metrics=len(self._metrics)):
+            return self._forward_impl(*args, **kwargs)
+
+    def _forward_impl(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
@@ -112,6 +119,15 @@ class MetricCollection:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Call update for each metric (only group leaders once groups are known)."""
+        if not _TELEMETRY.enabled:
+            self._update_impl(*args, **kwargs)
+            return
+        # the collection span parents every member metric's own span, so the
+        # per-metric rows nest instead of reading as unrelated siblings
+        with _span("MetricCollection.update", n_metrics=len(self._metrics)):
+            self._update_impl(*args, **kwargs)
+
+    def _update_impl(self, *args: Any, **kwargs: Any) -> None:
         if self._groups_checked:
             for cg in self._groups.values():
                 m0 = self._metrics[cg[0]]
@@ -240,6 +256,12 @@ class MetricCollection:
 
     def compute(self) -> Dict[str, Any]:
         """Compute each metric; group members borrow the leader's state."""
+        if not _TELEMETRY.enabled:
+            return self._compute_impl()
+        with _span("MetricCollection.compute", n_metrics=len(self._metrics)):
+            return self._compute_impl()
+
+    def _compute_impl(self) -> Dict[str, Any]:
         if self._enable_compute_groups and self._groups_checked:
             for cg in self._groups.values():
                 m0 = self._metrics[cg[0]]

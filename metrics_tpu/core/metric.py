@@ -51,6 +51,7 @@ from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import _nbytes
+from metrics_tpu.observability.trace import span as _span
 from metrics_tpu.parallel.distributed import distributed_available as _dist_available
 from metrics_tpu.parallel.distributed import gather_all_arrays
 from metrics_tpu.parallel.distributed import world_size as _world_size
@@ -271,10 +272,26 @@ class Metric(ABC):
     #: the reference has no tracing; this is a new opt-in capability)
     enable_profiling: bool = False
 
-    def _trace(self, phase: str):
+    def _profiler_annotation(self, phase: str):
         if self.enable_profiling:
             return jax.profiler.TraceAnnotation(f"{self.__class__.__name__}.{phase}")
         return contextlib.nullcontext()
+
+    def _trace_annotation(self, phase: str):
+        """The per-phase tracing context: a ``jax.profiler.TraceAnnotation``
+        when ``enable_profiling`` is set (device profiles), a telemetry
+        :func:`~metrics_tpu.observability.span` when the recorder is enabled
+        (host-side nesting), both when both are on, and a no-op otherwise."""
+        prof = self._profiler_annotation(phase) if self.enable_profiling else None
+        if _TELEMETRY.enabled:
+            sp = _span(f"{self.__class__.__name__}.{phase}")
+            if prof is None:
+                return sp
+            stack = contextlib.ExitStack()
+            stack.enter_context(prof)
+            stack.enter_context(sp)
+            return stack
+        return prof if prof is not None else contextlib.nullcontext()
 
     def _bump_auto_count(self) -> None:
         """Increment the auto-registered mean-merge update counter (a no-op
@@ -300,15 +317,29 @@ class Metric(ABC):
         self._computed = None
         self._update_called = True
         if not _TELEMETRY.enabled:  # disabled telemetry costs this ONE check
-            with self._trace("update"):
+            with self._trace_annotation("update"):
                 self._update(*_coerce_foreign(args), **_coerce_foreign(kwargs))
             self._bump_auto_count()
             return
         t0 = time.perf_counter()
-        with self._trace("update"):
-            self._update(*_coerce_foreign(args), **_coerce_foreign(kwargs))
-        self._bump_auto_count()
-        _TELEMETRY.record_call("update", self, time.perf_counter() - t0, args, kwargs)
+        coerced_args = _coerce_foreign(args)
+        coerced_kwargs = _coerce_foreign(kwargs)
+        with self._trace_annotation("update"):  # annotation + telemetry span
+            self._update(*coerced_args, **coerced_kwargs)
+            self._bump_auto_count()
+            # recorded INSIDE the span so the update event carries its id
+            is_new_sig = _TELEMETRY.record_call(
+                "update", self, time.perf_counter() - t0, args, kwargs
+            )
+        if is_new_sig and _TELEMETRY.profile_compiles:
+            # a NEW signature at this entry point = an XLA recompile of the
+            # metric's jitted kernels; bill it via the compiler's own cost
+            # analysis (observability/profiling.py) — opt-in, cold path only.
+            # The COERCED arguments are billed: jax cannot trace raw torch
+            # tensors, and they are what the jitted kernels actually see
+            from metrics_tpu.observability.profiling import metric_compile_cost
+
+            metric_compile_cost(self, coerced_args, coerced_kwargs, phase="update")
         if _TELEMETRY.footprint_warn_bytes is not None:
             _TELEMETRY.record_footprint(self, self.state_footprint())
 
@@ -328,16 +359,24 @@ class Metric(ABC):
         # a duration measured against the 0.0 placeholder
         rec = _TELEMETRY if _TELEMETRY.enabled else None
         t0 = time.perf_counter() if rec is not None else 0.0
-        with self.sync_context(
-            dist_sync_fn=self.dist_sync_fn,
-            should_sync=self._to_sync,
-            should_unsync=self._should_unsync,
-        ):
-            with self._trace("compute"):
-                value = self._compute()
-            self._computed = _squeeze_if_scalar(value)
-        if rec is not None:
-            rec.record_call("compute", self, time.perf_counter() - t0)
+        # the compute span wraps the WHOLE cycle including the distributed
+        # sync, so `<Metric>.sync` (and its transport spans) nest under it
+        span_ctx = (
+            _span(f"{type(self).__name__}.compute")
+            if rec is not None
+            else contextlib.nullcontext()
+        )
+        with span_ctx:
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                with self._profiler_annotation("compute"):
+                    value = self._compute()
+                self._computed = _squeeze_if_scalar(value)
+            if rec is not None:
+                rec.record_call("compute", self, time.perf_counter() - t0)
         return self._computed
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
@@ -353,29 +392,37 @@ class Metric(ABC):
             )
         rec = _TELEMETRY if _TELEMETRY.enabled else None
         t0 = time.perf_counter() if rec is not None else 0.0
+        # the forward span contains both inner update spans and the batch
+        # compute span, so the double-update cycle nests under one parent
+        span_ctx = (
+            _span(f"{type(self).__name__}.forward")
+            if rec is not None
+            else contextlib.nullcontext()
+        )
+        with span_ctx:
+            self.update(*args, **kwargs)
 
-        self.update(*args, **kwargs)
+            self._to_sync = self.dist_sync_on_step
+            self._should_unsync = False
 
-        self._to_sync = self.dist_sync_on_step
-        self._should_unsync = False
+            cache = self._snapshot_state()
 
-        cache = self._snapshot_state()
+            self.reset()
+            self.update(*args, **kwargs)
+            self._forward_cache = self.compute()
 
-        self.reset()
-        self.update(*args, **kwargs)
-        self._forward_cache = self.compute()
+            self._restore_state(cache)
 
-        self._restore_state(cache)
+            self._should_unsync = True
+            self._to_sync = True
+            self._update_called = True
 
-        self._should_unsync = True
-        self._to_sync = True
-        self._update_called = True
-
-        if rec is not None:
-            # the forward event's duration covers the WHOLE double-update
-            # cycle; the two inner update events it contains are also in the
-            # stream, making the double-update overhead directly visible
-            rec.record_call("forward", self, time.perf_counter() - t0, args, kwargs)
+            if rec is not None:
+                # the forward event's duration covers the WHOLE double-update
+                # cycle; the two inner update events it contains are also in
+                # the stream, making the double-update overhead directly
+                # visible
+                rec.record_call("forward", self, time.perf_counter() - t0, args, kwargs)
         return self._forward_cache
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -454,20 +501,22 @@ class Metric(ABC):
             return
         t0 = time.perf_counter()
         state_bytes = sum(self.state_footprint(include_children=False).values())
-        self._sync_dist(dist_sync_fn, process_group=process_group)
-        self._is_synced = True
-        # lifecycle-level event: metric attribution + duration + LOCAL state
-        # bytes, under its OWN type tag — "sync" events are the transport's
-        # (gather_all_arrays / sync_in_mesh), which own the gather-byte and
-        # pad-waste accounting, so totals are never double-counted and
-        # type=="sync" consumers always find the gather_bytes schema
-        _TELEMETRY.record_event(
-            "metric_sync",
-            metric=type(self).__name__,
-            local_state_bytes=state_bytes,
-            world_size=_world_size(process_group or self.process_group),
-            dur_ms=round((time.perf_counter() - t0) * 1e3, 4),
-        )
+        with _span(f"{type(self).__name__}.sync"):
+            self._sync_dist(dist_sync_fn, process_group=process_group)
+            self._is_synced = True
+            # lifecycle-level event: metric attribution + duration + LOCAL
+            # state bytes, under its OWN type tag — "sync" events are the
+            # transport's (gather_all_arrays / sync_in_mesh), which own the
+            # gather-byte and pad-waste accounting, so totals are never
+            # double-counted and type=="sync" consumers always find the
+            # gather_bytes schema
+            _TELEMETRY.record_event(
+                "metric_sync",
+                metric=type(self).__name__,
+                local_state_bytes=state_bytes,
+                world_size=_world_size(process_group or self.process_group),
+                dur_ms=round((time.perf_counter() - t0) * 1e3, 4),
+            )
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local states. Parity with reference metric.py:365-385."""

@@ -4,7 +4,6 @@ Behavior parity with /root/reference/torchmetrics/functional/classification/
 auroc.py:27-277, including the weighted-average empty-class exclusion and the
 ``max_fpr`` partial-AUC McClish correction.
 """
-import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -13,6 +12,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.classification.auc import _auc_compute_without_check
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.utils.data import _bincount, stable_sort_with_payloads
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
@@ -81,7 +81,7 @@ def _auroc_compute(
                 class_observed = jnp.sum(target_bool_mat, axis=0) > 0
                 for c in range(num_classes):
                     if not bool(class_observed[c]):
-                        warnings.warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
+                        rank_zero_warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
                 preds = preds[:, class_observed]
                 target_bool_mat = target_bool_mat[:, class_observed]
                 target = jnp.nonzero(target_bool_mat)[1]

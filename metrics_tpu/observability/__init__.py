@@ -23,13 +23,23 @@ events to that one artifact across subprocesses. See docs/observability.md.
 import os
 from typing import Dict
 
-from metrics_tpu.observability.exporters import export_jsonl, render_prometheus, summary
+from metrics_tpu.observability.aggregate import aggregate_across_hosts, counter_payload, merge_payloads
+from metrics_tpu.observability.exporters import (
+    PeriodicExporter,
+    export_jsonl,
+    render_prometheus,
+    summary,
+    write_prometheus,
+)
+from metrics_tpu.observability.profiling import compiled_cost, metric_compile_cost
 from metrics_tpu.observability.recorder import (
     _DEFAULT_RECORDER,
     EVENT_TYPES,
     TELEMETRY_ENV_VAR,
     MetricRecorder,
+    current_span_id,
 )
+from metrics_tpu.observability.trace import export_perfetto, span
 
 __all__ = [
     "MetricRecorder",
@@ -42,7 +52,17 @@ __all__ = [
     "maybe_export_env",
     "export_jsonl",
     "render_prometheus",
+    "write_prometheus",
     "summary",
+    "PeriodicExporter",
+    "compiled_cost",
+    "metric_compile_cost",
+    "span",
+    "current_span_id",
+    "export_perfetto",
+    "aggregate_across_hosts",
+    "counter_payload",
+    "merge_payloads",
 ]
 
 _RECORDERS: Dict[str, MetricRecorder] = {"default": _DEFAULT_RECORDER}

@@ -1,0 +1,137 @@
+"""Job-wide telemetry aggregation: merge every rank's counters onto one view.
+
+PR 1's recorder is process-local; on a multi-host job every rank keeps a
+private recorder and the rank-zero export silently reports 1/Nth of the
+job. :func:`aggregate_across_hosts` fixes the accounting: each process
+serializes its counter totals (call counts/times, signature counts, sync
+totals, footprint high-water marks, compile bills) to a JSON payload, a
+process allgather moves the payloads (padded to the max length — they are
+uneven), and the merge runs on every rank so rank zero exports the whole
+job while other ranks stay consistent.
+
+Merge semantics per counter family:
+
+* call counts / call times / sync totals / compile counts+times / dropped —
+  **summed** (extensive quantities; the job total is the sum of ranks)
+* distinct signature counts — **max** across ranks (each rank counts its
+  own distinct set; identical pipelines see identical signatures, so the
+  max is the best under-approximation of the job-wide distinct count that
+  needs no signature exchange — a rank whose count *differs* is itself a
+  data-skew signal, visible in the per-process detail)
+* footprint high-water marks — **max** (a high-water mark is a max)
+
+In a single-process run the allgather is skipped entirely and the local
+payload is returned as a world-size-1 aggregate — a no-op by construction.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER
+
+__all__ = ["aggregate_across_hosts", "counter_payload", "merge_payloads"]
+
+#: separator for (metric, phase) keys in the JSON payload; class and phase
+#: names are identifiers, so "|" cannot collide
+_KEY_SEP = "|"
+
+
+def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
+    """One process's aggregate counters as a flat JSON-safe dict (the unit
+    the cross-host allgather serializes)."""
+    rec = recorder if recorder is not None else _DEFAULT_RECORDER
+    from metrics_tpu.parallel.distributed import process_index
+
+    return {
+        "process": process_index(),
+        "call_counts": {_KEY_SEP.join(k): v for k, v in rec.call_counts().items()},
+        "call_times": {_KEY_SEP.join(k): v for k, v in rec.call_times().items()},
+        "signature_counts": dict(rec.signature_counts()),
+        "sync_totals": dict(rec.sync_totals()),
+        "footprint_hwm": dict(rec.footprint_high_water_marks()),
+        "compile_counts": dict(rec.compile_counts()),
+        "compile_times": dict(rec.compile_times()),
+        "dropped_events": rec.dropped_events(),
+    }
+
+
+def _merge_sum(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for m in maps:
+        for k, v in m.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _merge_max(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for m in maps:
+        for k, v in m.items():
+            out[k] = max(out.get(k, v), v)
+    return out
+
+
+def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-process counter payloads into one job-wide aggregate.
+
+    Returns tuple-keyed counters matching the recorder's accessors, plus
+    the raw per-process payloads under ``"processes"`` (per-rank detail for
+    the ``process``-labelled Prometheus series and straggler triage).
+    """
+    return {
+        "world_size": len(payloads),
+        "call_counts": {
+            tuple(k.split(_KEY_SEP)): v
+            for k, v in _merge_sum([p["call_counts"] for p in payloads]).items()
+        },
+        "call_times": {
+            tuple(k.split(_KEY_SEP)): v
+            for k, v in _merge_sum([p["call_times"] for p in payloads]).items()
+        },
+        "signature_counts": _merge_max([p["signature_counts"] for p in payloads]),
+        "sync_totals": _merge_sum([p["sync_totals"] for p in payloads]),
+        "footprint_hwm": _merge_max([p["footprint_hwm"] for p in payloads]),
+        "compile_counts": _merge_sum([p["compile_counts"] for p in payloads]),
+        "compile_times": _merge_sum([p["compile_times"] for p in payloads]),
+        "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
+        "processes": list(payloads),
+    }
+
+
+def aggregate_across_hosts(recorder: Optional[Any] = None) -> Dict[str, Any]:
+    """Merge this recorder's counters with every other process's.
+
+    Single-process: returns the local totals as a world-size-1 aggregate
+    without touching any collective. Multi-process: one
+    ``process_allgather`` of the JSON-serialized payloads (padded uint8 —
+    payload lengths are uneven across ranks) and a deterministic merge on
+    every rank. Call it at export time, then hand the result to
+    ``render_prometheus(aggregate=...)`` or read the merged counters
+    directly.
+    """
+    local = counter_payload(recorder)
+    from metrics_tpu.parallel.distributed import distributed_available
+
+    if not distributed_available():
+        return merge_payloads([local])
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    raw = json.dumps(local).encode("utf-8")
+    # lengths are uneven (different metric sets / signature tables per
+    # rank); exchange them first, pad to max, gather, trim per rank
+    lengths = np.asarray(
+        multihost_utils.process_allgather(np.asarray([len(raw)], np.int64), tiled=False)
+    ).reshape(-1)
+    max_len = int(lengths.max())
+    padded = np.zeros((max_len,), np.uint8)
+    padded[: len(raw)] = np.frombuffer(raw, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(padded, tiled=False))
+    payloads = [
+        json.loads(gathered[i, : int(lengths[i])].tobytes().decode("utf-8"))
+        for i in range(gathered.shape[0])
+    ]
+    payloads.sort(key=lambda p: p.get("process", 0))
+    return merge_payloads(payloads)

@@ -1,14 +1,20 @@
-"""Telemetry exporters: JSONL event log, Prometheus text exposition, and a
-human summary table.
+"""Telemetry exporters: JSONL event log, Prometheus text exposition, a
+human summary table, and a background :class:`PeriodicExporter` that keeps
+file artifacts fresh on an interval.
 
-All three are rank-zero-gated (multi-host jobs emit one copy) and read a
-consistent snapshot of the recorder, so they can run concurrently with
-metric updates.
+All exporters are rank-zero-gated (multi-host jobs emit one copy) and read
+a consistent snapshot of the recorder, so they can run concurrently with
+metric updates. Every file write is atomic (tmp file + ``os.replace`` in
+the target directory), so a concurrent scrape or a crash mid-write never
+observes a truncated artifact.
 """
 from __future__ import annotations
 
+import atexit
 import json
-from typing import Any, Dict, Optional
+import os
+import threading
+from typing import Any, Dict, List, Optional
 
 from metrics_tpu.utils.prints import _process_index
 
@@ -21,79 +27,188 @@ def _resolve(recorder: Optional[Any]) -> Any:
     return recorder
 
 
+# ---------------------------------------------------------------------------
+# atomic file writes
+# ---------------------------------------------------------------------------
+
+def _atomic_write(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` atomically: a same-directory tmp file is
+    fully written and fsynced, then ``os.replace``d over the target, so any
+    concurrent reader sees either the old complete artifact or the new one
+    — never a truncation. The tmp name is pid-distinct, so two processes
+    racing the same target each land a complete (last-writer-wins) file."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(directory, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "w") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _atomic_append(path: str, text: str) -> None:
+    """Atomic logical append: read the existing artifact (if any), write
+    existing+new through the tmp+replace path. O(file) per call — fine for
+    the once-per-process-exit appends the entry points perform; sustained
+    high-rate appenders should export full snapshots instead."""
+    existing = ""
+    try:
+        with open(path) as fh:
+            existing = fh.read()
+    except FileNotFoundError:
+        pass
+    _atomic_write(path, existing + text)
+
+
 def export_jsonl(path: str, recorder: Optional[Any] = None, append: bool = False) -> Optional[str]:
     """Write every recorded event as one JSON object per line.
 
     Returns the path written, or ``None`` on non-zero ranks (rank-zero
     gated). Events are plain dicts of JSON scalars/lists, so the artifact
-    round-trips through ``json.loads`` line by line.
+    round-trips through ``json.loads`` line by line. Writes are atomic
+    (tmp + ``os.replace``), including ``append=True`` — a reader or a
+    crash can never observe half an event line.
     """
     if _process_index() != 0:
         return None
     rec = _resolve(recorder)
-    mode = "a" if append else "w"
-    with open(path, mode) as fh:
-        for event in rec.events():
-            fh.write(json.dumps(event) + "\n")
+    text = "".join(json.dumps(event) + "\n" for event in rec.events())
+    if append:
+        _atomic_append(path, text)
+    else:
+        _atomic_write(path, text)
     return path
 
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
 
 def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def render_prometheus(recorder: Optional[Any] = None) -> str:
+def _labels(**kv: Any) -> str:
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in kv.items())
+    return "{" + inner + "}" if inner else ""
+
+
+def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[str, Any]] = None) -> str:
     """Prometheus text-format rendering of the aggregate counters/gauges.
 
     Meant for a scrape endpoint or a textfile-collector drop: call counts
     and cumulative wall time per (metric, phase), sync/gather byte totals,
-    distinct-signature gauges (the recompile detector's raw data), and
-    state-footprint high-water marks. Returns ``""`` on non-zero ranks.
+    distinct-signature gauges (the recompile detector's raw data),
+    state-footprint high-water marks, and compile bills. Returns ``""`` on
+    non-zero ranks.
+
+    ``aggregate`` — a job-wide result from
+    :func:`metrics_tpu.observability.aggregate_across_hosts`. When given,
+    the page covers the WHOLE job instead of this process: call counts are
+    the merged totals, and the families where per-rank detail matters
+    (wall time for stragglers, sync bytes, signature skew, footprint and
+    compile bills per host) carry a ``process`` label per rank.
     """
     if _process_index() != 0:
         return ""
     rec = _resolve(recorder)
-    counts = rec.call_counts()
-    times = rec.call_times()
-    sync = rec.sync_totals()
-    sigs = rec.signature_counts()
-    hwm = rec.footprint_high_water_marks()
+    if aggregate is not None:
+        counts = aggregate["call_counts"]
+        per_proc = aggregate["processes"]
+        dropped = aggregate["dropped_events"]
+    else:
+        counts = rec.call_counts()
+        # single-process rendering reuses the per-process machinery with
+        # this one recorder's payload, minus the process label
+        from metrics_tpu.observability.aggregate import counter_payload
 
-    lines = []
+        per_proc = [counter_payload(rec)]
+        dropped = rec.dropped_events()
+
+    def proc_label(payload: Dict[str, Any]) -> Dict[str, Any]:
+        return {"process": payload["process"]} if aggregate is not None else {}
+
+    lines: List[str] = []
     lines.append("# HELP metrics_tpu_calls_total Metric lifecycle calls by metric and phase.")
     lines.append("# TYPE metrics_tpu_calls_total counter")
     for (metric, phase), n in sorted(counts.items()):
-        lines.append(
-            f'metrics_tpu_calls_total{{metric="{_escape_label(metric)}",phase="{_escape_label(phase)}"}} {n}'
-        )
+        lines.append(f"metrics_tpu_calls_total{_labels(metric=metric, phase=phase)} {n}")
     lines.append("# HELP metrics_tpu_call_seconds_total Cumulative wall time by metric and phase.")
     lines.append("# TYPE metrics_tpu_call_seconds_total counter")
-    for (metric, phase), t in sorted(times.items()):
-        lines.append(
-            f'metrics_tpu_call_seconds_total{{metric="{_escape_label(metric)}",phase="{_escape_label(phase)}"}} {t:.6f}'
-        )
+    for payload in per_proc:
+        for key, t in sorted(payload["call_times"].items()):
+            metric, phase = key.split("|")
+            lines.append(
+                f"metrics_tpu_call_seconds_total"
+                f"{_labels(metric=metric, phase=phase, **proc_label(payload))} {t:.6f}"
+            )
     lines.append("# HELP metrics_tpu_sync_events_total Cross-device/process state synchronizations.")
     lines.append("# TYPE metrics_tpu_sync_events_total counter")
-    lines.append(f"metrics_tpu_sync_events_total {sync['sync_events']}")
+    for payload in per_proc:
+        lines.append(
+            f"metrics_tpu_sync_events_total{_labels(**proc_label(payload))}"
+            f" {payload['sync_totals']['sync_events']}"
+        )
     lines.append("# HELP metrics_tpu_gather_bytes_total Bytes of synced state received per participant.")
     lines.append("# TYPE metrics_tpu_gather_bytes_total counter")
-    lines.append(f"metrics_tpu_gather_bytes_total {sync['gather_bytes']}")
+    for payload in per_proc:
+        lines.append(
+            f"metrics_tpu_gather_bytes_total{_labels(**proc_label(payload))}"
+            f" {payload['sync_totals']['gather_bytes']}"
+        )
     lines.append("# HELP metrics_tpu_pad_waste_bytes_total Pad-to-max padding bytes moved by uneven gathers.")
     lines.append("# TYPE metrics_tpu_pad_waste_bytes_total counter")
-    lines.append(f"metrics_tpu_pad_waste_bytes_total {sync['pad_waste_bytes']}")
+    for payload in per_proc:
+        lines.append(
+            f"metrics_tpu_pad_waste_bytes_total{_labels(**proc_label(payload))}"
+            f" {payload['sync_totals']['pad_waste_bytes']}"
+        )
     lines.append("# HELP metrics_tpu_distinct_signatures Distinct (shape, dtype) call signatures per entry point.")
     lines.append("# TYPE metrics_tpu_distinct_signatures gauge")
-    for entry, n in sorted(sigs.items()):
-        lines.append(f'metrics_tpu_distinct_signatures{{entry="{_escape_label(entry)}"}} {n}')
+    for payload in per_proc:
+        for entry, n in sorted(payload["signature_counts"].items()):
+            lines.append(
+                f"metrics_tpu_distinct_signatures{_labels(entry=entry, **proc_label(payload))} {n}"
+            )
     lines.append("# HELP metrics_tpu_state_bytes_hwm State-footprint high-water mark per metric.")
     lines.append("# TYPE metrics_tpu_state_bytes_hwm gauge")
-    for metric, nbytes in sorted(hwm.items()):
-        lines.append(f'metrics_tpu_state_bytes_hwm{{metric="{_escape_label(metric)}"}} {nbytes}')
+    for payload in per_proc:
+        for metric, nbytes in sorted(payload["footprint_hwm"].items()):
+            lines.append(
+                f"metrics_tpu_state_bytes_hwm{_labels(metric=metric, **proc_label(payload))} {nbytes}"
+            )
+    lines.append("# HELP metrics_tpu_compiles_total Attributed XLA compilations per entry point.")
+    lines.append("# TYPE metrics_tpu_compiles_total counter")
+    for payload in per_proc:
+        for entry, n in sorted(payload["compile_counts"].items()):
+            lines.append(
+                f"metrics_tpu_compiles_total{_labels(entry=entry, **proc_label(payload))} {n}"
+            )
+    lines.append("# HELP metrics_tpu_compile_seconds_total Cumulative trace+lower+compile wall time per entry point.")
+    lines.append("# TYPE metrics_tpu_compile_seconds_total counter")
+    for payload in per_proc:
+        for entry, t in sorted(payload["compile_times"].items()):
+            lines.append(
+                f"metrics_tpu_compile_seconds_total{_labels(entry=entry, **proc_label(payload))} {t:.6f}"
+            )
     lines.append("# HELP metrics_tpu_dropped_events_total Events discarded past the buffer cap.")
     lines.append("# TYPE metrics_tpu_dropped_events_total counter")
-    lines.append(f"metrics_tpu_dropped_events_total {rec.dropped_events()}")
+    lines.append(f"metrics_tpu_dropped_events_total {dropped}")
     return "\n".join(lines) + "\n"
 
+
+def write_prometheus(path: str, recorder: Optional[Any] = None, aggregate: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Atomically drop the Prometheus page as a textfile-collector artifact.
+    Returns the path written, or ``None`` on non-zero ranks."""
+    if _process_index() != 0:
+        return None
+    _atomic_write(path, render_prometheus(recorder, aggregate=aggregate))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# human summary
+# ---------------------------------------------------------------------------
 
 def summary(recorder: Optional[Any] = None) -> str:
     """Human-readable summary table of where metric time went.
@@ -108,13 +223,17 @@ def summary(recorder: Optional[Any] = None) -> str:
     sync = rec.sync_totals()
     sigs = rec.signature_counts()
     hwm = rec.footprint_high_water_marks()
+    compiles = rec.compile_counts()
+    compile_times = rec.compile_times()
 
     rows = []
     for (metric, phase), n in sorted(counts.items(), key=lambda kv: -times.get(kv[0], 0.0)):
         total_ms = times.get((metric, phase), 0.0) * 1e3
         rows.append((metric, phase, n, total_ms, total_ms / max(n, 1)))
 
-    width = max([len(r[0]) for r in rows], default=6)
+    # clamp to the header's own width: all-short metric names must not
+    # shrink the column below len("metric") and shear the header row
+    width = max([len(r[0]) for r in rows] + [6])
     lines = [
         f"telemetry summary (recorder `{rec.name}`)",
         f"{'metric':<{width}}  {'phase':<8} {'calls':>7} {'total_ms':>10} {'mean_ms':>9}",
@@ -137,8 +256,130 @@ def summary(recorder: Optional[Any] = None) -> str:
         lines.append("distinct call signatures per entry point:")
         for entry, n in sorted(sigs.items(), key=lambda kv: -kv[1]):
             lines.append(f"  {entry}: {n}")
+    if compiles:
+        lines.append("compile bills per entry point (count, total ms):")
+        for entry, n in sorted(compiles.items(), key=lambda kv: -compile_times.get(kv[0], 0.0)):
+            lines.append(f"  {entry}: {n} compiles, {compile_times.get(entry, 0.0) * 1e3:.1f} ms")
     if hwm:
         lines.append("state-footprint high-water marks:")
         for metric, nbytes in sorted(hwm.items(), key=lambda kv: -kv[1]):
             lines.append(f"  {metric}: {nbytes} bytes")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# continuous export
+# ---------------------------------------------------------------------------
+
+class PeriodicExporter:
+    """Background thread that re-exports telemetry artifacts on an interval.
+
+    Long jobs should not need an explicit export call at every checkpoint:
+    give the exporter a Prometheus textfile path and/or a JSONL path (both
+    atomically re-rendered on ticks where anything new was recorded — a
+    scraper or tail can read at any moment and never sees a truncation),
+    then ``start()`` it. ``stop()`` — also registered via ``atexit`` —
+    performs one final export, so events recorded between the last tick
+    and interpreter exit still land.
+
+    Rank-zero gated: on other ranks ``start()`` is a no-op, matching the
+    exporters it drives. Restartable: ``start()`` after ``stop()`` begins
+    a fresh thread.
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 30.0,
+        prometheus_path: Optional[str] = None,
+        jsonl_path: Optional[str] = None,
+        recorder: Optional[Any] = None,
+    ) -> None:
+        if prometheus_path is None and jsonl_path is None:
+            raise ValueError("PeriodicExporter needs a prometheus_path and/or a jsonl_path")
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got {interval_s}")
+        self.interval_s = float(interval_s)
+        self.prometheus_path = prometheus_path
+        self.jsonl_path = jsonl_path
+        self._recorder = recorder
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        # (event count, dropped count) at the last export; every counter
+        # mutation either appends an event or bumps the dropped tally, so
+        # this pair is a complete change detector. None = never exported.
+        self._exported_state: Optional[tuple] = None
+        self._warned = False
+        self._lock = threading.Lock()
+
+    def start(self) -> "PeriodicExporter":
+        if _process_index() != 0:
+            return self
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop_event = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, name="metrics-tpu-telemetry-export", daemon=True
+            )
+            self._thread.start()
+        atexit.register(self.stop)
+        return self
+
+    def _run(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            try:
+                self.export_once()
+            except Exception as err:  # noqa: BLE001
+                # one bad tick (ENOSPC, a permissions hiccup, an event with
+                # a non-serializable field) must not kill continuous export
+                # for the rest of the job — warn once and keep ticking
+                if not self._warned:
+                    self._warned = True
+                    from metrics_tpu.utils.prints import rank_zero_warn
+
+                    rank_zero_warn(
+                        f"Telemetry: a PeriodicExporter tick failed ({err!r});"
+                        " the thread keeps running and will retry next tick."
+                        " Further tick failures are not re-warned.",
+                        UserWarning,
+                    )
+
+    def export_once(self) -> None:
+        """One export tick (also usable manually, without the thread).
+
+        Both artifacts are re-rendered in FULL (the recorder holds every
+        event in memory anyway, bounded by its event cap) and swapped in
+        atomically — no read-modify-append cycle, and a reader always sees
+        a complete artifact. A tick where nothing was recorded since the
+        last one skips the writes entirely (after the first tick, which
+        always materializes the artifacts)."""
+        rec = _resolve(self._recorder)
+        events = rec.events()
+        with self._lock:
+            state = (len(events), rec.dropped_events())
+            if state == self._exported_state:
+                return
+            if self.prometheus_path is not None:
+                _atomic_write(self.prometheus_path, render_prometheus(rec))
+            if self.jsonl_path is not None:
+                _atomic_write(
+                    self.jsonl_path, "".join(json.dumps(e) + "\n" for e in events)
+                )
+            self._exported_state = state
+
+    def stop(self) -> None:
+        """Stop the thread and perform one final export. Idempotent."""
+        thread = self._thread
+        self._stop_event.set()
+        if thread is not None:
+            thread.join(timeout=max(5.0, self.interval_s))
+            self._thread = None
+        if _process_index() == 0:
+            try:
+                self.export_once()
+            except Exception:  # noqa: BLE001 — exit paths must not raise
+                pass
+        try:
+            atexit.unregister(self.stop)
+        except Exception:
+            pass

@@ -1,0 +1,186 @@
+"""Compiler-level cost profiling: what a compiled metric *costs*.
+
+PR 1's recorder counts recompiles but prices nothing. This module asks the
+compiler itself: :func:`compiled_cost` lowers and compiles a function
+through the AOT pipeline (``jax.jit(...).trace().lower().compile()``),
+times each stage, and reads back XLA's ``cost_analysis()`` (flops, bytes
+accessed) plus ``memory_analysis()`` (argument/output/temp bytes) where the
+backend provides it. The result is a flat JSON-safe dict, and — when the
+default recorder is enabled — a typed ``compile`` event in the telemetry
+stream.
+
+The recorder's recompile detector hooks in here too: with
+``get_recorder().enable(profile_compiles=True)``, every NEW call signature
+a ``Metric.update``/``forward`` sees (i.e. every signature that retriggers
+XLA compilation of the metric's jitted kernels) bills the compile by
+lowering the metric's pure ``update_state`` on the offending arguments —
+the recompile warning's count becomes an attributed bill.
+
+Profiling never breaks the hot path: metrics whose update cannot be traced
+(``__jit_unsafe__``, list states, host-side numerics) silently decline.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER
+
+__all__ = ["compiled_cost", "metric_compile_cost"]
+
+#: memory_analysis fields worth surfacing (CompiledMemoryStats attributes)
+_MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def _normalize_cost(raw: Any) -> Dict[str, float]:
+    """XLA's cost_analysis comes back as a dict (or a 1-list of dicts, one
+    per computation) keyed by strings like ``"flops"`` / ``"bytes
+    accessed"`` / ``"bytes accessed0{}"``; normalize to a flat JSON-safe
+    dict with the two headline keys guaranteed present when reported."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else {}
+    if not isinstance(raw, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for key, value in raw.items():
+        try:
+            out[str(key)] = float(value)
+        except (TypeError, ValueError):
+            continue
+    if "bytes accessed" in out and "bytes_accessed" not in out:
+        out["bytes_accessed"] = out["bytes accessed"]
+    return out
+
+
+def _normalize_memory(stats: Any) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for field in _MEMORY_FIELDS:
+        value = getattr(stats, field, None)
+        if isinstance(value, int):
+            out[field] = value
+    return out
+
+
+def compiled_cost(
+    fn: Callable,
+    *args: Any,
+    entry: Optional[str] = None,
+    static_argnums: Tuple[int, ...] = (),
+    recorder: Optional[Any] = None,
+    **kwargs: Any,
+) -> Dict[str, Any]:
+    """Compile ``fn`` on ``args``/``kwargs`` ahead-of-time and return its
+    compiler-estimated cost.
+
+    ``fn`` may be a plain callable (jitted here) or an already-jitted
+    function (used as-is, so its static_argnums/donation survive). Returns
+    a JSON-safe dict::
+
+        {
+          "entry": "...",                  # fn name, or the `entry` override
+          "trace_s": ..., "lower_s": ..., "compile_s": ...,
+          "flops": ...,                    # None when the backend reports none
+          "bytes_accessed": ...,
+          "cost_analysis": {...},          # the full normalized XLA dict
+          "memory_analysis": {...},        # {} where unsupported
+        }
+
+    With the (resolved) recorder enabled, a typed ``compile`` event with
+    the same payload lands in the event stream. The AOT pipeline compiles
+    regardless of the jit cache, so calling this on an already-warm
+    function re-measures compile time rather than reading a cache hit —
+    that is the point: the bill is reproducible.
+    """
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn, static_argnums=static_argnums)
+    label = entry or getattr(fn, "__name__", None) or type(fn).__name__
+
+    t0 = time.perf_counter()
+    try:
+        traced = jitted.trace(*args, **kwargs)
+        t1 = time.perf_counter()
+        lowered = traced.lower()
+    except AttributeError:  # older jax: no .trace(); .lower() traces too
+        t1 = t0
+        lowered = jitted.lower(*args, **kwargs)
+    t2 = time.perf_counter()
+    compiled = lowered.compile()
+    t3 = time.perf_counter()
+
+    cost = _normalize_cost(_try(compiled.cost_analysis))
+    memory = _normalize_memory(_try(compiled.memory_analysis))
+
+    report: Dict[str, Any] = {
+        "entry": label,
+        "trace_s": round(t1 - t0, 6),
+        "lower_s": round(t2 - t1, 6),
+        "compile_s": round(t3 - t2, 6),
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes_accessed"),
+        "cost_analysis": cost,
+        "memory_analysis": memory,
+    }
+
+    rec = recorder if recorder is not None else _DEFAULT_RECORDER
+    if rec.enabled:
+        rec.record_compile(
+            label,
+            trace_s=report["trace_s"],
+            lower_s=report["lower_s"],
+            compile_s=report["compile_s"],
+            cost=cost,
+            memory=memory,
+        )
+    return report
+
+
+def _try(method: Callable) -> Any:
+    """cost_analysis/memory_analysis raise on backends that don't implement
+    them (and on some executables); absence of an estimate is data, not an
+    error."""
+    try:
+        return method()
+    except Exception:
+        return None
+
+
+def metric_compile_cost(
+    metric: Any,
+    args: Tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    phase: str = "update",
+    recorder: Optional[Any] = None,
+) -> Optional[Dict[str, Any]]:
+    """Bill one metric (re)compile: lower the metric's pure
+    ``update_state(state, *batch)`` on the actual offending arguments and
+    record the ``compile`` event under ``"<MetricClass>.<phase>"``.
+
+    This is the ``profile_compiles`` hook ``core/metric.py`` fires when the
+    signature tracker reports a NEW signature. Returns the
+    :func:`compiled_cost` report, or ``None`` when the metric declines
+    (untraceable update, list/host states) or profiling itself fails —
+    telemetry must never take down the hot path it observes.
+    """
+    if getattr(metric, "__jit_unsafe__", False):
+        return None
+    try:
+        state = {name: getattr(metric, name) for name in metric._defaults}
+        if any(isinstance(v, list) for v in state.values()):
+            # list ("cat") states grow the pytree per update; their update
+            # is host-driven and has no single compiled executable to bill
+            return None
+        entry = f"{type(metric).__name__}.{phase}"
+
+        def _step(state: Dict[str, Any], *batch: Any, **batch_kw: Any) -> Dict[str, Any]:
+            return metric.update_state(state, *batch, **batch_kw)
+
+        return compiled_cost(_step, state, *args, entry=entry, recorder=recorder, **(kwargs or {}))
+    except Exception:
+        return None

@@ -28,11 +28,27 @@ All warning/export paths are rank-zero-gated through
 """
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu.utils.prints import rank_zero_warn
+
+#: ambient span stack (innermost last) — lives here rather than in
+#: ``trace.py`` so the recorder can annotate every event with the active
+#: span without importing the trace module (which imports this one).
+#: Context-local (contextvars), so threads AND async tasks nest correctly.
+_SPAN_STACK: "contextvars.ContextVar[Tuple[int, ...]]" = contextvars.ContextVar(
+    "metrics_tpu_span_stack", default=()
+)
+
+
+def current_span_id() -> Optional[int]:
+    """Id of the innermost active :func:`metrics_tpu.observability.span`,
+    or ``None`` outside any span."""
+    stack = _SPAN_STACK.get()
+    return stack[-1] if stack else None
 
 #: environment variable holding a JSONL path; when set, the default recorder
 #: auto-enables at import and entry points append their events to that path
@@ -41,7 +57,7 @@ from metrics_tpu.utils.prints import rank_zero_warn
 TELEMETRY_ENV_VAR = "METRICS_TPU_TELEMETRY"
 
 #: core lifecycle event types; auxiliary events ("recompile_warning",
-#: "footprint", "tracker_increment") ride the same stream
+#: "footprint", "tracker_increment", "span", "compile") ride the same stream
 EVENT_TYPES = ("update", "compute", "forward", "sync")
 
 
@@ -111,11 +127,18 @@ class MetricRecorder:
         name: str = "default",
         recompile_threshold: int = DEFAULT_RECOMPILE_THRESHOLD,
         footprint_warn_bytes: Optional[int] = None,
+        profile_compiles: bool = False,
     ) -> None:
         self.name = name
         self.enabled = False
         self.recompile_threshold = recompile_threshold
         self.footprint_warn_bytes = footprint_warn_bytes
+        #: opt-in compiled-cost attribution: when True, every NEW call
+        #: signature at a metric entry point (i.e. every recompile the
+        #: signature tracker detects) is billed by lowering+compiling the
+        #: metric's pure ``update_state`` and recording a ``compile`` event
+        #: with the XLA cost analysis (see observability/profiling.py)
+        self.profile_compiles = profile_compiles
         self._lock = threading.Lock()
         self._t0 = time.time()
         self._events: List[Dict[str, Any]] = []
@@ -129,6 +152,8 @@ class MetricRecorder:
         self._sync_bytes = 0
         self._pad_waste_bytes = 0
         self._sync_events = 0
+        self._compile_counts: Dict[str, int] = {}
+        self._compile_times: Dict[str, float] = {}
         # per-thread compute-group attribution: a shared field would let
         # concurrent MetricCollection.update calls cross-attribute events
         self._group_local = threading.local()
@@ -140,11 +165,14 @@ class MetricRecorder:
         self,
         recompile_threshold: Optional[int] = None,
         footprint_warn_bytes: Optional[int] = None,
+        profile_compiles: Optional[bool] = None,
     ) -> "MetricRecorder":
         if recompile_threshold is not None:
             self.recompile_threshold = recompile_threshold
         if footprint_warn_bytes is not None:
             self.footprint_warn_bytes = footprint_warn_bytes
+        if profile_compiles is not None:
+            self.profile_compiles = profile_compiles
         self.enabled = True
         return self
 
@@ -166,6 +194,8 @@ class MetricRecorder:
             self._sync_bytes = 0
             self._pad_waste_bytes = 0
             self._sync_events = 0
+            self._compile_counts = {}
+            self._compile_times = {}
             self._group_local = threading.local()
         return self
 
@@ -200,6 +230,16 @@ class MetricRecorder:
         with self._lock:
             return dict(self._footprint_hwm)
 
+    def compile_counts(self) -> Dict[str, int]:
+        """Recorded XLA (re)compilations per entry point (``compile`` events)."""
+        with self._lock:
+            return dict(self._compile_counts)
+
+    def compile_times(self) -> Dict[str, float]:
+        """Cumulative trace+lower+compile wall seconds per entry point."""
+        with self._lock:
+            return dict(self._compile_times)
+
     def dropped_events(self) -> int:
         """Events discarded after the MAX_EVENTS buffer cap (aggregate
         counters still include them; the JSONL stream does not)."""
@@ -211,6 +251,12 @@ class MetricRecorder:
     # ------------------------------------------------------------------
     def _append(self, event: Dict[str, Any]) -> None:
         # caller holds the lock
+        stack = _SPAN_STACK.get()
+        if stack and "span_id" not in event:
+            # attribute every event to the innermost active trace span so
+            # flat rows ("an update inside a collection forward inside a
+            # sync") regain their nesting in post-hoc analysis
+            event["span_id"] = stack[-1]
         if len(self._events) >= self.MAX_EVENTS:
             self._dropped += 1
             if self._dropped == 1:
@@ -234,9 +280,14 @@ class MetricRecorder:
         duration_s: float,
         args: Tuple = (),
         kwargs: Optional[Dict[str, Any]] = None,
-    ) -> None:
+    ) -> bool:
         """Record one update/compute/forward lifecycle call with its wall
-        time and argument signature (and feed recompile detection)."""
+        time and argument signature (and feed recompile detection).
+
+        Returns True when the call carried a signature NOT seen before at
+        this entry point — i.e. a call that (re)triggers XLA compilation of
+        the metric's jitted kernels; the caller may then attribute the
+        compile cost (see ``profile_compiles``)."""
         label = type(metric).__name__
         sig = _signature_of(args, kwargs) if (args or kwargs) else ()
         with self._lock:
@@ -262,21 +313,26 @@ class MetricRecorder:
                 event["compute_group"] = list(group)
             self._append(event)
         if sig and phase in ("update", "forward"):
-            self.track_signature(f"{label}.{phase}", signature=sig)
+            return self.track_signature(f"{label}.{phase}", signature=sig)
+        return False
 
-    def track_signature(self, entry: str, *args: Any, signature: Optional[Tuple] = None, **kwargs: Any) -> None:
+    def track_signature(self, entry: str, *args: Any, signature: Optional[Tuple] = None, **kwargs: Any) -> bool:
         """Note one call signature for a jitted entry point; warn (once per
         entry, rank-zero) when the distinct-signature count crosses
         ``recompile_threshold`` — the classic "unpadded batch -> recompile
         every step" bug. Functional/jit users can call this directly with
-        their traced arguments."""
+        their traced arguments.
+
+        Returns True when the signature is NEW for this entry point (a
+        compilation trigger), False for a cache hit."""
         sig = signature if signature is not None else _signature_of(args, kwargs)
         with self._lock:
             seen = self._signatures.setdefault(entry, set())
             before = len(seen)
             seen.add(sig)
+            is_new = len(seen) > before
             crossed = (
-                len(seen) > before
+                is_new
                 and len(seen) > self.recompile_threshold
                 and entry not in self._recompile_warned
             )
@@ -303,6 +359,44 @@ class MetricRecorder:
                 " are genuinely static-bounded.",
                 UserWarning,
             )
+        return is_new
+
+    def record_compile(
+        self,
+        entry: str,
+        trace_s: float = 0.0,
+        lower_s: float = 0.0,
+        compile_s: float = 0.0,
+        cost: Optional[Dict[str, float]] = None,
+        memory: Optional[Dict[str, int]] = None,
+        **extra: Any,
+    ) -> None:
+        """Record one attributed XLA compilation: the trace/lower/compile
+        wall-time breakdown plus the compiler's cost analysis (flops, bytes
+        accessed) and, where the backend provides it, the compiled memory
+        stats. Emitted by :func:`metrics_tpu.observability.compiled_cost`
+        and by the recompile hook in ``core/metric.py`` (when
+        ``profile_compiles`` is on) — turning the recompile warning's count
+        into a bill."""
+        total_s = float(trace_s) + float(lower_s) + float(compile_s)
+        with self._lock:
+            self._compile_counts[entry] = self._compile_counts.get(entry, 0) + 1
+            self._compile_times[entry] = self._compile_times.get(entry, 0.0) + total_s
+            event: Dict[str, Any] = {
+                "type": "compile",
+                "entry": entry,
+                "t": round(time.time() - self._t0, 6),
+                "trace_ms": round(float(trace_s) * 1e3, 4),
+                "lower_ms": round(float(lower_s) * 1e3, 4),
+                "compile_ms": round(float(compile_s) * 1e3, 4),
+                "n_compiles": self._compile_counts[entry],
+            }
+            if cost:
+                event["cost_analysis"] = cost
+            if memory:
+                event["memory_analysis"] = memory
+            event.update(extra)
+            self._append(event)
 
     def record_sync(
         self,

@@ -30,6 +30,7 @@ import numpy as np
 
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import _nbytes
+from metrics_tpu.observability.trace import span as _span
 
 Array = jax.Array
 
@@ -91,45 +92,50 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     world = world_size(group)
     itemsize = jnp.dtype(result.dtype).itemsize
 
-    if result.ndim == 0:
-        gathered = _process_allgather(result)
-        if _TELEMETRY.enabled:
-            _TELEMETRY.record_sync(
-                "gather_all_arrays", gather_bytes=itemsize * world, world_size=world
-            )
-        return gathered
+    # the whole cross-process exchange is one trace span (shape exchange,
+    # padding, and the allgather itself), nesting under the calling
+    # metric's `.sync` span when the recorder is enabled
+    with _span("gather_all_arrays", world_size=world):
+        if result.ndim == 0:
+            gathered = _process_allgather(result)
+            if _TELEMETRY.enabled:
+                _TELEMETRY.record_sync(
+                    "gather_all_arrays", gather_bytes=itemsize * world, world_size=world
+                )
+            return gathered
 
-    # exchange shapes host-side, pad to elementwise max, gather, trim
-    local_shape = np.asarray(result.shape, dtype=np.int64)
-    all_shapes = _process_allgather(jnp.asarray(local_shape))
-    all_shapes = [np.asarray(s) for s in all_shapes]
-    max_shape = np.max(np.stack(all_shapes), axis=0)
+        # exchange shapes host-side, pad to elementwise max, gather, trim
+        local_shape = np.asarray(result.shape, dtype=np.int64)
+        all_shapes = _process_allgather(jnp.asarray(local_shape))
+        all_shapes = [np.asarray(s) for s in all_shapes]
+        max_shape = np.max(np.stack(all_shapes), axis=0)
 
-    if all((s == all_shapes[0]).all() for s in all_shapes):
-        gathered = _process_allgather(result)
+        if all((s == all_shapes[0]).all() for s in all_shapes):
+            gathered = _process_allgather(result)
+            if _TELEMETRY.enabled:
+                _TELEMETRY.record_sync(
+                    "gather_all_arrays",
+                    gather_bytes=int(result.size) * itemsize * world,
+                    world_size=world,
+                )
+            return gathered
+
+        pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
+        padded = jnp.pad(result, pad_width)
+        gathered = _process_allgather(padded)
         if _TELEMETRY.enabled:
+            # the uneven contract moves world_size pad-to-max slabs; the
+            # padding beyond each rank's true shape is pure waste the
+            # accounting exposes
+            moved = int(padded.size) * itemsize * world
+            true_bytes = int(sum(int(np.prod(s)) for s in all_shapes)) * itemsize
             _TELEMETRY.record_sync(
                 "gather_all_arrays",
-                gather_bytes=int(result.size) * itemsize * world,
+                gather_bytes=moved,
                 world_size=world,
+                pad_waste_bytes=moved - true_bytes,
             )
-        return gathered
-
-    pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
-    padded = jnp.pad(result, pad_width)
-    gathered = _process_allgather(padded)
-    if _TELEMETRY.enabled:
-        # the uneven contract moves world_size pad-to-max slabs; the padding
-        # beyond each rank's true shape is pure waste the accounting exposes
-        moved = int(padded.size) * itemsize * world
-        true_bytes = int(sum(int(np.prod(s)) for s in all_shapes)) * itemsize
-        _TELEMETRY.record_sync(
-            "gather_all_arrays",
-            gather_bytes=moved,
-            world_size=world,
-            pad_waste_bytes=moved - true_bytes,
-        )
-    return [g[tuple(slice(0, int(d)) for d in shp)] for g, shp in zip(gathered, all_shapes)]
+        return [g[tuple(slice(0, int(d)) for d in shp)] for g, shp in zip(gathered, all_shapes)]
 
 
 # ---------------------------------------------------------------------------
@@ -163,15 +169,18 @@ def all_gather_replicated(x: Array, axis_name: str, tiled: bool = True) -> Array
             axis=axis_name,
             in_jit=True,
         )
-    idx = jax.lax.axis_index(axis_name)
-    work_dtype = jnp.int32 if x.dtype == jnp.bool_ else x.dtype
-    buf = jnp.zeros((n,) + x.shape, work_dtype).at[idx].set(x.astype(work_dtype))
-    out = jax.lax.psum(buf, axis_name)
-    if x.dtype == jnp.bool_:
-        out = out.astype(jnp.bool_)
-    if tiled:
-        out = out.reshape((n * x.shape[0],) + x.shape[1:]) if x.ndim >= 1 else out
-    return out
+    # the span times the TRACE of the collective (host work, once per
+    # compilation), nesting under sync_in_mesh's span on the internal path
+    with _span("all_gather_replicated", axis=axis_name, in_jit=True):
+        idx = jax.lax.axis_index(axis_name)
+        work_dtype = jnp.int32 if x.dtype == jnp.bool_ else x.dtype
+        buf = jnp.zeros((n,) + x.shape, work_dtype).at[idx].set(x.astype(work_dtype))
+        out = jax.lax.psum(buf, axis_name)
+        if x.dtype == jnp.bool_:
+            out = out.astype(jnp.bool_)
+        if tiled:
+            out = out.reshape((n * x.shape[0],) + x.shape[1:]) if x.ndim >= 1 else out
+        return out
 
 
 def sync_in_mesh(
@@ -204,30 +213,35 @@ def sync_in_mesh(
             per_state_bytes[name] = nb * world if gathered else nb
         _MESH_SYNC_LOCAL.active = True
     try:
-        out: Dict[str, Union[Array, list]] = {}
-        for name, value in state.items():
-            red = reductions.get(name)
-            if isinstance(value, list):
-                cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
-                out[name] = [all_gather_replicated(cat, axis_name, tiled=True)]
-                continue
-            if red is None:
-                # "gathered, not reduced" parity: stack per-rank values along a new dim 0
-                out[name] = all_gather_replicated(value, axis_name, tiled=False)
-            elif red == "sum":
-                out[name] = jax.lax.psum(value, axis_name)
-            elif red == "mean":
-                out[name] = jax.lax.pmean(value, axis_name)
-            elif red == "max":
-                out[name] = jax.lax.pmax(value, axis_name)
-            elif red == "min":
-                out[name] = jax.lax.pmin(value, axis_name)
-            elif red == "cat":
-                out[name] = all_gather_replicated(value, axis_name, tiled=True)
-            elif callable(red):
-                out[name] = red(all_gather_replicated(value, axis_name, tiled=False))
-            else:
-                raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
+        # one span for the whole mesh sync trace; the internal
+        # all_gather_replicated spans nest under it (their *sync events*
+        # stay suppressed so bytes are not double-counted — spans are pure
+        # timing rows and nest freely)
+        with _span("sync_in_mesh", axis=axis_name, in_jit=True):
+            out: Dict[str, Union[Array, list]] = {}
+            for name, value in state.items():
+                red = reductions.get(name)
+                if isinstance(value, list):
+                    cat = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
+                    out[name] = [all_gather_replicated(cat, axis_name, tiled=True)]
+                    continue
+                if red is None:
+                    # "gathered, not reduced" parity: stack per-rank values along a new dim 0
+                    out[name] = all_gather_replicated(value, axis_name, tiled=False)
+                elif red == "sum":
+                    out[name] = jax.lax.psum(value, axis_name)
+                elif red == "mean":
+                    out[name] = jax.lax.pmean(value, axis_name)
+                elif red == "max":
+                    out[name] = jax.lax.pmax(value, axis_name)
+                elif red == "min":
+                    out[name] = jax.lax.pmin(value, axis_name)
+                elif red == "cat":
+                    out[name] = all_gather_replicated(value, axis_name, tiled=True)
+                elif callable(red):
+                    out[name] = red(all_gather_replicated(value, axis_name, tiled=False))
+                else:
+                    raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
     finally:
         if record:
             _MESH_SYNC_LOCAL.active = False

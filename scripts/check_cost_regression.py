@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Gate CI on bench regressions: wall-time AND compiled-cost drift.
+
+Diffs two bench artifacts (JSON-lines files as emitted by ``bench.py`` —
+one object per config, e.g. the committed ``BENCH_rNN.json`` rounds)::
+
+    python scripts/check_cost_regression.py BENCH_new.json --baseline BENCH_r05.json
+    python scripts/check_cost_regression.py BENCH_new.json --baseline BENCH_r05.json \
+        --tolerance 0.10 --cost-tolerance 0.02
+
+Two independent checks per metric present in BOTH artifacts:
+
+* **wall time** — the ``value`` field, direction-aware by unit: ``ms``
+  units are latencies (higher = regression), every other unit is a
+  throughput (lower = regression). Fails when the current value is worse
+  than baseline by more than ``--tolerance`` (relative, default 10% — wall
+  clock is noisy).
+* **compiled cost** — the ``cost_analysis.flops`` / ``.bytes_accessed``
+  fields that ``bench.py --cost-analysis`` embeds. The compiler's estimate
+  is deterministic for a fixed graph, so the default ``--cost-tolerance``
+  is tight (1%): any real growth in compiled flops/bytes is a code change,
+  not noise. Missing cost fields on either side skip the check (older
+  artifacts predate ``--cost-analysis``).
+
+Exit status 0 when clean, 1 with a per-metric listing otherwise; entries
+with an ``error`` field and metrics present on only one side are reported
+but never fail the gate (configs come and go between rounds).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_records(path: str) -> Dict[str, Dict[str, Any]]:
+    """Parse a JSON-lines bench artifact into {metric_name: record}; later
+    lines win (bench re-runs append)."""
+    records: Dict[str, Dict[str, Any]] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            name = obj.get("metric")
+            if name:
+                records[name] = obj
+    return records
+
+
+def _lower_is_better(record: Dict[str, Any]) -> bool:
+    """Latency-style units (ms, ns/call, ...) regress upward; rate units
+    (x/sec) regress downward. Anything that is not a per-second rate is
+    treated as a latency/cost — the conservative default for unknown
+    units, since passing a real regression is worse than flagging a win."""
+    unit = str(record.get("unit", "")).lower()
+    return not ("/sec" in unit or unit.endswith("/s"))
+
+
+def compare(
+    current: Dict[str, Dict[str, Any]],
+    baseline: Dict[str, Dict[str, Any]],
+    tolerance: float = 0.10,
+    cost_tolerance: float = 0.01,
+) -> Tuple[List[str], List[str]]:
+    """Returns (regressions, notes) — human-readable lines. A non-empty
+    regressions list means the gate fails."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    for name in sorted(set(current) | set(baseline)):
+        cur, base = current.get(name), baseline.get(name)
+        if cur is None or base is None:
+            notes.append(f"{name}: only in {'baseline' if cur is None else 'current'} — skipped")
+            continue
+        if "error" in cur or "error" in base:
+            notes.append(f"{name}: carries an error field — skipped")
+            continue
+
+        cv, bv = cur.get("value"), base.get("value")
+        if isinstance(cv, (int, float)) and isinstance(bv, (int, float)) and bv:
+            lower_better = _lower_is_better(base)
+            ratio = cv / bv
+            worse = ratio > 1 + tolerance if lower_better else ratio < 1 - tolerance
+            arrow = f"{bv:g} -> {cv:g} {base.get('unit', '')}".strip()
+            if worse:
+                regressions.append(
+                    f"{name}: wall-time regression {arrow}"
+                    f" ({abs(ratio - 1) * 100:.1f}% worse, tolerance {tolerance * 100:.0f}%)"
+                )
+            else:
+                notes.append(f"{name}: wall ok ({arrow})")
+
+        for field in ("flops", "bytes_accessed"):
+            cc = _cost_field(cur, field)
+            bc = _cost_field(base, field)
+            if cc is None or bc is None or not bc:
+                continue
+            ratio = cc / bc
+            if ratio > 1 + cost_tolerance:
+                regressions.append(
+                    f"{name}: compiled {field} regression {bc:g} -> {cc:g}"
+                    f" (+{(ratio - 1) * 100:.2f}%, tolerance {cost_tolerance * 100:.0f}%)"
+                )
+            elif ratio < 1 - cost_tolerance:
+                notes.append(f"{name}: compiled {field} improved {bc:g} -> {cc:g}")
+    return regressions, notes
+
+
+def _cost_field(record: Dict[str, Any], field: str) -> Optional[float]:
+    cost = record.get("cost_analysis")
+    if not isinstance(cost, dict):
+        return None
+    value = cost.get(field)
+    return float(value) if isinstance(value, (int, float)) else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("current", help="bench JSON-lines artifact to check")
+    parser.add_argument("--baseline", required=True, help="bench artifact to compare against")
+    parser.add_argument("--tolerance", type=float, default=0.10, help="relative wall-time slack (default 0.10)")
+    parser.add_argument(
+        "--cost-tolerance", type=float, default=0.01, help="relative compiled-cost slack (default 0.01)"
+    )
+    args = parser.parse_args(argv)
+
+    regressions, notes = compare(
+        load_records(args.current),
+        load_records(args.baseline),
+        tolerance=args.tolerance,
+        cost_tolerance=args.cost_tolerance,
+    )
+    for line in notes:
+        print(f"  note: {line}")
+    if regressions:
+        print(f"FAIL: {len(regressions)} regression(s) vs {args.baseline}")
+        for line in regressions:
+            print(f"  {line}")
+        return 1
+    print(f"OK: no regressions vs {args.baseline}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,15 +1,19 @@
 #!/usr/bin/env python
-"""Fail if any ``metrics_tpu/`` module calls ``print()`` directly.
+"""Fail if any ``metrics_tpu/`` module calls ``print()`` or a bare
+``warnings.warn`` directly.
 
 All user-facing output from library code must route through the rank-zero
 helpers in ``metrics_tpu/utils/prints.py`` (``rank_zero_print`` /
 ``rank_zero_info`` / ``rank_zero_warn``) so multi-host jobs emit one copy
-and logging stays filterable. A raw ``print()`` in library code spams every
-process in a pod job.
+and logging stays filterable. A raw ``print()`` — or a raw
+``warnings.warn()``, which is just print with a category — in library code
+spams every process in a pod job.
 
-AST-based: only real ``print(...)`` call sites count — doctest examples and
-other string content never false-positive. Exit status 0 when clean, 1 with
-a ``path:line`` listing otherwise. Run from anywhere:
+AST-based: only real call sites count — doctest examples and other string
+content never false-positive. Both ``warnings.warn(...)`` attribute calls
+and ``warn(...)`` calls after ``from warnings import warn`` are flagged.
+Exit status 0 when clean, 1 with a ``path:line`` listing otherwise. Run
+from anywhere:
 
     python scripts/check_no_print.py
 """
@@ -20,20 +24,47 @@ import sys
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 PACKAGE = REPO_ROOT / "metrics_tpu"
 
-# the one module allowed to touch print: it defines the gated helpers
+# the one module allowed to touch print/warnings.warn: it defines the
+# gated helpers
 ALLOWED = {PACKAGE / "utils" / "prints.py"}
 
 
-def print_call_lines(path: pathlib.Path):
-    """Line numbers of every ``print(...)`` call expression in ``path``."""
+def offender_lines(path: pathlib.Path):
+    """(lineno, kind) of every raw ``print(...)`` / ``warnings.warn(...)``
+    call expression in ``path``."""
     tree = ast.parse(path.read_text(), filename=str(path))
-    return [
-        node.lineno
+    warn_aliases = {
+        alias.asname or alias.name
         for node in ast.walk(tree)
-        if isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Name)
-        and node.func.id == "print"
-    ]
+        if isinstance(node, ast.ImportFrom) and node.module == "warnings"
+        for alias in node.names
+        if alias.name == "warn"
+    }
+    # `import warnings` / `import warnings as w` — every bound module name
+    module_aliases = {
+        alias.asname or alias.name
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Import)
+        for alias in node.names
+        if alias.name == "warnings"
+    }
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            out.append((node.lineno, "print()"))
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr == "warn"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in module_aliases
+        ):
+            out.append((node.lineno, "warnings.warn()"))
+        elif isinstance(func, ast.Name) and func.id in warn_aliases:
+            out.append((node.lineno, "warnings.warn()"))
+    return out
 
 
 def main() -> int:
@@ -41,12 +72,12 @@ def main() -> int:
     for path in sorted(PACKAGE.rglob("*.py")):
         if path in ALLOWED:
             continue
-        for lineno in print_call_lines(path):
-            offenders.append(f"{path.relative_to(REPO_ROOT)}:{lineno}")
+        for lineno, kind in offender_lines(path):
+            offenders.append(f"{path.relative_to(REPO_ROOT)}:{lineno} ({kind})")
     if offenders:
         sys.stderr.write(
-            "raw print() calls found in metrics_tpu/ — use the rank-zero helpers"
-            " from metrics_tpu/utils/prints.py instead:\n"
+            "raw print()/warnings.warn() calls found in metrics_tpu/ — use the"
+            " rank-zero helpers from metrics_tpu/utils/prints.py instead:\n"
         )
         for offender in offenders:
             sys.stderr.write(f"  {offender}\n")

@@ -1,0 +1,386 @@
+"""Compiler-level profiling, trace spans, and job-wide aggregation tests
+(ISSUE 3 tentpole): compiled-cost attribution, recompile billing, span
+nesting + Perfetto export, cross-host counter merging, the Prometheus
+text-format contract, and the continuous exporter."""
+import json
+import os
+import re
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MetricCollection, Precision, Recall
+from metrics_tpu.aggregation import MeanMetric, SumMetric
+from metrics_tpu.classification import ROC, ConfusionMatrix
+from metrics_tpu.observability import (
+    PeriodicExporter,
+    aggregate_across_hosts,
+    compiled_cost,
+    counter_payload,
+    current_span_id,
+    export_perfetto,
+    get_recorder,
+    merge_payloads,
+    metric_compile_cost,
+    render_prometheus,
+    span,
+    summary,
+)
+
+
+@pytest.fixture
+def recorder():
+    """The default recorder, enabled for one test and ALWAYS disabled+reset
+    after — the session-level conftest asserts nothing leaks."""
+    rec = get_recorder()
+    rec.reset()
+    rec.enable(recompile_threshold=rec.DEFAULT_RECOMPILE_THRESHOLD, footprint_warn_bytes=None)
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.footprint_warn_bytes = None
+        rec.recompile_threshold = rec.DEFAULT_RECOMPILE_THRESHOLD
+        rec.profile_compiles = False
+        rec.reset()
+
+
+# ---------------------------------------------------------------------------
+# compiled-cost profiling
+# ---------------------------------------------------------------------------
+
+def test_compiled_cost_classification_entry_point(recorder):
+    """Acceptance: compiled_cost returns flops/bytes estimates for a jitted
+    classification entry point under JAX_PLATFORMS=cpu, and records a
+    typed compile event with a non-empty cost payload."""
+    from metrics_tpu.functional.classification.auroc import auroc_rank_multiclass
+
+    preds = jnp.asarray(np.random.RandomState(0).rand(64, 10).astype(np.float32))
+    target = jnp.asarray(np.random.RandomState(1).randint(0, 10, 64), dtype=jnp.int32)
+    report = compiled_cost(
+        lambda p, t: auroc_rank_multiclass(p, t, 10, average="macro"),
+        preds,
+        target,
+        entry="auroc_rank_multiclass",
+    )
+    assert report["entry"] == "auroc_rank_multiclass"
+    assert report["flops"] and report["flops"] > 0
+    assert report["bytes_accessed"] and report["bytes_accessed"] > 0
+    # the wall breakdown is a real measurement, not placeholders
+    assert report["compile_s"] > 0
+    assert report["lower_s"] >= 0 and report["trace_s"] >= 0
+    assert report["cost_analysis"]["flops"] == report["flops"]
+    # JSON-safe end to end (the event stream and BENCH artifacts embed it)
+    json.dumps(report)
+
+    compile_events = [e for e in recorder.events() if e["type"] == "compile"]
+    assert len(compile_events) == 1
+    assert compile_events[0]["entry"] == "auroc_rank_multiclass"
+    assert compile_events[0]["cost_analysis"]["flops"] > 0
+    assert recorder.compile_counts() == {"auroc_rank_multiclass": 1}
+    assert recorder.compile_times()["auroc_rank_multiclass"] > 0
+
+
+def test_recompile_billing_via_profile_compiles(recorder):
+    """Acceptance: with profile_compiles on, every NEW (shape, dtype)
+    signature a metric update sees — i.e. every recompile — logs a compile
+    event carrying a non-empty cost-analysis payload; cache hits do not."""
+    recorder.profile_compiles = True
+    m = ConfusionMatrix(num_classes=4)
+    preds = jnp.asarray(np.random.RandomState(0).randint(0, 4, 16), dtype=jnp.int32)
+    target = jnp.asarray(np.random.RandomState(1).randint(0, 4, 16), dtype=jnp.int32)
+    m.update(preds, target)          # signature 1 -> compile event
+    m.update(preds, target)          # cache hit -> no new compile event
+    m.update(preds[:8], target[:8])  # signature 2 -> compile event
+
+    compile_events = [e for e in recorder.events() if e["type"] == "compile"]
+    assert len(compile_events) == 2
+    assert all(e["entry"] == "ConfusionMatrix.update" for e in compile_events)
+    for event in compile_events:
+        assert event["cost_analysis"], "recompile event must carry a non-empty cost payload"
+        assert event["cost_analysis"]["flops"] >= 0
+        assert event["compile_ms"] > 0
+    assert recorder.compile_counts() == {"ConfusionMatrix.update": 2}
+
+
+def test_metric_compile_cost_declines_list_state_metrics(recorder):
+    """Cat-state (list) metrics have no single compiled executable to bill;
+    the hook must decline, never crash the hot path."""
+    roc = ROC()
+    roc.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    assert metric_compile_cost(roc, (jnp.asarray([0.2]), jnp.asarray([1])), {}) is None
+
+
+# ---------------------------------------------------------------------------
+# trace spans
+# ---------------------------------------------------------------------------
+
+def test_span_disabled_is_free():
+    rec = get_recorder()
+    assert not rec.enabled
+    with span("noop") as sp:
+        assert sp.span_id is None
+        assert current_span_id() is None
+    assert rec.events() == []
+
+
+def test_span_nesting_and_event_attribution(recorder):
+    m = SumMetric()
+    with span("epoch", epoch=7) as outer:
+        assert current_span_id() == outer.span_id
+        m.update(jnp.asarray(1.0))
+    assert current_span_id() is None
+
+    events = recorder.events()
+    spans = {e["span_id"]: e for e in events if e["type"] == "span"}
+    outer_event = spans[outer.span_id]
+    assert outer_event["name"] == "epoch"
+    assert outer_event["parent_id"] is None
+    assert outer_event["attributes"] == {"epoch": 7}
+    update_span = next(e for e in spans.values() if e["name"] == "SumMetric.update")
+    assert update_span["parent_id"] == outer.span_id
+    # the flat update row re-attaches to the tree via span_id
+    update_event = next(e for e in events if e["type"] == "update")
+    assert update_event["span_id"] == update_span["span_id"]
+
+
+def test_collection_metric_sync_span_tree_and_perfetto(recorder, tmp_path):
+    """Acceptance: spans nest correctly across collection -> metric -> sync,
+    and export_perfetto emits valid trace-event JSON."""
+    col = MetricCollection(
+        [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+    )
+    preds = jnp.asarray([2, 1, 2, 0])
+    target = jnp.asarray([0, 2, 0, 2])
+    col.update(preds, target)
+    # a custom dist_sync_fn simulates a 2-rank world single-process, forcing
+    # the full sync path (and its spans) inside compute
+    for m in col.values():
+        m.dist_sync_fn = lambda x, group=None: [x, x]
+    col.compute()
+
+    spans = [e for e in recorder.events() if e["type"] == "span"]
+    by_id = {e["span_id"]: e for e in spans}
+
+    def parents_of(name):
+        return [
+            by_id.get(e["parent_id"], {}).get("name")
+            for e in spans
+            if e["name"] == name
+        ]
+
+    assert parents_of("Precision.update") == ["MetricCollection.update"]
+    assert parents_of("Recall.update") == ["MetricCollection.update"]
+    assert parents_of("Precision.compute") == ["MetricCollection.compute"]
+    assert parents_of("Precision.sync") == ["Precision.compute"]
+    assert parents_of("Recall.sync") == ["Recall.compute"]
+
+    path = str(tmp_path / "trace.json")
+    assert export_perfetto(path, recorder) == path
+    doc = json.loads(Path(path).read_text())
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for te in doc["traceEvents"]:
+        assert {"ph", "ts", "dur", "pid", "tid", "name"} <= set(te)
+        assert te["ph"] == "X"
+        assert te["ts"] >= 0 and te["dur"] >= 0
+    # nesting survives the ts/dur rendering: each child span's interval sits
+    # inside its parent's (same clock domain up to rounding jitter)
+    eps_us = 2_000.0
+    te_by_name = {}
+    for te in doc["traceEvents"]:
+        te_by_name.setdefault(te["name"], []).append(te)
+    parent = te_by_name["Precision.compute"][0]
+    child = te_by_name["Precision.sync"][0]
+    assert child["ts"] >= parent["ts"] - eps_us
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + eps_us
+
+
+# ---------------------------------------------------------------------------
+# job-wide aggregation
+# ---------------------------------------------------------------------------
+
+def test_aggregate_across_hosts_single_process_is_local_noop(recorder):
+    """Acceptance: in a single-process run the aggregate IS the local
+    totals (world size 1, no collective touched)."""
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    float(m.compute())
+    recorder.record_sync("gather_all_arrays", gather_bytes=512, world_size=2)
+
+    agg = aggregate_across_hosts(recorder)
+    assert agg["world_size"] == 1
+    assert agg["call_counts"] == recorder.call_counts()
+    assert agg["call_times"] == pytest.approx(recorder.call_times())
+    assert agg["sync_totals"] == recorder.sync_totals()
+    assert agg["signature_counts"] == recorder.signature_counts()
+    assert len(agg["processes"]) == 1 and agg["processes"][0]["process"] == 0
+
+
+def test_merge_payloads_sums_counts_and_maxes_hwm(recorder):
+    m = SumMetric()
+    m.update(jnp.ones((2,)))
+    recorder.record_footprint(m, {"value": 128})
+    p0 = counter_payload(recorder)
+    p1 = json.loads(json.dumps(p0))  # an independent "rank 1" payload
+    p1["process"] = 1
+    p1["footprint_hwm"]["SumMetric"] = 512
+    p1["sync_totals"]["gather_bytes"] = 100
+
+    merged = merge_payloads([p0, p1])
+    assert merged["world_size"] == 2
+    assert merged["call_counts"][("SumMetric", "update")] == 2 * p0["call_counts"]["SumMetric|update"]
+    assert merged["footprint_hwm"]["SumMetric"] == 512  # max, not sum
+    assert merged["sync_totals"]["gather_bytes"] == p0["sync_totals"]["gather_bytes"] + 100
+    assert merged["dropped_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format contract (satellite): minimal in-repo parser
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+    r" (?P<value>[-+]?(?:[0-9]*\.)?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|[-+]?Inf)$"
+)
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format parser: returns {name: {"type": ..., "help":
+    ..., "samples": [(labels_dict, value)]}} and asserts structural rules
+    (HELP/TYPE precede samples; every line parses)."""
+    families = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert _NAME_RE.fullmatch(name), f"bad HELP name: {line!r}"
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"help": help_text, "type": None, "samples": []}
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, type_text = rest.partition(" ")
+            assert name in families, f"TYPE before HELP for {name}"
+            assert families[name]["type"] is None, f"duplicate TYPE for {name}"
+            assert type_text in ("counter", "gauge", "histogram", "summary", "untyped")
+            families[name]["type"] = type_text
+        elif line.startswith("#"):
+            continue  # free comment
+        else:
+            match = _SAMPLE_RE.match(line)
+            assert match, f"unparseable sample line: {line!r}"
+            name = match.group("name")
+            assert name in families, f"sample {name} has no preceding HELP/TYPE"
+            assert families[name]["type"] is not None, f"sample {name} precedes its TYPE"
+            labels = {}
+            if match.group("labels"):
+                for pair in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"', match.group("labels")):
+                    labels[pair[0]] = pair[1]
+            families[name]["samples"].append((labels, float(match.group("value"))))
+    return families
+
+
+def _assert_exposition_valid(text):
+    families = _parse_prometheus(text)
+    assert families, "empty exposition"
+    for name, family in families.items():
+        if family["type"] == "counter":
+            assert name.endswith("_total"), f"counter {name} must end in _total"
+    return families
+
+
+def test_prometheus_exposition_parses_without_process_label(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    float(m.compute())
+    recorder.record_sync("gather_all_arrays", gather_bytes=1024, world_size=4, pad_waste_bytes=16)
+    recorder.record_compile("MeanMetric.update", compile_s=0.01, cost={"flops": 8.0})
+
+    families = _assert_exposition_valid(render_prometheus(recorder))
+    calls = families["metrics_tpu_calls_total"]["samples"]
+    assert ({"metric": "MeanMetric", "phase": "update"}, 1.0) in calls
+    assert all("process" not in labels for labels, _ in calls)
+    assert families["metrics_tpu_compiles_total"]["samples"] == [({"entry": "MeanMetric.update"}, 1.0)]
+    assert families["metrics_tpu_gather_bytes_total"]["samples"] == [({}, 1024.0)]
+
+
+def test_prometheus_exposition_with_process_label(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    recorder.record_sync("gather_all_arrays", gather_bytes=64, world_size=2)
+    p0 = counter_payload(recorder)
+    p1 = json.loads(json.dumps(p0))
+    p1["process"] = 1
+    p1["sync_totals"]["gather_bytes"] = 96
+    merged = merge_payloads([p0, p1])
+
+    families = _assert_exposition_valid(render_prometheus(recorder, aggregate=merged))
+    # merged call counts stay unlabelled; per-rank families carry process
+    calls = families["metrics_tpu_calls_total"]["samples"]
+    assert ({"metric": "MeanMetric", "phase": "update"}, 2.0) in calls
+    gathers = dict(
+        (labels["process"], value)
+        for labels, value in families["metrics_tpu_gather_bytes_total"]["samples"]
+    )
+    assert gathers == {"0": 64.0, "1": 96.0}
+    seconds = families["metrics_tpu_call_seconds_total"]["samples"]
+    assert {labels["process"] for labels, _ in seconds} == {"0", "1"}
+
+
+# ---------------------------------------------------------------------------
+# continuous export
+# ---------------------------------------------------------------------------
+
+def test_periodic_exporter_writes_fresh_atomic_artifacts(recorder, tmp_path):
+    m = SumMetric()
+    m.update(jnp.asarray(1.0))
+    prom_path = str(tmp_path / "metrics.prom")
+    jsonl_path = str(tmp_path / "telemetry.jsonl")
+    exporter = PeriodicExporter(
+        interval_s=0.05, prometheus_path=prom_path, jsonl_path=jsonl_path, recorder=recorder
+    )
+    exporter.start()
+    try:
+        deadline = time.time() + 5.0
+        while not (os.path.exists(prom_path) and os.path.exists(jsonl_path)):
+            assert time.time() < deadline, "exporter never ticked"
+            time.sleep(0.02)
+        m.update(jnp.asarray(2.0))  # recorded after the first tick
+    finally:
+        exporter.stop()  # final export catches the late event
+
+    lines = Path(jsonl_path).read_text().splitlines()
+    events = [json.loads(line) for line in lines]  # every line round-trips
+    assert len(events) == len(recorder.events())
+    assert [e["type"] for e in events].count("update") == 2
+    _assert_exposition_valid(Path(prom_path).read_text())
+    # atomic writes leave no tmp droppings, and stop() is idempotent
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    exporter.stop()
+
+
+def test_periodic_exporter_requires_a_path():
+    with pytest.raises(ValueError):
+        PeriodicExporter(interval_s=1.0)
+
+
+# ---------------------------------------------------------------------------
+# summary alignment (satellite)
+# ---------------------------------------------------------------------------
+
+def test_summary_header_aligns_with_short_metric_names(recorder):
+    roc = ROC()  # 3-char name: shorter than the "metric" header itself
+    roc.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    lines = summary(recorder).splitlines()
+    header, row = lines[1], lines[2]
+    idx = header.index("phase")
+    assert header.startswith("metric")
+    assert row.startswith("ROC")
+    assert row[idx:].startswith("update"), f"phase column sheared: {row!r}"
