@@ -701,6 +701,104 @@ def bench_inference() -> None:
     )
 
 
+def bench_fused() -> None:
+    """Fused vs eager MetricCollection update throughput (ISSUE 4 tentpole).
+
+    A 6-metric classification collection (Accuracy / Precision / Recall /
+    F1Score / ConfusionMatrix / CohenKappa) is updated over batches cycling
+    THREE ragged shapes. The eager side pays one XLA dispatch per metric per
+    batch; the fused side runs ``compile_update(buckets=...)`` — one jitted
+    dispatch per batch with pad-and-mask bucketing, so the three shapes
+    share ONE compilation. Both sides get one untimed discovery batch first
+    (compute groups settle), and the timed region ends with a
+    block-until-ready over every state so kernel completion is inside it.
+    """
+    import jax
+    import jax.numpy as jnp
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.classification import (
+        Accuracy,
+        CohenKappa,
+        ConfusionMatrix,
+        F1Score,
+        Precision,
+        Recall,
+    )
+
+    rng = np.random.RandomState(7)
+    n_classes = 10
+    shapes = (1900, 2000, 2048)
+
+    def make_batch(n):
+        p = rng.rand(n, n_classes).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        return jnp.asarray(p), jnp.asarray(rng.randint(0, n_classes, n))
+
+    def make_collection():
+        return MetricCollection(
+            [
+                Accuracy(),
+                Precision(num_classes=n_classes, average="macro"),
+                Recall(num_classes=n_classes, average="macro"),
+                F1Score(num_classes=n_classes, average="macro"),
+                ConfusionMatrix(num_classes=n_classes),
+                CohenKappa(num_classes=n_classes),
+            ]
+        )
+
+    batches = [make_batch(n) for n in shapes]
+    epoch = batches * 10  # 30 timed updates, 3 ragged shapes interleaved
+
+    def block(col):
+        jax.block_until_ready(
+            [
+                getattr(m, s)
+                for m in col.values()
+                for s in m._defaults
+                if not isinstance(getattr(m, s), (list, int))
+            ]
+        )
+
+    eager, fused = make_collection(), make_collection()
+    # untimed discovery batch: compute groups settle before either side is
+    # measured, so the fused cache sees ONE stable metric structure
+    eager.update(*batches[0])
+    fused.update(*batches[0])
+    handle = fused.compile_update(buckets=(2048,))
+    for b in batches:  # warmup: compiles (fused) and caches (eager) per shape
+        eager.update(*b)
+        fused.update(*b)
+    block(eager)
+    block(fused)
+
+    t0 = time.perf_counter()
+    for b in epoch:
+        eager.update(*b)
+    block(eager)
+    eager_ups = len(epoch) / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for b in epoch:
+        fused.update(*b)
+    block(fused)
+    fused_ups = len(epoch) / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "collection_fused_update_throughput",
+                "value": round(fused_ups, 1),
+                "unit": "updates/sec",
+                "eager_updates_per_sec": round(eager_ups, 1),
+                "fused_vs_eager": round(fused_ups / eager_ups, 3),
+                "bucketed_compiles": handle.n_compiles,
+                "bucketed_shapes": len(shapes),
+                "n_metrics": len(fused),
+            }
+        )
+    )
+
+
 def bench_telemetry() -> None:
     """Micro-bench for the telemetry zero-overhead-when-disabled contract:
     per-call wall cost of ``Metric.update`` with the recorder disabled vs
@@ -735,6 +833,17 @@ def bench_telemetry() -> None:
     if was_enabled:
         rec.enable()
 
+    # pin the `_coerce_foreign` all-native fast path (ISSUE 4 satellite):
+    # jax-array inputs must pass the update()-boundary coercion with a few
+    # isinstance checks, no recursion, no allocation
+    from metrics_tpu.core.metric import _coerce_foreign
+
+    native_args = (x, x)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        _coerce_foreign(native_args)
+    coerce_ns = (time.perf_counter() - t0) / n * 1e9
+
     print(
         json.dumps(
             {
@@ -742,6 +851,7 @@ def bench_telemetry() -> None:
                 "value": round(disabled_ns, 1),
                 "unit": "ns/call",
                 "enabled_ns_per_call": round(enabled_ns, 1),
+                "coerce_fastpath_ns_per_call": round(coerce_ns, 1),
             }
         )
     )
@@ -754,6 +864,7 @@ SUBCOMMANDS = {
     "sync": bench_sync,
     "inference": bench_inference,
     "telemetry": bench_telemetry,
+    "fused": bench_fused,
 }
 
 
@@ -836,7 +947,7 @@ def main() -> None:
     import subprocess
 
     records = []  # every emitted JSON object, for the --baseline check
-    for name in ("map", "retrieval", "image", "inference", "sync", "telemetry"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "telemetry"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],

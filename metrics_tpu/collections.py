@@ -65,6 +65,7 @@ class MetricCollection:
         self._enable_compute_groups = compute_groups
         self._groups: Dict[int, List[str]] = {}
         self._groups_checked: bool = False
+        self._fused = None  # FusedUpdate handle once compile_update() is called
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -128,6 +129,9 @@ class MetricCollection:
             self._update_impl(*args, **kwargs)
 
     def _update_impl(self, *args: Any, **kwargs: Any) -> None:
+        if self._fused is not None:
+            self._fused(*args, **kwargs)
+            return
         if self._groups_checked:
             for cg in self._groups.values():
                 m0 = self._metrics[cg[0]]
@@ -242,7 +246,11 @@ class MetricCollection:
             state2 = getattr(metric2, key)
             if type(state1) is not type(state2):
                 return False
-            if isinstance(state1, jnp.ndarray):
+            if isinstance(state1, (int, float)):
+                # host-resident counters (the eager `_n_updates` fast path)
+                if state1 != state2:
+                    return False
+            elif isinstance(state1, jnp.ndarray):
                 if state1.shape != state2.shape or not bool(jnp.allclose(state1, state2)):
                     return False
             elif isinstance(state1, list):
@@ -274,6 +282,45 @@ class MetricCollection:
         res = {k: m.compute() for k, m in self.items(keep_base=True)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
+
+    def compile_update(self, buckets=None, donate=None):
+        """Compile the whole collection's update into ONE jitted XLA dispatch.
+
+        Returns a :class:`metrics_tpu.core.fused.FusedUpdate` handle and
+        routes subsequent :meth:`update` calls through it: every fusible
+        member metric's pure ``update_state`` transform (one per compute
+        group, not per metric) runs inside a single jitted
+        ``(states, batch) -> states`` function with donated state buffers,
+        including the per-metric mean-merge counter bump. Metrics flagged
+        ``__jit_unsafe__``, wrapper metrics, and list-state metrics fall
+        back to the eager per-metric path transparently in the same call.
+
+        ``buckets`` — optional ascending batch-size buckets for pad-and-mask
+        shape bucketing: shape-varying batches pad up to the nearest bucket
+        and reuse its one compilation instead of recompiling per shape.
+        ``donate`` — override the backend-derived buffer-donation default
+        (donation is honored on TPU/GPU; donated state arrays must not be
+        aliased by callers). See docs/fused_updates.md.
+
+        ``forward`` keeps the eager double-update semantics; ``clone()``
+        drops the handle (compiled executables are not copyable) and the
+        clone re-compiles on first use.
+        """
+        from metrics_tpu.core.fused import FusedUpdate
+
+        self._fused = FusedUpdate(self, buckets=buckets, donate=donate)
+        return self._fused
+
+    @property
+    def fused_update(self):
+        """The active :class:`FusedUpdate` handle, or ``None`` (eager)."""
+        return self._fused
+
+    def state_reductions(self) -> Dict[str, Dict[str, Any]]:
+        """Per-metric reducer specs (name -> ``Metric.state_reductions()``)
+        — the shape :func:`metrics_tpu.parallel.distributed.sync_pytree_in_mesh`
+        takes for a one-collective-round sync of the whole collection."""
+        return {name: m.state_reductions() for name, m in self._metrics.items()}
 
     def reset(self) -> None:
         """Reset all metrics; discovered compute groups are kept (parity with
@@ -372,6 +419,7 @@ class MetricCollection:
             raise ValueError("Unknown input to MetricCollection.")
 
         self._groups_checked = False
+        self._fused = None  # membership changed: any compiled fused update is stale
         if self._enable_compute_groups:
             self._init_compute_groups()
         else:

@@ -1,0 +1,4 @@
+from metrics_tpu.core.fused import FUSED_ENTRY, FusedUpdate  # noqa: F401
+from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401
+
+__all__ = ["CompositionalMetric", "FUSED_ENTRY", "FusedUpdate", "Metric"]

@@ -1,0 +1,461 @@
+"""Fused single-dispatch MetricCollection updates.
+
+A collection of N metrics updated eagerly pays N separate XLA dispatches per
+batch (plus one more per metric for the mean-merge counter bump), with host
+round-trips between each. This module stitches every member metric's pure
+``update_state`` transform into ONE jitted ``(states, batch) -> states``
+function, so the whole collection's update is a single device dispatch:
+
+* **Donated state buffers** — the states pytree is passed with
+  ``donate_argnums=0`` (on backends that honor donation), so accumulator
+  updates are in-place on device instead of allocate-and-copy. Callers must
+  not hold outside references to state arrays across a fused update.
+* **Signature-keyed compile cache** — entries are keyed on the batch's
+  array (shape, dtype) signature, the non-array (static) arguments, the
+  fused metric set, and the states' own signature, following the bucketing
+  precedent in ``functional/detection/mean_ap.py`` / ``functional/audio/
+  stoi.py``. Each entry is AOT-compiled once (``jit -> lower -> compile``)
+  and billed to telemetry as its own ``compile`` event.
+* **Pad-and-mask shape bucketing** — with ``buckets=(...)``, shape-varying
+  batches are edge-padded along the leading axis to the nearest bucket and
+  the pad rows' contribution is subtracted inside the kernel (one extra
+  single-row update per metric), so ≥3 ragged batch shapes share ONE
+  compilation — the exact recompile failure mode the telemetry recorder
+  warns about. Exact for sum-reduced states (the pad rows replicate the
+  last real row, so their contribution is ``k * delta(last_row)``) and a
+  no-op for max/min-reduced states (a replicated row cannot move an
+  extremum); metrics with mean/custom/None-reduced array states decline
+  bucketing, as does any metric flagging ``__fused_bucket_unsafe__``.
+* **Compute-group dedup** — once groups are known, only group leaders are
+  updated inside the fused kernel (one update per group, not per metric),
+  the same 2-3x sharing the eager path provides.
+* **Transparent fallback** — metrics flagged ``__jit_unsafe__``, wrapper
+  metrics (child registries), list ("cat") states, and metrics whose update
+  fails a one-time trace probe run through the ordinary eager per-metric
+  path in the same call, so the fused path composes with any collection.
+
+The auto-registered ``_n_updates`` mean-merge counter is bumped INSIDE the
+kernel (once per batch, sentinel-preserving), eliminating the per-metric
+``jnp.where`` dispatch of the eager path.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import _AUTO_COUNT, Metric, _coerce_foreign
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
+from metrics_tpu.utils.data import dim_zero_max, dim_zero_min, dim_zero_sum
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+#: telemetry entry point for fused-update signature tracking (the recompile
+#: detector) and per-cache-entry compile billing
+FUSED_ENTRY = "MetricCollection.fused_update"
+
+#: one-time warning threshold for compile-cache growth — an un-bucketed
+#: ragged pipeline (or a per-batch static scalar) compiles per batch, and
+#: that must be loud even with telemetry off
+_CACHE_WARN_ENTRIES = 16
+
+
+def _supports_donation() -> bool:
+    """Buffer donation is honored on TPU/GPU; XLA:CPU ignores it (with a
+    per-dispatch warning), so donation defaults off there."""
+    return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+
+
+def _pure_update(metric: Metric, state: Dict[str, Any], args: Tuple, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """``(state, batch) -> state`` through the metric's ``_update``, WITHOUT
+    the auto-count bump or telemetry — the fused kernel owns both."""
+    old = metric._bind(state)
+    try:
+        metric._update(*args, **kwargs)
+        return {k: getattr(metric, k) for k in metric._defaults}
+    finally:
+        for k, v in old.items():
+            object.__setattr__(metric, k, v)
+
+
+def _state_pytree(metric: Metric) -> Dict[str, Array]:
+    """The metric's current array-state pytree (host ints — the eager
+    counter fast path — re-materialize as int32 scalars)."""
+    out = {}
+    for name in metric._defaults:
+        val = getattr(metric, name)
+        out[name] = jnp.asarray(val, jnp.int32) if isinstance(val, int) else jnp.asarray(val)
+    return out
+
+
+def _default_pytree(metric: Metric) -> Dict[str, Array]:
+    return {k: jnp.asarray(v) for k, v in metric._defaults.items()}
+
+
+class _CacheEntry:
+    __slots__ = ("fn", "aot", "index", "calls")
+
+    def __init__(self, fn: Any, aot: bool, index: int) -> None:
+        self.fn = fn
+        self.aot = aot
+        self.index = index
+        self.calls = 0
+
+
+class FusedUpdate:
+    """Handle returned by :meth:`MetricCollection.compile_update`.
+
+    Calling the handle (or ``collection.update(...)`` once compiled) runs
+    the fused single-dispatch update. ``buckets`` enables pad-and-mask
+    shape bucketing along ``axis 0``; ``donate`` overrides the
+    backend-derived buffer-donation default.
+    """
+
+    def __init__(
+        self,
+        collection: Any,
+        buckets: Optional[Sequence[int]] = None,
+        donate: Optional[bool] = None,
+    ) -> None:
+        self._collection = collection
+        self._buckets: Tuple[int, ...] = tuple(sorted(int(b) for b in buckets)) if buckets else ()
+        if any(b <= 0 for b in self._buckets):
+            raise ValueError(f"bucket sizes must be positive, got {self._buckets}")
+        self._donate = _supports_donation() if donate is None else bool(donate)
+        self._cache: Dict[Tuple, _CacheEntry] = {}
+        self._fusible: Dict[Tuple, bool] = {}
+        self._bucket_ok: Dict[Tuple[str, ...], bool] = {}
+        self._bucket_warned = False
+        self.n_compiles = 0
+
+    # compiled executables (and the collection back-reference) must not be
+    # deep-copied: MetricCollection.clone() drops the handle and the clone
+    # re-compiles on its own first fused call
+    def __deepcopy__(self, memo: Dict) -> None:
+        return None
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    # ------------------------------------------------------------------
+    # fusibility / bucket eligibility
+    # ------------------------------------------------------------------
+    def _is_fusible(self, name: str, args: Tuple, kwargs: Dict[str, Any], sig: Tuple) -> bool:
+        m = self._collection._metrics[name]
+        if getattr(m, "__jit_unsafe__", False) or m._children:
+            return False
+        if any(isinstance(v, list) for v in m._defaults.values()) or any(
+            isinstance(getattr(m, k), list) for k in m._defaults
+        ):
+            return False
+        key = (name, sig)
+        cached = self._fusible.get(key)
+        if cached is not None:
+            return cached
+        # one-time trace probe: host-dependent updates (concrete value
+        # checks, data-dependent shapes) surface here instead of crashing
+        # the fused kernel build
+        try:
+            fkw = m._filter_kwargs(**kwargs)
+            jax.eval_shape(lambda s, a, kw: _pure_update(m, s, a, kw), _state_pytree(m), args, fkw)
+            ok = True
+        except Exception:
+            ok = False
+        self._fusible[key] = ok
+        return ok
+
+    def _bucket_eligible(self, names: List[str]) -> bool:
+        key = tuple(names)
+        cached = self._bucket_ok.get(key)
+        if cached is None:
+            cached = self._bucket_ok[key] = self._bucket_eligible_uncached(names)
+        return cached
+
+    def _bucket_eligible_uncached(self, names: List[str]) -> bool:
+        for name in names:
+            m = self._collection._metrics[name]
+            if getattr(m, "__fused_bucket_unsafe__", False):
+                return False
+            for sname, red in m._reductions.items():
+                if sname == _AUTO_COUNT:
+                    continue  # bumped once per batch; padding cannot skew it
+                if red not in (dim_zero_sum, dim_zero_max, dim_zero_min):
+                    return False
+                default = m._defaults[sname]
+                if red is dim_zero_sum and getattr(default, "dtype", None) == jnp.bool_:
+                    return False
+        return True
+
+    # ------------------------------------------------------------------
+    # call path
+    # ------------------------------------------------------------------
+    def __call__(self, *args: Any, **kwargs: Any) -> None:
+        col = self._collection
+        rec = _TELEMETRY if _TELEMETRY.enabled else None
+        t0 = time.perf_counter() if rec is not None else 0.0
+        args = _coerce_foreign(args)
+        kwargs = _coerce_foreign(kwargs)
+
+        if col._groups_checked:
+            leaders = [cg[0] for cg in col._groups.values()]
+        else:
+            leaders = list(col._metrics)
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        # floats trace as 0-d arrays: a per-batch Python scalar (a weight, a
+        # threshold) must not key the compile cache by VALUE, or every batch
+        # recompiles. Ints/bools/strings stay static — they are commonly
+        # structural (top_k, flags); a metric that needs a float concrete
+        # fails the fusibility probe and falls back to the eager path.
+        dyn_idx = {
+            i
+            for i, leaf in enumerate(leaves)
+            if isinstance(leaf, (jnp.ndarray, np.ndarray, float))
+        }
+        dyn = [jnp.asarray(leaves[i]) for i in sorted(dyn_idx)]
+        static = tuple((i, leaves[i]) for i in range(len(leaves)) if i not in dyn_idx)
+        sig = tuple((tuple(x.shape), str(x.dtype)) for x in dyn)
+
+        fused_set = {n for n in leaders if self._is_fusible(n, args, kwargs, sig)}
+        fused_names = [n for n in leaders if n in fused_set]
+        fallback_names = [n for n in leaders if n not in fused_set]
+
+        # eager fallback keeps the ordinary per-metric lifecycle (telemetry,
+        # coercion already done) — with group attribution intact
+        member_of = {cg[0]: cg for cg in col._groups.values()} if col._groups_checked else {}
+        for name in fallback_names:
+            m = col._metrics[name]
+            group = member_of.get(name, [name])
+            if rec is not None and len(group) > 1:
+                with rec.group_attribution(group):
+                    m.update(*args, **m._filter_kwargs(**kwargs))
+            else:
+                m.update(*args, **m._filter_kwargs(**kwargs))
+
+        bucket = cache_hit = None
+        if fused_names:
+            bucket, cache_hit = self._run_fused(fused_names, treedef, dyn, static, sig)
+
+        if not col._groups_checked and col._enable_compute_groups:
+            # first-call group discovery on the concrete post-update states
+            # (the eager path's semantics); the NEXT call fuses leaders only
+            col._merge_compute_groups()
+            col._groups_checked = True
+
+        if rec is not None:
+            rec.record_fused_update(
+                n_metrics=len(col._metrics),
+                n_fused=len(fused_names),
+                n_fallback=len(fallback_names),
+                duration_s=time.perf_counter() - t0,
+                n_groups=len(col._groups) if col._groups_checked else None,
+                bucket=bucket,
+                cache_entries=len(self._cache),
+                cache_hit=cache_hit,
+            )
+
+    def _run_fused(
+        self,
+        names: List[str],
+        treedef: Any,
+        dyn: List[Array],
+        static: Tuple,
+        sig: Tuple,
+    ) -> Tuple[Optional[int], bool]:
+        col = self._collection
+        bucket = self._pick_bucket(dyn, names)
+        n_valid = None
+        if bucket is not None:
+            n = next(int(x.shape[0]) for x in dyn if x.ndim >= 1)
+            n_valid = jnp.asarray(n, jnp.int32)
+            if bucket != n:
+                dyn = [
+                    jnp.pad(x, [(0, bucket - n)] + [(0, 0)] * (x.ndim - 1), mode="edge")
+                    if x.ndim >= 1
+                    else x
+                    for x in dyn
+                ]
+            sig = tuple((tuple(x.shape), str(x.dtype)) for x in dyn)
+
+        states = {name: _state_pytree(col._metrics[name]) for name in names}
+        state_sig = tuple(
+            (name, k, tuple(v.shape), str(v.dtype)) for name in names for k, v in states[name].items()
+        )
+        static_sig = tuple((i, repr(v)) for i, v in static)
+        key = (tuple(names), treedef, sig, static_sig, state_sig, bucket)
+
+        entry = self._cache.get(key)
+        cache_hit = entry is not None
+        if entry is None:
+            entry = self._compile(key, names, treedef, static, bucket, states, dyn, n_valid)
+            if len(self._cache) == _CACHE_WARN_ENTRIES:
+                rank_zero_warn(
+                    f"compile_update: the fused compile cache now holds"
+                    f" {_CACHE_WARN_ENTRIES} entries — shape-varying batches (or a"
+                    " per-batch static argument such as a Python int) are"
+                    " recompiling the fused kernel repeatedly. Pass"
+                    " `compile_update(buckets=...)` to collapse ragged batch"
+                    " sizes, and pass per-batch scalars as floats or 0-d arrays"
+                    " so they trace instead of keying the cache.",
+                    UserWarning,
+                )
+        if _TELEMETRY.enabled:
+            # feed the recompile detector: bucketed shapes collapse to one
+            # signature here, un-bucketed ragged batches accumulate and trip
+            # the standard recompile warning
+            _TELEMETRY.track_signature(FUSED_ENTRY, signature=(sig, static_sig, bucket))
+
+        entry.calls += 1
+        if bucket is not None:
+            new_states = entry.fn(states, dyn, n_valid)
+        else:
+            new_states = entry.fn(states, dyn)
+
+        member_of = {cg[0]: cg for cg in col._groups.values()} if col._groups_checked else {}
+        for name in names:
+            for mname in member_of.get(name, [name]):
+                # group members get the leader's NEW arrays too: after a
+                # donating update the previous arrays are dead buffers, and
+                # compute() installed exactly those into the members — they
+                # must never be left pointing at donated memory
+                m = col._metrics[mname]
+                for k, v in new_states[name].items():
+                    object.__setattr__(m, k, v)
+                m._update_called = True
+                m._computed = None
+        return bucket, cache_hit
+
+    def _pick_bucket(self, dyn: List[Array], names: List[str]) -> Optional[int]:
+        if not self._buckets or not dyn:
+            return None
+        # scalar leaves (traced Python floats, 0-d arrays) ride along
+        # unpadded; bucketing keys on the batched (ndim >= 1) leaves
+        batched = [x for x in dyn if x.ndim >= 1]
+        if not batched:
+            return None
+        n = int(batched[0].shape[0])
+        if n == 0:  # an empty batch has no last row to edge-pad from
+            return None
+        if any(int(x.shape[0]) != n for x in batched):
+            return None
+        if not self._bucket_eligible(names):
+            if not self._bucket_warned:
+                self._bucket_warned = True
+                rank_zero_warn(
+                    "compile_update: shape bucketing is disabled for this collection —"
+                    " a fused metric carries a mean/custom/None-reduced (or"
+                    " `__fused_bucket_unsafe__`) state with no exact pad correction."
+                    " Batches compile per exact shape instead.",
+                    UserWarning,
+                )
+            return None
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return None  # larger than every bucket: exact-shape entry
+
+    # ------------------------------------------------------------------
+    # kernel build + AOT compile
+    # ------------------------------------------------------------------
+    def _compile(
+        self,
+        key: Tuple,
+        names: List[str],
+        treedef: Any,
+        static: Tuple,
+        bucket: Optional[int],
+        states: Dict[str, Dict[str, Array]],
+        dyn: List[Array],
+        n_valid: Optional[Array],
+    ) -> _CacheEntry:
+        col_metrics = self._collection._metrics
+        static_map = dict(static)
+        n_leaves = len(static) + len(dyn)
+        dyn_pos = [i for i in range(n_leaves) if i not in static_map]
+
+        def rebuild(dyn_leaves: List[Array]) -> Tuple[Tuple, Dict[str, Any]]:
+            leaves: List[Any] = [None] * n_leaves
+            for i, v in static_map.items():
+                leaves[i] = v
+            for pos, v in zip(dyn_pos, dyn_leaves):
+                leaves[pos] = v
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        def _one_metric(name: str, state: Dict[str, Array], dyn_leaves: List[Array], k_pad: Optional[Array]) -> Dict[str, Array]:
+            m = col_metrics[name]
+            args, kwargs = rebuild(dyn_leaves)
+            fkw = m._filter_kwargs(**kwargs)
+            new = _pure_update(m, state, args, fkw)
+            if k_pad is not None:
+                # pad rows replicate the last real row: their contribution to
+                # a sum-reduced state is k * delta(last_row); max/min states
+                # cannot be moved by a replicated row and need no correction
+                pad_args, pad_kwargs = rebuild([x[-1:] if x.ndim >= 1 else x for x in dyn_leaves])
+                pad_fkw = m._filter_kwargs(**pad_kwargs)
+                init = _default_pytree(m)
+                d = _pure_update(m, init, pad_args, pad_fkw)
+                for s, v in new.items():
+                    if s != _AUTO_COUNT and m._reductions[s] is dim_zero_sum:
+                        delta = d[s] - init[s]
+                        new[s] = v - delta * k_pad.astype(jnp.result_type(delta))
+            if _AUTO_COUNT in new:
+                c = new[_AUTO_COUNT]
+                new[_AUTO_COUNT] = jnp.where(c < 0, c, c + 1)
+            return new
+
+        if bucket is not None:
+            def raw(states_in, dyn_leaves, n_ok):
+                k_pad = jnp.asarray(bucket, jnp.int32) - n_ok
+                return {n: _one_metric(n, states_in[n], dyn_leaves, k_pad) for n in names}
+            example = (states, dyn, n_valid)
+        else:
+            def raw(states_in, dyn_leaves):
+                return {n: _one_metric(n, states_in[n], dyn_leaves, None) for n in names}
+            example = (states, dyn)
+
+        index = self.n_compiles
+        label = f"{FUSED_ENTRY}[{index}]"
+        jitted = jax.jit(raw, donate_argnums=(0,) if self._donate else ())
+        t0 = time.perf_counter()
+        try:
+            lowered = jitted.lower(*example)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+            entry = _CacheEntry(compiled, aot=True, index=index)
+        except Exception:
+            # AOT pipeline unavailable: fall back to the jitted callable
+            # (jax's own cache compiles on first call instead)
+            t1 = t2 = time.perf_counter()
+            compiled = None
+            entry = _CacheEntry(jitted, aot=False, index=index)
+
+        self.n_compiles += 1
+        self._cache[key] = entry
+        if _TELEMETRY.enabled:
+            cost: Dict[str, float] = {}
+            memory: Dict[str, int] = {}
+            if compiled is not None:
+                from metrics_tpu.observability.profiling import _normalize_cost, _normalize_memory, _try
+
+                cost = _normalize_cost(_try(compiled.cost_analysis))
+                memory = _normalize_memory(_try(compiled.memory_analysis))
+            # per-cache-entry compile billing: each entry is its own labelled
+            # compile event, so the recompile count is priced entry by entry
+            _TELEMETRY.record_compile(
+                label,
+                trace_s=t1 - t0,
+                lower_s=0.0,
+                compile_s=t2 - t1,
+                cost=cost or None,
+                memory=memory or None,
+                n_fused_metrics=len(names),
+                bucket=bucket,
+                donated=self._donate and entry.aot,
+            )
+        return entry

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Uni
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.utils.data import (
     _flatten,
@@ -79,10 +80,27 @@ def _sentinel_count_sum(x: "Array") -> "Array":
     return jnp.where(jnp.all(x >= 0), jnp.sum(x, axis=0), jnp.asarray(-1, x.dtype))
 
 
+#: concrete types known to pass through coercion unchanged — `isinstance`
+#: against the abstract ``jax.Array`` costs more than the recursion it
+#: guards, so the fast path keys on exact types, learning each concrete
+#: jax array/tracer type the first time the slow path clears it
+_NATIVE_LEAF_TYPES = {np.ndarray}
+
+
 def _coerce_foreign(obj: Any) -> Any:
     """Convert foreign array types (torch tensors — the reference's native
     inputs) to jax arrays, recursing through lists/tuples/dicts; everything
-    else (jax/numpy arrays, strings, scalars) passes through unchanged."""
+    else (jax/numpy arrays, strings, scalars) passes through unchanged.
+
+    The common hot-path case — every top-level leaf already a jax/numpy
+    array — returns the input object untouched (same identity) without
+    recursing: one exact-type set lookup per leaf. ``bench.py telemetry``
+    pins the cost."""
+    t = type(obj)
+    if t in _NATIVE_LEAF_TYPES:
+        return obj
+    if (t is tuple or t is list) and all(type(o) in _NATIVE_LEAF_TYPES for o in obj):
+        return obj
     if hasattr(obj, "detach") and hasattr(obj, "cpu") and hasattr(obj, "numpy"):
         return jnp.asarray(torch_to_numpy(obj))
     if isinstance(obj, tuple):
@@ -91,6 +109,8 @@ def _coerce_foreign(obj: Any) -> Any:
         return [_coerce_foreign(o) for o in obj]
     if isinstance(obj, dict):
         return {k: _coerce_foreign(v) for k, v in obj.items()}
+    if isinstance(obj, jnp.ndarray):
+        _NATIVE_LEAF_TYPES.add(t)
     return obj
 
 
@@ -300,10 +320,33 @@ class Metric(ABC):
         ``load_state_dict``) and must STAY negative: updates after such a
         restore would otherwise rebuild a small positive count that misses
         the restored accumulation history, and ``merge_states`` would trust
-        it as a confident underweight."""
-        if _AUTO_COUNT in self._defaults:
-            count = getattr(self, _AUTO_COUNT)
-            object.__setattr__(self, _AUTO_COUNT, jnp.where(count < 0, count, count + 1))
+        it as a confident underweight.
+
+        Eager fast path: outside jit the counter stays a plain Python int —
+        the first bump after a reset/restore pays one host readback to
+        concretize it, and every later bump is host arithmetic instead of a
+        ``jnp.where`` device dispatch per update. Inside jit (tracer
+        counter, e.g. via ``update_state``) the jit-safe ``where`` form is
+        kept. Sync/checkpoint boundaries re-materialize the int as an int32
+        array, so the functional/distributed contracts are unchanged."""
+        if _AUTO_COUNT not in self._defaults:
+            return
+        count = getattr(self, _AUTO_COUNT)
+        if isinstance(count, int):
+            if count >= 0:
+                object.__setattr__(self, _AUTO_COUNT, count + 1)
+            return
+        if (
+            isinstance(count, jnp.ndarray)
+            and not isinstance(count, jax.core.Tracer)
+            # a multi-host global array (shard_states over a mesh) cannot be
+            # concretized on one host; it keeps the device-side bump
+            and getattr(count, "is_fully_addressable", True)
+        ):
+            c = int(count)
+            object.__setattr__(self, _AUTO_COUNT, c + 1 if c >= 0 else c)
+            return
+        object.__setattr__(self, _AUTO_COUNT, jnp.where(count < 0, count, count + 1))
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate into global state. Parity with reference metric.py:421-428,460-463.
@@ -448,7 +491,12 @@ class Metric(ABC):
     # distributed sync state machine
     # ------------------------------------------------------------------
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
-        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+        # the eager-path counter fast path keeps `_n_updates` as a Python
+        # int; the gather contract below only moves arrays
+        input_dict = {
+            attr: jnp.asarray(v, jnp.int32) if isinstance(v, int) else v
+            for attr, v in ((a, getattr(self, a)) for a in self._reductions)
+        }
 
         for attr in self._reductions:
             if self._cat_states.get(attr) and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
@@ -621,11 +669,16 @@ class Metric(ABC):
             # fallback instead of trusting a counter that missed its history
             if _AUTO_COUNT in state:
                 self._bump_auto_count()
-            return {
+            out = {
                 k: getattr(self, k)
                 for k in self._defaults
                 if k != _AUTO_COUNT or k in state
             }
+            # the eager counter fast path leaves a Python int behind; the
+            # functional contract returns array leaves
+            if isinstance(out.get(_AUTO_COUNT), int):
+                out[_AUTO_COUNT] = jnp.asarray(out[_AUTO_COUNT], jnp.int32)
+            return out
         finally:
             for k, v in old.items():
                 object.__setattr__(self, k, v)
@@ -731,6 +784,8 @@ class Metric(ABC):
             val = getattr(self, name)
             if isinstance(val, list):
                 out[name] = int(sum(_nbytes(v) for v in val))
+            elif isinstance(val, int):
+                out[name] = 4  # host-resident int32 counter (eager fast path)
             else:
                 out[name] = _nbytes(val)
         if include_children:

@@ -52,6 +52,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "footprint_hwm": dict(rec.footprint_high_water_marks()),
         "compile_counts": dict(rec.compile_counts()),
         "compile_times": dict(rec.compile_times()),
+        "fused_update_totals": dict(rec.fused_update_totals()),
         "dropped_events": rec.dropped_events(),
     }
 
@@ -94,6 +95,9 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         "footprint_hwm": _merge_max([p["footprint_hwm"] for p in payloads]),
         "compile_counts": _merge_sum([p["compile_counts"] for p in payloads]),
         "compile_times": _merge_sum([p["compile_times"] for p in payloads]),
+        # extensive, like the call counts they mirror (older payloads from
+        # pre-fused ranks simply contribute nothing)
+        "fused_update_totals": _merge_sum([p.get("fused_update_totals", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
         "processes": list(payloads),
     }

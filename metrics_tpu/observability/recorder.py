@@ -57,7 +57,8 @@ def current_span_id() -> Optional[int]:
 TELEMETRY_ENV_VAR = "METRICS_TPU_TELEMETRY"
 
 #: core lifecycle event types; auxiliary events ("recompile_warning",
-#: "footprint", "tracker_increment", "span", "compile") ride the same stream
+#: "footprint", "tracker_increment", "span", "compile", "fused_update")
+#: ride the same stream
 EVENT_TYPES = ("update", "compute", "forward", "sync")
 
 
@@ -154,6 +155,9 @@ class MetricRecorder:
         self._sync_events = 0
         self._compile_counts: Dict[str, int] = {}
         self._compile_times: Dict[str, float] = {}
+        self._fused_updates = 0
+        self._fused_metric_updates = 0
+        self._fused_fallback_updates = 0
         # per-thread compute-group attribution: a shared field would let
         # concurrent MetricCollection.update calls cross-attribute events
         self._group_local = threading.local()
@@ -196,6 +200,9 @@ class MetricRecorder:
             self._sync_events = 0
             self._compile_counts = {}
             self._compile_times = {}
+            self._fused_updates = 0
+            self._fused_metric_updates = 0
+            self._fused_fallback_updates = 0
             self._group_local = threading.local()
         return self
 
@@ -239,6 +246,17 @@ class MetricRecorder:
         """Cumulative trace+lower+compile wall seconds per entry point."""
         with self._lock:
             return dict(self._compile_times)
+
+    def fused_update_totals(self) -> Dict[str, int]:
+        """Aggregate fused-collection-update counters: batches dispatched
+        through the fused path, metric updates served inside fused kernels,
+        and metric updates that fell back to the eager loop."""
+        with self._lock:
+            return {
+                "fused_updates": self._fused_updates,
+                "fused_metric_updates": self._fused_metric_updates,
+                "fallback_metric_updates": self._fused_fallback_updates,
+            }
 
     def dropped_events(self) -> int:
         """Events discarded after the MAX_EVENTS buffer cap (aggregate
@@ -462,6 +480,34 @@ class MetricRecorder:
                 " or more frequent compute()+reset() cycles.",
                 UserWarning,
             )
+
+    def record_fused_update(
+        self,
+        n_metrics: int,
+        n_fused: int,
+        n_fallback: int,
+        duration_s: float,
+        **extra: Any,
+    ) -> None:
+        """Record ONE fused collection update (one XLA dispatch serving
+        ``n_fused`` metric updates, plus ``n_fallback`` eager fallbacks in
+        the same batch). Exactly one ``fused_update`` event per batch is
+        the fused path's dispatch-count contract — the guard test in
+        tests/bases/test_fused.py pins it."""
+        with self._lock:
+            self._fused_updates += 1
+            self._fused_metric_updates += int(n_fused)
+            self._fused_fallback_updates += int(n_fallback)
+            event: Dict[str, Any] = {
+                "type": "fused_update",
+                "t": round(time.time() - self._t0, 6),
+                "n_metrics": int(n_metrics),
+                "n_fused": int(n_fused),
+                "n_fallback": int(n_fallback),
+                "dur_ms": round(duration_s * 1e3, 4),
+            }
+            event.update(extra)
+            self._append(event)
 
     def record_event(self, etype: str, **fields: Any) -> None:
         """Record a free-form auxiliary event (e.g. ``tracker_increment``)."""

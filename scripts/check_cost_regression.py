@@ -53,6 +53,17 @@ def load_records(path: str) -> Dict[str, Dict[str, Any]]:
     return records
 
 
+#: extra per-record fields gated beyond value/cost_analysis — the fused
+#: MetricCollection bench (``collection_fused_update_throughput``) carries
+#: its speedup ratio and its compilation count in-line, and losing either
+#: (fused drops under eager, or bucketed shapes stop sharing a compile)
+#: is a regression even when raw wall throughput still passes
+AUX_FIELDS: Dict[str, str] = {
+    "fused_vs_eager": "higher",
+    "bucketed_compiles": "lower",
+}
+
+
 def _lower_is_better(record: Dict[str, Any]) -> bool:
     """Latency-style units (ms, ns/call, ...) regress upward; rate units
     (x/sec) regress downward. Anything that is not a per-second rate is
@@ -108,6 +119,20 @@ def compare(
                 )
             elif ratio < 1 - cost_tolerance:
                 notes.append(f"{name}: compiled {field} improved {bc:g} -> {cc:g}")
+
+        for field, direction in AUX_FIELDS.items():
+            cv, bv = cur.get(field), base.get(field)
+            if not (isinstance(cv, (int, float)) and isinstance(bv, (int, float))) or not bv:
+                continue
+            ratio = cv / bv
+            worse = ratio < 1 - tolerance if direction == "higher" else ratio > 1 + tolerance
+            if worse:
+                regressions.append(
+                    f"{name}: {field} regression {bv:g} -> {cv:g}"
+                    f" ({abs(ratio - 1) * 100:.1f}% worse, tolerance {tolerance * 100:.0f}%)"
+                )
+            else:
+                notes.append(f"{name}: {field} ok ({bv:g} -> {cv:g})")
     return regressions, notes
 
 

@@ -1,0 +1,351 @@
+"""Fused single-dispatch MetricCollection updates (ISSUE 4 tentpole).
+
+Parity suite: ``compile_update`` results must bit-match the eager loop
+across classification / regression / retrieval metrics, compute groups,
+``__jit_unsafe__`` fallbacks, and reset→update→compute cycles; the compile
+cache must collapse bucketed shapes into one compilation; and the fused
+path must issue exactly ONE ``fused_update`` telemetry event (one
+dispatch) per batch.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import Accuracy, ConfusionMatrix, Precision, Recall
+from metrics_tpu.core.fused import FUSED_ENTRY
+from metrics_tpu.core.metric import Metric, _coerce_foreign
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.parallel.distributed import sync_in_mesh, sync_pytree_in_mesh
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from metrics_tpu.retrieval import RetrievalMAP
+
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable(recompile_threshold=rec.DEFAULT_RECOMPILE_THRESHOLD)
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.recompile_threshold = rec.DEFAULT_RECOMPILE_THRESHOLD
+        rec.reset()
+
+
+def _cls_batch(rng, n, c=3):
+    preds = rng.rand(n, c).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    return jnp.asarray(preds), jnp.asarray(rng.randint(0, c, n))
+
+
+def _cls_collection():
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(num_classes=3, average="macro"),
+            Recall(num_classes=3, average="macro"),
+            ConfusionMatrix(num_classes=3),
+        ]
+    )
+
+
+def _assert_parity(eager, fused):
+    res_e, res_f = eager.compute(), fused.compute()
+    assert res_e.keys() == res_f.keys()
+    for key in res_e:
+        assert bool(jnp.array_equal(res_e[key], res_f[key])), (
+            f"{key}: eager {res_e[key]} != fused {res_f[key]}"
+        )
+
+
+class _MeanStateMetric(Metric):
+    """Running average with a mean-reduced state — exercises the in-kernel
+    `_n_updates` bump (and blocks bucketing: no exact pad correction)."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+    def _update(self, preds, target):
+        self.avg = (self.avg + jnp.mean(preds)) / 2
+
+    def _compute(self):
+        return self.avg
+
+
+class _JitUnsafeSum(Metric):
+    """Sum metric flagged untraceable — must use the eager fallback leg."""
+
+    __jit_unsafe__ = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _update(self, preds, target):
+        self.total = self.total + jnp.sum(preds)
+
+    def _compute(self):
+        return self.total
+
+
+def test_fused_parity_classification_with_compute_group():
+    rng = np.random.RandomState(0)
+    eager, fused = _cls_collection(), _cls_collection()
+    fused.compile_update()
+    for n in (64, 64, 64):
+        batch = _cls_batch(rng, n)
+        eager.update(*batch)
+        fused.update(*batch)
+    # Precision/Recall share a compute group on both paths
+    assert eager.compute_groups == fused.compute_groups
+    assert any(len(cg) > 1 for cg in fused.compute_groups.values())
+    _assert_parity(eager, fused)
+
+
+def test_fused_parity_regression():
+    rng = np.random.RandomState(1)
+    mk = lambda: MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    eager, fused = mk(), mk()
+    fused.compile_update()
+    for _ in range(3):
+        preds = jnp.asarray(rng.rand(50).astype(np.float32))
+        target = jnp.asarray(rng.rand(50).astype(np.float32))
+        eager.update(preds, target)
+        fused.update(preds, target)
+    _assert_parity(eager, fused)
+
+
+def test_fused_parity_retrieval_jit_unsafe_fallback(recorder):
+    """Retrieval metrics are `__jit_unsafe__` (data-dependent grouping):
+    they run through the eager fallback leg of the SAME fused call."""
+    rng = np.random.RandomState(2)
+    mk = lambda: MetricCollection([Accuracy(), RetrievalMAP()])
+    eager, fused = mk(), mk()
+    fused.compile_update()
+    idx = jnp.asarray(np.repeat(np.arange(8), 8))
+    for _ in range(2):
+        preds = jnp.asarray(rng.rand(64).astype(np.float32))
+        target = jnp.asarray((rng.rand(64) < 0.3).astype(np.int32))
+        eager.update(preds, target, indexes=idx)
+        fused.update(preds, target, indexes=idx)
+    _assert_parity(eager, fused)
+    totals = recorder.fused_update_totals()
+    assert totals["fused_updates"] == 2
+    assert totals["fallback_metric_updates"] == 2  # RetrievalMAP, both batches
+
+
+def test_fused_explicit_jit_unsafe_flag_falls_back():
+    rng = np.random.RandomState(3)
+    mk = lambda: MetricCollection([Accuracy(), _JitUnsafeSum()])
+    eager, fused = mk(), mk()
+    handle = fused.compile_update()
+    batch = _cls_batch(rng, 32)
+    eager.update(*batch)
+    fused.update(*batch)
+    _assert_parity(eager, fused)
+    assert handle.n_compiles == 1  # only Accuracy fused
+
+
+def test_fused_reset_update_compute_cycle():
+    rng = np.random.RandomState(4)
+    eager, fused = _cls_collection(), _cls_collection()
+    handle = fused.compile_update()
+    for _ in range(2):
+        batch = _cls_batch(rng, 64)
+        eager.update(*batch)
+        fused.update(*batch)
+    _assert_parity(eager, fused)
+    eager.reset()
+    fused.reset()
+    batch = _cls_batch(rng, 64)
+    eager.update(*batch)
+    fused.update(*batch)
+    _assert_parity(eager, fused)
+    # the post-reset cycle reuses the settled-structure cache entry
+    assert handle.cache_size == handle.n_compiles <= 2
+
+
+def test_fused_mean_state_counter_bumped_in_kernel():
+    eager = MetricCollection([_MeanStateMetric()])
+    fused = MetricCollection([_MeanStateMetric()])
+    fused.compile_update()
+    for i in range(3):
+        x = jnp.asarray([float(i), float(i + 1)])
+        eager.update(x, x)
+        fused.update(x, x)
+    _assert_parity(eager, fused)
+    counter_e = getattr(eager["_MeanStateMetric"], "_n_updates")
+    counter_f = getattr(fused["_MeanStateMetric"], "_n_updates")
+    assert int(counter_e) == int(counter_f) == 3
+    # eager fast path keeps a host int; the fused kernel owns a device bump
+    assert isinstance(counter_e, int)
+    assert isinstance(counter_f, jnp.ndarray)
+
+
+def test_bucketed_shapes_share_one_compilation(recorder):
+    """Two+ bucketed batch shapes must hit ONE compile-cache entry, with
+    bit parity against the eager loop on the unpadded batches."""
+    rng = np.random.RandomState(5)
+    groups = [["Accuracy"], ["Precision", "Recall"], ["ConfusionMatrix"]]
+    mk = lambda: MetricCollection(
+        [
+            Accuracy(),
+            Precision(num_classes=3, average="macro"),
+            Recall(num_classes=3, average="macro"),
+            ConfusionMatrix(num_classes=3),
+        ],
+        compute_groups=groups,  # pinned structure: no discovery recompile
+    )
+    eager, fused = mk(), mk()
+    handle = fused.compile_update(buckets=(128,))
+    for n in (100, 120, 128):
+        batch = _cls_batch(rng, n)
+        eager.update(*batch)
+        fused.update(*batch)
+    assert handle.cache_size == 1
+    assert handle.n_compiles == 1
+    assert recorder.signature_counts()[FUSED_ENTRY] == 1
+    assert recorder.compile_counts() == {f"{FUSED_ENTRY}[0]": 1}
+    _assert_parity(eager, fused)
+
+
+def test_fused_emits_exactly_one_event_per_batch(recorder):
+    """The dispatch-count guard: one typed `fused_update` event per batch,
+    and NO per-metric update events for fused metrics."""
+    rng = np.random.RandomState(6)
+    fused = _cls_collection()
+    fused.compile_update()
+    n_batches = 4
+    for _ in range(n_batches):
+        fused.update(*_cls_batch(rng, 64))
+    events = [e for e in recorder.events() if e["type"] == "fused_update"]
+    assert len(events) == n_batches
+    assert all(e["n_fallback"] == 0 for e in events)
+    # no eager per-metric update events leaked: the fused path is one dispatch
+    assert not [e for e in recorder.events() if e["type"] == "update"]
+    assert recorder.fused_update_totals()["fused_updates"] == n_batches
+
+
+def test_fused_bucketing_declined_for_mean_states():
+    """A mean-reduced state has no exact pad correction: bucketing must be
+    declined (with a warning), falling back to per-shape entries."""
+    import warnings
+
+    fused = MetricCollection([_MeanStateMetric()])
+    handle = fused.compile_update(buckets=(64,))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        fused.update(jnp.ones((10,)), jnp.ones((10,)))
+        fused.update(jnp.ones((20,)), jnp.ones((20,)))
+    assert any("bucketing is disabled" in str(w.message) for w in caught)
+    assert handle.n_compiles == 2  # per exact shape, not per bucket
+
+
+def test_fused_handle_dropped_on_clone_and_add():
+    fused = _cls_collection()
+    fused.compile_update()
+    assert fused.fused_update is not None
+    clone = fused.clone(prefix="val_")
+    assert clone.fused_update is None  # compiled executables are not copyable
+    clone.update(*_cls_batch(np.random.RandomState(7), 16))  # eager path works
+    fused.add_metrics(MeanSquaredError())
+    assert fused.fused_update is None  # membership change invalidates
+
+
+def test_fused_donation_defaults_off_on_cpu():
+    fused = _cls_collection()
+    handle = fused.compile_update()
+    assert handle._donate is False  # suite runs on forced-CPU devices
+    handle2 = fused.compile_update(donate=True)
+    assert handle2._donate is True
+
+
+def test_coerce_foreign_native_fast_path_keeps_identity():
+    x = jnp.asarray([1.0, 2.0])
+    args = (x, x)
+    assert _coerce_foreign(args) is args
+    assert _coerce_foreign(x) is x
+    npx = np.ones(3)
+    assert _coerce_foreign((npx,)) == (npx,)
+    # mixed containers still recurse
+    out = _coerce_foreign({"a": x, "b": [x]})
+    assert out["a"] is x and out["b"][0] is x
+
+
+def test_sync_pytree_in_mesh_one_round_matches_per_state():
+    """The fused whole-pytree sync must agree with the per-state
+    `sync_in_mesh` path for every reduction kind."""
+    n_dev = 8
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("rank",))
+    rng = np.random.RandomState(8)
+    per_rank = {
+        "m1": {
+            "total": jnp.asarray(rng.rand(n_dev).astype(np.float32)),
+            "hits": jnp.asarray(rng.randint(0, 5, (n_dev, 4)).astype(np.int32)),
+        },
+        "m2": {
+            "best": jnp.asarray(rng.rand(n_dev, 3).astype(np.float32)),
+            "avg": jnp.asarray(rng.rand(n_dev).astype(np.float32)),
+        },
+    }
+    reductions = {
+        "m1": {"total": "sum", "hits": "sum"},
+        "m2": {"best": "max", "avg": "mean"},
+    }
+
+    def body(total, hits, best, avg):
+        state = {
+            "m1": {"total": total[0], "hits": hits[0]},
+            "m2": {"best": best[0], "avg": avg[0]},
+        }
+        out = sync_pytree_in_mesh(state, reductions, "rank")
+        return out["m1"]["total"], out["m1"]["hits"], out["m2"]["best"], out["m2"]["avg"]
+
+    args = (
+        per_rank["m1"]["total"][:, None],
+        per_rank["m1"]["hits"][:, None],
+        per_rank["m2"]["best"][:, None],
+        per_rank["m2"]["avg"][:, None],
+    )
+    total, hits, best, avg = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P("rank"), P("rank"), P("rank"), P("rank")),
+            out_specs=(P(), P(), P(), P()),
+        )
+    )(*args)
+    assert np.allclose(total, per_rank["m1"]["total"].sum())
+    assert np.array_equal(np.asarray(hits)[0], np.asarray(per_rank["m1"]["hits"].sum(0)))
+    assert np.allclose(np.asarray(best)[0], per_rank["m2"]["best"].max(0))
+    assert np.allclose(avg, per_rank["m2"]["avg"].mean(0))
+
+
+def test_sync_pytree_in_mesh_records_one_sync_event(recorder):
+    n_dev = 8
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("rank",))
+    state_shapes = {"a": {"x": jnp.ones((n_dev, 2)), "y": jnp.ones((n_dev,))}}
+    reductions = {"a": {"x": "sum", "y": "max"}}
+
+    def body(x, y):
+        out = sync_pytree_in_mesh({"a": {"x": x[0], "y": y[0]}}, reductions, "rank")
+        return out["a"]["x"], out["a"]["y"]
+
+    jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P("rank"), P("rank")), out_specs=(P(), P())
+        )
+    )(state_shapes["a"]["x"][:, None], state_shapes["a"]["y"][:, None])
+    syncs = [e for e in recorder.events() if e["type"] == "sync"]
+    assert len(syncs) == 1
+    assert syncs[0]["source"] == "sync_pytree_in_mesh"
+    # sum(x) + max(y): two (reduction, dtype) groups, two collective rounds
+    assert syncs[0]["collective_rounds"] == 2
+    assert syncs[0]["n_states"] == 2
