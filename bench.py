@@ -464,6 +464,7 @@ def bench_sync() -> None:
     from jax.sharding import Mesh, PartitionSpec as P
 
     from metrics_tpu.parallel.distributed import sync_in_mesh
+    from metrics_tpu.utils.compat import shard_map
 
     n_dev = 8
     cap = 65536
@@ -491,7 +492,7 @@ def bench_sync() -> None:
         return total[None]
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             step,
             mesh=mesh,
             in_specs=(P("rank"), P("rank"), P("rank"), P("rank"), P("rank")),

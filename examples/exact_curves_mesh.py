@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu import AUROC, AveragePrecision
 from metrics_tpu.parallel.distributed import sync_in_mesh
+from metrics_tpu.utils.compat import shard_map
 
 
 def main() -> None:
@@ -94,7 +95,7 @@ def main() -> None:
                 ap.compute_state(sync_in_mesh(s_ap, ap.state_reductions(), "dp"))[None],
             )
 
-        return jax.shard_map(
+        return shard_map(
             device_eval, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=(P("dp"), P("dp"))
         )(preds, target)
 

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import Accuracy, MeanSquaredError
 from metrics_tpu.parallel.distributed import sync_in_mesh
+from metrics_tpu.utils.compat import shard_map
 
 
 def main() -> None:
@@ -83,7 +84,7 @@ def main() -> None:
                 jnp.mean(losses)[None],
             )
 
-        return jax.shard_map(
+        return shard_map(
             lambda p, x, y: body(
                 p,
                 # init states are replicated constants; mark them as varying

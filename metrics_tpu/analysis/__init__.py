@@ -1,0 +1,69 @@
+"""tracelint — static analysis for the framework's trace-safety invariants.
+
+The runtime enforces this codebase's contracts late: a host round-trip in
+an ``update`` kernel surfaces as a failed ``eval_shape`` fusibility probe
+(silent eager fallback), a Python scalar in a jitted-signature position as
+a recompile storm the telemetry recorder warns about, a stray collective
+as a multi-host hang. ``tracelint`` moves those checks to review time: an
+AST-based engine with a pluggable rule registry, per-line suppression
+pragmas (``# tracelint: disable=RULE-ID``), a checked-in baseline for
+grandfathered violations, and text/JSON reporters.
+
+Rule catalog (see ``docs/static_analysis.md`` for rationale + fix recipes):
+
+* **TL-TRACE** — host round-trips (``float()``/``int()``/``bool()``/
+  ``.item()``/``np.asarray``/``jax.device_get``/``.block_until_ready()``)
+  and Python ``if``/``while`` on traced values inside ``update``/``compute``
+  of metrics not declared ``__jit_unsafe__``, and inside functional kernels.
+* **TL-RECOMPILE** — Python-scalar / ``.shape``-derived values flowing into
+  jitted-signature positions (the hazard the fused-update 0-d-array
+  coercion guards against).
+* **TL-STATE** — registered-state attributes assigned outside
+  update/reset/sync contexts, ``add_state`` with an unknown
+  ``dist_reduce_fx``, and list-state / wrapper metrics missing an explicit
+  ``__jit_unsafe__`` declaration.
+* **TL-COLLECTIVE** — raw ``jax.lax.p*`` / ``process_allgather`` collectives
+  outside ``metrics_tpu/parallel/`` and ``observability/aggregate.py``.
+* **TL-PRINT** — raw ``print()`` / bare ``warnings.warn()`` in library code
+  (absorbs ``scripts/check_no_print.py``; the script remains as an alias).
+
+Run ``python scripts/tracelint.py`` (stdlib-only, no jax import) or
+``python -m metrics_tpu.analysis``.
+
+This package is deliberately stdlib-only so the CLI scripts can load it
+without importing the (jax-heavy) parent package.
+"""
+from .engine import (  # noqa: F401
+    FileContext,
+    LintResult,
+    Violation,
+    analyze_paths,
+    analyze_source,
+    default_package_root,
+    package_relpath,
+    suppressed_rules,
+)
+from .baseline import load_baseline, save_baseline, split_by_baseline  # noqa: F401
+from .reporters import render_json, render_text  # noqa: F401
+from .rules import RULE_REGISTRY, Rule, all_rules, get_rules, register_rule  # noqa: F401
+
+__all__ = [
+    "FileContext",
+    "LintResult",
+    "Violation",
+    "RULE_REGISTRY",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "default_package_root",
+    "get_rules",
+    "load_baseline",
+    "package_relpath",
+    "register_rule",
+    "render_json",
+    "render_text",
+    "save_baseline",
+    "split_by_baseline",
+    "suppressed_rules",
+]

@@ -1,0 +1,7 @@
+"""``python -m metrics_tpu.analysis`` — the tracelint CLI."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""tracelint command line.
+
+``python scripts/tracelint.py [paths...]`` (stdlib-only load) or
+``python -m metrics_tpu.analysis [paths...]``.
+
+Exit status: 0 when every violation is baselined or suppressed, 1 when new
+violations exist (or, with ``--check``, when the baseline is stale), 2 on
+usage errors. ``--baseline-update`` rewrites the baseline to the current
+violation set and always exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+from typing import List, Optional
+
+from .baseline import load_baseline, save_baseline, split_by_baseline
+from .engine import Violation, analyze_paths, default_package_root
+from .reporters import render_json, render_text
+from .rules import all_rules, get_rules
+
+#: repo-root-relative default; lives next to the other check scripts
+DEFAULT_BASELINE = "scripts/tracelint_baseline.json"
+
+
+def _repo_root() -> pathlib.Path:
+    return default_package_root().parent
+
+
+def _baseline_entry_violation(rule: str, path: str, snippet: str) -> Violation:
+    """Reconstruct a carry-over Violation from a baseline key (line/col are
+    informational only and not part of the key)."""
+    return Violation(rule=rule, path=path, line=0, col=0, message="", snippet=snippet)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tracelint",
+        description="Static analyzer for metrics_tpu's trace-safety, state, and recompile invariants.",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        type=pathlib.Path,
+        help="files/directories to lint (default: the metrics_tpu package)",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=pathlib.Path,
+        default=None,
+        help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: report every violation as new",
+    )
+    parser.add_argument(
+        "--baseline-update",
+        action="store_true",
+        help="rewrite the baseline to the current violation set and exit 0",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: additionally fail (exit 1) on stale baseline entries",
+    )
+    parser.add_argument("--json", action="store_true", help="emit the JSON report")
+    parser.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            sys.stdout.write(f"{rule.id}: {rule.description}\n")
+        return 0
+
+    try:
+        rules = get_rules(args.rules.split(",")) if args.rules else all_rules()
+    except KeyError as err:
+        sys.stderr.write(f"tracelint: {err.args[0]}\n")
+        return 2
+
+    paths = args.paths or [default_package_root()]
+    result = analyze_paths(paths, rules)
+    for err in result.parse_errors:
+        sys.stderr.write(f"tracelint: parse error: {err}\n")
+
+    analyzed = set(result.relpaths)
+    baseline_path = args.baseline or (_repo_root() / DEFAULT_BASELINE)
+    if args.baseline_update:
+        # scope the rewrite to the ANALYZED files: entries for files outside
+        # this run's paths are carried over untouched, so a partial-path
+        # update can never wipe other files' grandfathered violations
+        carried = [
+            v
+            for (rule, vpath, snippet), count in load_baseline(baseline_path).items()
+            for v in [_baseline_entry_violation(rule, vpath, snippet)] * count
+            if vpath not in analyzed
+        ]
+        entries = carried + list(result.violations)
+        save_baseline(baseline_path, entries)
+        sys.stdout.write(
+            f"tracelint: baseline {baseline_path} updated with "
+            f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'}"
+            f" ({len(carried)} carried over from outside the analyzed paths)\n"
+        )
+        return 0
+
+    baseline = load_baseline(baseline_path) if not args.no_baseline else None
+    if baseline is not None:
+        new, grandfathered, stale = split_by_baseline(result.violations, baseline)
+        # staleness is only meaningful for files this run actually looked at
+        stale = {k: n for k, n in stale.items() if k[1] in analyzed}
+    else:
+        new, grandfathered, stale = list(result.violations), [], {}
+
+    stale_count = sum(stale.values()) if stale else 0
+    if args.json:
+        sys.stdout.write(
+            render_json(
+                new,
+                grandfathered,
+                suppressed_count=len(result.suppressed),
+                n_files=result.n_files,
+                rules=[r.id for r in rules],
+                stale_count=stale_count,
+            )
+        )
+    else:
+        sys.stdout.write(
+            render_text(
+                new,
+                grandfathered,
+                suppressed_count=len(result.suppressed),
+                n_files=result.n_files,
+                stale_count=stale_count,
+            )
+        )
+
+    if new or result.parse_errors:
+        return 1
+    if args.check and stale_count:
+        return 1
+    return 0

@@ -1,0 +1,281 @@
+"""tracelint engine: file contexts, suppression pragmas, and the run loop.
+
+Stdlib-only (ast/pathlib/re): the scripts load this package standalone so a
+lint run never pays the jax import. Rules receive a :class:`FileContext`
+(parsed tree + import-alias maps) and yield :class:`Violation` records; the
+engine drops violations whose source line carries a
+``# tracelint: disable=RULE-ID`` pragma and hands the rest to the baseline
+partitioner.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: the package whose invariants the rules encode; relpaths are computed
+#: against this directory so path-scoped rules (TL-COLLECTIVE, TL-PRINT)
+#: stay stable no matter where the checkout lives
+PACKAGE_NAME = "metrics_tpu"
+
+_PRAGMA_RE = re.compile(r"#\s*tracelint:\s*disable=([A-Za-z0-9_\-,\s]+)")
+
+
+def suppressed_rules(line_text: str) -> Set[str]:
+    """Rule ids disabled by a ``# tracelint: disable=...`` pragma on a line.
+
+    Ids are comma-separated and case-insensitive; ``all`` disables every
+    rule. Text after the id list (a justification) is permitted:
+    ``# tracelint: disable=TL-TRACE — eager-only guard``.
+    """
+    match = _PRAGMA_RE.search(line_text)
+    if not match:
+        return set()
+    return {tok.strip().upper() for tok in match.group(1).split(",") if tok.strip()}
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One rule finding, addressed by package-relative path.
+
+    ``snippet`` (the stripped source line) — not the line number — is the
+    stable half of the baseline key, so unrelated edits above a
+    grandfathered violation don't invalidate the baseline.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class FileContext:
+    """Parsed view of one source file handed to every rule."""
+
+    def __init__(self, path: Optional[pathlib.Path], relpath: str, source: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self._alias_maps: Optional[Dict[str, Set[str]]] = None
+
+    # ------------------------------------------------------------------
+    # import-alias maps (lazy; shared by several rules)
+    # ------------------------------------------------------------------
+    def _aliases(self) -> Dict[str, Set[str]]:
+        if self._alias_maps is not None:
+            return self._alias_maps
+        numpy: Set[str] = set()
+        jnp: Set[str] = set()
+        jax_names: Set[str] = set()
+        lax: Set[str] = set()
+        warnings_mod: Set[str] = set()
+        warn_fns: Set[str] = set()
+        lax_collectives: Set[str] = set()
+        process_allgather: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "numpy":
+                        numpy.add(bound)
+                    elif alias.name == "jax.numpy" and alias.asname:
+                        jnp.add(alias.asname)
+                    elif alias.name == "jax":
+                        jax_names.add(bound)
+                    elif alias.name == "jax.lax" and alias.asname:
+                        lax.add(alias.asname)
+                    elif alias.name == "warnings":
+                        warnings_mod.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if node.module == "jax" and alias.name == "numpy":
+                        jnp.add(bound)
+                    elif node.module == "jax" and alias.name == "lax":
+                        lax.add(bound)
+                    elif node.module == "numpy":
+                        pass  # from-numpy imports are host by definition; TL-TRACE keys on np.<fn>
+                    elif node.module == "warnings" and alias.name == "warn":
+                        warn_fns.add(bound)
+                    elif node.module == "jax.lax":
+                        lax_collectives.add(bound)
+                    elif node.module and "multihost_utils" in node.module and alias.name == "process_allgather":
+                        process_allgather.add(bound)
+        self._alias_maps = {
+            "numpy": numpy,
+            "jnp": jnp,
+            "jax": jax_names,
+            "lax": lax,
+            "warnings": warnings_mod,
+            "warn_fns": warn_fns,
+            "lax_names": lax_collectives,
+            "process_allgather": process_allgather,
+        }
+        return self._alias_maps
+
+    @property
+    def numpy_aliases(self) -> Set[str]:
+        return self._aliases()["numpy"]
+
+    @property
+    def jnp_aliases(self) -> Set[str]:
+        return self._aliases()["jnp"]
+
+    @property
+    def jax_aliases(self) -> Set[str]:
+        return self._aliases()["jax"]
+
+    @property
+    def lax_aliases(self) -> Set[str]:
+        return self._aliases()["lax"]
+
+    @property
+    def warnings_aliases(self) -> Set[str]:
+        return self._aliases()["warnings"]
+
+    @property
+    def warn_fn_aliases(self) -> Set[str]:
+        return self._aliases()["warn_fns"]
+
+    @property
+    def lax_from_imports(self) -> Set[str]:
+        return self._aliases()["lax_names"]
+
+    @property
+    def process_allgather_aliases(self) -> Set[str]:
+        return self._aliases()["process_allgather"]
+
+    # ------------------------------------------------------------------
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def violation(self, rule_id: str, node: ast.AST, message: str) -> Violation:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Violation(
+            rule=rule_id,
+            path=self.relpath,
+            line=lineno,
+            col=col,
+            message=message,
+            snippet=self.line_text(lineno).strip(),
+        )
+
+
+@dataclass
+class LintResult:
+    """Outcome of one analyzer run (pre-baseline partitioning)."""
+
+    violations: List[Violation] = field(default_factory=list)
+    suppressed: List[Violation] = field(default_factory=list)
+    n_files: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+    #: package-relative paths of every file analyzed — lets the CLI scope
+    #: baseline updates/staleness to the analyzed subset
+    relpaths: List[str] = field(default_factory=list)
+
+
+def default_package_root() -> pathlib.Path:
+    """The ``metrics_tpu`` package directory (this file's grandparent)."""
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def package_relpath(path: pathlib.Path) -> str:
+    """Posix path relative to the ``metrics_tpu`` package dir when the file
+    lives under one; otherwise the bare filename (test fixtures, scripts)."""
+    parts = list(path.resolve().parts)
+    if PACKAGE_NAME in parts:
+        idx = len(parts) - 1 - parts[::-1].index(PACKAGE_NAME)
+        tail = parts[idx + 1 :]
+        if tail:
+            return "/".join(tail)
+    return path.name
+
+
+def run_rules(ctx: FileContext, rules: Sequence) -> Tuple[List[Violation], List[Violation]]:
+    """Run ``rules`` over one file; returns (kept, pragma-suppressed)."""
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    for rule in rules:
+        for violation in rule.check(ctx):
+            disabled = suppressed_rules(ctx.line_text(violation.line))
+            if "ALL" in disabled or violation.rule.upper() in disabled:
+                suppressed.append(violation)
+            else:
+                kept.append(violation)
+    return kept, suppressed
+
+
+def analyze_source(
+    source: str,
+    relpath: str = "<string>",
+    rules: Optional[Sequence] = None,
+    path: Optional[pathlib.Path] = None,
+) -> Tuple[List[Violation], List[Violation]]:
+    """Analyze a source string (the test-fixture entry point)."""
+    from .rules import all_rules
+
+    ctx = FileContext(path, relpath, source)
+    return run_rules(ctx, rules if rules is not None else all_rules())
+
+
+def iter_python_files(paths: Iterable[pathlib.Path]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def analyze_paths(
+    paths: Optional[Iterable[pathlib.Path]] = None,
+    rules: Optional[Sequence] = None,
+) -> LintResult:
+    """Analyze every ``*.py`` under ``paths`` (default: the whole package)."""
+    from .rules import all_rules
+
+    if paths is None:
+        paths = [default_package_root()]
+    if rules is None:
+        rules = all_rules()
+    result = LintResult()
+    for path in iter_python_files(paths):
+        try:
+            ctx = FileContext(path, package_relpath(path), path.read_text())
+        except (SyntaxError, UnicodeDecodeError) as err:
+            result.parse_errors.append(f"{path}: {err}")
+            continue
+        kept, suppressed = run_rules(ctx, rules)
+        result.violations.extend(kept)
+        result.suppressed.extend(suppressed)
+        result.n_files += 1
+        result.relpaths.append(ctx.relpath)
+    result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return result

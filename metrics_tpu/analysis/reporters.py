@@ -1,0 +1,80 @@
+"""tracelint reporters: human text and machine JSON.
+
+The JSON schema is stable (version-tagged) so CI annotators and editors can
+consume it:
+
+```json
+{
+  "version": 1,
+  "tool": "tracelint",
+  "violations": [
+    {"rule": "TL-TRACE", "path": "a.py", "line": 3, "col": 4,
+     "message": "...", "snippet": "...", "baselined": false}
+  ],
+  "summary": {"files": 10, "new": 1, "baselined": 0, "suppressed": 0,
+              "rules": ["TL-COLLECTIVE", "..."]}
+}
+```
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+from .engine import Violation
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_text(
+    new: Sequence[Violation],
+    baselined: Sequence[Violation] = (),
+    suppressed_count: int = 0,
+    n_files: int = 0,
+    stale_count: int = 0,
+) -> str:
+    """Human report: new violations with fix hints, then a summary line."""
+    out: List[str] = []
+    if new:
+        out.append("tracelint: NEW violations (fix, suppress with a justified")
+        out.append("`# tracelint: disable=RULE-ID` pragma, or re-baseline):")
+        for v in new:
+            out.append(f"  {v.render()}")
+            if v.snippet:
+                out.append(f"      {v.snippet}")
+    summary = (
+        f"tracelint: {n_files} files, {len(new)} new, {len(baselined)} baselined,"
+        f" {suppressed_count} suppressed"
+    )
+    if stale_count:
+        summary += f", {stale_count} stale baseline entr{'y' if stale_count == 1 else 'ies'} (run --baseline-update)"
+    out.append(summary)
+    return "\n".join(out) + "\n"
+
+
+def render_json(
+    new: Sequence[Violation],
+    baselined: Sequence[Violation] = (),
+    suppressed_count: int = 0,
+    n_files: int = 0,
+    rules: Sequence[str] = (),
+    stale_count: int = 0,
+) -> str:
+    payload = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "tracelint",
+        "violations": [
+            {**v.to_dict(), "baselined": False} for v in new
+        ] + [
+            {**v.to_dict(), "baselined": True} for v in baselined
+        ],
+        "summary": {
+            "files": n_files,
+            "new": len(new),
+            "baselined": len(baselined),
+            "suppressed": suppressed_count,
+            "stale_baseline_entries": stale_count,
+            "rules": sorted(rules),
+        },
+    }
+    return json.dumps(payload, indent=2) + "\n"

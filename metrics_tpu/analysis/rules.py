@@ -1,0 +1,847 @@
+"""tracelint rule registry and the built-in rule set.
+
+Every rule encodes a real invariant of this codebase (module docstrings of
+``core/metric.py``, ``core/fused.py``, ``parallel/distributed.py`` are the
+source of truth); the catalog with rationale and fix recipes lives in
+``docs/static_analysis.md``. Rules are registered via :func:`register_rule`
+so downstream projects (or later PRs) can add their own without touching
+the engine.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+from .engine import FileContext, Violation
+
+RULE_REGISTRY: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    """Base class for tracelint rules. Subclasses set ``id``/``description``
+    and implement ``check(ctx) -> Iterator[Violation]``."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def violation(self, ctx: FileContext, node: ast.AST, message: str) -> Violation:
+        return ctx.violation(self.id, node, message)
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and add to the registry (id-keyed)."""
+    instance = cls()
+    if not instance.id:
+        raise ValueError(f"rule {cls.__name__} must set an id")
+    RULE_REGISTRY[instance.id] = instance
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [RULE_REGISTRY[k] for k in sorted(RULE_REGISTRY)]
+
+
+def get_rules(ids: Optional[Iterable[str]] = None) -> List[Rule]:
+    if ids is None:
+        return all_rules()
+    out = []
+    for rule_id in ids:
+        key = rule_id.strip().upper()
+        if key not in RULE_REGISTRY:
+            raise KeyError(f"unknown tracelint rule {rule_id!r}; known: {sorted(RULE_REGISTRY)}")
+        out.append(RULE_REGISTRY[key])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def _last_name(node: ast.AST) -> Optional[str]:
+    """Rightmost identifier of a Name / dotted Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """``jax.lax.psum`` -> ["jax", "lax", "psum"]; empty if not a pure chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+#: string reducers ``add_state`` accepts (core/metric.py:244-255)
+KNOWN_REDUCERS = {"sum", "mean", "max", "min", "cat"}
+
+#: methods whose bodies are trace-scoped (the jit/fusion surface)
+TRACED_METHODS = {"_update", "_compute", "update", "compute", "update_state", "compute_state"}
+
+#: method-name patterns allowed to assign registered state
+_STATE_WRITE_TOKENS = ("update", "reset", "sync", "bind", "restore", "merge", "load", "init")
+_STATE_WRITE_METHODS = {"__init__", "set_dtype", "to_device", "shard_states", "state_dict"}
+
+#: attributes that are static under tracing — touching them is NOT a host
+#: round-trip (shape/dtype-derived control flow compiles away)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+#: builtins whose results are host/static values, not traced reads
+_STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "type", "range", "enumerate", "zip"}
+
+
+class ClassInfo:
+    """Per-class facts the stateful rules share."""
+
+    def __init__(self, node: ast.ClassDef) -> None:
+        self.node = node
+        self.name = node.name
+        self.base_names = [n for n in (_last_name(b) for b in node.bases) if n]
+        self.state_names: Set[str] = set()
+        self.list_state_names: Set[str] = set()
+        self.has_list_state = False
+        self.add_state_calls: List[ast.Call] = []
+        self.jit_unsafe_declared = False
+        self.jit_unsafe_truthy = False
+        self._scan()
+
+    def _scan(self) -> None:
+        for stmt in self.node.body:
+            target = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = _last_name(stmt.targets[0]) if isinstance(stmt.targets[0], ast.Name) else None
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                target = stmt.target.id
+            if target == "__jit_unsafe__":
+                self._record_decl(getattr(stmt, "value", None))
+        for node in ast.walk(self.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                # self.__jit_unsafe__ = ... (instance-level declaration)
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and tgt.attr == "__jit_unsafe__"
+                ):
+                    self._record_decl(node.value)
+                # self.__dict__["__jit_unsafe__"] = ... (shadows the class attr)
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and isinstance(tgt.value.value, ast.Name)
+                    and tgt.value.value.id == "self"
+                    and tgt.value.attr == "__dict__"
+                    and isinstance(tgt.slice, ast.Constant)
+                    and tgt.slice.value == "__jit_unsafe__"
+                ):
+                    self._record_decl(node.value)
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "add_state"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "self"
+                ):
+                    self.add_state_calls.append(node)
+                    if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):
+                        self.state_names.add(node.args[0].value)
+                    default = None
+                    if len(node.args) >= 2:
+                        default = node.args[1]
+                    for kw in node.keywords:
+                        if kw.arg == "default":
+                            default = kw.value
+                    if isinstance(default, ast.List):
+                        self.has_list_state = True
+                        if node.args and isinstance(node.args[0], ast.Constant):
+                            self.list_state_names.add(node.args[0].value)
+
+    def _record_decl(self, value: Optional[ast.AST]) -> None:
+        self.jit_unsafe_declared = True
+        if isinstance(value, ast.Constant):
+            self.jit_unsafe_truthy = self.jit_unsafe_truthy or bool(value.value)
+        else:
+            # a computed declaration: treat as possibly-unsafe (exempts
+            # TL-TRACE conservatively; still counts as declared for TL-STATE)
+            self.jit_unsafe_truthy = True
+
+    def methods(self) -> Iterator[ast.FunctionDef]:
+        for stmt in self.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield stmt
+
+
+def collect_classes(ctx: FileContext) -> Dict[str, ClassInfo]:
+    return {
+        node.name: ClassInfo(node)
+        for node in ctx.tree.body
+        if isinstance(node, ast.ClassDef)
+    }
+
+
+def _is_metric_like(info: ClassInfo, classes: Dict[str, ClassInfo], _seen: Optional[Set[str]] = None) -> bool:
+    """Metric subclass by name heuristic + in-module transitive bases; any
+    class registering state via ``add_state`` counts regardless of name."""
+    if info.add_state_calls:
+        return True
+    _seen = _seen or set()
+    for base in info.base_names:
+        if base == "Metric" or base.endswith("Metric"):
+            return True
+        if base in classes and base not in _seen:
+            _seen.add(base)
+            if _is_metric_like(classes[base], classes, _seen):
+                return True
+    return False
+
+
+def _resolved(info: ClassInfo, classes: Dict[str, ClassInfo], attr: str) -> bool:
+    """OR-fold a boolean ClassInfo attribute over in-module ancestors."""
+    seen: Set[str] = set()
+
+    def walk(ci: ClassInfo) -> bool:
+        if getattr(ci, attr):
+            return True
+        for base in ci.base_names:
+            if base in classes and base not in seen:
+                seen.add(base)
+                if walk(classes[base]):
+                    return True
+        return False
+
+    return walk(info)
+
+
+def _resolved_states(info: ClassInfo, classes: Dict[str, ClassInfo], attr: str = "state_names") -> Set[str]:
+    names: Set[str] = set()
+    seen: Set[str] = set()
+
+    def walk(ci: ClassInfo) -> None:
+        names.update(getattr(ci, attr))
+        for base in ci.base_names:
+            if base in classes and base not in seen:
+                seen.add(base)
+                walk(classes[base])
+
+    walk(info)
+    return names
+
+
+def _mentions_concrete_guard(node: ast.AST) -> bool:
+    """True when an expression calls the ``_is_concrete`` eager-only guard
+    (utils/checks.py) — the codebase's sanctioned pattern for host-side
+    checks that tracing skips."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _last_name(sub.func) == "_is_concrete":
+            return True
+    return False
+
+
+class _TracedNames:
+    """Conservative taint set: function parameters, locals assigned from
+    definitely-traced expressions, and ``self.<registered-state>`` reads.
+
+    Deliberately strict — a call to an unknown (host) helper BREAKS taint,
+    so host metadata derived from arrays (input-format modes, shape cases)
+    never flags. The cost is missing round-trips laundered through helper
+    returns; the fused path's runtime ``eval_shape`` probe still owns those.
+    """
+
+    def __init__(self, params: Set[str], states: Set[str], list_states: Set[str], ctx: FileContext) -> None:
+        self.names = set(params)
+        self.states = states - list_states  # list states are host containers
+        self.ctx = ctx
+
+    def mentions(self, node: ast.AST) -> bool:
+        """Does ``node`` read a definitely-traced value OTHER than via static
+        attrs (``.shape``/``.ndim``/``.dtype``/``.size``), static builtins,
+        or identity (``is``/``is not``) comparisons?"""
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return node.attr in self.states
+            return self.mentions(node.value)
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops
+        ):
+            # identity and container-membership (dict-key) checks are host
+            # structure reads, never value concretizations
+            return False
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in _STATIC_CALLS:
+                return False
+            # a jnp.* call produces a traced array by construction
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in self.ctx.jnp_aliases
+            ):
+                return True
+            # a method on a traced object (x.astype, x.at[...].set) is traced;
+            # any OTHER call (host helper) breaks taint on purpose
+            if isinstance(func, ast.Attribute) and self.mentions(func.value):
+                return True
+            return False
+        return any(self.mentions(child) for child in ast.iter_child_nodes(node))
+
+    def absorb_assign(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and self.mentions(stmt.value):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.names.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            self.names.add(el.id)
+        elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+            if self.mentions(stmt.value):
+                self.names.add(stmt.target.id)
+
+
+# ---------------------------------------------------------------------------
+# TL-TRACE
+# ---------------------------------------------------------------------------
+
+@register_rule
+class TraceRule(Rule):
+    """Host round-trips / concrete control flow on traced values inside the
+    jit-traced surface (``update``/``compute`` of non-``__jit_unsafe__``
+    metrics, and functional kernels).
+
+    A ``float()``/``.item()``/``np.asarray`` on a traced value forces a
+    device->host sync per batch and fails the ``FusedUpdate`` eval_shape
+    fusibility probe, silently demoting the whole collection to the eager
+    path; Python ``if``/``while`` on traced data raises
+    ``ConcretizationTypeError`` under jit. Host checks that tracing must
+    skip belong under an ``if _is_concrete(...)`` guard (utils/checks.py) —
+    guarded blocks are exempt.
+    """
+
+    id = "TL-TRACE"
+    description = (
+        "host round-trip or concrete control flow on a traced value inside update/compute"
+    )
+
+    _HOST_SYNC_METHODS = {"item", "block_until_ready"}
+    _CAST_BUILTINS = {"float", "int", "bool"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        classes = collect_classes(ctx)
+        for info in classes.values():
+            if not _is_metric_like(info, classes):
+                continue
+            if _resolved(info, classes, "jit_unsafe_truthy"):
+                continue  # declared host-side: the eager path is its contract
+            states = _resolved_states(info, classes)
+            list_states = _resolved_states(info, classes, "list_state_names")
+            for method in info.methods():
+                if method.name in TRACED_METHODS:
+                    yield from self._scan_function(ctx, method, states, list_states)
+        # functional kernels: the pure (state, batch) -> state surface. Only
+        # the unambiguous syncs are flagged here — host-side reference
+        # kernels (text tokenizers, audio DSP engines) legitimately use
+        # float()/np on Python data.
+        if ctx.relpath.startswith("functional/"):
+            for node in ctx.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    yield from self._scan_hard_syncs(ctx, node)
+
+    # -- metric-method scan ------------------------------------------------
+    def _scan_function(
+        self, ctx: FileContext, fn: ast.FunctionDef, states: Set[str], list_states: Set[str]
+    ) -> Iterator[Violation]:
+        params = {a.arg for a in list(fn.args.args) + list(fn.args.kwonlyargs) if a.arg != "self"}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        traced = _TracedNames(params, states, list_states, ctx)
+        yield from self._scan_stmts(ctx, fn.body, traced)
+
+    def _scan_stmts(self, ctx: FileContext, stmts: Sequence[ast.stmt], traced: _TracedNames) -> Iterator[Violation]:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                if _mentions_concrete_guard(stmt.test):
+                    # eager-only branch: host syncs here are the sanctioned
+                    # pattern; the else branch is the traced path
+                    yield from self._scan_stmts(ctx, stmt.orelse, traced)
+                    continue
+                # isinstance-bearing tests are host type-dispatch (the
+                # list-vs-array state idiom), not value reads
+                is_type_dispatch = any(
+                    isinstance(sub, ast.Call) and _last_name(sub.func) == "isinstance"
+                    for sub in ast.walk(stmt.test)
+                )
+                if not is_type_dispatch and traced.mentions(stmt.test):
+                    yield self.violation(
+                        ctx,
+                        stmt,
+                        "Python `if` on a traced value concretizes under jit; use jnp.where/"
+                        "lax.cond, hoist to a static (shape/dtype) check, or guard with "
+                        "`if _is_concrete(...)`",
+                    )
+                yield from self._scan_expr_container(ctx, stmt.test, traced)
+                yield from self._scan_stmts(ctx, stmt.body, traced)
+                yield from self._scan_stmts(ctx, stmt.orelse, traced)
+            elif isinstance(stmt, ast.While):
+                if traced.mentions(stmt.test):
+                    yield self.violation(
+                        ctx,
+                        stmt,
+                        "Python `while` on a traced value concretizes under jit; use "
+                        "lax.while_loop or restructure to static bounds",
+                    )
+                yield from self._scan_expr_container(ctx, stmt.test, traced)
+                yield from self._scan_stmts(ctx, stmt.body, traced)
+                yield from self._scan_stmts(ctx, stmt.orelse, traced)
+            elif isinstance(stmt, (ast.For, ast.With, ast.Try)):
+                for field_name in ("body", "orelse", "finalbody"):
+                    yield from self._scan_stmts(ctx, getattr(stmt, field_name, []) or [], traced)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    yield from self._scan_stmts(ctx, handler.body, traced)
+                if isinstance(stmt, ast.For):
+                    yield from self._scan_expr_container(ctx, stmt.iter, traced)
+                if isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        yield from self._scan_expr_container(ctx, item.context_expr, traced)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan_stmts(ctx, stmt.body, traced)
+            else:
+                yield from self._scan_expr_container(ctx, stmt, traced)
+                traced.absorb_assign(stmt)
+
+    def _scan_expr_container(self, ctx: FileContext, node: ast.AST, traced: _TracedNames) -> Iterator[Violation]:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Attribute) and func.attr in self._HOST_SYNC_METHODS:
+                yield self.violation(
+                    ctx,
+                    sub,
+                    f"`.{func.attr}()` forces a device->host sync inside a traced "
+                    "update/compute; keep the value on device (jnp ops) or move the "
+                    "readback to the caller",
+                )
+            elif _last_name(func) == "device_get":
+                yield self.violation(
+                    ctx,
+                    sub,
+                    "`jax.device_get` inside update/compute blocks on a host transfer "
+                    "per batch; return the array and let the caller fetch it",
+                )
+            elif isinstance(func, ast.Name) and func.id in self._CAST_BUILTINS:
+                if any(traced.mentions(a) for a in sub.args):
+                    yield self.violation(
+                        ctx,
+                        sub,
+                        f"`{func.id}()` on a traced value is a host round-trip that "
+                        "breaks FusedUpdate fusion (forces `__jit_unsafe__`); keep it "
+                        "as a 0-d jnp array",
+                    )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in {"asarray", "array"}
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ctx.numpy_aliases
+            ):
+                if any(traced.mentions(a) for a in sub.args) or any(
+                    traced.mentions(kw.value) for kw in sub.keywords
+                ):
+                    yield self.violation(
+                        ctx,
+                        sub,
+                        f"`{func.value.id}.{func.attr}` on a traced value pulls it to "
+                        "host; use jnp.asarray so the kernel stays fusible",
+                    )
+
+    # -- functional-kernel scan (hard syncs only) --------------------------
+    def _scan_hard_syncs(self, ctx: FileContext, fn: ast.FunctionDef) -> Iterator[Violation]:
+        guarded: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.If) and _mentions_concrete_guard(node.test):
+                for sub in ast.walk(node):
+                    guarded.add(id(sub))
+        for node in ast.walk(fn):
+            if id(node) in guarded or not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in self._HOST_SYNC_METHODS:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"`.{func.attr}()` in a functional kernel forces a host sync; "
+                    "functional kernels must stay pure (state, batch) -> state",
+                )
+            elif _last_name(func) == "device_get":
+                yield self.violation(
+                    ctx,
+                    node,
+                    "`jax.device_get` in a functional kernel forces a host sync; "
+                    "return the array instead",
+                )
+
+
+# ---------------------------------------------------------------------------
+# TL-RECOMPILE
+# ---------------------------------------------------------------------------
+
+class _JitStaticSpec:
+    """Which argument positions/names of a jitted callable are STATIC.
+
+    Only static args key the compile cache by value (an ordinary Python
+    scalar passed dynamically traces as a weak-typed 0-d array and shares
+    one compilation), so the rule confines itself to them. ``unknown`` is
+    set when the static spec exists but cannot be parsed statically — then
+    every scalar-hazard arg is flagged (conservative).
+    """
+
+    def __init__(self) -> None:
+        self.argnums: Set[int] = set()
+        self.argnames: Set[str] = set()
+        self.unknown = False
+
+    def absorb(self, call: ast.Call, params: Optional[List[str]] = None) -> None:
+        for kw in call.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            values: List = []
+            node = kw.value
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+            for el in elts:
+                if isinstance(el, ast.Constant):
+                    values.append(el.value)
+                else:
+                    self.unknown = True
+            if kw.arg == "static_argnums":
+                self.argnums.update(v for v in values if isinstance(v, int))
+            else:
+                names = [v for v in values if isinstance(v, str)]
+                self.argnames.update(names)
+                if params is not None:
+                    # map names to positions so positional call sites
+                    # (the stoi idiom) are covered too
+                    self.argnums.update(params.index(n) for n in names if n in params)
+
+    def is_static(self, index: Optional[int], name: Optional[str]) -> bool:
+        if self.unknown:
+            return True
+        if index is not None and index in self.argnums:
+            return True
+        return name is not None and name in self.argnames
+
+    @property
+    def has_static(self) -> bool:
+        return self.unknown or bool(self.argnums) or bool(self.argnames)
+
+
+@register_rule
+class RecompileRule(Rule):
+    """Python-scalar / shape-derived values flowing into jitted STATIC
+    signature positions.
+
+    A ``.shape[0]`` / ``len(x)`` / ``int(...)`` value in a
+    ``static_argnums``/``static_argnames`` position is part of the compile
+    signature: every new value compiles a fresh executable — the
+    unbounded-recompile storm ``FusedUpdate``'s 0-d-array coercion
+    (core/fused.py) exists to prevent. Pass ``jnp.asarray(value)`` into a
+    dynamic position so the scalar traces instead (dynamic Python scalars
+    already trace and are not flagged).
+    """
+
+    id = "TL-RECOMPILE"
+    description = "Python scalar or .shape-derived value in a jitted static-arg position"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        jitted = self._jitted_specs(ctx)
+        if not jitted:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            spec = jitted.get(name)
+            if spec is None:
+                continue
+            flagged = [
+                (arg, i, None) for i, arg in enumerate(node.args)
+            ] + [(kw.value, None, kw.arg) for kw in node.keywords]
+            for arg, index, kwname in flagged:
+                if not spec.is_static(index, kwname):
+                    continue
+                hazard = self._scalar_hazard(arg)
+                if hazard:
+                    yield self.violation(
+                        ctx,
+                        arg,
+                        f"{hazard} flows into a STATIC position of jitted `{name}` and "
+                        "keys the compile cache per value; pass jnp.asarray(...) through "
+                        "a dynamic position so it traces",
+                    )
+
+    @staticmethod
+    def _jitted_specs(ctx: FileContext) -> Dict[str, _JitStaticSpec]:
+        jitted: Dict[str, _JitStaticSpec] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                value = node.value
+                if isinstance(value, ast.Call) and _last_name(value.func) == "jit":
+                    spec = _JitStaticSpec()
+                    spec.absorb(value)
+                    if spec.has_static:
+                        jitted[node.targets[0].id] = spec
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = [a.arg for a in node.args.args]
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and (
+                        _last_name(dec.func) == "jit"
+                        or (_last_name(dec.func) == "partial" and dec.args and _last_name(dec.args[0]) == "jit")
+                    ):
+                        spec = _JitStaticSpec()
+                        spec.absorb(dec, params)
+                        if spec.has_static:
+                            jitted[node.name] = spec
+        return jitted
+
+    @staticmethod
+    def _scalar_hazard(arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.Subscript) and isinstance(arg.value, ast.Attribute) and arg.value.attr == "shape":
+            return "a `.shape[...]` int"
+        if isinstance(arg, ast.Attribute) and arg.attr in {"ndim"}:
+            return "a `.ndim` int"
+        if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+            if arg.func.id == "len":
+                return "a `len(...)` int"
+            if arg.func.id in {"int", "float"}:
+                return f"a concretized `{arg.func.id}(...)` scalar"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TL-STATE
+# ---------------------------------------------------------------------------
+
+@register_rule
+class StateRule(Rule):
+    """State-registry discipline.
+
+    Registered states carry a ``dist_reduce_fx`` contract that sync, merge,
+    and the fused kernel all trust; writing one outside an
+    update/reset/sync context desynchronizes ``_defaults``/``_cache``
+    bookkeeping (a ``_compute`` that assigns state breaks compute-caching
+    and double-update ``forward``). List-state and wrapper metrics must
+    declare ``__jit_unsafe__`` explicitly — the fused path excludes them
+    either way, but the declaration is the reviewed, documented decision
+    (and the MetricTester keys its jit checks on it).
+    """
+
+    id = "TL-STATE"
+    description = "metric state registry discipline (writes, reducers, declarations)"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        classes = collect_classes(ctx)
+        for info in classes.values():
+            if not _is_metric_like(info, classes):
+                continue
+            yield from self._check_reducers(ctx, info)
+            yield from self._check_state_writes(ctx, info, classes)
+            yield from self._check_declarations(ctx, info, classes)
+
+    def _check_reducers(self, ctx: FileContext, info: ClassInfo) -> Iterator[Violation]:
+        for call in info.add_state_calls:
+            fx = None
+            if len(call.args) >= 3:
+                fx = call.args[2]
+            for kw in call.keywords:
+                if kw.arg == "dist_reduce_fx":
+                    fx = kw.value
+            if isinstance(fx, ast.Constant) and isinstance(fx.value, str) and fx.value not in KNOWN_REDUCERS:
+                yield self.violation(
+                    ctx,
+                    call,
+                    f"add_state with unknown dist_reduce_fx {fx.value!r}; use one of "
+                    f"{sorted(KNOWN_REDUCERS)}, None, or a callable",
+                )
+
+    def _check_state_writes(self, ctx: FileContext, info: ClassInfo, classes: Dict[str, ClassInfo]) -> Iterator[Violation]:
+        states = _resolved_states(info, classes)
+        if not states:
+            return
+        for method in info.methods():
+            name = method.name
+            if name in _STATE_WRITE_METHODS or any(tok in name for tok in _STATE_WRITE_TOKENS):
+                continue
+            for node in ast.walk(method):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and tgt.attr in states
+                    ):
+                        yield self.violation(
+                            ctx,
+                            node,
+                            f"registered state `{tgt.attr}` assigned in `{name}`, outside "
+                            "the update/reset/sync lifecycle; state writes elsewhere "
+                            "desync the reset defaults and the sync cache",
+                        )
+
+    def _check_declarations(self, ctx: FileContext, info: ClassInfo, classes: Dict[str, ClassInfo]) -> Iterator[Violation]:
+        # a subclass that registers no list state itself inherits the
+        # ancestor's declaration (or the ancestor is flagged on its own)
+        is_wrapper = ctx.relpath.startswith("wrappers/")
+        if not (is_wrapper or info.has_list_state):
+            return
+        if not _resolved(info, classes, "jit_unsafe_declared"):
+            kind = "wrapper metric" if is_wrapper else "list-state metric"
+            yield self.violation(
+                ctx,
+                info.node,
+                f"{kind} `{info.name}` must declare `__jit_unsafe__` explicitly "
+                "(True if update cannot trace, False if it can); the fused path "
+                "and MetricTester key on the declaration",
+            )
+
+
+# ---------------------------------------------------------------------------
+# TL-COLLECTIVE
+# ---------------------------------------------------------------------------
+
+@register_rule
+class CollectiveRule(Rule):
+    """Raw XLA collectives outside the transport layer.
+
+    ``parallel/distributed.py`` owns gather-byte/pad-waste telemetry, the
+    VMA-clean all-gather, and reduction-fusion; ``observability/
+    aggregate.py`` owns the host-level counter allgather. A raw
+    ``jax.lax.p*`` anywhere else bypasses that accounting and couples metric
+    code to mesh-axis names — route through ``sync_in_mesh`` /
+    ``gather_all_arrays`` instead.
+    """
+
+    id = "TL-COLLECTIVE"
+    description = "raw collective outside metrics_tpu/parallel or observability/aggregate.py"
+
+    COLLECTIVES = {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "psum_scatter",
+        "ppermute",
+        "pshuffle",
+        "pgather",
+        "all_gather",
+        "all_to_all",
+    }
+    ALLOWED_PREFIXES = ("parallel/",)
+    ALLOWED_FILES = {"observability/aggregate.py"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        rel = ctx.relpath
+        if rel.startswith(self.ALLOWED_PREFIXES) or rel in self.ALLOWED_FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            chain = _attr_chain(func)
+            name = chain[-1] if chain else None
+            if name in self.COLLECTIVES:
+                # jax.lax.psum / lax.psum / from jax.lax import psum
+                rooted_in_lax = "lax" in chain[:-1] or (
+                    isinstance(func, ast.Name) and func.id in ctx.lax_from_imports
+                )
+                if rooted_in_lax:
+                    yield self.violation(
+                        ctx,
+                        node,
+                        f"raw collective `{'.'.join(chain)}` outside the transport layer; "
+                        "route through parallel.distributed (sync_in_mesh/"
+                        "all_gather_replicated) so byte accounting and axis naming stay "
+                        "centralized",
+                    )
+            elif name == "process_allgather":
+                yield self.violation(
+                    ctx,
+                    node,
+                    "raw `process_allgather` outside the transport layer; use "
+                    "parallel.distributed.gather_all_arrays or observability."
+                    "aggregate.aggregate_across_hosts",
+                )
+
+
+# ---------------------------------------------------------------------------
+# TL-PRINT
+# ---------------------------------------------------------------------------
+
+@register_rule
+class PrintRule(Rule):
+    """Raw ``print()`` / bare ``warnings.warn()`` in library code.
+
+    Multi-host jobs run one Python process per host: an unguarded print
+    emits once per process. All user-facing output must route through the
+    rank-zero helpers in ``utils/prints.py`` (the one module allowed to
+    touch print/warnings directly). Absorbs ``scripts/check_no_print.py``,
+    which remains as a thin alias over this rule.
+    """
+
+    id = "TL-PRINT"
+    description = "raw print()/warnings.warn() in library code (use rank-zero helpers)"
+
+    ALLOWED_FILES = {"utils/prints.py"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if ctx.relpath in self.ALLOWED_FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                yield self.violation(
+                    ctx,
+                    node,
+                    "raw print() in library code; use rank_zero_print/rank_zero_info "
+                    "from metrics_tpu.utils.prints",
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "warn"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ctx.warnings_aliases
+            ):
+                yield self.violation(
+                    ctx,
+                    node,
+                    "bare warnings.warn() in library code; use rank_zero_warn from "
+                    "metrics_tpu.utils.prints",
+                )
+            elif isinstance(func, ast.Name) and func.id in ctx.warn_fn_aliases:
+                yield self.violation(
+                    ctx,
+                    node,
+                    "bare warn() in library code; use rank_zero_warn from "
+                    "metrics_tpu.utils.prints",
+                )

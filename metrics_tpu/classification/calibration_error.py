@@ -29,6 +29,8 @@ class CalibrationError(Metric):
 
     DISTANCES = {"l1", "l2", "max"}
     is_differentiable = False
+    #: list-append update traces; the cat states exclude it from fusion anyway
+    __jit_unsafe__ = False
 
     def __init__(
         self,

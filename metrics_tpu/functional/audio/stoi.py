@@ -193,6 +193,6 @@ def short_time_objective_intelligibility(
     obm = jnp.asarray(_third_octave_matrix(_FS, _NFFT, _NUM_BANDS, _MIN_FREQ))
     window = jnp.asarray(_hann(_N_FRAME))
     return _stoi_kernel(
-        jnp.asarray(x), jnp.asarray(y), obm, window, int(bucket), bool(extended),
+        jnp.asarray(x), jnp.asarray(y), obm, window, int(bucket), bool(extended),  # tracelint: disable=TL-RECOMPILE — bucket is rounded to 32s above, so the static-arg compile set is bounded by design
         jnp.asarray(num_segments, jnp.float32),
     ).astype(jnp.float32)

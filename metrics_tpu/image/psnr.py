@@ -28,6 +28,8 @@ class PeakSignalNoiseRatio(Metric):
 
     is_differentiable = True
     higher_is_better = True
+    #: list-append update traces; the cat states exclude it from fusion anyway
+    __jit_unsafe__ = False
 
     def __init__(
         self,

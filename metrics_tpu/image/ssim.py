@@ -31,6 +31,8 @@ class StructuralSimilarityIndexMeasure(Metric):
 
     is_differentiable = True
     higher_is_better = True
+    #: list-append update traces; the cat states exclude it from fusion anyway
+    __jit_unsafe__ = False
 
     def __init__(
         self,
@@ -79,6 +81,8 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
 
     is_differentiable = True
     higher_is_better = True
+    #: list-append update traces; the cat states exclude it from fusion anyway
+    __jit_unsafe__ = False
 
     def __init__(
         self,

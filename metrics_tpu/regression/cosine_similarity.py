@@ -30,6 +30,8 @@ class CosineSimilarity(Metric):
 
     is_differentiable = True
     higher_is_better = True
+    #: list-append update traces; the cat states exclude it from fusion anyway
+    __jit_unsafe__ = False
 
     def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
         super().__init__(**kwargs)

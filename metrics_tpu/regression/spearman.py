@@ -28,6 +28,8 @@ class SpearmanCorrCoef(Metric):
 
     is_differentiable = False
     higher_is_better = True
+    #: list-append update traces; the cat states exclude it from fusion anyway
+    __jit_unsafe__ = False
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)

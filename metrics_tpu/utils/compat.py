@@ -1,0 +1,22 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` namespace; builds in the wild sit on either
+side of the move (this container's jax has only the experimental path).
+Import it from here so library code, benchmarks, docs examples, and tests
+run on both:
+
+    from metrics_tpu.utils.compat import shard_map
+
+The call signature (``mesh=``, ``in_specs=``, ``out_specs=``) is identical
+on both sides of the move.
+"""
+
+import jax
+
+if callable(getattr(jax, "shard_map", None)):  # newer jax: top-level export
+    shard_map = jax.shard_map
+else:  # older jax: experimental namespace (or a non-callable module stub)
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map"]

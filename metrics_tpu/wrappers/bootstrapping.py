@@ -46,6 +46,10 @@ class BootStrapper(Metric):
         ['mean', 'std']
     """
 
+    #: delegates to the child metric's full eager lifecycle (telemetry,
+    #: coercion); the child registry already excludes it from fusion
+    __jit_unsafe__ = True
+
     def __init__(
         self,
         base_metric: Metric,

@@ -24,6 +24,10 @@ class ClasswiseWrapper(Metric):
         ['accuracy_dog', 'accuracy_fish', 'accuracy_horse']
     """
 
+    #: delegates to the child metric's full eager lifecycle (telemetry,
+    #: coercion); the child registry already excludes it from fusion
+    __jit_unsafe__ = True
+
     def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
         super().__init__()
         if not isinstance(metric, Metric):

@@ -24,6 +24,10 @@ class MinMaxMetric(Metric):
         ['max', 'min', 'raw']
     """
 
+    #: delegates to the child metric's full eager lifecycle (telemetry,
+    #: coercion); the child registry already excludes it from fusion
+    __jit_unsafe__ = True
+
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(base_metric, Metric):

@@ -40,6 +40,10 @@ class MultioutputWrapper(Metric):
         [0.9654, 0.9082]
     """
 
+    #: delegates to the child metric's full eager lifecycle (telemetry,
+    #: coercion); the child registry already excludes it from fusion
+    __jit_unsafe__ = True
+
     is_differentiable = False
 
     def __init__(

@@ -3,84 +3,40 @@
 ``warnings.warn`` directly.
 
 All user-facing output from library code must route through the rank-zero
-helpers in ``metrics_tpu/utils/prints.py`` (``rank_zero_print`` /
-``rank_zero_info`` / ``rank_zero_warn``) so multi-host jobs emit one copy
-and logging stays filterable. A raw ``print()`` — or a raw
-``warnings.warn()``, which is just print with a category — in library code
-spams every process in a pod job.
+helpers in ``metrics_tpu/utils/prints.py`` so multi-host jobs emit one copy
+and logging stays filterable.
 
-AST-based: only real call sites count — doctest examples and other string
-content never false-positive. Both ``warnings.warn(...)`` attribute calls
-and ``warn(...)`` calls after ``from warnings import warn`` are flagged.
-Exit status 0 when clean, 1 with a ``path:line`` listing otherwise. Run
-from anywhere:
+This script is now a thin alias over tracelint's **TL-PRINT** rule
+(``metrics_tpu/analysis/``) so one engine owns every convention check —
+same contract as before: exit 0 when clean, 1 with a ``path:line`` listing
+otherwise. Run from anywhere:
 
     python scripts/check_no_print.py
+
+Equivalent: ``python scripts/tracelint.py --rules TL-PRINT --no-baseline``.
 """
-import ast
 import pathlib
 import sys
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-PACKAGE = REPO_ROOT / "metrics_tpu"
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
 
-# the one module allowed to touch print/warnings.warn: it defines the
-# gated helpers
-ALLOWED = {PACKAGE / "utils" / "prints.py"}
-
-
-def offender_lines(path: pathlib.Path):
-    """(lineno, kind) of every raw ``print(...)`` / ``warnings.warn(...)``
-    call expression in ``path``."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    warn_aliases = {
-        alias.asname or alias.name
-        for node in ast.walk(tree)
-        if isinstance(node, ast.ImportFrom) and node.module == "warnings"
-        for alias in node.names
-        if alias.name == "warn"
-    }
-    # `import warnings` / `import warnings as w` — every bound module name
-    module_aliases = {
-        alias.asname or alias.name
-        for node in ast.walk(tree)
-        if isinstance(node, ast.Import)
-        for alias in node.names
-        if alias.name == "warnings"
-    }
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if isinstance(func, ast.Name) and func.id == "print":
-            out.append((node.lineno, "print()"))
-        elif (
-            isinstance(func, ast.Attribute)
-            and func.attr == "warn"
-            and isinstance(func.value, ast.Name)
-            and func.value.id in module_aliases
-        ):
-            out.append((node.lineno, "warnings.warn()"))
-        elif isinstance(func, ast.Name) and func.id in warn_aliases:
-            out.append((node.lineno, "warnings.warn()"))
-    return out
+from tracelint import load_analysis  # noqa: E402
 
 
 def main() -> int:
-    offenders = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        if path in ALLOWED:
-            continue
-        for lineno, kind in offender_lines(path):
-            offenders.append(f"{path.relative_to(REPO_ROOT)}:{lineno} ({kind})")
-    if offenders:
+    load_analysis()
+    from metrics_tpu.analysis import analyze_paths, get_rules
+
+    result = analyze_paths(rules=get_rules(["TL-PRINT"]))
+    if result.violations:
         sys.stderr.write(
             "raw print()/warnings.warn() calls found in metrics_tpu/ — use the"
             " rank-zero helpers from metrics_tpu/utils/prints.py instead:\n"
         )
-        for offender in offenders:
-            sys.stderr.write(f"  {offender}\n")
+        for v in result.violations:
+            kind = "print()" if v.message.startswith("raw print") else "warnings.warn()"
+            sys.stderr.write(f"  metrics_tpu/{v.path}:{v.line} ({kind})\n")
         return 1
     return 0
 

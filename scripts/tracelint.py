@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""tracelint CLI — static analysis of metrics_tpu's trace-safety, state,
+recompile, collective, and print conventions.
+
+Thin launcher over ``metrics_tpu/analysis/`` that loads the (stdlib-only)
+analysis package WITHOUT importing the jax-heavy parent package, so a lint
+run starts instantly and works on machines with no accelerator stack.
+``python -m metrics_tpu.analysis`` is the equivalent in-package entry point.
+
+    python scripts/tracelint.py                  # lint the package vs baseline
+    python scripts/tracelint.py --check          # CI mode (stale baseline fails)
+    python scripts/tracelint.py --baseline-update
+    python scripts/tracelint.py --json path/to/file.py
+    python scripts/tracelint.py --list-rules
+"""
+import importlib.util
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+_PKG_DIR = REPO_ROOT / "metrics_tpu" / "analysis"
+_PKG_NAME = "metrics_tpu.analysis"
+
+
+def load_analysis():
+    """Import ``metrics_tpu.analysis`` standalone (no parent-package import).
+
+    Registers a stub ``metrics_tpu`` package entry so the analysis
+    package's relative imports resolve without executing the real
+    ``metrics_tpu/__init__.py`` (which imports jax and every metric).
+    """
+    if _PKG_NAME in sys.modules:
+        return sys.modules[_PKG_NAME]
+    if "metrics_tpu" not in sys.modules:
+        import types
+
+        stub = types.ModuleType("metrics_tpu")
+        stub.__path__ = [str(_PKG_DIR.parent)]
+        sys.modules["metrics_tpu"] = stub
+    spec = importlib.util.spec_from_file_location(
+        _PKG_NAME,
+        _PKG_DIR / "__init__.py",
+        submodule_search_locations=[str(_PKG_DIR)],
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG_NAME] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+if __name__ == "__main__":
+    load_analysis()
+    from metrics_tpu.analysis.cli import main
+
+    sys.exit(main())

@@ -16,6 +16,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import Metric
 from metrics_tpu.parallel.distributed import gather_all_arrays, sync_in_mesh
+from metrics_tpu.utils.compat import shard_map
 from tests.bases.test_metric import DummyListMetric, DummyMetric
 
 
@@ -33,7 +34,7 @@ def test_sync_in_mesh_sum():
 
     data = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
     out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P("rank"), out_specs=P())
+        shard_map(body, mesh=mesh, in_specs=P("rank"), out_specs=P())
     )(data)
     assert np.allclose(out, data.sum())
 
@@ -49,7 +50,7 @@ def test_sync_in_mesh_all_reductions():
 
     data = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
     s, m, n, a = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P("rank"), out_specs=(P(), P(), P(), P()))
+        shard_map(body, mesh=mesh, in_specs=P("rank"), out_specs=(P(), P(), P(), P()))
     )(data)
     assert np.allclose(s, data.sum())
     assert np.allclose(m, data.max())
@@ -67,7 +68,7 @@ def test_sync_in_mesh_cat():
 
     data = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
     out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P("rank"), out_specs=P())
+        shard_map(body, mesh=mesh, in_specs=P("rank"), out_specs=P())
     )(data)
     assert np.allclose(np.sort(np.asarray(out).ravel()), np.arange(16))
 
@@ -84,7 +85,7 @@ def test_metric_update_inside_shard_map():
         return metric.compute_state(synced)
 
     data = jnp.arange(8, dtype=jnp.float32)
-    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("rank"), out_specs=P()))(data)
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P("rank"), out_specs=P()))(data)
     assert np.allclose(out, data.sum())
 
 

@@ -12,8 +12,9 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.utils.compat import shard_map
 
 from metrics_tpu import MetricCollection
 from metrics_tpu.classification import Accuracy, ConfusionMatrix, Precision, Recall

@@ -569,10 +569,7 @@ def test_auto_counter_sentinel_survives_distributed_sum():
     m.unsync()
 
     # in-mesh: the counter's reducer rides sync_in_mesh's callable branch
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from metrics_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from metrics_tpu.parallel.distributed import sync_in_mesh

@@ -106,10 +106,7 @@ def test_recompile_signature_warning_fires_exactly_once(recorder):
 def test_sync_byte_accounting_on_mesh(recorder):
     """sync_in_mesh on the 8-virtual-device mesh records exact gather bytes:
     cat states count world_size shards, reduced states one payload."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from metrics_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from metrics_tpu.parallel.distributed import sync_in_mesh

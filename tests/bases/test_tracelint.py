@@ -1,0 +1,711 @@
+"""tracelint static-analyzer tests: per-rule positive/negative fixtures,
+suppression pragmas, baseline round-trip, JSON reporter schema, and the
+tier-1 package gate (the whole of ``metrics_tpu/`` must be clean against
+the checked-in baseline).
+"""
+import json
+import pathlib
+import subprocess
+import sys
+from collections import Counter
+
+import pytest
+
+from metrics_tpu.analysis import (
+    RULE_REGISTRY,
+    analyze_paths,
+    analyze_source,
+    default_package_root,
+    get_rules,
+    load_baseline,
+    render_json,
+    save_baseline,
+    split_by_baseline,
+    suppressed_rules,
+)
+from metrics_tpu.analysis.cli import DEFAULT_BASELINE, main as cli_main
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+_METRIC_PREAMBLE = """
+import numpy as np
+import jax
+import jax.numpy as jnp
+from metrics_tpu.core.metric import Metric
+"""
+
+
+def _check(source, relpath="classification/fixture.py", rules=None):
+    kept, suppressed = analyze_source(
+        _METRIC_PREAMBLE + source, relpath, rules=get_rules(rules) if rules else None
+    )
+    return kept, suppressed
+
+
+def _rules_of(violations):
+    return {v.rule for v in violations}
+
+
+# ---------------------------------------------------------------------------
+# TL-TRACE
+# ---------------------------------------------------------------------------
+
+class TestTraceRule:
+    def test_float_on_traced_update_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + float(jnp.sum(preds))
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_item_in_compute_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total.item()
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_np_asarray_on_param_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        host = np.asarray(preds)
+        self.total = self.total + host.sum()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_if_on_traced_value_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        if jnp.max(preds) > 1:
+            preds = preds / jnp.max(preds)
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_shape_checks_and_clean_update_pass(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds, target):
+        if preds.ndim == 2 and preds.shape[0] > 0:
+            preds = preds.reshape(-1)
+        self.total = self.total + jnp.sum(preds * target)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+    def test_is_concrete_guard_exempts(self):
+        """The eager-only guard pattern (utils/checks.py) must not flag."""
+        kept, _ = _check(
+            """
+from metrics_tpu.utils.checks import _is_concrete
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        if _is_concrete(preds):
+            if bool(jnp.any(jnp.isnan(preds))):
+                raise RuntimeError("nan")
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+    def test_jit_unsafe_class_exempt(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = True  # host-side reference implementation
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + float(np.asarray(preds).sum())
+    def _compute(self):
+        return float(self.total)
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+    def test_functional_kernel_item_flags(self):
+        kept, _ = _check(
+            """
+def kernel_update(state, preds):
+    return state + jnp.sum(preds).item()
+""",
+            relpath="functional/classification/fixture.py",
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_functional_kernel_clean_passes(self):
+        kept, _ = _check(
+            """
+def kernel_update(state, preds):
+    return state + jnp.sum(preds)
+""",
+            relpath="functional/classification/fixture.py",
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-RECOMPILE
+# ---------------------------------------------------------------------------
+
+class TestRecompileRule:
+    def test_shape_arg_in_static_position_flags(self):
+        kept, _ = _check(
+            """
+fn = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+def run(x):
+    return fn(x, x.shape[0])
+"""
+        )
+        assert "TL-RECOMPILE" in _rules_of(kept)
+
+    def test_len_and_int_args_flag(self):
+        kept, _ = _check(
+            """
+from functools import partial
+@partial(jax.jit, static_argnums=(1,))
+def fn(x, n):
+    return x * n
+def run(x, items):
+    return fn(x, len(items)) + fn(x, int(x.sum()))
+"""
+        )
+        assert sum(v.rule == "TL-RECOMPILE" for v in kept) == 2
+
+    def test_static_argnames_maps_to_positional_call(self):
+        """The stoi idiom: static_argnames args passed positionally."""
+        kept, _ = _check(
+            """
+from functools import partial
+@partial(jax.jit, static_argnames=("bucket",))
+def fn(x, bucket):
+    return x[:bucket]
+def run(x):
+    return fn(x, int(x.sum())) + fn(x, bucket=len(x))
+"""
+        )
+        assert sum(v.rule == "TL-RECOMPILE" for v in kept) == 2
+
+    def test_dynamic_scalar_arg_passes(self):
+        """Without static_argnums, a Python scalar traces as a weak 0-d
+        array and shares ONE compilation — no hazard, no flag."""
+        kept, _ = _check(
+            """
+fn = jax.jit(lambda x, n: x * n)
+def run(x, items):
+    return fn(x, x.shape[0]) + fn(x, len(items))
+"""
+        )
+        assert "TL-RECOMPILE" not in _rules_of(kept)
+
+    def test_coerced_scalar_passes(self):
+        """jnp.asarray-wrapped values in dynamic positions never flag."""
+        kept, _ = _check(
+            """
+fn = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+def run(x):
+    return fn(x, jnp.asarray(x.shape[0]))
+"""
+        )
+        assert "TL-RECOMPILE" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-STATE
+# ---------------------------------------------------------------------------
+
+class TestStateRule:
+    def test_unknown_reducer_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="avg")
+"""
+        )
+        assert "TL-STATE" in _rules_of(kept)
+
+    def test_known_reducers_and_callable_pass(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("a", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("b", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("c", default=jnp.asarray(0.0), dist_reduce_fx=jnp.sum)
+"""
+        )
+        assert "TL-STATE" not in _rules_of(kept)
+
+    def test_state_write_in_compute_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        self.total = self.total * 2
+        return self.total
+"""
+        )
+        assert "TL-STATE" in _rules_of(kept)
+
+    def test_state_write_in_update_and_reset_pass(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def reset(self):
+        self.total = jnp.asarray(0.0)
+        super().reset()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-STATE" not in _rules_of(kept)
+
+    def test_list_state_without_declaration_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+"""
+        )
+        assert "TL-STATE" in _rules_of(kept)
+
+    def test_list_state_with_declaration_passes(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = False  # append-only update traces
+    def __init__(self):
+        super().__init__()
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+"""
+        )
+        assert "TL-STATE" not in _rules_of(kept)
+
+    def test_wrapper_without_declaration_flags(self):
+        kept, _ = _check(
+            """
+class W(Metric):
+    def __init__(self, base):
+        super().__init__()
+        self.metric = base
+""",
+            relpath="wrappers/fixture.py",
+        )
+        assert "TL-STATE" in _rules_of(kept)
+
+    def test_instance_level_declaration_counts(self):
+        """The _capacity.py idiom: self.__dict__["__jit_unsafe__"] = ..."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.__dict__["__jit_unsafe__"] = False
+"""
+        )
+        assert "TL-STATE" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-COLLECTIVE
+# ---------------------------------------------------------------------------
+
+class TestCollectiveRule:
+    def test_raw_psum_outside_transport_flags(self):
+        kept, _ = _check(
+            """
+def my_sync(x):
+    return jax.lax.psum(x, "rank")
+"""
+        )
+        assert "TL-COLLECTIVE" in _rules_of(kept)
+
+    def test_from_import_collective_flags(self):
+        kept, _ = _check(
+            """
+from jax.lax import all_gather
+def my_sync(x):
+    return all_gather(x, "rank")
+"""
+        )
+        assert "TL-COLLECTIVE" in _rules_of(kept)
+
+    def test_process_allgather_flags(self):
+        kept, _ = _check(
+            """
+from jax.experimental import multihost_utils
+def my_sync(x):
+    return multihost_utils.process_allgather(x)
+"""
+        )
+        assert "TL-COLLECTIVE" in _rules_of(kept)
+
+    def test_transport_layer_allowed(self):
+        kept, _ = _check(
+            """
+def sync_impl(x):
+    return jax.lax.psum(x, "rank")
+""",
+            relpath="parallel/fixture.py",
+        )
+        assert "TL-COLLECTIVE" not in _rules_of(kept)
+
+    def test_aggregate_module_allowed(self):
+        kept, _ = _check(
+            """
+from jax.experimental import multihost_utils
+def agg(x):
+    return multihost_utils.process_allgather(x)
+""",
+            relpath="observability/aggregate.py",
+        )
+        assert "TL-COLLECTIVE" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-PRINT
+# ---------------------------------------------------------------------------
+
+class TestPrintRule:
+    def test_print_flags(self):
+        kept, _ = _check("""
+def f():
+    print("hello")
+""")
+        assert "TL-PRINT" in _rules_of(kept)
+
+    def test_warnings_warn_flags(self):
+        kept, _ = _check("""
+import warnings
+def f():
+    warnings.warn("x")
+""")
+        assert "TL-PRINT" in _rules_of(kept)
+
+    def test_from_import_warn_flags(self):
+        kept, _ = _check("""
+from warnings import warn
+def f():
+    warn("x")
+""")
+        assert "TL-PRINT" in _rules_of(kept)
+
+    def test_rank_zero_helpers_pass(self):
+        kept, _ = _check("""
+from metrics_tpu.utils.prints import rank_zero_warn
+def f():
+    rank_zero_warn("x")
+""")
+        assert "TL-PRINT" not in _rules_of(kept)
+
+    def test_prints_module_allowed(self):
+        kept, _ = _check("""
+def rank_zero_print(*args):
+    print(*args)
+""", relpath="utils/prints.py")
+        assert "TL-PRINT" not in _rules_of(kept)
+
+    def test_doctest_print_never_flags(self):
+        """AST-based: print inside a docstring example is not a call site."""
+        kept, _ = _check('''
+def f():
+    """Example:
+        >>> print("hello")
+    """
+    return 1
+''')
+        assert "TL-PRINT" not in _rules_of(kept)
+
+    def test_check_no_print_alias_still_works(self):
+        """The legacy script invocation is an alias over TL-PRINT."""
+        result = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "scripts" / "check_no_print.py")],
+            capture_output=True,
+            text=True,
+        )
+        assert result.returncode == 0, result.stderr
+
+
+# ---------------------------------------------------------------------------
+# suppression pragmas
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_pragma_parses(self):
+        assert suppressed_rules("x = 1  # tracelint: disable=TL-TRACE") == {"TL-TRACE"}
+        assert suppressed_rules("x = 1  # tracelint: disable=tl-trace, TL-STATE") == {
+            "TL-TRACE",
+            "TL-STATE",
+        }
+        assert suppressed_rules("x = 1  # tracelint: disable=all") == {"ALL"}
+        assert suppressed_rules("x = 1  # a normal comment") == set()
+
+    def test_pragma_suppresses_on_violation_line(self):
+        kept, suppressed = _check(
+            """
+def f():
+    print("hello")  # tracelint: disable=TL-PRINT — CLI surface
+"""
+        )
+        assert "TL-PRINT" not in _rules_of(kept)
+        assert "TL-PRINT" in _rules_of(suppressed)
+
+    def test_pragma_for_other_rule_does_not_suppress(self):
+        kept, suppressed = _check(
+            """
+def f():
+    print("hello")  # tracelint: disable=TL-TRACE
+"""
+        )
+        assert "TL-PRINT" in _rules_of(kept)
+
+    def test_disable_all_suppresses_everything(self):
+        kept, suppressed = _check(
+            """
+def f(x):
+    print(jax.lax.psum(x, "rank"))  # tracelint: disable=all
+"""
+        )
+        assert kept == []
+        assert {"TL-PRINT", "TL-COLLECTIVE"} <= _rules_of(suppressed)
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _violations(self):
+        kept, _ = _check(
+            """
+def f():
+    print("a")
+    print("a")
+    print("b")
+"""
+        )
+        return [v for v in kept if v.rule == "TL-PRINT"]
+
+    def test_round_trip_is_clean(self, tmp_path):
+        violations = self._violations()
+        baseline_file = tmp_path / "baseline.json"
+        save_baseline(baseline_file, violations)
+        loaded = load_baseline(baseline_file)
+        new, grandfathered, stale = split_by_baseline(violations, loaded)
+        assert new == []
+        assert len(grandfathered) == len(violations)
+        assert not stale
+
+    def test_duplicate_lines_tracked_by_count(self, tmp_path):
+        violations = self._violations()
+        assert len(violations) == 3  # two identical `print("a")` lines + one "b"
+        baseline_file = tmp_path / "baseline.json"
+        save_baseline(baseline_file, violations)
+        loaded = load_baseline(baseline_file)
+        assert sum(loaded.values()) == 3
+        # dropping one duplicate from the baseline surfaces exactly one NEW
+        short = Counter(loaded)
+        key = next(k for k in short if 'print("a")' in k[2])
+        short[key] -= 1
+        new, grandfathered, _ = split_by_baseline(violations, short)
+        assert len(new) == 1
+
+    def test_new_violation_not_masked(self, tmp_path):
+        violations = self._violations()
+        baseline_file = tmp_path / "baseline.json"
+        save_baseline(baseline_file, violations[:1])
+        loaded = load_baseline(baseline_file)
+        new, _, _ = split_by_baseline(violations, loaded)
+        assert len(new) == len(violations) - 1
+
+    def test_fixed_violation_reported_stale(self, tmp_path):
+        violations = self._violations()
+        baseline_file = tmp_path / "baseline.json"
+        save_baseline(baseline_file, violations)
+        loaded = load_baseline(baseline_file)
+        _, _, stale = split_by_baseline(violations[:1], loaded)
+        assert sum(stale.values()) == 2
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(tmp_path / "nope.json") == Counter()
+
+    def test_version_mismatch_raises(self, tmp_path):
+        bad = tmp_path / "baseline.json"
+        bad.write_text(json.dumps({"version": 99, "entries": []}))
+        with pytest.raises(ValueError, match="version"):
+            load_baseline(bad)
+
+
+# ---------------------------------------------------------------------------
+# JSON reporter schema
+# ---------------------------------------------------------------------------
+
+class TestJsonReporter:
+    def test_schema(self):
+        kept, suppressed = _check(
+            """
+def f():
+    print("a")
+"""
+        )
+        payload = json.loads(
+            render_json(kept, [], suppressed_count=len(suppressed), n_files=1, rules=["TL-PRINT"])
+        )
+        assert payload["version"] == 1
+        assert payload["tool"] == "tracelint"
+        assert isinstance(payload["violations"], list) and payload["violations"]
+        entry = payload["violations"][0]
+        for field in ("rule", "path", "line", "col", "message", "snippet", "baselined"):
+            assert field in entry
+        assert entry["baselined"] is False
+        summary = payload["summary"]
+        for field in ("files", "new", "baselined", "suppressed", "rules"):
+            assert field in summary
+        assert summary["new"] == len(kept)
+
+    def test_cli_json_mode(self, tmp_path, capsys):
+        src = tmp_path / "mod.py"
+        src.write_text("print('x')\n")
+        rc = cli_main([str(src), "--json", "--no-baseline"])
+        out = capsys.readouterr().out
+        payload = json.loads(out)
+        assert rc == 1
+        assert payload["summary"]["new"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI baseline scoping: partial-path runs must not clobber or mis-report
+# entries belonging to files outside the analyzed set
+# ---------------------------------------------------------------------------
+
+class TestCliBaselineScoping:
+    def _two_files(self, tmp_path):
+        dirty_a = tmp_path / "a.py"
+        dirty_a.write_text("print('a')\n")
+        dirty_b = tmp_path / "b.py"
+        dirty_b.write_text("print('b')\n")
+        return dirty_a, dirty_b
+
+    def test_partial_baseline_update_carries_other_files(self, tmp_path, capsys):
+        dirty_a, dirty_b = self._two_files(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        # baseline both files, then re-update from only a.py
+        assert cli_main([str(dirty_a), str(dirty_b), "--baseline", str(baseline), "--baseline-update"]) == 0
+        assert cli_main([str(dirty_a), "--baseline", str(baseline), "--baseline-update"]) == 0
+        capsys.readouterr()
+        loaded = load_baseline(baseline)
+        # b.py's grandfathered entry survived the a.py-only rewrite
+        assert any(path == "b.py" for (_, path, _) in loaded)
+        assert cli_main([str(dirty_a), str(dirty_b), "--baseline", str(baseline), "--check"]) == 0
+        capsys.readouterr()
+
+    def test_partial_check_ignores_other_files_staleness(self, tmp_path, capsys):
+        dirty_a, dirty_b = self._two_files(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        assert cli_main([str(dirty_a), str(dirty_b), "--baseline", str(baseline), "--baseline-update"]) == 0
+        capsys.readouterr()
+        # checking only a.py: b.py's unconsumed entry is NOT stale
+        assert cli_main([str(dirty_a), "--baseline", str(baseline), "--check"]) == 0
+        out = capsys.readouterr().out
+        assert "stale" not in out
+        # but a genuinely fixed violation in an ANALYZED file still is
+        dirty_a.write_text("x = 1\n")
+        assert cli_main([str(dirty_a), "--baseline", str(baseline), "--check"]) == 1
+        assert "stale" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# package gate (tier-1): the whole library must be clean vs the baseline
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_package_has_no_new_violations(self):
+        result = analyze_paths([default_package_root()])
+        baseline = load_baseline(REPO_ROOT / DEFAULT_BASELINE)
+        new, grandfathered, _ = split_by_baseline(result.violations, baseline)
+        assert not result.parse_errors
+        details = "\n".join(v.render() for v in new)
+        assert new == [], f"new tracelint violations in metrics_tpu/:\n{details}"
+
+    def test_baseline_is_small(self):
+        """Acceptance gate: at most 15 grandfathered entries, every one
+        carrying the auditable (rule, path, snippet) key."""
+        baseline = load_baseline(REPO_ROOT / DEFAULT_BASELINE)
+        assert sum(baseline.values()) <= 15
+
+    def test_every_rule_registered(self):
+        assert set(RULE_REGISTRY) == {
+            "TL-TRACE",
+            "TL-RECOMPILE",
+            "TL-STATE",
+            "TL-COLLECTIVE",
+            "TL-PRINT",
+        }
+
+    def test_cli_script_exits_zero_on_package(self):
+        result = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "scripts" / "tracelint.py"), "--check"],
+            capture_output=True,
+            text=True,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr

@@ -29,6 +29,7 @@ from metrics_tpu.functional.classification.exact_curve import (
     curve_buffer_merge,
     curve_buffer_update,
 )
+from metrics_tpu.utils.compat import shard_map
 
 CAPACITY = 512
 
@@ -158,7 +159,7 @@ def test_exact_curves_sync_over_mesh():
         return auroc[None], ap[None]
 
     auroc, ap = jax.jit(
-        jax.shard_map(
+        shard_map(
             step,
             mesh=mesh,
             in_specs=(P("rank"), P("rank")),
@@ -537,7 +538,7 @@ def test_multiclass_curve_family_whole_lifecycle_in_jit_and_mesh_sync():
         return ap_val[None], n_points[None]
 
     ap_got, n_points = jax.jit(
-        jax.shard_map(
+        shard_map(
             step, mesh=mesh, in_specs=(P("rank"), P("rank")), out_specs=(P("rank"), P("rank"))
         )
     )(
