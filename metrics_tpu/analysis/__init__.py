@@ -26,6 +26,22 @@ Rule catalog (see ``docs/static_analysis.md`` for rationale + fix recipes):
   outside ``metrics_tpu/parallel/`` and ``observability/aggregate.py``.
 * **TL-PRINT** — raw ``print()`` / bare ``warnings.warn()`` in library code
   (absorbs ``scripts/check_no_print.py``; the script remains as an alias).
+* **TL-DECL** — ``__jit_unsafe__`` declarations contradicted or made
+  redundant by the abstract interpreter's verdict (``interp.py``): a stale
+  ``True`` silently forces the eager path; a wrong ``False`` crashes the
+  fused build instead of falling back.
+* **TL-FLOW** — state-lifecycle dataflow (``stateflow.py``): a ``"sum"``-
+  reduced leaf mutated by anything other than additive assignment, an
+  overriding ``reset`` that misses a leaf, a registered-but-dead leaf.
+
+v2 adds the **interprocedural abstract interpreter** (``interp.py``): calls
+from metric updates resolve into ``metrics_tpu/functional/`` and ``utils/``,
+a taint/None-ness/bool-ness lattice classifies every metric as ``fusible`` /
+``unsafe(cat-growth | host-sync | data-dependent-shape)`` / ``unknown``, and
+``scripts/tracelint.py --manifest`` serializes the verdicts plus per-leaf
+shape/dtype/reduction abstractions to ``scripts/fusibility_manifest.json``
+(``manifest.py``) — which ``core/fused.py`` consults at runtime to skip the
+``eval_shape`` fusibility probe for ``fusible``-verdict metrics.
 
 Run ``python scripts/tracelint.py`` (stdlib-only, no jax import) or
 ``python -m metrics_tpu.analysis``.
@@ -40,30 +56,68 @@ from .engine import (  # noqa: F401
     analyze_paths,
     analyze_source,
     default_package_root,
+    file_suppressed_rules,
     package_relpath,
     suppressed_rules,
 )
 from .baseline import load_baseline, save_baseline, split_by_baseline  # noqa: F401
 from .reporters import render_json, render_text  # noqa: F401
 from .rules import RULE_REGISTRY, Rule, all_rules, get_rules, register_rule  # noqa: F401
+from .interp import (  # noqa: F401
+    Project,
+    Signal,
+    StateEntry,
+    Verdict,
+    classify,
+    class_facts,
+    summarize_function,
+    verdict_from_signals,
+)
+from .manifest import (  # noqa: F401
+    build_manifest,
+    class_key,
+    load_manifest,
+    lookup_class,
+    manifest_verdict,
+    render_manifest,
+    runtime_manifest,
+)
+from .stateflow import analyze_class as analyze_state_flows  # noqa: F401
 
 __all__ = [
     "FileContext",
     "LintResult",
-    "Violation",
+    "Project",
     "RULE_REGISTRY",
     "Rule",
+    "Signal",
+    "StateEntry",
+    "Verdict",
+    "Violation",
     "all_rules",
     "analyze_paths",
     "analyze_source",
+    "analyze_state_flows",
+    "build_manifest",
+    "class_facts",
+    "class_key",
+    "classify",
     "default_package_root",
+    "file_suppressed_rules",
     "get_rules",
     "load_baseline",
+    "load_manifest",
+    "lookup_class",
+    "manifest_verdict",
     "package_relpath",
     "register_rule",
     "render_json",
+    "render_manifest",
     "render_text",
+    "runtime_manifest",
     "save_baseline",
     "split_by_baseline",
     "suppressed_rules",
+    "summarize_function",
+    "verdict_from_signals",
 ]

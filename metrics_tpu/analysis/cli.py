@@ -17,6 +17,7 @@ from typing import List, Optional
 
 from .baseline import load_baseline, save_baseline, split_by_baseline
 from .engine import Violation, analyze_paths, default_package_root
+from .manifest import DEFAULT_MANIFEST
 from .reporters import render_json, render_text
 from .rules import all_rules, get_rules
 
@@ -72,6 +73,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--json", action="store_true", help="emit the JSON report")
     parser.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
+    parser.add_argument(
+        "--manifest",
+        action="store_true",
+        help="fusibility-manifest mode: write the abstract interpreter's per-metric "
+        "verdicts (always full-package); with --check, fail instead if the "
+        "committed manifest is stale",
+    )
+    parser.add_argument(
+        "--manifest-path",
+        type=pathlib.Path,
+        default=None,
+        help=f"manifest file (default: <repo>/{DEFAULT_MANIFEST})",
+    )
     return parser
 
 
@@ -83,6 +97,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rule in all_rules():
             sys.stdout.write(f"{rule.id}: {rule.description}\n")
         return 0
+
+    if args.manifest:
+        return _manifest_mode(args)
 
     try:
         rules = get_rules(args.rules.split(",")) if args.rules else all_rules()
@@ -151,4 +168,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     if args.check and stale_count:
         return 1
+    return 0
+
+
+def _manifest_mode(args) -> int:
+    """``--manifest``: regenerate the fusibility manifest; ``--manifest
+    --check``: CI freshness gate (byte-compare against the committed file)."""
+    from .manifest import build_manifest, render_manifest
+
+    path = args.manifest_path or (_repo_root() / DEFAULT_MANIFEST)
+    rendered = render_manifest(build_manifest())
+    n = rendered.count('"verdict"')
+    if args.check:
+        committed = path.read_text() if path.is_file() else None
+        if committed != rendered:
+            sys.stderr.write(
+                f"tracelint: fusibility manifest {path} is "
+                f"{'missing' if committed is None else 'STALE'} — regenerate with "
+                "`python scripts/tracelint.py --manifest` and commit the result\n"
+            )
+            return 1
+        sys.stdout.write(f"tracelint: fusibility manifest {path} is fresh ({n} metrics)\n")
+        return 0
+    path.write_text(rendered)
+    sys.stdout.write(f"tracelint: fusibility manifest written to {path} ({n} metrics)\n")
     return 0

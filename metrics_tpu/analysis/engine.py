@@ -21,6 +21,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 PACKAGE_NAME = "metrics_tpu"
 
 _PRAGMA_RE = re.compile(r"#\s*tracelint:\s*disable=([A-Za-z0-9_\-,\s]+)")
+_FILE_PRAGMA_RE = re.compile(r"#\s*tracelint:\s*disable-file=([A-Za-z0-9_\-,\s]+)")
 
 
 def suppressed_rules(line_text: str) -> Set[str]:
@@ -34,6 +35,46 @@ def suppressed_rules(line_text: str) -> Set[str]:
     if not match:
         return set()
     return {tok.strip().upper() for tok in match.group(1).split(",") if tok.strip()}
+
+
+def file_suppressed_rules(lines: Sequence[str], tree: ast.Module) -> Set[str]:
+    """Rule ids disabled file-wide by ``# tracelint: disable-file=...``.
+
+    Only the module docstring line region is honored (the header lines up to
+    and including the docstring statement, or the comment block preceding the
+    first statement) — a file-wide waiver is a visible, top-of-file decision,
+    never something buried mid-module. ``all`` disables every rule.
+    """
+    if tree.body:
+        first = tree.body[0]
+        is_docstring = (
+            isinstance(first, ast.Expr)
+            and isinstance(first.value, ast.Constant)
+            and isinstance(first.value.value, str)
+        )
+        last_line = (getattr(first, "end_lineno", first.lineno) or first.lineno) if is_docstring else max(
+            first.lineno - 1, 0
+        )
+    else:
+        last_line = len(lines)
+    rules: Set[str] = set()
+    for text in lines[:last_line]:
+        match = _FILE_PRAGMA_RE.search(text)
+        if match:
+            rules.update(tok.strip().upper() for tok in match.group(1).split(",") if tok.strip())
+    return rules
+
+
+def _dotted_chain(node: ast.AST) -> List[str]:
+    """``jax.numpy`` -> ["jax", "numpy"]; [] when not a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
 
 
 @dataclass(frozen=True)
@@ -79,6 +120,8 @@ class FileContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=relpath)
         self._alias_maps: Optional[Dict[str, Set[str]]] = None
+        self._member_maps: Optional[Dict[str, Dict[str, str]]] = None
+        self._file_suppressed: Optional[Set[str]] = None
 
     # ------------------------------------------------------------------
     # import-alias maps (lazy; shared by several rules)
@@ -94,6 +137,8 @@ class FileContext:
         warn_fns: Set[str] = set()
         lax_collectives: Set[str] = set()
         process_allgather: Set[str] = set()
+        jnp_members: Dict[str, str] = {}
+        numpy_members: Dict[str, str] = {}
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
@@ -116,13 +161,61 @@ class FileContext:
                     elif node.module == "jax" and alias.name == "lax":
                         lax.add(bound)
                     elif node.module == "numpy":
-                        pass  # from-numpy imports are host by definition; TL-TRACE keys on np.<fn>
+                        # direct-member imports (`from numpy import asarray`)
+                        # are host pullers at the call site; record bound ->
+                        # original so rules can key on the member name
+                        numpy_members[bound] = alias.name
+                    elif node.module == "jax.numpy":
+                        jnp_members[bound] = alias.name
                     elif node.module == "warnings" and alias.name == "warn":
                         warn_fns.add(bound)
                     elif node.module == "jax.lax":
                         lax_collectives.add(bound)
                     elif node.module and "multihost_utils" in node.module and alias.name == "process_allgather":
                         process_allgather.add(bound)
+        # simple same-file rebindings (`np = jnp`, `mylax = jax.lax`): a
+        # Name-to-Name or Name-to-dotted-chain assignment re-aliases the
+        # module object, and every rule keyed on the original alias must
+        # follow it. MODULE-LEVEL assignments only — a function-local shadow
+        # (`np = jnp` inside one helper) must not re-alias `np` file-wide
+        # and silently exempt every other function's `np.*` host pulls.
+        # Fixed-point so chained rebindings (`a = jnp; b = a`) resolve in
+        # file order regardless of statement order.
+        rebinds: List[Tuple[str, object]] = []
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, (ast.Name, ast.Attribute))
+            ):
+                rebinds.append((node.targets[0].id, node.value))
+        changed = True
+        while changed:
+            changed = False
+            for bound, value in rebinds:
+                chain = _dotted_chain(value)
+                for names, canonical in (
+                    (jnp, ["jax", "numpy"]),
+                    (lax, ["jax", "lax"]),
+                    (numpy, ["numpy"]),
+                    (jax_names, ["jax"]),
+                ):
+                    if bound in names:
+                        continue
+                    root_match = chain and (
+                        chain == canonical or (len(chain) == 1 and chain[0] in names)
+                    )
+                    # `x = jax.numpy` / `x = jax.lax` via a jax alias root
+                    attr_match = (
+                        len(chain) == 2
+                        and chain[0] in jax_names
+                        and ["jax", chain[1]] == canonical
+                    )
+                    if root_match or attr_match:
+                        names.add(bound)
+                        changed = True
+        self._member_maps = {"jnp_members": jnp_members, "numpy_members": numpy_members}
         self._alias_maps = {
             "numpy": numpy,
             "jnp": jnp,
@@ -166,6 +259,26 @@ class FileContext:
     @property
     def process_allgather_aliases(self) -> Set[str]:
         return self._aliases()["process_allgather"]
+
+    @property
+    def jnp_member_imports(self) -> Dict[str, str]:
+        """``from jax.numpy import concatenate [as cat]`` -> {"cat": "concatenate"}."""
+        self._aliases()
+        return self._member_maps["jnp_members"]
+
+    @property
+    def numpy_member_imports(self) -> Dict[str, str]:
+        """``from numpy import asarray [as aa]`` -> {"aa": "asarray"}."""
+        self._aliases()
+        return self._member_maps["numpy_members"]
+
+    @property
+    def file_suppressed(self) -> Set[str]:
+        """Rule ids waived for the whole file by a docstring-region
+        ``# tracelint: disable-file=...`` pragma (``ALL`` waives every rule)."""
+        if self._file_suppressed is None:
+            self._file_suppressed = file_suppressed_rules(self.lines, self.tree)
+        return self._file_suppressed
 
     # ------------------------------------------------------------------
     def line_text(self, lineno: int) -> str:
@@ -220,7 +333,10 @@ def run_rules(ctx: FileContext, rules: Sequence) -> Tuple[List[Violation], List[
     """Run ``rules`` over one file; returns (kept, pragma-suppressed)."""
     kept: List[Violation] = []
     suppressed: List[Violation] = []
+    file_disabled = ctx.file_suppressed
     for rule in rules:
+        if "ALL" in file_disabled or rule.id.upper() in file_disabled:
+            continue  # file-wide waiver: the rule never runs on this file
         for violation in rule.check(ctx):
             disabled = suppressed_rules(ctx.line_text(violation.line))
             if "ALL" in disabled or violation.rule.upper() in disabled:

@@ -1,0 +1,1714 @@
+"""tracelint v2: interprocedural abstract interpreter over metric updates.
+
+tracelint v1 rules are single-file and single-function; the framework's
+central contract — "a metric whose update is pure and fixed-shape fuses
+into the one-dispatch kernel" — is interprocedural: metric ``_update``
+bodies immediately call into ``metrics_tpu/functional/`` kernels, which call
+into ``metrics_tpu/utils/`` input formatters. This module resolves those
+calls across files and runs an abstract interpretation that classifies
+every metric class into one of three **verdicts**:
+
+* ``fusible`` — the update provably stays on device with fixed shapes: every
+  reachable operation is a jnp/lax op, a resolved in-package helper that is
+  itself clean, a static builtin, or a method on a traced array. The fused
+  path (``core/fused.py``) may skip its runtime ``eval_shape`` probe.
+* ``unsafe`` — a definitive violation was found on an unconditional path,
+  with a machine-derived **reason**:
+  - ``cat-growth`` — unbounded list-state concatenation (``default=[]``
+    states, ``self.<state>.append`` in update);
+  - ``host-sync`` — a device->host round-trip (``float()``/``.item()``/
+    ``np.*`` on a traced value) or Python control flow on traced data;
+  - ``data-dependent-shape`` — an output shape that depends on data values
+    (``jnp.unique``/``nonzero``, boolean-mask indexing, traced slice
+    bounds, length-less ``bincount``).
+* ``unknown`` — the analysis hit something it cannot bound (an unresolved
+  call receiving traced values, a config-dependent state container, or an
+  unsafe signal on a *conditional* path that a concrete config may never
+  take). The runtime probe remains the authority.
+
+The **value lattice** tracks, per local name: *taintedness* (does it carry a
+traced array), *None-ness* (``none`` / ``notnone`` / ``maybe`` — used to
+kill statically-dead ``if x is None`` branches, the idiom every input
+formatter uses to gate its host-side fallbacks), and *bool-ness* (is it a
+comparison result, i.e. a potential boolean mask). Function summaries
+``(signals, return taint, return None-ness)`` are memoized per
+``(function, argument binding)`` so the interprocedural walk stays linear.
+
+Sanctioned host escapes are honored: any ``if`` mentioning the
+``_is_concrete`` eager-only guard skips its guarded side, and the
+``if not _is_concrete(...): raise`` idiom marks the remainder of the block
+eager-only.
+
+Everything here is stdlib-only (ast) — the CLI never imports jax.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext, PACKAGE_NAME, default_package_root
+
+# ---------------------------------------------------------------------------
+# verdict vocabulary (stable — serialized into the fusibility manifest)
+# ---------------------------------------------------------------------------
+
+VERDICT_FUSIBLE = "fusible"
+VERDICT_UNSAFE = "unsafe"
+VERDICT_UNKNOWN = "unknown"
+
+REASON_CAT_GROWTH = "cat-growth"
+REASON_HOST_SYNC = "host-sync"
+REASON_DATA_SHAPE = "data-dependent-shape"
+
+#: signal kinds an update scan can raise; "unknown" and "trace-raise" never
+#: make a metric unsafe, they only block the fusible verdict ("trace-raise"
+#: marks a reachable, UNCAUGHT `if not _is_concrete(...): raise` — an input
+#: configuration that fails under tracing; a caller that wraps the call in
+#: try/except has handled it, and the signal is dropped at that call site)
+_SIGNAL_KINDS = (REASON_HOST_SYNC, REASON_DATA_SHAPE, REASON_CAT_GROWTH, "unknown", "trace-raise")
+
+# None-ness lattice
+_NONE = "none"
+_NOT_NONE = "notnone"
+_MAYBE = "maybe"
+
+#: jnp/lax members whose OUTPUT shape depends on data values — poison for
+#: the fixed-shape contract (jnp.where is handled separately: only its
+#: single-argument form is dynamic)
+_DATA_DEP_MEMBERS = {
+    "unique",
+    "unique_values",
+    "unique_counts",
+    "unique_all",
+    "unique_inverse",
+    "nonzero",
+    "flatnonzero",
+    "argwhere",
+    "compress",
+    "extract",
+    "setdiff1d",
+    "union1d",
+    "intersect1d",
+    "trim_zeros",
+}
+
+#: jnp members returning HOST values (dtype predicates and metadata) — their
+#: results never taint, so `if jnp.issubdtype(x.dtype, ...)` stays static
+_HOST_RESULT_MEMBERS = {
+    "issubdtype",
+    "result_type",
+    "promote_types",
+    "iinfo",
+    "finfo",
+    "dtype",
+    "ndim",
+    "shape",
+    "size",
+    "isdtype",
+}
+
+#: jnp members whose result is a boolean mask when fed traced data
+_BOOLISH_MEMBERS = {
+    "isnan",
+    "isinf",
+    "isfinite",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_or",
+    "logical_not",
+    "logical_xor",
+    "greater",
+    "greater_equal",
+    "less",
+    "less_equal",
+    "equal",
+    "not_equal",
+    "isclose",
+    "isin",
+}
+
+#: array-method names that force a host sync / dynamic shape
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "__array__"}
+_DATA_DEP_METHODS = {"nonzero"}
+
+#: builtins whose results are host/static values (superset of the rule-side
+#: set: pure readers plus shape-free constructors)
+_SAFE_HOST_BUILTINS = {
+    "isinstance",
+    "len",
+    "getattr",
+    "hasattr",
+    "type",
+    "range",
+    "enumerate",
+    "zip",
+    "max",
+    "min",
+    "abs",
+    "sum",
+    "sorted",
+    "reversed",
+    "list",
+    "tuple",
+    "dict",
+    "set",
+    "str",
+    "repr",
+    "format",
+    "print",
+    "id",
+    "round",
+    "all",
+    "any",
+    "map",
+    "filter",
+    "super",
+    "ValueError",
+    "TypeError",
+    "RuntimeError",
+    "KeyError",
+    "NotImplementedError",
+}
+
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+#: attributes that are static under tracing
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding"}
+
+#: resolution depth budget — deep enough for the longest real chain
+#: (metric update -> functional kernel -> input formatter -> per-case
+#: checker -> validator -> leaf predicate) with headroom
+_DEPTH_BUDGET = 8
+
+
+def _last_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _mentions_concrete_guard(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _last_name(sub.func) == "_is_concrete":
+            return True
+    return False
+
+
+def _is_not_concrete_test(node: ast.AST) -> bool:
+    """``not _is_concrete(...)``-shaped test: the negated eager guard whose
+    raising body makes the REST of the block eager-only."""
+    return (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.Not)
+        and _mentions_concrete_guard(node.operand)
+    )
+
+
+def _always_raises(stmts: Sequence[ast.stmt]) -> bool:
+    """Every terminal path of ``stmts`` ends in raise/return (a guard body)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Raise, ast.Return)):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return _always_raises(last.body) and _always_raises(last.orelse)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# signals and verdicts
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Signal:
+    """One abstract-interpretation finding inside an update's call graph."""
+
+    kind: str  # one of _SIGNAL_KINDS
+    detail: str
+    conditional: bool  # found under a host-config branch that may be dead
+    line: int = 0
+
+
+@dataclass(frozen=True)
+class Verdict:
+    """Static fusibility classification of one metric class."""
+
+    status: str  # fusible | unsafe | unknown
+    reason: Optional[str] = None  # unsafe reason (cat-growth | host-sync | data-dependent-shape)
+    detail: Optional[str] = None  # human-readable context for the verdict
+
+    def to_dict(self) -> Dict[str, Optional[str]]:
+        return {"status": self.status, "reason": self.reason, "detail": self.detail}
+
+
+def verdict_from_signals(signals: Sequence[Signal]) -> Verdict:
+    """Definitive (unconditional) unsafe signals decide; anything weaker —
+    conditional unsafety, unresolved calls, uncaught trace-time raises —
+    degrades to ``unknown`` so the runtime probe stays the authority; a
+    silent scan is ``fusible``."""
+    for sig in signals:
+        if sig.kind not in ("unknown", "trace-raise") and not sig.conditional:
+            return Verdict(VERDICT_UNSAFE, sig.kind, sig.detail)
+    if signals:
+        first = signals[0]
+        return Verdict(
+            VERDICT_UNKNOWN,
+            None,
+            f"{first.kind}: {first.detail}" if first.kind != "unknown" else first.detail,
+        )
+    return Verdict(VERDICT_FUSIBLE)
+
+
+# ---------------------------------------------------------------------------
+# abstract values
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Value:
+    tainted: bool = False
+    noneness: str = _MAYBE
+    boolish: bool = False
+
+
+_HOST = _Value(tainted=False, noneness=_NOT_NONE)
+
+
+@dataclass
+class _Env:
+    """Per-function abstract store."""
+
+    traced: Set[str] = field(default_factory=set)
+    boolmask: Set[str] = field(default_factory=set)
+    noneness: Dict[str, str] = field(default_factory=dict)
+    states: Set[str] = field(default_factory=set)  # traced self.<attr> names
+    list_states: Set[str] = field(default_factory=set)  # may-be-list self attrs
+
+    def value_of(self, name: str) -> _Value:
+        return _Value(
+            tainted=name in self.traced,
+            noneness=self.noneness.get(name, _MAYBE),
+            boolish=name in self.boolmask,
+        )
+
+    def bind(self, name: str, value: _Value) -> None:
+        if value.tainted:
+            self.traced.add(name)
+        else:
+            self.traced.discard(name)
+        if value.boolish:
+            self.boolmask.add(name)
+        else:
+            self.boolmask.discard(name)
+        self.noneness[name] = value.noneness
+
+    def snapshot(self) -> "_Env":
+        return _Env(
+            traced=set(self.traced),
+            boolmask=set(self.boolmask),
+            noneness=dict(self.noneness),
+            states=self.states,  # shared: never mutated during a scan
+            list_states=self.list_states,
+        )
+
+    def absorb_branches(self, a: "_Env", b: "_Env") -> None:
+        """Join two branch environments back into this one: taint unions
+        (conservative), None-ness meets (agreement survives, disagreement
+        decays to maybe) — so a binding in ONE branch can never mask the
+        other branch's path (`num_classes = preds.shape[1]` in the float
+        branch must not kill the label branch's None check)."""
+        self.traced.clear()
+        self.traced.update(a.traced | b.traced)
+        self.boolmask.clear()
+        self.boolmask.update(a.boolmask | b.boolmask)
+        merged: Dict[str, str] = {}
+        for key in set(a.noneness) | set(b.noneness):
+            va = a.noneness.get(key, _MAYBE)
+            vb = b.noneness.get(key, _MAYBE)
+            merged[key] = va if va == vb else _MAYBE
+        self.noneness.clear()
+        self.noneness.update(merged)
+
+
+# ---------------------------------------------------------------------------
+# cross-file resolution
+# ---------------------------------------------------------------------------
+
+class Project:
+    """Parse-once view of the package for cross-file symbol resolution.
+
+    Modules are addressed package-relative (``functional/classification/
+    accuracy.py``); ``from metrics_tpu.x.y import f`` (or the relative
+    equivalent) resolves ``f`` to its def in ``x/y.py``, following one
+    ``__init__.py`` re-export hop.
+    """
+
+    def __init__(self, root: Optional[pathlib.Path] = None) -> None:
+        self.root = pathlib.Path(root) if root is not None else default_package_root()
+        self._ctx_cache: Dict[str, Optional[FileContext]] = {}
+        self._import_cache: Dict[int, Dict[str, Tuple[str, str]]] = {}
+        self._summary_cache: Dict[Tuple, Tuple[List[Signal], bool, str]] = {}
+        self._in_progress: Set[Tuple] = set()
+
+    # -- file / module access ------------------------------------------
+    def ctx(self, relpath: str) -> Optional[FileContext]:
+        cached = self._ctx_cache.get(relpath, _MISSING)
+        if cached is not _MISSING:
+            return cached
+        path = self.root / relpath
+        ctx: Optional[FileContext] = None
+        if path.is_file():
+            try:
+                ctx = FileContext(path, relpath, path.read_text())
+            except (SyntaxError, UnicodeDecodeError):
+                ctx = None
+        self._ctx_cache[relpath] = ctx
+        return ctx
+
+    def module_relpath(self, module: str) -> Optional[str]:
+        """``metrics_tpu.functional.x`` -> ``functional/x.py`` (or the
+        package ``__init__.py``); None for out-of-package modules."""
+        if module == PACKAGE_NAME:
+            return "__init__.py"
+        prefix = PACKAGE_NAME + "."
+        if not module.startswith(prefix):
+            return None
+        tail = module[len(prefix):].replace(".", "/")
+        if (self.root / (tail + ".py")).is_file():
+            return tail + ".py"
+        if (self.root / tail / "__init__.py").is_file():
+            return tail + "/__init__.py"
+        return None
+
+    def imports_of(self, ctx: FileContext) -> Dict[str, Tuple[str, str]]:
+        """bound name -> (absolute module, original name) for every
+        ``from <in-package module> import name [as bound]`` in ``ctx``."""
+        cached = self._import_cache.get(id(ctx))
+        if cached is not None:
+            return cached
+        out: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            module = node.module or ""
+            if node.level:
+                # relative import: resolve against the file's package path
+                parts = ctx.relpath.split("/")[:-1]
+                if node.level - 1:
+                    parts = parts[: -(node.level - 1)] if node.level - 1 <= len(parts) else []
+                base = ".".join([PACKAGE_NAME] + parts)
+                module = f"{base}.{module}" if module else base
+            if not (module == PACKAGE_NAME or module.startswith(PACKAGE_NAME + ".")):
+                continue
+            for alias in node.names:
+                out[alias.asname or alias.name] = (module, alias.name)
+        self._import_cache[id(ctx)] = out
+        return out
+
+    def _find_def(self, ctx: FileContext, name: str, kind) -> Optional[Tuple[FileContext, ast.AST]]:
+        for node in ctx.tree.body:
+            if isinstance(node, kind) and node.name == name:
+                return ctx, node
+        return None
+
+    def resolve_function(
+        self, ctx: FileContext, name: str, _hops: int = 4
+    ) -> Optional[Tuple[FileContext, ast.FunctionDef]]:
+        """Find the def of ``name`` visible from ``ctx``: same module first,
+        then module-level rebindings (``_kappa_update = _confmat_update``),
+        then in-package ``from`` imports (one ``__init__`` hop)."""
+        found = self._find_def(ctx, name, ast.FunctionDef)
+        if found is not None:
+            return found  # type: ignore[return-value]
+        if _hops > 0:
+            for node in ctx.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name
+                    and isinstance(node.value, ast.Name)
+                ):
+                    return self.resolve_function(ctx, node.value.id, _hops - 1)
+        target = self.imports_of(ctx).get(name)
+        if target is None or _hops <= 0:
+            return None
+        relpath = self.module_relpath(target[0])
+        if relpath is None:
+            return None
+        tctx = self.ctx(relpath)
+        if tctx is None or tctx is ctx:
+            return None
+        return self.resolve_function(tctx, target[1], _hops - 1)
+
+    def resolve_class(
+        self, ctx: FileContext, name: str, _hops: int = 4
+    ) -> Optional[Tuple[FileContext, ast.ClassDef]]:
+        found = self._find_def(ctx, name, ast.ClassDef)
+        if found is not None:
+            return found  # type: ignore[return-value]
+        target = self.imports_of(ctx).get(name)
+        if target is None or _hops <= 0:
+            return None
+        relpath = self.module_relpath(target[0])
+        if relpath is None:
+            return None
+        tctx = self.ctx(relpath)
+        if tctx is None or tctx is ctx:
+            return None
+        return self.resolve_class(tctx, target[1], _hops - 1)
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()
+
+
+# ---------------------------------------------------------------------------
+# the abstract interpreter
+# ---------------------------------------------------------------------------
+
+class _Scanner:
+    """Walks one function body collecting :class:`Signal`s, tracking the
+    taint / None-ness / bool-ness lattice, resolving in-package calls."""
+
+    def __init__(self, project: Project, ctx: FileContext, depth: int) -> None:
+        self.project = project
+        self.ctx = ctx
+        self.depth = depth
+        self.signals: List[Signal] = []
+        self.return_value = _Value(tainted=False, noneness=_NOT_NONE)
+        self._saw_return = False
+        #: >0 while scanning a `try` body that has except handlers: callees'
+        #: trace-time raises are caught here, so their "trace-raise" signals
+        #: are dropped at this call site
+        self._shielded = 0
+
+    # -- entry points --------------------------------------------------
+    def scan(self, fn: ast.FunctionDef, env: _Env) -> None:
+        self._scan_stmts(fn.body, env, conditional=False)
+
+    def _emit(self, kind: str, detail: str, conditional: bool, node: ast.AST) -> None:
+        self.signals.append(
+            Signal(kind=kind, detail=detail, conditional=conditional, line=getattr(node, "lineno", 0))
+        )
+
+    # -- statements ----------------------------------------------------
+    def _scan_stmts(self, stmts: Sequence[ast.stmt], env: _Env, conditional: bool) -> None:
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If):
+                stop = self._scan_if(stmt, env, conditional)
+                if stop:
+                    return  # remainder is eager-only (guarded-raise idiom)
+            elif isinstance(stmt, ast.While):
+                test = self._eval(stmt.test, env, conditional)
+                if test.tainted:
+                    self._emit(
+                        REASON_HOST_SYNC,
+                        "Python `while` on a traced value concretizes under jit",
+                        conditional,
+                        stmt,
+                    )
+                self._scan_stmts(stmt.body, env, True)
+                self._scan_stmts(stmt.orelse, env, True)
+            elif isinstance(stmt, ast.For):
+                it = self._eval(stmt.iter, env, conditional)
+                self._bind_target(stmt.target, _Value(tainted=it.tainted, noneness=_NOT_NONE), env)
+                self._scan_stmts(stmt.body, env, conditional)
+                self._scan_stmts(stmt.orelse, env, conditional)
+            elif isinstance(stmt, ast.Try):
+                if stmt.handlers:
+                    self._shielded += 1
+                try:
+                    self._scan_stmts(stmt.body, env, conditional)
+                finally:
+                    if stmt.handlers:
+                        self._shielded -= 1
+                for handler in stmt.handlers:
+                    self._scan_stmts(handler.body, env, True)
+                self._scan_stmts(stmt.orelse, env, conditional)
+                self._scan_stmts(stmt.finalbody, env, conditional)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._eval(item.context_expr, env, conditional)
+                self._scan_stmts(stmt.body, env, conditional)
+            elif isinstance(stmt, ast.Assign):
+                value = self._eval(stmt.value, env, conditional)
+                for tgt in stmt.targets:
+                    self._scan_state_write(tgt, stmt.value, env, conditional)
+                    self._bind_target(tgt, value, env)
+            elif isinstance(stmt, ast.AugAssign):
+                value = self._eval(stmt.value, env, conditional)
+                if isinstance(stmt.target, ast.Name):
+                    prev = env.value_of(stmt.target.id)
+                    env.bind(
+                        stmt.target.id,
+                        _Value(tainted=prev.tainted or value.tainted, noneness=_NOT_NONE),
+                    )
+            elif isinstance(stmt, ast.AnnAssign):
+                if stmt.value is not None:
+                    value = self._eval(stmt.value, env, conditional)
+                    self._scan_state_write(stmt.target, stmt.value, env, conditional)
+                    self._bind_target(stmt.target, value, env)
+            elif isinstance(stmt, ast.Return):
+                self._saw_return = True
+                if stmt.value is not None:
+                    value = self._eval(stmt.value, env, conditional)
+                    self.return_value = _Value(
+                        tainted=self.return_value.tainted or value.tainted,
+                        noneness=value.noneness if not self._saw_return else _MAYBE
+                        if self.return_value.noneness != value.noneness
+                        else value.noneness,
+                    )
+            elif isinstance(stmt, ast.Expr):
+                self._eval(stmt.value, env, conditional)
+            elif isinstance(stmt, ast.Assert):
+                test = self._eval(stmt.test, env, conditional)
+                if test.tainted:
+                    self._emit(
+                        REASON_HOST_SYNC,
+                        "`assert` on a traced value concretizes under jit",
+                        conditional,
+                        stmt,
+                    )
+            elif isinstance(stmt, ast.Raise):
+                if stmt.exc is not None:
+                    self._eval(stmt.exc, env, conditional)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested defs: out of scope for the update surface
+            else:
+                continue
+
+    def _scan_if(self, stmt: ast.If, env: _Env, conditional: bool) -> bool:
+        """Returns True when the remainder of the enclosing block is
+        eager-only (the ``if not _is_concrete(...): raise`` idiom)."""
+        if _mentions_concrete_guard(stmt.test):
+            # guarded side is host-only by contract; the else side traces
+            self._scan_stmts(stmt.orelse, env, conditional)
+            if _is_not_concrete_test(stmt.test) and _always_raises(stmt.body):
+                # `if not _is_concrete(...): raise` — this code path FAILS
+                # under tracing. An enclosing try/except owns the failure;
+                # otherwise the fusible verdict is blocked (probe decides)
+                if not self._shielded:
+                    self._emit(
+                        "trace-raise",
+                        "reachable `if not _is_concrete(...): raise` fails under tracing "
+                        "for some input configurations",
+                        conditional,
+                        stmt,
+                    )
+                return True
+            return False
+
+        # statically-dead branch elimination on None-ness
+        live = self._liveness(stmt.test, env)
+        if live == "body":
+            self._scan_stmts(stmt.body, env, conditional)
+            return False
+        if live == "orelse":
+            self._scan_stmts(stmt.orelse, env, conditional)
+            return False
+
+        test = self._eval(stmt.test, env, conditional)
+        is_type_dispatch = any(
+            isinstance(sub, ast.Call) and _last_name(sub.func) == "isinstance"
+            for sub in ast.walk(stmt.test)
+        )
+        if test.tainted and not is_type_dispatch:
+            self._emit(
+                REASON_HOST_SYNC,
+                "Python `if` on a traced value concretizes under jit",
+                conditional,
+                stmt,
+            )
+        # isolated branch environments, joined on exit — bindings from one
+        # branch must not leak into (and mask) the other
+        env_body = env.snapshot()
+        env_orelse = env.snapshot()
+        self._scan_stmts(stmt.body, env_body, True)
+        self._scan_stmts(stmt.orelse, env_orelse, True)
+        env.absorb_branches(env_body, env_orelse)
+        return False
+
+    def _liveness(self, test: ast.AST, env: _Env) -> Optional[str]:
+        """Which branch of ``if test`` is statically live, when decidable
+        from None-ness: `x is None` / `x is not None` / bare `x` / `not x`
+        with x's None-ness known."""
+        def name_noneness(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Name):
+                return env.noneness.get(node.id, _MAYBE)
+            return None
+
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 and len(test.comparators) == 1:
+            left, right = test.left, test.comparators[0]
+            is_none_cmp = isinstance(right, ast.Constant) and right.value is None
+            if is_none_cmp:
+                nn = name_noneness(left)
+                if isinstance(test.ops[0], ast.Is):
+                    if nn == _NONE:
+                        return "body"
+                    if nn == _NOT_NONE:
+                        return "orelse"
+                elif isinstance(test.ops[0], ast.IsNot):
+                    if nn == _NONE:
+                        return "orelse"
+                    if nn == _NOT_NONE:
+                        return "body"
+        if isinstance(test, ast.Name) and env.noneness.get(test.id) == _NONE:
+            return "orelse"  # `if x:` with x known-None is statically false
+        if (
+            isinstance(test, ast.UnaryOp)
+            and isinstance(test.op, ast.Not)
+            and isinstance(test.operand, ast.Name)
+            and env.noneness.get(test.operand.id) == _NONE
+        ):
+            return "body"  # `if not x:` with x known-None
+        return None
+
+    def _bind_target(self, tgt: ast.AST, value: _Value, env: _Env) -> None:
+        if isinstance(tgt, ast.Name):
+            env.bind(tgt.id, value)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind_target(el, _Value(tainted=value.tainted, noneness=_MAYBE), env)
+        elif isinstance(tgt, ast.Starred):
+            self._bind_target(tgt.value, value, env)
+        # attribute/subscript targets carry no local binding
+
+    def _scan_state_write(self, tgt: ast.AST, rhs: ast.AST, env: _Env, conditional: bool) -> None:
+        """Assignment to a registered state: growing the array (concatenate
+        with itself) is the array-state spelling of cat-growth."""
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+            and tgt.attr in env.states
+        ):
+            return
+        for sub in ast.walk(rhs):
+            if isinstance(sub, ast.Call) and _last_name(sub.func) in {
+                "concatenate",
+                "append",
+                "hstack",
+                "vstack",
+            }:
+                mentions_state = any(
+                    isinstance(n, ast.Attribute)
+                    and n.attr == tgt.attr
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    for a in list(sub.args) + [kw.value for kw in sub.keywords]
+                    for n in ast.walk(a)
+                )
+                if mentions_state:
+                    self._emit(
+                        REASON_CAT_GROWTH,
+                        f"state `{tgt.attr}` grows by concatenation each update",
+                        conditional,
+                        sub,
+                    )
+
+    # -- expressions ---------------------------------------------------
+    def _eval(self, node: ast.AST, env: _Env, conditional: bool) -> _Value:
+        if isinstance(node, ast.Constant):
+            return _Value(tainted=False, noneness=_NONE if node.value is None else _NOT_NONE)
+        if isinstance(node, ast.Name):
+            return env.value_of(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                self._eval(node.value, env, conditional)  # still visit for signals
+                return _Value(tainted=False, noneness=_NOT_NONE)
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return _Value(tainted=node.attr in env.states, noneness=_MAYBE)
+            base = self._eval(node.value, env, conditional)
+            return _Value(tainted=base.tainted, noneness=_MAYBE)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, conditional)
+        if isinstance(node, ast.Compare):
+            values = [self._eval(node.left, env, conditional)] + [
+                self._eval(c, env, conditional) for c in node.comparators
+            ]
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+                return _Value(tainted=False, noneness=_NOT_NONE)
+            tainted = any(v.tainted for v in values)
+            return _Value(tainted=tainted, noneness=_NOT_NONE, boolish=tainted)
+        if isinstance(node, (ast.BinOp,)):
+            left = self._eval(node.left, env, conditional)
+            right = self._eval(node.right, env, conditional)
+            boolish = (left.boolish or right.boolish) and isinstance(
+                node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)
+            )
+            return _Value(tainted=left.tainted or right.tainted, noneness=_NOT_NONE, boolish=boolish)
+        if isinstance(node, ast.UnaryOp):
+            operand = self._eval(node.operand, env, conditional)
+            return _Value(tainted=operand.tainted, noneness=_NOT_NONE, boolish=operand.boolish)
+        if isinstance(node, ast.BoolOp):
+            values = [self._eval(v, env, conditional) for v in node.values]
+            return _Value(
+                tainted=any(v.tainted for v in values),
+                noneness=_MAYBE,
+                boolish=any(v.boolish for v in values),
+            )
+        if isinstance(node, ast.IfExp):
+            test = self._eval(node.test, env, conditional)
+            if test.tainted:
+                self._emit(
+                    REASON_HOST_SYNC,
+                    "conditional expression on a traced value concretizes under jit",
+                    conditional,
+                    node,
+                )
+            body = self._eval(node.body, env, conditional)
+            orelse = self._eval(node.orelse, env, conditional)
+            return _Value(
+                tainted=body.tainted or orelse.tainted,
+                noneness=body.noneness if body.noneness == orelse.noneness else _MAYBE,
+            )
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env, conditional)
+            self._scan_subscript(node, base, env, conditional)
+            # `x.shape[i]` yields an int, never None; general subscripts
+            # (dict lookups) stay maybe-None
+            shape_like = (
+                isinstance(node.value, ast.Attribute) and node.value.attr in _STATIC_ATTRS
+            )
+            return _Value(
+                tainted=base.tainted, noneness=_NOT_NONE if shape_like else _MAYBE
+            )
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            values = [self._eval(el, env, conditional) for el in node.elts]
+            return _Value(tainted=any(v.tainted for v in values), noneness=_NOT_NONE)
+        if isinstance(node, ast.Dict):
+            tainted = False
+            for k, v in zip(node.keys, node.values):
+                if k is not None:
+                    tainted |= self._eval(k, env, conditional).tainted
+                tainted |= self._eval(v, env, conditional).tainted
+            return _Value(tainted=tainted, noneness=_NOT_NONE)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            tainted = False
+            for gen in node.generators:
+                it = self._eval(gen.iter, env, conditional)
+                self._bind_target(gen.target, _Value(tainted=it.tainted, noneness=_NOT_NONE), env)
+                tainted |= it.tainted
+                for cond in gen.ifs:
+                    cv = self._eval(cond, env, conditional)
+                    if cv.tainted:
+                        self._emit(
+                            REASON_DATA_SHAPE,
+                            "comprehension filtered on a traced value has a data-dependent length",
+                            conditional,
+                            cond,
+                        )
+            if isinstance(node, ast.DictComp):
+                tainted |= self._eval(node.key, env, conditional).tainted
+                tainted |= self._eval(node.value, env, conditional).tainted
+            else:
+                tainted |= self._eval(node.elt, env, conditional).tainted
+            return _Value(tainted=tainted, noneness=_NOT_NONE)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    fv = self._eval(v.value, env, conditional)
+                    if fv.tainted:
+                        self._emit(
+                            REASON_HOST_SYNC,
+                            "f-string interpolation of a traced value reads it on host",
+                            conditional,
+                            v,
+                        )
+            return _Value(tainted=False, noneness=_NOT_NONE)
+        if isinstance(node, ast.NamedExpr):
+            value = self._eval(node.value, env, conditional)
+            self._bind_target(node.target, value, env)
+            return value
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env, conditional)
+        if isinstance(node, ast.Lambda):
+            return _Value(tainted=False, noneness=_NOT_NONE)
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._eval(part, env, conditional)
+            return _Value(tainted=False, noneness=_NOT_NONE)
+        # unhandled expression kinds: visit children conservatively
+        tainted = False
+        for child in ast.iter_child_nodes(node):
+            tainted |= self._eval(child, env, conditional).tainted
+        return _Value(tainted=tainted, noneness=_MAYBE)
+
+    def _scan_subscript(self, node: ast.Subscript, base: _Value, env: _Env, conditional: bool) -> None:
+        sl = node.slice
+        parts: List[ast.AST]
+        if isinstance(sl, ast.Tuple):
+            parts = list(sl.elts)
+        else:
+            parts = [sl]
+        for part in parts:
+            if isinstance(part, ast.Slice):
+                for bound in (part.lower, part.upper, part.step):
+                    if bound is None:
+                        continue
+                    bv = self._eval(bound, env, conditional)
+                    if bv.tainted and base.tainted:
+                        self._emit(
+                            REASON_DATA_SHAPE,
+                            "slice bound derived from traced data gives a data-dependent shape",
+                            conditional,
+                            part,
+                        )
+            else:
+                pv = self._eval(part, env, conditional)
+                if base.tainted and pv.tainted and pv.boolish:
+                    self._emit(
+                        REASON_DATA_SHAPE,
+                        "boolean-mask indexing selects a data-dependent number of elements",
+                        conditional,
+                        part,
+                    )
+
+    # -- calls ----------------------------------------------------------
+    def _eval_call(self, node: ast.Call, env: _Env, conditional: bool) -> _Value:
+        func = node.func
+        arg_values = [self._eval(a, env, conditional) for a in node.args]
+        kw_values = {kw.arg: self._eval(kw.value, env, conditional) for kw in node.keywords}
+        any_taint = any(v.tainted for v in arg_values) or any(
+            v.tainted for v in kw_values.values()
+        )
+
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name == "_is_concrete":
+                return _Value(tainted=False, noneness=_NOT_NONE)
+            if name in _CAST_BUILTINS:
+                if any_taint:
+                    self._emit(
+                        REASON_HOST_SYNC,
+                        f"`{name}()` on a traced value forces a device->host round-trip",
+                        conditional,
+                        node,
+                    )
+                return _Value(tainted=False, noneness=_NOT_NONE)
+            if name in _SAFE_HOST_BUILTINS:
+                # container/iteration builtins preserve taint of their input
+                keeps = name in {"sum", "max", "min", "abs", "list", "tuple", "sorted", "reversed"}
+                return _Value(tainted=any_taint and keeps, noneness=_NOT_NONE)
+            if name in self.ctx.jnp_member_imports:
+                return self._jnp_call(self.ctx.jnp_member_imports[name], node, arg_values, kw_values, env, conditional)
+            if name in self.ctx.numpy_member_imports:
+                if any_taint:
+                    self._emit(
+                        REASON_HOST_SYNC,
+                        f"numpy `{name}` on a traced value pulls it to host",
+                        conditional,
+                        node,
+                    )
+                return _Value(tainted=False, noneness=_NOT_NONE)
+            resolved = self.project.resolve_function(self.ctx, name)
+            if resolved is not None:
+                return self._resolved_call(resolved, node, arg_values, kw_values, conditional)
+            if any_taint:
+                # an "unknown" signal already blocks the fusible verdict, so
+                # the result is modeled untainted: propagating taint out of a
+                # hole would cascade into FALSE unconditional unsafe signals
+                # downstream (`if` on the artifact), turning unknown into a
+                # wrong unsafe verdict
+                self._emit(
+                    "unknown",
+                    f"unresolved call `{name}` receives traced values",
+                    conditional,
+                    node,
+                )
+            return _Value(tainted=False, noneness=_MAYBE)
+
+        if isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            root = chain[0] if chain else None
+            member = func.attr
+            # module-rooted calls
+            if root is not None and len(chain) >= 2:
+                if root in self.ctx.jnp_aliases and len(chain) == 2:
+                    return self._jnp_call(member, node, arg_values, kw_values, env, conditional)
+                if root in self.ctx.lax_aliases or (
+                    len(chain) >= 3 and root in self.ctx.jax_aliases and chain[1] == "lax"
+                ):
+                    return _Value(tainted=True, noneness=_NOT_NONE)
+                if root in self.ctx.jax_aliases:
+                    if member == "device_get":
+                        self._emit(
+                            REASON_HOST_SYNC,
+                            "`jax.device_get` blocks on a host transfer",
+                            conditional,
+                            node,
+                        )
+                        return _Value(tainted=False, noneness=_NOT_NONE)
+                    if len(chain) >= 3 and chain[1] == "numpy":
+                        return self._jnp_call(member, node, arg_values, kw_values, env, conditional)
+                    return _Value(tainted=True, noneness=_NOT_NONE)
+                if root in self.ctx.numpy_aliases and root not in self.ctx.jnp_aliases:
+                    if any_taint:
+                        self._emit(
+                            REASON_HOST_SYNC,
+                            f"`{root}.{member}` on a traced value pulls it to host",
+                            conditional,
+                            node,
+                        )
+                    return _Value(tainted=False, noneness=_NOT_NONE)
+            # self.<method>(...) — resolve within the class chain if bound
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and self._method_resolver is not None
+            ):
+                resolved = self._method_resolver(member)
+                if resolved is not None:
+                    return self._resolved_call(resolved, node, arg_values, kw_values, conditional, skip_self=True)
+                if member == "add_state":
+                    return _Value(tainted=False, noneness=_NOT_NONE)
+                if any_taint:
+                    self._emit(
+                        "unknown",
+                        f"unresolved method `self.{member}` receives traced values",
+                        conditional,
+                        node,
+                    )
+                return _Value(tainted=False, noneness=_MAYBE)
+            # method on an evaluated receiver
+            receiver = self._eval(func.value, env, conditional)
+            if (
+                member == "append"
+                and isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id == "self"
+                and func.value.attr in (env.states | env.list_states)
+            ):
+                self._emit(
+                    REASON_CAT_GROWTH,
+                    f"state `{func.value.attr}` accumulates by append (unbounded concatenation)",
+                    conditional,
+                    node,
+                )
+                return _Value(tainted=False, noneness=_NOT_NONE)
+            if receiver.tainted:
+                if member in _HOST_SYNC_METHODS:
+                    self._emit(
+                        REASON_HOST_SYNC,
+                        f"`.{member}()` forces a device->host sync",
+                        conditional,
+                        node,
+                    )
+                    return _Value(tainted=False, noneness=_NOT_NONE)
+                if member in _DATA_DEP_METHODS:
+                    self._emit(
+                        REASON_DATA_SHAPE,
+                        f"`.{member}()` has a data-dependent output shape",
+                        conditional,
+                        node,
+                    )
+                    return _Value(tainted=True, noneness=_NOT_NONE)
+                return _Value(
+                    tainted=True, noneness=_NOT_NONE, boolish=member in _BOOLISH_MEMBERS
+                )
+            if any_taint:
+                self._emit(
+                    "unknown",
+                    f"unresolved call `{'.'.join(chain) or member}` receives traced values",
+                    conditional,
+                    node,
+                )
+            return _Value(tainted=False, noneness=_MAYBE)
+
+        # call on an arbitrary expression (rare)
+        self._eval(func, env, conditional)
+        if any_taint:
+            self._emit("unknown", "unresolved indirect call receives traced values", conditional, node)
+        return _Value(tainted=False, noneness=_MAYBE)
+
+    #: set by classify_* so `self.<method>()` resolves along the class chain
+    _method_resolver = None
+
+    def _jnp_call(
+        self,
+        member: str,
+        node: ast.Call,
+        arg_values: List[_Value],
+        kw_values: Dict[Optional[str], _Value],
+        env: _Env,
+        conditional: bool,
+    ) -> _Value:
+        if member in _DATA_DEP_MEMBERS:
+            self._emit(
+                REASON_DATA_SHAPE,
+                f"`jnp.{member}` has a data-dependent output shape",
+                conditional,
+                node,
+            )
+            return _Value(tainted=True, noneness=_NOT_NONE)
+        if member == "where" and len(node.args) == 1:
+            self._emit(
+                REASON_DATA_SHAPE,
+                "single-argument `jnp.where` is `nonzero` — data-dependent output shape",
+                conditional,
+                node,
+            )
+            return _Value(tainted=True, noneness=_NOT_NONE)
+        if member == "bincount" and "length" not in kw_values:
+            self._emit(
+                REASON_DATA_SHAPE,
+                "`jnp.bincount` without `length=` has a data-dependent output shape",
+                conditional,
+                node,
+            )
+            return _Value(tainted=True, noneness=_NOT_NONE)
+        if member == "repeat" and "total_repeat_length" not in kw_values:
+            repeats_tainted = (len(arg_values) >= 2 and arg_values[1].tainted) or kw_values.get(
+                "repeats", _HOST
+            ).tainted
+            if repeats_tainted:
+                self._emit(
+                    REASON_DATA_SHAPE,
+                    "`jnp.repeat` with traced repeats and no `total_repeat_length` has a data-dependent shape",
+                    conditional,
+                    node,
+                )
+                return _Value(tainted=True, noneness=_NOT_NONE)
+        if member in _HOST_RESULT_MEMBERS:
+            return _Value(tainted=False, noneness=_NOT_NONE)
+        return _Value(tainted=True, noneness=_NOT_NONE, boolish=member in _BOOLISH_MEMBERS)
+
+    def _resolved_call(
+        self,
+        resolved: Tuple[FileContext, ast.FunctionDef],
+        node: ast.Call,
+        arg_values: List[_Value],
+        kw_values: Dict[Optional[str], _Value],
+        conditional: bool,
+        skip_self: bool = False,
+    ) -> _Value:
+        tctx, fn = resolved
+        if self.depth <= 0:
+            if any(v.tainted for v in arg_values) or any(v.tainted for v in kw_values.values()):
+                self._emit(
+                    "unknown",
+                    f"call depth budget exhausted at `{fn.name}`",
+                    conditional,
+                    node,
+                )
+            # untainted result for the same reason as unresolved calls: the
+            # unknown signal is already recorded, and an artificial taint
+            # would fabricate unconditional unsafe signals downstream
+            return _Value(tainted=False, noneness=_MAYBE)
+
+        signals, ret = summarize_function(
+            self.project,
+            tctx,
+            fn,
+            arg_values,
+            kw_values,
+            depth=self.depth - 1,
+            skip_self=skip_self,
+        )
+        for sig in signals:
+            if sig.kind == "trace-raise" and self._shielded:
+                continue  # an enclosing try/except owns the trace-time raise
+            self.signals.append(
+                Signal(sig.kind, f"{sig.detail} (via `{fn.name}`)", sig.conditional or conditional, sig.line)
+            )
+        return ret
+
+
+def _bind_params(
+    fn: ast.FunctionDef,
+    arg_values: List[_Value],
+    kw_values: Dict[Optional[str], _Value],
+    skip_self: bool,
+) -> Tuple[Set[str], Dict[str, str]]:
+    """Map a concrete call's abstract arguments onto the callee's params;
+    returns (tainted param names, param None-ness)."""
+    params = [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+    if skip_self and params and params[0] == "self":
+        params = params[1:]
+    defaults = list(fn.args.defaults)
+    default_map: Dict[str, ast.AST] = {}
+    for pname, dflt in zip(params[len(params) - len(defaults):], defaults):
+        default_map[pname] = dflt
+    for kwarg, dflt in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if dflt is not None:
+            default_map[kwarg.arg] = dflt
+    kw_params = [a.arg for a in fn.args.kwonlyargs]
+
+    tainted: Set[str] = set()
+    noneness: Dict[str, str] = {}
+
+    def note(pname: str, value: _Value) -> None:
+        if value.tainted:
+            tainted.add(pname)
+        noneness[pname] = value.noneness
+
+    consumed = 0
+    for i, value in enumerate(arg_values):
+        if i < len(params):
+            note(params[i], value)
+            consumed = i + 1
+        elif fn.args.vararg is not None:
+            note(fn.args.vararg.arg, value)
+    for kwname, value in kw_values.items():
+        if kwname is None:  # **kwargs expansion at the call site
+            for pname in params[consumed:] + kw_params:
+                if value.tainted:
+                    tainted.add(pname)
+                noneness.setdefault(pname, _MAYBE)
+            if fn.args.kwarg is not None:
+                note(fn.args.kwarg.arg, value)
+        elif kwname in params or kwname in kw_params:
+            note(kwname, value)
+        elif fn.args.kwarg is not None:
+            note(fn.args.kwarg.arg, value)
+    # unbound params take their declared default's None-ness
+    for pname in params + kw_params:
+        if pname in noneness:
+            continue
+        dflt = default_map.get(pname)
+        if isinstance(dflt, ast.Constant):
+            noneness[pname] = _NONE if dflt.value is None else _NOT_NONE
+        else:
+            noneness[pname] = _MAYBE
+    # a MAYBE binding upgrades to notnone when the parameter's annotation
+    # excludes None (`num_classes: int`): passing None there is already a
+    # type error, so dead-branch elimination may trust the annotation
+    ann_by_name = {
+        a.arg: a.annotation
+        for a in list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs)
+    }
+    for pname, nn in list(noneness.items()):
+        if nn == _MAYBE and _annotation_excludes_none(ann_by_name.get(pname)):
+            noneness[pname] = _NOT_NONE
+    return tainted, noneness
+
+
+def _annotation_excludes_none(ann: Optional[ast.AST]) -> bool:
+    """True for annotations that rule out None (``int``, ``Array``,
+    ``Union[str, List[str]]``); False for Optional/None/Any/strings."""
+    if ann is None:
+        return False
+    for sub in ast.walk(ann):
+        if isinstance(sub, ast.Constant) and (sub.value is None or isinstance(sub.value, str)):
+            return False  # explicit None, or a quoted annotation we won't parse
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name in ("Optional", "Any", "object", "None"):
+            return False
+    return True
+
+
+def summarize_function(
+    project: Project,
+    ctx: FileContext,
+    fn: ast.FunctionDef,
+    arg_values: List[_Value],
+    kw_values: Dict[Optional[str], _Value],
+    depth: int,
+    skip_self: bool = False,
+) -> Tuple[List[Signal], _Value]:
+    """Memoized abstract scan of ``fn`` under one argument binding."""
+    tainted, noneness = _bind_params(fn, arg_values, kw_values, skip_self)
+    key = (
+        ctx.relpath,
+        fn.name,
+        fn.lineno,
+        frozenset(tainted),
+        tuple(sorted(noneness.items())),
+    )
+    cached = project._summary_cache.get(key)
+    if cached is not None:
+        return list(cached[0]), _Value(tainted=cached[1], noneness=cached[2])
+    if key in project._in_progress:
+        return [], _Value(tainted=True, noneness=_MAYBE)  # recursion: optimistic
+    project._in_progress.add(key)
+    try:
+        scanner = _Scanner(project, ctx, depth)
+        env = _Env(traced=set(tainted), noneness=dict(noneness))
+        scanner.scan(fn, env)
+        result = (scanner.signals, scanner.return_value.tainted, scanner.return_value.noneness)
+        project._summary_cache[key] = (list(scanner.signals), result[1], result[2])
+        return result[0], _Value(tainted=result[1], noneness=result[2])
+    finally:
+        project._in_progress.discard(key)
+
+
+# ---------------------------------------------------------------------------
+# class-level classification
+# ---------------------------------------------------------------------------
+
+#: add_state default-expression container classification
+_CONTAINER_ARRAY = "array"
+_CONTAINER_LIST = "list"
+_CONTAINER_UNKNOWN = "unknown"
+
+#: jnp constructors whose first argument is the shape
+_SHAPED_CTORS = {"zeros", "ones", "empty", "full"}
+
+_DTYPE_DEFAULTS = {"zeros": "float32", "ones": "float32", "empty": "float32", "full": None}
+
+
+def _dim_of(node: ast.AST) -> object:
+    """One abstract dimension: a concrete int, a symbol (parameter name),
+    or "?" when the expression is beyond the lattice."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return "?"
+
+
+def _shape_of(node: ast.AST) -> Optional[List[object]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [_dim_of(el) for el in node.elts]
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, ast.Name) or isinstance(node, ast.Attribute):
+        return None  # a shape variable: rank unknown
+    return None
+
+
+def _dtype_name(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    name = _last_name(node)
+    if name and (name.startswith(("int", "uint", "float", "bfloat", "complex")) or name == "bool_"):
+        return "bool" if name == "bool_" else name
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@dataclass
+class StateEntry:
+    """Abstract description of one registered state leaf."""
+
+    name: str
+    container: str  # array | list | unknown
+    shape: Optional[List[object]]  # dims: int | symbol str | "?" ; None = unknown
+    dtype: Optional[str]
+    dist_reduce_fx: Optional[str]  # "sum"/"mean"/... | "custom" | None
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "container": self.container,
+            "shape": self.shape,
+            "dtype": self.dtype,
+            "dist_reduce_fx": self.dist_reduce_fx,
+        }
+
+
+def _infer_default(
+    expr: Optional[ast.AST],
+    bindings: Optional[Dict[str, List[ast.AST]]] = None,
+    _depth: int = 3,
+) -> Tuple[str, Optional[List[object]], Optional[str]]:
+    """(container, shape, dtype) of an ``add_state`` default expression.
+
+    ``bindings`` maps local names to every expression assigned to them in
+    the class body: a name bound exactly once resolves through (the
+    ``default = jnp.zeros(...) if multilabel else ...`` idiom); multiple
+    bindings are genuinely config-dependent and stay unknown.
+    """
+    if expr is None or _depth <= 0:
+        return _CONTAINER_UNKNOWN, None, None
+    if isinstance(expr, ast.Name) and bindings is not None:
+        bound = bindings.get(expr.id)
+        if bound is not None and len(bound) == 1:
+            return _infer_default(bound[0], bindings, _depth - 1)
+        return _CONTAINER_UNKNOWN, None, None
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and not expr.args
+        and not expr.keywords
+        and bindings is not None
+    ):
+        # `default()` thunk idiom: resolve the zero-arg callable's body
+        bound = bindings.get(expr.func.id)
+        if bound is not None and len(bound) == 1:
+            target = bound[0]
+            if isinstance(target, ast.Lambda):
+                return _infer_default(target.body, bindings, _depth - 1)
+            if isinstance(target, ast.Name) and target.id == "list":
+                return _CONTAINER_LIST, None, None
+        if expr.func.id == "list":
+            return _CONTAINER_LIST, None, None
+        if bound is not None:
+            return _CONTAINER_UNKNOWN, None, None
+    if isinstance(expr, ast.List):
+        return _CONTAINER_LIST, None, None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, (int, float, bool)):
+        dtype = "bool" if isinstance(expr.value, bool) else (
+            "int32" if isinstance(expr.value, int) else "float32"
+        )
+        return _CONTAINER_ARRAY, [], dtype
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.operand, ast.Constant):
+        return _infer_default(expr.operand, bindings, _depth - 1)
+    if isinstance(expr, ast.IfExp):
+        c1, s1, d1 = _infer_default(expr.body, bindings, _depth - 1)
+        c2, s2, d2 = _infer_default(expr.orelse, bindings, _depth - 1)
+        container = c1 if c1 == c2 else _CONTAINER_UNKNOWN
+        return container, s1 if s1 == s2 else None, d1 if d1 == d2 else None
+    if isinstance(expr, ast.Call):
+        member = _last_name(expr.func)
+        dtype_kw = next((kw.value for kw in expr.keywords if kw.arg == "dtype"), None)
+        if member in _SHAPED_CTORS:
+            shape = _shape_of(expr.args[0]) if expr.args else None
+            dtype = _dtype_name(dtype_kw) or (
+                _dtype_name(expr.args[2]) if member == "full" and len(expr.args) >= 3 else None
+            ) or _DTYPE_DEFAULTS.get(member)
+            if member == "full" and dtype is None and len(expr.args) >= 2:
+                _, _, dtype = _infer_default(expr.args[1], bindings, _depth - 1)
+            return _CONTAINER_ARRAY, shape, dtype
+        if member == "eye" and expr.args:
+            dim = _dim_of(expr.args[0])
+            return _CONTAINER_ARRAY, [dim, dim], _dtype_name(dtype_kw) or "float32"
+        if member in {"asarray", "array"} and expr.args:
+            container, shape, dtype = _infer_default(expr.args[0], bindings, _depth - 1)
+            if container == _CONTAINER_LIST:
+                # jnp.asarray([...]) is an ARRAY literal
+                inner = expr.args[0]
+                shape = [len(inner.elts)] if isinstance(inner, ast.List) else None
+                container, dtype = _CONTAINER_ARRAY, dtype
+            explicit = _dtype_name(dtype_kw) or (
+                _dtype_name(expr.args[1]) if len(expr.args) >= 2 else None
+            )
+            return _CONTAINER_ARRAY, shape, explicit or dtype
+        return _CONTAINER_UNKNOWN, None, _dtype_name(dtype_kw)
+    return _CONTAINER_UNKNOWN, None, None
+
+
+_STRING_REDUCERS = {"sum", "mean", "max", "min", "cat"}
+
+
+def _reducer_of(call: ast.Call) -> Optional[str]:
+    """The dist_reduce_fx of an add_state call: a known string, None (no
+    reduction), or "custom" for callables/unrecognized expressions."""
+    fx: Optional[ast.AST] = None
+    if len(call.args) >= 3:
+        fx = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "dist_reduce_fx":
+            fx = kw.value
+    if fx is None:
+        return None
+    if isinstance(fx, ast.Constant):
+        if fx.value is None:
+            return None
+        if isinstance(fx.value, str) and fx.value in _STRING_REDUCERS:
+            return fx.value
+    return "custom"
+
+
+def state_entries_of(class_node: ast.ClassDef) -> List[StateEntry]:
+    """Every ``self.add_state(...)`` in the class body, abstracted."""
+    entries: List[StateEntry] = []
+    seen: Set[str] = set()
+    # local constant propagation for the `default = <expr>; add_state(...,
+    # default=default)` idiom: single-binding names resolve through
+    bindings: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(class_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            bindings.setdefault(node.targets[0].id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name) and node.value is not None:
+            bindings.setdefault(node.target.id, []).append(node.value)
+    for node in ast.walk(class_node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr == "add_state"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            continue
+        name = None
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        default: Optional[ast.AST] = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default = kw.value
+        container, shape, dtype = _infer_default(default, bindings)
+        if name is None:
+            continue  # dynamically-named state: recorded via the unknown-container path
+        if name in seen:
+            # registered twice (config branches): containers must agree
+            prev = next(e for e in entries if e.name == name)
+            if prev.container != container:
+                prev.container = _CONTAINER_UNKNOWN
+                prev.shape = None
+            continue
+        seen.add(name)
+        entries.append(StateEntry(name, container, shape, dtype, _reducer_of(node)))
+    return entries
+
+
+@dataclass
+class ClassFacts:
+    """Merged cross-file view of a metric class and its in-package bases."""
+
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    entries: List[StateEntry]
+    declared: Optional[bool]  # explicit __jit_unsafe__ (None = undeclared)
+    declared_here: Optional[bool]  # declaration in THIS class body only
+    declared_computed: bool
+    update: Optional[Tuple[FileContext, ast.FunctionDef]]
+    chain: List[Tuple[FileContext, ast.ClassDef]]
+    is_metric: bool
+
+
+def _own_declaration(class_node: ast.ClassDef) -> Tuple[Optional[bool], bool]:
+    """(declared value, computed?) for a __jit_unsafe__ declaration in this
+    class body — class-level assignment or the instance-dict idiom."""
+    declared: Optional[bool] = None
+    computed = False
+
+    def record(value: Optional[ast.AST]) -> None:
+        nonlocal declared, computed
+        if isinstance(value, ast.Constant):
+            declared = bool(value.value) if declared is None else (declared or bool(value.value))
+        else:
+            computed = True
+            declared = True if declared is None else declared
+
+    for stmt in class_node.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+        if target == "__jit_unsafe__":
+            record(getattr(stmt, "value", None))
+    for node in ast.walk(class_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and tgt.attr == "__jit_unsafe__"
+            ):
+                record(node.value)
+            if (
+                isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.value, ast.Attribute)
+                and isinstance(tgt.value.value, ast.Name)
+                and tgt.value.value.id == "self"
+                and tgt.value.attr == "__dict__"
+                and isinstance(tgt.slice, ast.Constant)
+                and tgt.slice.value == "__jit_unsafe__"
+            ):
+                record(node.value)
+    return declared, computed
+
+
+def class_facts(project: Project, ctx: FileContext, class_node: ast.ClassDef) -> ClassFacts:
+    """Resolve the class chain across files and merge state registrations,
+    declarations, and the effective update method."""
+    chain: List[Tuple[FileContext, ast.ClassDef]] = []
+    seen: Set[Tuple[str, str]] = set()
+    queue: List[Tuple[FileContext, ast.ClassDef]] = [(ctx, class_node)]
+    is_metric = False
+    while queue:
+        cur_ctx, cur_node = queue.pop(0)
+        key = (cur_ctx.relpath, cur_node.name)
+        if key in seen:
+            continue
+        seen.add(key)
+        chain.append((cur_ctx, cur_node))
+        for base in cur_node.bases:
+            base_name = _last_name(base)
+            if base_name is None:
+                continue
+            if base_name == "Metric" or base_name.endswith("Metric") or base_name == "ABC":
+                if base_name != "ABC":
+                    is_metric = True
+                resolved = project.resolve_class(cur_ctx, base_name)
+                if resolved is not None and base_name != "ABC":
+                    queue.append(resolved)
+                continue
+            resolved = project.resolve_class(cur_ctx, base_name)
+            if resolved is not None:
+                queue.append(resolved)
+
+    entries: List[StateEntry] = []
+    names: Set[str] = set()
+    declared: Optional[bool] = None
+    computed = False
+    for cur_ctx, cur_node in chain:
+        for entry in state_entries_of(cur_node):
+            if entry.name not in names:
+                names.add(entry.name)
+                entries.append(entry)
+        if entries and not is_metric:
+            is_metric = True  # registers state: metric-like regardless of name
+        if declared is None and not (
+            cur_node.name == "Metric" and cur_ctx.relpath == "core/metric.py"
+        ):
+            # the base Metric's `__jit_unsafe__ = False` is the inherited
+            # DEFAULT, not an explicit per-metric declaration
+            d, c = _own_declaration(cur_node)
+            if d is not None:
+                declared, computed = d, c
+
+    update: Optional[Tuple[FileContext, ast.FunctionDef]] = None
+    for method_name in ("_update", "update"):
+        for cur_ctx, cur_node in chain:
+            for stmt in cur_node.body:
+                if isinstance(stmt, ast.FunctionDef) and stmt.name == method_name:
+                    update = (cur_ctx, stmt)
+                    break
+            if update is not None:
+                break
+        if update is not None:
+            break
+
+    declared_here, computed_here = _own_declaration(class_node)
+    return ClassFacts(
+        name=class_node.name,
+        relpath=ctx.relpath,
+        node=class_node,
+        entries=entries,
+        declared=declared,
+        declared_here=declared_here,
+        declared_computed=computed or computed_here,
+        update=update,
+        chain=chain,
+        is_metric=is_metric,
+    )
+
+
+def _string_annotated_params(fn: ast.FunctionDef) -> Set[str]:
+    """Update parameters whose type annotation mentions ``str`` — a declared
+    host-text input that can never trace."""
+    out: Set[str] = set()
+    for arg in list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs):
+        if arg.arg == "self" or arg.annotation is None:
+            continue
+        for sub in ast.walk(arg.annotation):
+            if (isinstance(sub, ast.Name) and sub.id == "str") or (
+                isinstance(sub, ast.Constant) and sub.value == "str"
+            ):
+                out.add(arg.arg)
+                break
+    return out
+
+
+def _method_resolver_for(project: Project, facts: ClassFacts):
+    """Resolve ``self.<name>(...)`` along the class chain (in-package only)."""
+
+    def resolve(name: str) -> Optional[Tuple[FileContext, ast.FunctionDef]]:
+        for cur_ctx, cur_node in facts.chain:
+            for stmt in cur_node.body:
+                if isinstance(stmt, ast.FunctionDef) and stmt.name == name:
+                    return cur_ctx, stmt
+        return None
+
+    return resolve
+
+
+def classify(project: Project, ctx: FileContext, class_node: ast.ClassDef) -> Tuple[Verdict, ClassFacts]:
+    """The per-class verdict and the facts it was derived from."""
+    facts = class_facts(project, ctx, class_node)
+
+    definite_lists = [e.name for e in facts.entries if e.container == _CONTAINER_LIST]
+    if definite_lists:
+        return (
+            Verdict(
+                VERDICT_UNSAFE,
+                REASON_CAT_GROWTH,
+                f"list state{'s' if len(definite_lists) > 1 else ''} "
+                f"{', '.join(sorted(definite_lists))} accumulate by unbounded concatenation",
+            ),
+            facts,
+        )
+
+    if facts.update is None:
+        return Verdict(VERDICT_UNKNOWN, None, "no update method found in the class chain"), facts
+
+    unknown_containers = [e.name for e in facts.entries if e.container == _CONTAINER_UNKNOWN]
+
+    up_ctx, up_fn = facts.update
+    text_params = _string_annotated_params(up_fn)
+    if text_params:
+        # declared host-text inputs: jax cannot trace Python strings, so the
+        # update is host-side by type contract, whatever its body does
+        return (
+            Verdict(
+                VERDICT_UNSAFE,
+                REASON_HOST_SYNC,
+                "update consumes Python strings (host text processing): "
+                + ", ".join(sorted(text_params)),
+            ),
+            facts,
+        )
+    scanner = _Scanner(project, up_ctx, _DEPTH_BUDGET)
+    scanner._method_resolver = _method_resolver_for(project, facts)
+    params = {a.arg for a in list(up_fn.args.posonlyargs) + list(up_fn.args.args) if a.arg != "self"}
+    params.update(a.arg for a in up_fn.args.kwonlyargs)
+    if up_fn.args.vararg:
+        params.add(up_fn.args.vararg.arg)
+    if up_fn.args.kwarg:
+        params.add(up_fn.args.kwarg.arg)
+    env = _Env(
+        traced=set(params),
+        noneness={p: _NOT_NONE for p in params},
+        states={e.name for e in facts.entries if e.container != _CONTAINER_LIST},
+        list_states=set(unknown_containers),
+    )
+    scanner.scan(up_fn, env)
+    signals = list(scanner.signals)
+    if unknown_containers:
+        signals.append(
+            Signal(
+                "unknown",
+                "state container depends on constructor configuration: "
+                + ", ".join(sorted(unknown_containers)),
+                conditional=True,
+                line=class_node.lineno,
+            )
+        )
+    return verdict_from_signals(signals), facts
+
+
+def iter_metric_classes(ctx: FileContext) -> Iterator[ast.ClassDef]:
+    """Top-level classes in ``ctx`` worth classifying (named like metrics,
+    based on an in-package metric, or registering state)."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef):
+            yield node

@@ -12,13 +12,19 @@ consume it:
      "message": "...", "snippet": "...", "baselined": false}
   ],
   "summary": {"files": 10, "new": 1, "baselined": 0, "suppressed": 0,
-              "rules": ["TL-COLLECTIVE", "..."]}
+              "rules": ["TL-COLLECTIVE", "..."],
+              "by_rule": {"TL-TRACE": 1}}
 }
 ```
+
+``by_rule`` counts NEW violations per rule id (omitting zero-count rules),
+so CI annotators can tell WHICH invariant regressed without walking the
+violation list.
 """
 from __future__ import annotations
 
 import json
+from collections import Counter
 from typing import List, Sequence
 
 from .engine import Violation
@@ -46,6 +52,9 @@ def render_text(
         f"tracelint: {n_files} files, {len(new)} new, {len(baselined)} baselined,"
         f" {suppressed_count} suppressed"
     )
+    if new:
+        by_rule = Counter(v.rule for v in new)
+        summary += " (" + ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) + ")"
     if stale_count:
         summary += f", {stale_count} stale baseline entr{'y' if stale_count == 1 else 'ies'} (run --baseline-update)"
     out.append(summary)
@@ -75,6 +84,7 @@ def render_json(
             "suppressed": suppressed_count,
             "stale_baseline_entries": stale_count,
             "rules": sorted(rules),
+            "by_rule": dict(sorted(Counter(v.rule for v in new).items())),
         },
     }
     return json.dumps(payload, indent=2) + "\n"

@@ -285,12 +285,16 @@ class _TracedNames:
             func = node.func
             if isinstance(func, ast.Name) and func.id in _STATIC_CALLS:
                 return False
-            # a jnp.* call produces a traced array by construction
+            # a jnp.* call produces a traced array by construction — whether
+            # spelled via the module alias or a direct member import
+            # (`from jax.numpy import concatenate`)
             if (
                 isinstance(func, ast.Attribute)
                 and isinstance(func.value, ast.Name)
                 and func.value.id in self.ctx.jnp_aliases
             ):
+                return True
+            if isinstance(func, ast.Name) and func.id in self.ctx.jnp_member_imports:
                 return True
             # a method on a traced object (x.astype, x.at[...].set) is traced;
             # any OTHER call (host helper) breaks taint on purpose
@@ -459,6 +463,7 @@ class TraceRule(Rule):
                 and func.attr in {"asarray", "array"}
                 and isinstance(func.value, ast.Name)
                 and func.value.id in ctx.numpy_aliases
+                and func.value.id not in ctx.jnp_aliases
             ):
                 if any(traced.mentions(a) for a in sub.args) or any(
                     traced.mentions(kw.value) for kw in sub.keywords
@@ -468,6 +473,21 @@ class TraceRule(Rule):
                         sub,
                         f"`{func.value.id}.{func.attr}` on a traced value pulls it to "
                         "host; use jnp.asarray so the kernel stays fusible",
+                    )
+            elif (
+                isinstance(func, ast.Name)
+                and ctx.numpy_member_imports.get(func.id) in {"asarray", "array"}
+                and func.id not in ctx.jnp_member_imports
+            ):
+                # direct-member import form: `from numpy import asarray`
+                if any(traced.mentions(a) for a in sub.args) or any(
+                    traced.mentions(kw.value) for kw in sub.keywords
+                ):
+                    yield self.violation(
+                        ctx,
+                        sub,
+                        f"`{func.id}` (imported from numpy) on a traced value pulls "
+                        "it to host; use jnp.asarray so the kernel stays fusible",
                     )
 
     # -- functional-kernel scan (hard syncs only) --------------------------
@@ -769,9 +789,12 @@ class CollectiveRule(Rule):
             chain = _attr_chain(func)
             name = chain[-1] if chain else None
             if name in self.COLLECTIVES:
-                # jax.lax.psum / lax.psum / from jax.lax import psum
-                rooted_in_lax = "lax" in chain[:-1] or (
-                    isinstance(func, ast.Name) and func.id in ctx.lax_from_imports
+                # jax.lax.psum / lax.psum / from jax.lax import psum / a
+                # same-file rebinding (`mylax = jax.lax`; engine alias maps)
+                rooted_in_lax = (
+                    "lax" in chain[:-1]
+                    or (len(chain) > 1 and chain[0] in ctx.lax_aliases)
+                    or (isinstance(func, ast.Name) and func.id in ctx.lax_from_imports)
                 )
                 if rooted_in_lax:
                     yield self.violation(
@@ -845,3 +868,106 @@ class PrintRule(Rule):
                     "bare warn() in library code; use rank_zero_warn from "
                     "metrics_tpu.utils.prints",
                 )
+
+
+# ---------------------------------------------------------------------------
+# TL-DECL
+# ---------------------------------------------------------------------------
+
+@register_rule
+class DeclRule(Rule):
+    """``__jit_unsafe__`` declarations cross-checked against the abstract
+    interpreter's verdict (analysis/interp.py).
+
+    The declaration is the reviewed contract the fused path and MetricTester
+    key on — and PR-by-PR it goes stale in both directions: a metric
+    declared ``True`` whose update became pure and fixed-shape (ROADMAP
+    item 2 replaces cat-state with sketches) silently keeps paying the
+    eager path, and a metric declared ``False`` that grew a host sync
+    crashes the fused kernel build instead of falling back. Both are
+    findings; ``unknown`` verdicts never fire (the runtime probe stays the
+    authority), and cat-growth never contradicts ``False`` (list states are
+    excluded from fusion by a separate runtime check, not the declaration).
+    """
+
+    id = "TL-DECL"
+    description = "__jit_unsafe__ declaration contradicted or made redundant by the static verdict"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        from . import interp
+
+        classes = collect_classes(ctx)
+        project = _shared_project()
+        for info in classes.values():
+            if not _is_metric_like(info, classes):
+                continue
+            verdict, facts = interp.classify(project, ctx, info.node)
+            if facts.declared_here is None or facts.declared_computed:
+                continue  # undeclared or computed declarations are not auditable
+            if facts.declared_here and verdict.status == interp.VERDICT_FUSIBLE:
+                yield self.violation(
+                    ctx,
+                    info.node,
+                    f"`{info.name}` declares `__jit_unsafe__ = True` but its update is "
+                    "statically fusible (pure, fixed-shape through every resolved call); "
+                    "the stale declaration forces the eager path — remove it or document "
+                    "the dynamic case the analysis cannot see with a pragma",
+                )
+            elif (
+                not facts.declared_here
+                and verdict.status == interp.VERDICT_UNSAFE
+                and verdict.reason in (interp.REASON_HOST_SYNC, interp.REASON_DATA_SHAPE)
+            ):
+                yield self.violation(
+                    ctx,
+                    info.node,
+                    f"`{info.name}` declares `__jit_unsafe__ = False` but its update is "
+                    f"statically unsafe ({verdict.reason}): {verdict.detail}; the fused "
+                    "kernel build will fail instead of falling back — fix the update or "
+                    "declare True",
+                )
+
+
+#: one Project per process: parse-once resolution shared by TL-DECL/TL-FLOW
+#: and the manifest builder (file contexts are immutable once parsed)
+_PROJECT = None
+
+
+def _shared_project():
+    global _PROJECT
+    if _PROJECT is None:
+        from .interp import Project
+
+        _PROJECT = Project()
+    return _PROJECT
+
+
+# ---------------------------------------------------------------------------
+# TL-FLOW
+# ---------------------------------------------------------------------------
+
+@register_rule
+class FlowRule(Rule):
+    """State-lifecycle dataflow (analysis/stateflow.py): reducer-consistent
+    accumulation, reset restoration, and live leaves.
+
+    A ``"sum"``-reduced leaf mutated by anything other than additive
+    assignment breaks the cross-rank reduction contract sync and
+    ``merge_states`` trust; an overriding ``reset`` that misses a leaf
+    leaks accumulation across epochs; a registered-but-never-touched leaf
+    is dead sync weight. TL-STATE checks WHERE states are written — this
+    rule checks WHAT the writes mean.
+    """
+
+    id = "TL-FLOW"
+    description = "state write inconsistent with its dist_reduce_fx / reset / liveness contract"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        from . import stateflow
+
+        classes = collect_classes(ctx)
+        for info in classes.values():
+            if not _is_metric_like(info, classes):
+                continue
+            for finding in stateflow.analyze_class(ctx, info.node):
+                yield self.violation(ctx, finding.node, finding.message)

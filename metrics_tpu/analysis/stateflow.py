@@ -1,0 +1,345 @@
+"""State-lifecycle dataflow: the TL-FLOW analysis.
+
+Every ``add_state`` leaf carries a ``dist_reduce_fx`` contract that sync,
+``merge_states``, and the fused kernel all trust. This pass checks that the
+class's own lifecycle honors it:
+
+* **Reducer-consistent writes** — a ``"sum"``-reduced leaf must accumulate
+  additively in update methods (``self.x = self.x + delta`` / ``+=`` /
+  ``.at[...].add``): a plain overwrite discards prior batches on this rank
+  AND double-counts nothing on others after a cross-rank sum, and an
+  extremum update (``jnp.maximum``) makes per-rank values non-additive. The
+  dual holds for ``"max"``/``"min"`` leaves, where an additive write breaks
+  the idempotent-extremum contract.
+* **Reset restoration** — a class that overrides ``reset`` must either call
+  ``super().reset()`` (which restores every registered default) or assign
+  each leaf itself; a leaf missed by an overriding reset survives across
+  epochs and silently inflates the next accumulation.
+* **Live leaves** — a leaf registered by a class that defines its own
+  update but never touches the leaf anywhere in the file is dead weight:
+  it still costs sync bytes every ``compute`` and suggests a typo'd
+  attribute name (write hits ``__setattr__`` but not the registry).
+
+Only leaves with a CONSTANT string reducer are checked (config-dependent
+reducers — the StatScores ``"cat"``-or-``"sum"`` idiom — and custom
+callables have no statically-checkable write contract). Findings surface
+through the ``TL-FLOW`` rule in :mod:`.rules`.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext
+
+#: methods whose writes are ACCUMULATION (the reducer contract applies);
+#: reset/sync/bind/merge/load writes are restoration and exempt
+_UPDATE_METHODS = {"_update", "update", "update_state"}
+
+#: additive accumulation spellings for sum-reduced leaves
+_ADDITIVE_AUG_OPS = (ast.Add, ast.Sub)
+_EXTREMUM_FNS = {"maximum", "minimum", "max", "min"}
+_ADD_METHOD_NAMES = {"add"}  # self.x.at[idx].add(v)
+
+
+@dataclass(frozen=True)
+class FlowFinding:
+    node: ast.AST
+    message: str
+
+
+def _state_reducers(class_node: ast.ClassDef) -> Dict[str, str]:
+    """name -> constant string reducer, for this class's own add_state calls."""
+    from .interp import _reducer_of  # shared reducer extraction
+
+    out: Dict[str, str] = {}
+    for node in ast.walk(class_node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr == "add_state"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):
+            reducer = _reducer_of(node)
+            if isinstance(reducer, str) and reducer in {"sum", "mean", "max", "min", "cat"}:
+                out[node.args[0].value] = reducer
+    return out
+
+
+def _mentions_self_attr(node: ast.AST, attr: str) -> bool:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and sub.attr == attr
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            return True
+    return False
+
+
+def _self_attr_writes(method: ast.FunctionDef) -> Iterator[Tuple[ast.stmt, str, str]]:
+    """(stmt, state name, kind) for writes to self.<attr>; kind is
+    "assign" or the AugAssign op class name."""
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    yield node, tgt.attr, "assign"
+        elif isinstance(node, ast.AugAssign):
+            tgt = node.target
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                yield node, tgt.attr, type(node.op).__name__
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt = node.target
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                yield node, tgt.attr, "assign"
+
+
+def _is_extremum_rhs(rhs: ast.AST, attr: str) -> bool:
+    """``jnp.maximum(self.attr, ...)``-shaped RHS (top-level call)."""
+    if not isinstance(rhs, ast.Call):
+        return False
+    name = rhs.func.attr if isinstance(rhs.func, ast.Attribute) else (
+        rhs.func.id if isinstance(rhs.func, ast.Name) else None
+    )
+    if name not in _EXTREMUM_FNS:
+        return False
+    return any(_mentions_self_attr(a, attr) for a in rhs.args)
+
+
+def _is_additive_rhs(rhs: ast.AST, attr: str) -> bool:
+    """Additive accumulation forms: ``self.x + e`` / ``e + self.x`` /
+    ``self.x - e`` (top-level BinOp) or ``self.x.at[...].add(...)``."""
+    if isinstance(rhs, ast.BinOp) and isinstance(rhs.op, _ADDITIVE_AUG_OPS):
+        return _mentions_self_attr(rhs.left, attr) or _mentions_self_attr(rhs.right, attr)
+    if (
+        isinstance(rhs, ast.Call)
+        and isinstance(rhs.func, ast.Attribute)
+        and rhs.func.attr in _ADD_METHOD_NAMES
+        and _mentions_self_attr(rhs.func.value, attr)
+    ):
+        return True
+    return False
+
+
+def _locals_reading_attr(method: ast.FunctionDef, attrs: Iterable[str]) -> Dict[str, Set[str]]:
+    """attr -> local names whose assigned value reads ``self.<attr>``
+    (transitively through other such locals) — the two-step accumulation
+    idiom ``new_total = self.total + x; self.total = new_total`` reads the
+    prior value even though the final write's RHS does not mention it."""
+    readers: Dict[str, Set[str]] = {attr: set() for attr in attrs}
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(method):
+            if not (isinstance(node, ast.Assign) and node.value is not None):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            for attr, locs in readers.items():
+                if _mentions_self_attr(node.value, attr) or any(
+                    isinstance(sub, ast.Name) and sub.id in locs
+                    for sub in ast.walk(node.value)
+                ):
+                    for name in names:
+                        if name not in locs:
+                            locs.add(name)
+                            changed = True
+    return readers
+
+
+def _check_update_writes(
+    method: ast.FunctionDef, reducers: Dict[str, str]
+) -> Iterator[FlowFinding]:
+    readers = _locals_reading_attr(method, reducers)
+    for stmt, attr, kind in _self_attr_writes(method):
+        reducer = reducers.get(attr)
+        if reducer is None:
+            continue
+        rhs = getattr(stmt, "value", None)
+
+        def rhs_reads_prior(expr: ast.AST) -> bool:
+            if _mentions_self_attr(expr, attr):
+                return True
+            return any(
+                isinstance(sub, ast.Name) and sub.id in readers[attr]
+                for sub in ast.walk(expr)
+            )
+
+        if reducer == "sum":
+            if kind == "assign":
+                if rhs is not None and _is_extremum_rhs(rhs, attr):
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"sum\"`-reduced state `{attr}` updated with an extremum "
+                        f"(`{_last_call_name(rhs)}`) in `{method.name}`; per-rank values stop "
+                        "being additive and the cross-rank sum double-counts — declare the "
+                        'state `dist_reduce_fx="max"/"min"` or accumulate additively',
+                    )
+                elif rhs is not None and not rhs_reads_prior(rhs):
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"sum\"`-reduced state `{attr}` overwritten in `{method.name}` "
+                        "without reading its prior value; the overwrite discards earlier "
+                        "batches on this rank — accumulate additively "
+                        f"(`self.{attr} = self.{attr} + delta`)",
+                    )
+            elif kind not in ("Add", "Sub"):
+                yield FlowFinding(
+                    stmt,
+                    f"`\"sum\"`-reduced state `{attr}` mutated with `{kind}` in "
+                    f"`{method.name}`; only additive accumulation keeps per-rank values "
+                    "summable across the mesh",
+                )
+        elif reducer in ("max", "min"):
+            additive = (kind in ("Add", "Sub")) or (
+                kind == "assign" and rhs is not None and _is_additive_rhs(rhs, attr)
+            )
+            if additive:
+                yield FlowFinding(
+                    stmt,
+                    f"`\"{reducer}\"`-reduced state `{attr}` accumulated additively in "
+                    f"`{method.name}`; an extremum-reduced leaf must be updated with "
+                    f"`jnp.{'maximum' if reducer == 'max' else 'minimum'}(self.{attr}, ...)` "
+                    "or its cross-rank reduction is meaningless",
+                )
+
+
+def _last_call_name(rhs: ast.AST) -> str:
+    if isinstance(rhs, ast.Call):
+        if isinstance(rhs.func, ast.Attribute):
+            return rhs.func.attr
+        if isinstance(rhs.func, ast.Name):
+            return rhs.func.id
+    return "?"
+
+
+def _calls_super_reset(method: ast.FunctionDef) -> bool:
+    for node in ast.walk(method):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "reset":
+            # super().reset() / Metric.reset(self): base-class reset restores
+            # every registered default. `child.reset()` on some OTHER object
+            # does NOT — it must not satisfy the restoration check.
+            if isinstance(func.value, ast.Call) and isinstance(func.value.func, ast.Name) and func.value.func.id == "super":
+                return True
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id != "self"
+                and any(isinstance(a, ast.Name) and a.id == "self" for a in node.args)
+            ):
+                return True
+    return False
+
+
+def _check_reset(
+    class_node: ast.ClassDef, reducers: Dict[str, str], all_states: Set[str]
+) -> Iterator[FlowFinding]:
+    reset = next(
+        (s for s in class_node.body if isinstance(s, ast.FunctionDef) and s.name == "reset"),
+        None,
+    )
+    if reset is None or _calls_super_reset(reset):
+        return
+    restored = {attr for _, attr, _ in _self_attr_writes(reset)}
+    missing = sorted(all_states - restored)
+    if missing:
+        yield FlowFinding(
+            reset,
+            f"`reset` override restores {sorted(restored & all_states)} but not "
+            f"{missing} and never calls `super().reset()`; unrestored state leaks "
+            "across epochs",
+        )
+
+
+def _check_live_leaves(
+    ctx: FileContext, class_node: ast.ClassDef, own_states: Set[str]
+) -> Iterator[FlowFinding]:
+    has_update = any(
+        isinstance(s, ast.FunctionDef) and s.name in ("_update", "update")
+        for s in class_node.body
+    )
+    if not has_update or not own_states:
+        return
+    # liveness is file-scoped: in-file subclasses and helpers may own the
+    # read/write side of a base-registered leaf. The add_state name argument
+    # itself does not count as a touch — it IS the registration.
+    registration_names: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_state"
+            and node.args
+        ):
+            registration_names.add(id(node.args[0]))
+    touched: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            touched.add(node.attr)
+        elif (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in registration_names
+        ):
+            # getattr(self, name) / dynamic state access by string literal
+            touched.add(node.value)
+    for name in sorted(own_states):
+        if name not in touched:
+            yield FlowFinding(
+                class_node,
+                f"state `{name}` is registered but never read or written anywhere in "
+                "this file; dead state still pays sync bytes every compute (typo'd "
+                "attribute?)",
+            )
+
+
+def analyze_class(ctx: FileContext, class_node: ast.ClassDef) -> List[FlowFinding]:
+    """All TL-FLOW findings for one class."""
+    reducers = _state_reducers(class_node)
+    findings: List[FlowFinding] = []
+    own_states: Set[str] = set()
+    for node in ast.walk(class_node):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_state"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            own_states.add(node.args[0].value)
+    for stmt in class_node.body:
+        if isinstance(stmt, ast.FunctionDef) and stmt.name in _UPDATE_METHODS:
+            findings.extend(_check_update_writes(stmt, reducers))
+    findings.extend(_check_reset(class_node, reducers, own_states))
+    findings.extend(_check_live_leaves(ctx, class_node, own_states))
+    return findings

@@ -283,7 +283,7 @@ class MetricCollection:
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
-    def compile_update(self, buckets=None, donate=None):
+    def compile_update(self, buckets=None, donate=None, use_manifest=None):
         """Compile the whole collection's update into ONE jitted XLA dispatch.
 
         Returns a :class:`metrics_tpu.core.fused.FusedUpdate` handle and
@@ -302,13 +302,20 @@ class MetricCollection:
         (donation is honored on TPU/GPU; donated state arrays must not be
         aliased by callers). See docs/fused_updates.md.
 
+        ``use_manifest`` — consult the committed tracelint fusibility
+        manifest (``scripts/fusibility_manifest.json``) to skip the
+        ``eval_shape`` probe for statically-proven-fusible members (default
+        on; ``METRICS_TPU_NO_MANIFEST=1`` disables globally, and
+        ``METRICS_TPU_VERIFY_MANIFEST=1`` cross-checks verdicts against the
+        probe). See docs/static_analysis.md for the verdict lattice.
+
         ``forward`` keeps the eager double-update semantics; ``clone()``
         drops the handle (compiled executables are not copyable) and the
         clone re-compiles on first use.
         """
         from metrics_tpu.core.fused import FusedUpdate
 
-        self._fused = FusedUpdate(self, buckets=buckets, donate=donate)
+        self._fused = FusedUpdate(self, buckets=buckets, donate=donate, use_manifest=use_manifest)
         return self._fused
 
     @property

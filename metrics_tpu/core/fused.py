@@ -33,6 +33,16 @@ function, so the whole collection's update is a single device dispatch:
   metrics (child registries), list ("cat") states, and metrics whose update
   fails a one-time trace probe run through the ordinary eager per-metric
   path in the same call, so the fused path composes with any collection.
+* **Manifest-seeded fusibility** — the tracelint abstract interpreter
+  (``metrics_tpu/analysis/interp.py``) proves fusibility at review time and
+  ``scripts/fusibility_manifest.json`` carries the verdicts; a metric whose
+  class is verdicted ``fusible`` skips the per-(metric, signature)
+  ``jax.eval_shape`` probe entirely, cutting first-batch setup cost. The
+  probe remains the authority for ``unknown``/absent classes, and
+  ``METRICS_TPU_VERIFY_MANIFEST=1`` runs it anyway as a cross-check
+  (warning on disagreement, trusting the probe). A manifest-seeded fused
+  build that still fails re-probes the seeded members and retries once, so
+  a stale manifest degrades to the eager path instead of crashing.
 
 The auto-registered ``_n_updates`` mean-merge counter is bumped INSIDE the
 kernel (once per batch, sentinel-preserving), eliminating the per-metric
@@ -40,6 +50,7 @@ kernel (once per batch, sentinel-preserving), eliminating the per-metric
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +58,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# stdlib-only import: the analysis package never pulls jax, so consulting
+# the static manifest costs one cached JSON read, not an import cascade
+from metrics_tpu.analysis.manifest import (
+    ENV_VERIFY_MANIFEST,
+    manifest_verdict as _manifest_verdict,
+)
+from metrics_tpu.analysis.interp import VERDICT_FUSIBLE as _FUSIBLE
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric, _coerce_foreign
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.utils.data import dim_zero_max, dim_zero_min, dim_zero_sum
@@ -62,6 +80,13 @@ FUSED_ENTRY = "MetricCollection.fused_update"
 #: ragged pipeline (or a per-batch static scalar) compiles per batch, and
 #: that must be loud even with telemetry off
 _CACHE_WARN_ENTRIES = 16
+
+
+def _env_flag(name: str) -> bool:
+    """Boolean env switch: '0'/'false'/'no'/'off'/'' all read as DISABLED,
+    so exporting METRICS_TPU_VERIFY_MANIFEST=0 opts out instead of silently
+    enabling verify mode (which would defeat the probe-skip fast path)."""
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false", "no", "off")
 
 
 def _supports_donation() -> bool:
@@ -120,14 +145,23 @@ class FusedUpdate:
         collection: Any,
         buckets: Optional[Sequence[int]] = None,
         donate: Optional[bool] = None,
+        use_manifest: Optional[bool] = None,
     ) -> None:
         self._collection = collection
         self._buckets: Tuple[int, ...] = tuple(sorted(int(b) for b in buckets)) if buckets else ()
         if any(b <= 0 for b in self._buckets):
             raise ValueError(f"bucket sizes must be positive, got {self._buckets}")
         self._donate = _supports_donation() if donate is None else bool(donate)
+        # static-manifest consultation default-on; METRICS_TPU_NO_MANIFEST
+        # (handled inside manifest.py) or use_manifest=False turn it off
+        self._use_manifest = True if use_manifest is None else bool(use_manifest)
         self._cache: Dict[Tuple, _CacheEntry] = {}
         self._fusible: Dict[Tuple, bool] = {}
+        #: (name, sig) keys whose fusibility came from the static manifest
+        #: WITHOUT a runtime probe — the retry safety net re-probes exactly
+        #: these if a fused build ever fails
+        self._manifest_seeded: set = set()
+        self.manifest_probe_skips = 0
         self._bucket_ok: Dict[Tuple[str, ...], bool] = {}
         self._bucket_warned = False
         self.n_compiles = 0
@@ -157,6 +191,15 @@ class FusedUpdate:
         cached = self._fusible.get(key)
         if cached is not None:
             return cached
+        verify = _env_flag(ENV_VERIFY_MANIFEST)
+        if self._use_manifest and not verify:
+            # manifest-seeded fast path: a class the abstract interpreter
+            # proved fusible skips the eval_shape probe for every signature
+            if _manifest_verdict(type(m)) == _FUSIBLE:
+                self._fusible[key] = True
+                self._manifest_seeded.add(key)
+                self.manifest_probe_skips += 1
+                return True
         # one-time trace probe: host-dependent updates (concrete value
         # checks, data-dependent shapes) surface here instead of crashing
         # the fused kernel build
@@ -166,6 +209,16 @@ class FusedUpdate:
             ok = True
         except Exception:
             ok = False
+        if verify and self._use_manifest:
+            static = _manifest_verdict(type(m))
+            if static == _FUSIBLE and not ok:
+                rank_zero_warn(
+                    f"fusibility manifest says `{type(m).__name__}` is fusible but the"
+                    " eval_shape probe disagrees for this signature; trusting the probe."
+                    " The committed manifest is stale — regenerate with"
+                    " `python scripts/tracelint.py --manifest`.",
+                    UserWarning,
+                )
         self._fusible[key] = ok
         return ok
 
@@ -239,7 +292,43 @@ class FusedUpdate:
 
         bucket = cache_hit = None
         if fused_names:
-            bucket, cache_hit = self._run_fused(fused_names, treedef, dyn, static, sig)
+            try:
+                bucket, cache_hit = self._run_fused(fused_names, treedef, dyn, static, sig)
+            except Exception:
+                if not any((n, sig) in self._manifest_seeded for n in fused_names):
+                    raise  # no static seed involved: a genuine bug, not a stale manifest
+                # stale-manifest safety net: the build trusted a static
+                # `fusible` verdict that the tracer just refuted. Stop
+                # trusting the manifest for this handle, re-probe every
+                # previously-seeded member, run the refuted ones eagerly,
+                # and retry the (now probe-verified) fused set once.
+                rank_zero_warn(
+                    "fused update build failed for a manifest-seeded metric set; "
+                    "the committed fusibility manifest is stale. Falling back to "
+                    "eval_shape probes for this collection — regenerate with "
+                    "`python scripts/tracelint.py --manifest`.",
+                    UserWarning,
+                )
+                self._use_manifest = False
+                for key in list(self._manifest_seeded):
+                    self._fusible.pop(key, None)
+                self._manifest_seeded.clear()
+                retry_set = {n for n in fused_names if self._is_fusible(n, args, kwargs, sig)}
+                demoted = [n for n in fused_names if n not in retry_set]
+                # demoted members take the ordinary eager fallback path,
+                # including group attribution, and are counted as fallbacks
+                for name in demoted:
+                    m = col._metrics[name]
+                    group = member_of.get(name, [name])
+                    if rec is not None and len(group) > 1:
+                        with rec.group_attribution(group):
+                            m.update(*args, **m._filter_kwargs(**kwargs))
+                    else:
+                        m.update(*args, **m._filter_kwargs(**kwargs))
+                fallback_names = fallback_names + demoted
+                fused_names = [n for n in fused_names if n in retry_set]
+                if fused_names:
+                    bucket, cache_hit = self._run_fused(fused_names, treedef, dyn, static, sig)
 
         if not col._groups_checked and col._enable_compute_groups:
             # first-call group discovery on the concrete post-update states

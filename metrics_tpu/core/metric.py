@@ -924,6 +924,28 @@ class Metric(ABC):
         return self
 
     # ------------------------------------------------------------------
+    # static analysis (tracelint v2 manifest; no reference analog)
+    # ------------------------------------------------------------------
+    @classmethod
+    def static_fusibility(cls) -> Optional[Dict[str, Any]]:
+        """This class's entry in the tracelint fusibility manifest, or None.
+
+        The manifest (``scripts/fusibility_manifest.json``, regenerated by
+        ``python scripts/tracelint.py --manifest``) carries the abstract
+        interpreter's verdict — ``fusible`` / ``unsafe`` (with a
+        machine-derived reason: ``cat-growth`` / ``host-sync`` /
+        ``data-dependent-shape``) / ``unknown`` — plus the abstract
+        shape/dtype/reduction of every registered state leaf.
+        ``FusedUpdate`` consults the same entry to skip its ``eval_shape``
+        probe for ``fusible`` classes; exposing it here lets users (and the
+        package gate test) ask a metric *why* it does or does not fuse.
+        Classes outside ``metrics_tpu`` (user subclasses) have no entry.
+        """
+        from metrics_tpu.analysis.manifest import lookup_class
+
+        return lookup_class(cls)
+
+    # ------------------------------------------------------------------
     # misc
     # ------------------------------------------------------------------
     def clone(self) -> "Metric":

@@ -55,12 +55,15 @@ def load_records(path: str) -> Dict[str, Dict[str, Any]]:
 
 #: extra per-record fields gated beyond value/cost_analysis — the fused
 #: MetricCollection bench (``collection_fused_update_throughput``) carries
-#: its speedup ratio and its compilation count in-line, and losing either
-#: (fused drops under eager, or bucketed shapes stop sharing a compile)
-#: is a regression even when raw wall throughput still passes
+#: its speedup ratio, its compilation count, and its manifest-seeded
+#: first-batch setup latency in-line; losing any of them (fused drops under
+#: eager, bucketed shapes stop sharing a compile, or the fusibility
+#: manifest stops pre-seeding the probes and cold starts regress) is a
+#: regression even when raw wall throughput still passes
 AUX_FIELDS: Dict[str, str] = {
     "fused_vs_eager": "higher",
     "bucketed_compiles": "lower",
+    "fused_first_batch_ms": "lower",
 }
 
 

@@ -12,6 +12,8 @@ run starts instantly and works on machines with no accelerator stack.
     python scripts/tracelint.py --baseline-update
     python scripts/tracelint.py --json path/to/file.py
     python scripts/tracelint.py --list-rules
+    python scripts/tracelint.py --manifest           # regenerate fusibility manifest
+    python scripts/tracelint.py --manifest --check   # CI freshness gate
 """
 import importlib.util
 import pathlib

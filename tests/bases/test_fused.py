@@ -7,6 +7,8 @@ cache must collapse bucketed shapes into one compilation; and the fused
 path must issue exactly ONE ``fused_update`` telemetry event (one
 dispatch) per batch.
 """
+import json
+
 import numpy as np
 import pytest
 
@@ -350,3 +352,116 @@ def test_sync_pytree_in_mesh_records_one_sync_event(recorder):
     # sum(x) + max(y): two (reduction, dtype) groups, two collective rounds
     assert syncs[0]["collective_rounds"] == 2
     assert syncs[0]["n_states"] == 2
+
+
+# ---------------------------------------------------------------------------
+# manifest-seeded fusibility (ISSUE 6): probe skip, parity, safety net
+# ---------------------------------------------------------------------------
+
+class TestManifestSeeding:
+    def _batches(self, n=3):
+        rng = np.random.RandomState(11)
+        return [_cls_batch(rng, 64) for _ in range(n)]
+
+    def test_parity_with_and_without_manifest(self):
+        """Fused results must be identical whether fusibility came from the
+        static manifest or the runtime eval_shape probe — and the manifest
+        handle must actually skip probes for fusible-verdict members."""
+        batches = self._batches()
+        seeded, probed = _cls_collection(), _cls_collection()
+        seeded.update(*batches[0])
+        probed.update(*batches[0])
+        h_seeded = seeded.compile_update(use_manifest=True)
+        h_probed = probed.compile_update(use_manifest=False)
+        for b in batches:
+            seeded.update(*b)
+            probed.update(*b)
+        assert h_seeded.manifest_probe_skips >= 1  # ConfusionMatrix is fusible-verdict
+        assert h_probed.manifest_probe_skips == 0
+        _assert_parity(probed, seeded)
+
+    def test_manifest_vs_eager_parity(self):
+        batches = self._batches()
+        eager, fused = _cls_collection(), _cls_collection()
+        eager.update(*batches[0])
+        fused.update(*batches[0])
+        fused.compile_update(use_manifest=True)
+        for b in batches:
+            eager.update(*b)
+            fused.update(*b)
+        _assert_parity(eager, fused)
+
+    def test_stale_manifest_falls_back_instead_of_crashing(self, tmp_path, monkeypatch):
+        """A manifest wrongly claiming a host-sync metric fusible must not
+        crash the fused path: the build failure is caught, the seeded
+        members re-probe, and the refuted metric runs eagerly — with a
+        warning naming the stale manifest."""
+        import metrics_tpu.analysis.manifest as manifest_mod
+        from metrics_tpu.analysis.manifest import class_key
+
+        class HostSyncMetric(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def _update(self, preds, target):
+                self.total = self.total + float(np.asarray(preds).sum())
+
+            def _compute(self):
+                return self.total
+
+        # forge a manifest entry for a REAL package class that fails the
+        # probe at runtime: monkeypatch its key onto the local class
+        fake_key = "classification/fixture.py::HostSyncMetric"
+        # plain assignment: the class is test-local, nothing to restore
+        HostSyncMetric.__module__ = "metrics_tpu.classification.fixture"
+        HostSyncMetric.__qualname__ = "HostSyncMetric"
+        assert class_key(HostSyncMetric) == fake_key
+
+        stale = {
+            "version": 1,
+            "tool": "tracelint",
+            "metrics": {
+                fake_key: {
+                    "verdict": "fusible",
+                    "reason": None,
+                    "detail": None,
+                    "declared_jit_unsafe": None,
+                    "states": {},
+                }
+            },
+        }
+        path = tmp_path / "stale_manifest.json"
+        path.write_text(json.dumps(stale))
+        monkeypatch.setenv("METRICS_TPU_MANIFEST", str(path))
+        manifest_mod.invalidate_runtime_cache()
+        try:
+            rng = np.random.RandomState(5)
+            batches = [_cls_batch(rng, 32) for _ in range(2)]
+            col = MetricCollection({"host": HostSyncMetric(), "cm": ConfusionMatrix(num_classes=3)})
+            ref = MetricCollection({"host": HostSyncMetric(), "cm": ConfusionMatrix(num_classes=3)})
+            col.update(*batches[0])
+            ref.update(*batches[0])
+            handle = col.compile_update(use_manifest=True)
+            with pytest.warns(UserWarning, match="stale"):
+                for b in batches:
+                    col.update(*b)
+            for b in batches:
+                ref.update(*b)
+            _assert_parity(ref, col)
+            # the handle stopped trusting the manifest after the failure
+            assert handle._use_manifest is False
+        finally:
+            manifest_mod.invalidate_runtime_cache()
+
+    def test_verify_mode_probes_anyway(self, monkeypatch):
+        """METRICS_TPU_VERIFY_MANIFEST=1: the probe runs even for
+        fusible-verdict classes (cross-check mode), so no skips happen."""
+        monkeypatch.setenv("METRICS_TPU_VERIFY_MANIFEST", "1")
+        batches = self._batches(2)
+        col = _cls_collection()
+        col.update(*batches[0])
+        handle = col.compile_update(use_manifest=True)
+        for b in batches:
+            col.update(*b)
+        assert handle.manifest_probe_skips == 0

@@ -1,0 +1,311 @@
+"""Fusibility manifest (tracelint v2 tentpole): freshness, the package-wide
+declaration gate, and static-verdict vs runtime-probe agreement.
+
+The committed ``scripts/fusibility_manifest.json`` is a build artifact of
+``python scripts/tracelint.py --manifest`` that the fused update path
+consults at runtime, so three invariants are tier-1:
+
+* the committed file matches a fresh full-package analysis (staleness);
+* for every ``Metric`` subclass, the static verdict agrees with the
+  declared ``__jit_unsafe__`` — genuinely-dynamic classes are allowlisted
+  HERE, each with its machine-derived reason asserted, so the jit-unsafe
+  set can only shrink deliberately;
+* ``fusible`` verdicts agree with the runtime ``jax.eval_shape`` probe for
+  real input signatures (the verdict the fused path trusts INSTEAD of
+  probing).
+"""
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu  # noqa: F401  (imports every metric module: subclass walk)
+from metrics_tpu.analysis import build_manifest, load_manifest, render_manifest
+from metrics_tpu.analysis.manifest import DEFAULT_MANIFEST, class_key, lookup_class
+from metrics_tpu.core.fused import _pure_update, _state_pytree
+from metrics_tpu.core.metric import Metric
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+MANIFEST_PATH = REPO_ROOT / DEFAULT_MANIFEST
+
+
+@pytest.fixture(scope="module")
+def committed():
+    data = load_manifest(MANIFEST_PATH)
+    assert data is not None, f"missing/invalid committed manifest at {MANIFEST_PATH}"
+    return data
+
+
+def _all_metric_subclasses():
+    seen = set()
+
+    def walk(cls):
+        for sub in cls.__subclasses__():
+            if sub not in seen:
+                seen.add(sub)
+                walk(sub)
+
+    walk(Metric)
+    return sorted(
+        (c for c in seen if (c.__module__ or "").startswith("metrics_tpu.")),
+        key=lambda c: (c.__module__, c.__qualname__),
+    )
+
+
+# ---------------------------------------------------------------------------
+# freshness
+# ---------------------------------------------------------------------------
+
+class TestFreshness:
+    def test_committed_manifest_is_fresh(self, committed):
+        """Byte-for-byte: the committed manifest equals a fresh analysis
+        (exactly what CI's `tracelint --manifest --check` enforces)."""
+        assert render_manifest(build_manifest()) == render_manifest(committed)
+
+    def test_manifest_covers_every_runtime_metric_class(self, committed):
+        metrics = committed["metrics"]
+        missing = [
+            class_key(cls)
+            for cls in _all_metric_subclasses()
+            if class_key(cls) is not None and class_key(cls) not in metrics
+        ]
+        assert missing == [], f"metric classes absent from the manifest: {missing}"
+
+    def test_schema_fields(self, committed):
+        for key, entry in committed["metrics"].items():
+            assert entry["verdict"] in ("fusible", "unsafe", "unknown"), key
+            if entry["verdict"] == "unsafe":
+                assert entry["reason"] in ("cat-growth", "host-sync", "data-dependent-shape"), key
+            else:
+                assert entry["reason"] is None, key
+            assert isinstance(entry["states"], dict), key
+            for state in entry["states"].values():
+                assert state["container"] in ("array", "list", "unknown"), key
+
+
+# ---------------------------------------------------------------------------
+# package-wide declaration gate
+# ---------------------------------------------------------------------------
+
+#: every class explicitly declared ``__jit_unsafe__ = True`` must appear
+#: here with the abstract interpreter's machine-derived classification —
+#: (verdict, reason). Shrinking this list (ROADMAP item 2: sketch-backed
+#: states) is progress; ADDING to it is a reviewed decision.
+GENUINELY_DYNAMIC = {
+    # unbounded cat-state accumulation
+    "AUC": ("unsafe", "cat-growth"),
+    "AUROC": ("unsafe", "cat-growth"),
+    "AveragePrecision": ("unsafe", "cat-growth"),
+    "PrecisionRecallCurve": ("unsafe", "cat-growth"),
+    "ROC": ("unsafe", "cat-growth"),
+    "MeanAveragePrecision": ("unsafe", "cat-growth"),
+    "FrechetInceptionDistance": ("unsafe", "cat-growth"),
+    "InceptionScore": ("unsafe", "cat-growth"),
+    "KernelInceptionDistance": ("unsafe", "cat-growth"),
+    "RetrievalMetric": ("unsafe", "cat-growth"),
+    "BERTScore": ("unsafe", "cat-growth"),
+    "CHRFScore": ("unsafe", "cat-growth"),
+    "ExtendedEditDistance": ("unsafe", "cat-growth"),
+    "TranslationEditRate": ("unsafe", "cat-growth"),
+    # host-side processing (strings / DSP / torch encoders)
+    "PerceptualEvaluationSpeechQuality": ("unsafe", "host-sync"),
+    "ShortTimeObjectiveIntelligibility": ("unsafe", "host-sync"),
+    "LearnedPerceptualImagePatchSimilarity": ("unsafe", "host-sync"),
+    "BLEUScore": ("unsafe", "host-sync"),
+    "CharErrorRate": ("unsafe", "host-sync"),
+    "MatchErrorRate": ("unsafe", "host-sync"),
+    "ROUGEScore": ("unsafe", "host-sync"),
+    "SacreBLEUScore": ("unsafe", "host-sync"),
+    "WordErrorRate": ("unsafe", "host-sync"),
+    "WordInfoLost": ("unsafe", "host-sync"),
+    "WordInfoPreserved": ("unsafe", "host-sync"),
+    # beyond the lattice: child registries / dict inputs (probe decides)
+    "SQuAD": ("unknown", None),
+    "BootStrapper": ("unknown", None),
+    "ClasswiseWrapper": ("unknown", None),
+    "MinMaxMetric": ("unknown", None),
+    "MultioutputWrapper": ("unknown", None),
+}
+
+#: UNDECLARED classes the interpreter still proves unsafe for a non-cat
+#: reason: the runtime probe already excludes them from fusion (inherited
+#: ``__jit_unsafe__ = False`` is not an explicit claim), but drift here
+#: should be a conscious decision
+UNDECLARED_UNSAFE = {
+    "PermutationInvariantTraining": ("unsafe", "host-sync"),
+}
+
+
+class TestDeclarationGate:
+    def test_every_declared_true_is_allowlisted_with_reason(self, committed):
+        metrics = committed["metrics"]
+        for cls in _all_metric_subclasses():
+            key = class_key(cls)
+            entry = metrics.get(key) if key else None
+            if entry is None or "__jit_unsafe__" not in cls.__dict__:
+                continue
+            if not cls.__dict__["__jit_unsafe__"]:
+                continue
+            expected = GENUINELY_DYNAMIC.get(cls.__qualname__)
+            assert expected is not None, (
+                f"{key} declares __jit_unsafe__=True but is not in the "
+                "GENUINELY_DYNAMIC allowlist; add it WITH its machine-derived reason"
+            )
+            verdict, reason = expected
+            assert entry["verdict"] == verdict, (key, entry["verdict"], verdict)
+            assert entry["reason"] == reason, (key, entry["reason"], reason)
+
+    def test_declared_true_never_statically_fusible(self, committed):
+        """The TL-DECL invariant at package scope: a True declaration with a
+        fusible verdict is a stale declaration."""
+        stale = [
+            key
+            for key, entry in committed["metrics"].items()
+            if entry["declared_jit_unsafe"] is True and entry["verdict"] == "fusible"
+        ]
+        assert stale == [], f"stale __jit_unsafe__=True declarations: {stale}"
+
+    def test_declared_false_never_host_or_shape_unsafe(self, committed):
+        """The reverse TL-DECL invariant: an explicit False with a host-sync
+        or data-dependent-shape verdict would crash the fused build.
+        (cat-growth does NOT contradict False: list states are excluded
+        from fusion by a separate runtime check, not the declaration.)"""
+        contradicted = [
+            key
+            for key, entry in committed["metrics"].items()
+            if entry["declared_jit_unsafe"] is False
+            and entry["verdict"] == "unsafe"
+            and entry["reason"] in ("host-sync", "data-dependent-shape")
+        ]
+        assert contradicted == [], f"contradicted __jit_unsafe__=False declarations: {contradicted}"
+
+    def test_undeclared_unsafe_set_is_pinned(self, committed):
+        found = {
+            key.split("::")[1]: (entry["verdict"], entry["reason"])
+            for key, entry in committed["metrics"].items()
+            if entry["declared_jit_unsafe"] is None
+            and entry["verdict"] == "unsafe"
+            and entry["reason"] in ("host-sync", "data-dependent-shape")
+        }
+        assert found == UNDECLARED_UNSAFE
+
+    def test_static_fusibility_classmethod(self):
+        from metrics_tpu.classification import ConfusionMatrix
+        from metrics_tpu.regression import MeanSquaredError
+
+        entry = ConfusionMatrix.static_fusibility()
+        assert entry is not None and entry["verdict"] == "fusible"
+        assert entry["states"]["confmat"]["dist_reduce_fx"] == "sum"
+        assert MeanSquaredError.static_fusibility()["verdict"] == "fusible"
+
+        class Local(MeanSquaredError):  # outside the package: no entry
+            pass
+
+        assert Local.static_fusibility() is None
+
+
+# ---------------------------------------------------------------------------
+# static verdict vs runtime eval_shape probe
+# ---------------------------------------------------------------------------
+
+def _probe_ok(metric, args):
+    try:
+        jax.eval_shape(
+            lambda s, a: _pure_update(metric, s, a, {}), _state_pytree(metric), args
+        )
+        return True
+    except Exception:
+        return False
+
+
+class TestProbeAgreement:
+    def _cases(self):
+        from metrics_tpu.classification import (
+            Accuracy,
+            CohenKappa,
+            ConfusionMatrix,
+            F1Score,
+            Precision,
+            Recall,
+        )
+        from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+
+        rng = np.random.RandomState(3)
+        n, c = 32, 5
+        probs = rng.rand(n, c).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        cls_args = (jnp.asarray(probs), jnp.asarray(rng.randint(0, c, n)))
+        reg_args = (
+            jnp.asarray(rng.rand(n).astype(np.float32)),
+            jnp.asarray(rng.rand(n).astype(np.float32)),
+        )
+        yield Accuracy(), cls_args
+        yield Precision(num_classes=c, average="macro"), cls_args
+        yield Recall(num_classes=c, average="macro"), cls_args
+        yield F1Score(num_classes=c, average="macro"), cls_args
+        yield ConfusionMatrix(num_classes=c), cls_args
+        yield CohenKappa(num_classes=c), cls_args
+        yield MeanSquaredError(), reg_args
+        yield MeanAbsoluteError(), reg_args
+
+    def test_fusible_verdicts_agree_with_probe(self):
+        """Every currently-fused collection member: a `fusible` verdict must
+        imply a passing probe (the verdict REPLACES the probe at runtime),
+        and a failing probe must never carry a `fusible` verdict."""
+        checked = 0
+        fusible_seen = 0
+        for metric, args in self._cases():
+            entry = lookup_class(type(metric))
+            assert entry is not None, type(metric).__qualname__
+            ok = _probe_ok(metric, args)
+            if entry["verdict"] == "fusible":
+                fusible_seen += 1
+                assert ok, f"{type(metric).__qualname__}: fusible verdict but probe fails"
+            if not ok:
+                assert entry["verdict"] != "fusible", type(metric).__qualname__
+            checked += 1
+        assert checked == 8
+        # the skip-probe win must actually exist in a standard collection
+        assert fusible_seen >= 2
+
+    def test_every_fusible_class_instantiable_probe_agrees(self, committed):
+        """All fusible-verdict classes with argument-free (or num_classes)
+        constructors: instantiate and probe with family-typical inputs."""
+        import importlib
+
+        rng = np.random.RandomState(0)
+        ctor = {
+            "ConfusionMatrix": dict(num_classes=4),
+            "CohenKappa": dict(num_classes=4),
+            "JaccardIndex": dict(num_classes=4),
+            "MatthewsCorrCoef": dict(num_classes=4),
+        }
+        reg = (
+            jnp.asarray(rng.rand(16).astype(np.float32)),
+            jnp.asarray(rng.rand(16).astype(np.float32)),
+        )
+        labels = (jnp.asarray(rng.randint(0, 4, 16)), jnp.asarray(rng.randint(0, 4, 16)))
+        hinge = (jnp.asarray(rng.randn(16).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 16)))
+        audio = (
+            jnp.asarray(rng.randn(2, 400).astype(np.float32)),
+            jnp.asarray(rng.randn(2, 400).astype(np.float32)),
+        )
+        for key, entry in committed["metrics"].items():
+            if entry["verdict"] != "fusible":
+                continue
+            rel, cls_name = key.split("::")
+            module = importlib.import_module("metrics_tpu." + rel[:-3].replace("/", "."))
+            cls = getattr(module, cls_name)
+            metric = cls(**ctor.get(cls_name, {}))
+            if rel.startswith("audio/"):
+                args = audio
+            elif rel.startswith("regression/"):
+                args = reg
+            elif cls_name == "HingeLoss":
+                args = hinge
+            else:
+                args = labels
+            assert _probe_ok(metric, args), f"{key}: fusible verdict but probe fails"

@@ -700,6 +700,8 @@ class TestPackageGate:
             "TL-STATE",
             "TL-COLLECTIVE",
             "TL-PRINT",
+            "TL-DECL",
+            "TL-FLOW",
         }
 
     def test_cli_script_exits_zero_on_package(self):
@@ -709,3 +711,685 @@ class TestPackageGate:
             text=True,
         )
         assert result.returncode == 0, result.stdout + result.stderr
+
+
+# ---------------------------------------------------------------------------
+# engine satellites: alias rebindings, direct member imports, file pragmas
+# ---------------------------------------------------------------------------
+
+class TestAliasRebinding:
+    def test_jnp_rebinding_tracks_taint(self):
+        """`np = jnp` makes np.* calls traced producers for TL-TRACE."""
+        kept, _ = _check(
+            """
+np2 = jnp
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        x = np2.cumsum(preds)
+        if x[-1] > 0:
+            preds = preds / x[-1]
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_rebound_numpy_asarray_not_flagged_as_host(self):
+        """`np = jnp` must NOT flag np.asarray as a host pull."""
+        kept, _ = _check(
+            """
+import numpy
+np3 = jnp
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + np3.asarray(preds).sum()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+    def test_direct_jnp_member_import_tracks_taint(self):
+        kept, _ = _check(
+            """
+from jax.numpy import concatenate
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        both = concatenate([preds, preds])
+        if both[0] > 0:
+            preds = preds * 2
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_direct_numpy_member_import_flags_host_pull(self):
+        kept, _ = _check(
+            """
+from numpy import asarray
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        host = asarray(preds)
+        self.total = self.total + host.sum()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_lax_rebinding_collective_flags(self):
+        kept, _ = _check(
+            """
+mylax = jax.lax
+def my_sync(x):
+    return mylax.psum(x, "rank")
+"""
+        )
+        assert "TL-COLLECTIVE" in _rules_of(kept)
+
+    def test_unrebound_name_still_clean(self):
+        kept, _ = _check(
+            """
+import numpy
+def helper(meta):
+    return numpy.prod(meta)
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+
+class TestFilePragma:
+    def test_docstring_pragma_suppresses_rule_file_wide(self):
+        kept, suppressed = analyze_source(
+            '"""Fixture module.\n\n# tracelint: disable-file=TL-PRINT — CLI surface\n"""\n'
+            "def f():\n    print('a')\n    print('b')\n",
+            "classification/fixture.py",
+        )
+        assert "TL-PRINT" not in _rules_of(kept)
+
+    def test_leading_comment_pragma_counts(self):
+        kept, _ = analyze_source(
+            "# tracelint: disable-file=TL-PRINT\nimport sys\n\ndef f():\n    print('a')\n",
+            "classification/fixture.py",
+        )
+        assert "TL-PRINT" not in _rules_of(kept)
+
+    def test_disable_file_all(self):
+        kept, _ = analyze_source(
+            '"""Doc.\n\n# tracelint: disable-file=all\n"""\nimport jax\n\n'
+            "def f(x):\n    print(jax.lax.psum(x, 'r'))\n",
+            "classification/fixture.py",
+        )
+        assert kept == []
+
+    def test_pragma_after_docstring_region_ignored(self):
+        """A disable-file pragma buried mid-module must NOT waive the rule."""
+        kept, _ = analyze_source(
+            '"""Doc."""\n\ndef f():\n    # tracelint: disable-file=TL-PRINT\n    print("a")\n',
+            "classification/fixture.py",
+        )
+        assert "TL-PRINT" in _rules_of(kept)
+
+    def test_other_rules_unaffected(self):
+        kept, _ = analyze_source(
+            '"""Doc.\n\n# tracelint: disable-file=TL-COLLECTIVE\n"""\n'
+            "def f():\n    print('a')\n",
+            "classification/fixture.py",
+        )
+        assert "TL-PRINT" in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-DECL: declarations vs the abstract interpreter's verdict
+# ---------------------------------------------------------------------------
+
+class TestDeclRule:
+    def test_stale_true_declaration_flags(self):
+        """Seeded mutant (acceptance): declared True, statically fusible."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = True
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-DECL" in _rules_of(kept)
+
+    def test_contradicted_false_declaration_flags(self):
+        """Seeded mutant (acceptance, reverse direction): declared False,
+        host-sync in the update."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = False
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + float(jnp.sum(preds))
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-DECL" in _rules_of(kept)
+
+    def test_false_with_data_dependent_shape_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = False
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        kept_vals = preds[preds > 0]
+        self.total = self.total + jnp.sum(kept_vals)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-DECL" in _rules_of(kept)
+
+    def test_true_with_genuine_host_sync_passes(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = True
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + float(np.asarray(preds).sum())
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-DECL" not in _rules_of(kept)
+
+    def test_false_with_cat_state_passes(self):
+        """cat-growth never contradicts False: list states are excluded
+        from fusion by the runtime list check, not the declaration."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    __jit_unsafe__ = False
+    def __init__(self):
+        super().__init__()
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+    def _update(self, preds):
+        self.preds.append(preds)
+    def _compute(self):
+        return jnp.concatenate(self.preds)
+"""
+        )
+        assert "TL-DECL" not in _rules_of(kept)
+
+    def test_unknown_verdict_never_fires(self):
+        """An unresolved helper call blocks the fusible verdict, so a True
+        declaration cannot be proven stale."""
+        kept, _ = _check(
+            """
+from somewhere_external import mystery_kernel
+class M(Metric):
+    __jit_unsafe__ = True
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + mystery_kernel(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-DECL" not in _rules_of(kept)
+
+    def test_undeclared_never_fires(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-DECL" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-FLOW: state-lifecycle dataflow
+# ---------------------------------------------------------------------------
+
+class TestFlowRule:
+    def test_sum_state_overwrite_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_sum_state_extremum_update_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = jnp.maximum(self.total, jnp.max(preds))
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_sum_state_imul_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(1.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total *= jnp.prod(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_max_state_additive_update_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("peak", default=jnp.asarray(0.0), dist_reduce_fx="max")
+    def _update(self, preds):
+        self.peak = self.peak + jnp.max(preds)
+    def _compute(self):
+        return self.peak
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_reset_override_missing_leaf_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("a", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("b", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.a = self.a + jnp.sum(preds)
+        self.b = self.b + jnp.max(preds)
+    def reset(self):
+        self.a = jnp.asarray(0.0)
+    def _compute(self):
+        return self.a / self.b
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_dead_leaf_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("ghost", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_clean_lifecycle_passes(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("peak", default=jnp.asarray(0.0), dist_reduce_fx="max")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+        self.peak = jnp.maximum(self.peak, jnp.max(preds))
+    def reset(self):
+        super().reset()
+    def _compute(self):
+        return self.total / self.peak
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_where_guarded_sum_write_passes(self):
+        """RHS mentioning the leaf (jnp.where blend) is accumulation the
+        rule cannot refute — no finding."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds, mask):
+        self.total = jnp.where(jnp.any(mask), self.total + jnp.sum(preds), self.total)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_conditional_reducer_skipped(self):
+        """StatScores idiom: a variable reducer has no checkable contract."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self, samplewise):
+        super().__init__()
+        fx = "cat" if samplewise else "sum"
+        self.add_state("tp", default=jnp.zeros(3), dist_reduce_fx=fx)
+    def _update(self, preds):
+        self.tp = jnp.sum(preds)
+    def _compute(self):
+        return self.tp
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# the abstract interpreter's verdicts (interp.py) — fixture-level checks
+# ---------------------------------------------------------------------------
+
+class TestInterpVerdicts:
+    def _verdict(self, source, relpath="classification/fixture.py"):
+        import ast as _ast
+
+        from metrics_tpu.analysis.engine import FileContext
+        from metrics_tpu.analysis.interp import Project, classify
+
+        ctx = FileContext(None, relpath, _METRIC_PREAMBLE + source)
+        project = Project()
+        node = next(n for n in ctx.tree.body if isinstance(n, _ast.ClassDef))
+        verdict, _ = classify(project, ctx, node)
+        return verdict
+
+    def test_pure_additive_update_is_fusible(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert v.status == "fusible"
+
+    def test_list_state_is_cat_growth(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    __jit_unsafe__ = True
+    def __init__(self):
+        super().__init__()
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+    def _update(self, preds):
+        self.preds.append(preds)
+    def _compute(self):
+        return jnp.concatenate(self.preds)
+"""
+        )
+        assert (v.status, v.reason) == ("unsafe", "cat-growth")
+
+    def test_item_call_is_host_sync(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds).item()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert (v.status, v.reason) == ("unsafe", "host-sync")
+
+    def test_jnp_unique_is_data_dependent_shape(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        classes = jnp.unique(preds)
+        self.total = self.total + classes.shape[0]
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert (v.status, v.reason) == ("unsafe", "data-dependent-shape")
+
+    def test_string_annotation_is_host_sync(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds: str):
+        self.total = self.total + len(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert (v.status, v.reason) == ("unsafe", "host-sync")
+
+    def test_unresolved_call_is_unknown(self):
+        v = self._verdict(
+            """
+from nowhere import helper
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + helper(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert v.status == "unknown"
+
+    def test_cross_file_functional_resolution(self):
+        """The real interprocedural case: an update calling into
+        metrics_tpu/functional/ resolves and stays fusible."""
+        v = self._verdict(
+            """
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+class M(Metric):
+    def __init__(self, num_classes: int):
+        super().__init__()
+        self.num_classes = num_classes
+        self.add_state("confmat", default=jnp.zeros((3, 3), dtype=jnp.int32), dist_reduce_fx="sum")
+    def _update(self, preds, target):
+        self.confmat = self.confmat + _confusion_matrix_update(preds, target, self.num_classes)
+    def _compute(self):
+        return self.confmat
+"""
+        )
+        assert v.status == "fusible"
+
+    def test_concrete_guard_exempts(self):
+        v = self._verdict(
+            """
+from metrics_tpu.utils.checks import _is_concrete
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        if _is_concrete(preds):
+            if float(jnp.max(preds)) > 1e6:
+                raise ValueError("suspicious magnitude")
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert v.status == "fusible"
+
+    def test_state_shape_symbols_recorded(self):
+        import ast as _ast
+
+        from metrics_tpu.analysis.engine import FileContext
+        from metrics_tpu.analysis.interp import Project, classify
+
+        ctx = FileContext(
+            None,
+            "classification/fixture.py",
+            _METRIC_PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self, num_classes: int):
+        super().__init__()
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.confmat = self.confmat + preds
+    def _compute(self):
+        return self.confmat
+""",
+        )
+        node = next(n for n in ctx.tree.body if isinstance(n, _ast.ClassDef))
+        _, facts = classify(Project(), ctx, node)
+        entry = next(e for e in facts.entries if e.name == "confmat")
+        assert entry.container == "array"
+        assert entry.shape == ["num_classes", "num_classes"]
+        assert entry.dtype == "int32"
+        assert entry.dist_reduce_fx == "sum"
+
+
+# ---------------------------------------------------------------------------
+# review fixes: scope-sensitivity, two-step accumulation, child resets
+# ---------------------------------------------------------------------------
+
+class TestReviewRegressions:
+    def test_function_local_rebind_does_not_exempt_module_numpy(self):
+        """A local `np = jnp` shadow inside one helper must not re-alias
+        np file-wide and suppress host-pull detection elsewhere."""
+        kept, _ = _check(
+            """
+def unrelated_helper(x):
+    np = jnp  # local shadow
+    return np.sum(x)
+
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        host = np.asarray(preds)
+        self.total = self.total + host.sum()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" in _rules_of(kept)
+
+    def test_two_step_additive_accumulation_passes(self):
+        """`new = self.total + x; self.total = new` reads the prior value —
+        not an overwrite."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        new_total = self.total + jnp.sum(preds)
+        self.total = new_total
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_child_only_reset_still_flags_missing_leaves(self):
+        """`child.reset()` is not `super().reset()`: own leaves must still
+        be restored."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self, child):
+        super().__init__()
+        self.child = child
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def reset(self):
+        self.child.reset()
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_base_class_reset_with_self_counts(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def reset(self):
+        Metric.reset(self)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
