@@ -830,6 +830,181 @@ def bench_fused() -> None:
     )
 
 
+def bench_async() -> None:
+    """Async vs blocking fused ingest under a producer/consumer serving loop
+    (ISSUE 7 tentpole).
+
+    The same 6-metric classification collection as ``bench_fused``, updated
+    at a fixed batch shape. Each serving-loop step first *handles a
+    request* — modeled as an I/O-bound wait calibrated to ~1x the blocking
+    fused update's wall cost, because a real serving loop spends the gap
+    between metric updates blocked on the next request batch / model
+    forward, not burning host CPU (a CPU-bound gap on the 2-vCPU CI box
+    would measure core contention, not pipeline design) — then accounts
+    the batch:
+
+    * **blocking** — ``compile_update()``; the step pays request-wait +
+      the fused update's host dispatch serially.
+    * **async** — ``compile_update_async(queue_depth=2)``; the step pays
+      request-wait + a microseconds ``update_async`` enqueue, and the
+      worker thread overlaps the fused dispatch (and any eager fallbacks)
+      with the next request's wait.
+
+    Emits ``async_vs_blocking`` (steady-state throughput ratio, each side's
+    best of 5 alternating epochs; the acceptance floor is 1.3x) and the p99
+    ``update_async`` call latency within that best epoch —
+    both gated as AUX_FIELDS by scripts/check_cost_regression.py — plus a
+    ``states_bit_identical`` parity bit: both sides consume the identical
+    batch sequence and must land byte-equal final states.
+    """
+    import jax
+    import jax.numpy as jnp
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.classification import (
+        Accuracy,
+        CohenKappa,
+        ConfusionMatrix,
+        F1Score,
+        Precision,
+        Recall,
+    )
+
+    rng = np.random.RandomState(7)
+    n_classes = 10
+    n = 2048
+    steps = 100
+
+    def make_batch():
+        p = rng.rand(n, n_classes).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        return jnp.asarray(p), jnp.asarray(rng.randint(0, n_classes, n))
+
+    def make_collection():
+        return MetricCollection(
+            [
+                Accuracy(),
+                Precision(num_classes=n_classes, average="macro"),
+                Recall(num_classes=n_classes, average="macro"),
+                F1Score(num_classes=n_classes, average="macro"),
+                ConfusionMatrix(num_classes=n_classes),
+                CohenKappa(num_classes=n_classes),
+            ]
+        )
+
+    def block(col):
+        jax.block_until_ready(
+            [
+                getattr(m, s)
+                for m in col.values()
+                for s in m._defaults
+                if not isinstance(getattr(m, s), (list, int))
+            ]
+        )
+
+    pool = [make_batch() for _ in range(8)]
+    warmup = pool[:4]
+    epoch = [pool[i % len(pool)] for i in range(steps)]
+
+    # --- blocking side: discovery, compile, warmup, calibrate update cost ---
+    blocking = make_collection()
+    blocking.update(*pool[0])
+    blocking.compile_update()
+    for b in warmup:
+        blocking.update(*b)
+    block(blocking)
+    # calibrate with the min over 3 groups: the wait models the request gap
+    # and sets the overlap regime, so a single GC pause or scheduler stall
+    # in the calibration pass must not inflate it (an overshot wait dilutes
+    # the measurable overlap toward 1x regardless of pipeline quality)
+    per_group = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for b in warmup:
+            blocking.update(*b)
+        block(blocking)
+        per_group.append((time.perf_counter() - t0) / len(warmup))
+    update_ms = min(per_group) * 1e3
+
+    # per-step request handling, calibrated to ~1x the update cost — the
+    # overlap-matters-most regime (request rate ~= metric accounting rate)
+    work_s = update_ms / 1e3
+
+    def produce():
+        time.sleep(work_s)
+
+    # --- async side: identical batch sequence, ingest via the queue ---
+    asynchronous = make_collection()
+    asynchronous.update(*pool[0])
+    handle = asynchronous.compile_update_async(queue_depth=2)
+    for b in warmup * 4:  # mirror the blocking side's warmup + calibration
+        handle.update_async(*b)
+    handle.flush()
+    block(asynchronous)
+
+    # --- timed epochs: best-of-5 per side, alternating so clock drift and
+    # background load hit both sides alike; each side's best epoch is its
+    # steady-state throughput (standard min-of-N wall-time practice — on
+    # shared-infra vCPUs single epochs swing tens of percent) ---
+    latencies = []  # enqueue latencies of the BEST async epoch: p99 must
+    # characterize the pipeline's steady state, not whichever epochs a
+    # noisy-neighbor stall happened to hit (a starved scheduler inflates
+    # the pooled tail by 10-100x with zero code change)
+    blocking_ups = 0.0
+    async_ups = 0.0
+    for _rep in range(5):
+        t0 = time.perf_counter()
+        for b in epoch:
+            produce()
+            blocking.update(*b)
+        block(blocking)
+        blocking_ups = max(blocking_ups, steps / (time.perf_counter() - t0))
+
+        lat_rep = []
+        t0 = time.perf_counter()
+        for b in epoch:
+            produce()
+            t_call = time.perf_counter()
+            handle.update_async(*b)
+            lat_rep.append(time.perf_counter() - t_call)
+        handle.flush()  # the tail drain is part of the measured epoch
+        block(asynchronous)
+        ups = steps / (time.perf_counter() - t0)
+        if ups > async_ups:
+            async_ups, latencies = ups, lat_rep
+    p99_ms = float(np.percentile(latencies, 99) * 1e3)
+    dropped = handle.dropped
+    handle.close()
+
+    # parity: both sides consumed the identical sequence — every state
+    # leaf must match byte for byte
+    identical = True
+    for name, m_async in asynchronous.items(keep_base=True):
+        m_block = blocking[name]
+        for sname in m_async._defaults:
+            va, vb = np.asarray(getattr(m_async, sname)), np.asarray(getattr(m_block, sname))
+            if not np.array_equal(va, vb):
+                identical = False
+
+    print(
+        json.dumps(
+            {
+                "metric": "collection_async_update_throughput",
+                "value": round(async_ups, 1),
+                "unit": "updates/sec",
+                "blocking_updates_per_sec": round(blocking_ups, 1),
+                "async_vs_blocking": round(async_ups / blocking_ups, 3),
+                "update_async_p99_ms": round(p99_ms, 3),
+                "request_wait_ms": round(work_s * 1e3, 3),
+                "blocking_update_ms": round(update_ms, 3),
+                "queue_depth": 2,
+                "dropped_batches": dropped,
+                "n_metrics": len(asynchronous),
+                "states_bit_identical": identical,
+            }
+        )
+    )
+
+
 def bench_telemetry() -> None:
     """Micro-bench for the telemetry zero-overhead-when-disabled contract:
     per-call wall cost of ``Metric.update`` with the recorder disabled vs
@@ -896,6 +1071,7 @@ SUBCOMMANDS = {
     "inference": bench_inference,
     "telemetry": bench_telemetry,
     "fused": bench_fused,
+    "async": bench_async,
 }
 
 
@@ -978,7 +1154,7 @@ def main() -> None:
     import subprocess
 
     records = []  # every emitted JSON object, for the --baseline check
-    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "telemetry"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "telemetry"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],

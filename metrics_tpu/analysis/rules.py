@@ -745,6 +745,111 @@ class StateRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# TL-BLOCK
+# ---------------------------------------------------------------------------
+
+@register_rule
+class BlockRule(Rule):
+    """Host-blocking readbacks on the async-ingest hot path.
+
+    The async update pipeline's contract (``core/pipeline.py``) is that the
+    serving loop never stalls on metrics accounting: ``update_async`` must
+    return in microseconds and the worker must hand batches to XLA's async
+    dispatch without waiting on device completion. One ``.item()`` /
+    ``jax.device_get`` / ``block_until_ready`` / ``float()``/``int()``-on-a-
+    device-value there silently turns the pipeline back into the blocking
+    path it exists to replace — per batch, invisibly. Scope: every function
+    named ``*_async`` anywhere in the package, plus the worker/enqueue/drain
+    paths of ``core/pipeline.py`` (method-name keyed). Deliberate blocking
+    entry points (``flush``, ``close``, ``update_blocking``) are outside the
+    scope by naming convention; intentional hits take the standard
+    ``# tracelint: disable=TL-BLOCK`` pragma or a baseline entry.
+    """
+
+    id = "TL-BLOCK"
+    description = (
+        "host-blocking readback on the async hot path (*_async functions, "
+        "core/pipeline.py worker/enqueue paths)"
+    )
+
+    _SYNC_METHODS = {"item", "block_until_ready"}
+    _CAST_BUILTINS = {"float", "int"}
+    _HOT_FILE = "core/pipeline.py"
+    _HOT_NAME_TOKENS = ("worker", "enqueue", "drain")
+
+    def _is_hot(self, ctx: FileContext, fn: ast.FunctionDef) -> bool:
+        if fn.name.endswith("_async"):
+            return True
+        return ctx.relpath == self._HOT_FILE and any(
+            tok in fn.name for tok in self._HOT_NAME_TOKENS
+        )
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        hot = [
+            node
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and self._is_hot(ctx, node)
+        ]
+        hot_ids = {id(fn) for fn in hot}
+        for fn in hot:
+            # a hot function nested inside another hot function is scanned
+            # once, as its own entry
+            yield from self._scan(ctx, fn, hot_ids)
+
+    def _scan(self, ctx: FileContext, fn: ast.FunctionDef, hot_ids: Set[int]) -> Iterator[Violation]:
+        params = {a.arg for a in list(fn.args.args) + list(fn.args.kwonlyargs) if a.arg != "self"}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        tainted = _TracedNames(params, set(), set(), ctx)
+        skip: Set[int] = set()
+        for node in ast.walk(fn):
+            if id(node) in skip:
+                continue
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not fn
+                and id(node) in hot_ids
+            ):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tainted.absorb_assign(node)
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in self._SYNC_METHODS:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"`.{func.attr}()` blocks the host on device completion inside the"
+                    " async hot path — the serving loop stalls on every batch; keep"
+                    " readbacks out of update_async/worker code (flush() is the"
+                    " sanctioned drain point)",
+                )
+            elif _last_name(func) == "device_get":
+                yield self.violation(
+                    ctx,
+                    node,
+                    "`jax.device_get` forces a device->host transfer inside the async"
+                    " hot path; enqueue the array and let the caller (or an exporter)"
+                    " fetch it after flush()",
+                )
+            elif isinstance(func, ast.Name) and func.id in self._CAST_BUILTINS:
+                if any(tainted.mentions(a) for a in node.args):
+                    yield self.violation(
+                        ctx,
+                        node,
+                        f"`{func.id}()` on a batch-derived value concretizes it — a"
+                        " blocking readback per batch on the async hot path; keep it"
+                        " as an array (or move the cast behind flush())",
+                    )
+
+
+# ---------------------------------------------------------------------------
 # TL-COLLECTIVE
 # ---------------------------------------------------------------------------
 

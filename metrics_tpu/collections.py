@@ -21,6 +21,7 @@ import numpy as np
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.trace import span as _span
+from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -66,6 +67,8 @@ class MetricCollection:
         self._groups: Dict[int, List[str]] = {}
         self._groups_checked: bool = False
         self._fused = None  # FusedUpdate handle once compile_update() is called
+        self._async = None  # AsyncUpdateHandle once compile_update_async() is called
+        self._bulk_insert = False  # add_metrics defers the membership handler
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -77,6 +80,36 @@ class MetricCollection:
 
     def __setitem__(self, key: str, value: Metric) -> None:
         self._metrics[key] = value
+        # a dict-style insert is a membership change exactly like
+        # add_metrics (which routes here and runs the shared handler once,
+        # after its whole batch of inserts — per-item group reseeds would
+        # spuriously fail explicit compute_groups-list validation against
+        # a partially-built membership)
+        if not self._bulk_insert:
+            self._on_membership_change()
+
+    def _on_membership_change(self) -> None:
+        """Everything a membership change must refresh: compiled fused and
+        async handles are stale (the worker would keep writing through the
+        old member set in the background), and the compute groups must be
+        reseeded — a merge over the pre-insert ``_groups`` would silently
+        exclude the new member from every future update."""
+        self._groups_checked = False
+        self._invalidate_compiled()
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _invalidate_compiled(self) -> None:
+        """Drop any compiled fused update and close an open async handle
+        (discarding queued batches — their fused set no longer matches the
+        membership); a fresh ``compile_update[_async]()`` is required to
+        resume."""
+        self._fused = None
+        if self._async is not None:
+            self._async.close(drain=False)
+            self._async = None
 
     def __contains__(self, key: str) -> bool:
         return key in self._metrics
@@ -111,6 +144,9 @@ class MetricCollection:
             return self._forward_impl(*args, **kwargs)
 
     def _forward_impl(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        # forward's double-update cycle reads AND restores every state; it
+        # must not race the async worker's buffer ownership
+        self._drain_async()
         res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
@@ -129,6 +165,12 @@ class MetricCollection:
             self._update_impl(*args, **kwargs)
 
     def _update_impl(self, *args: Any, **kwargs: Any) -> None:
+        if self._async is not None and not self._async.closed:
+            # blocking updates interleave with queued async batches in FIFO
+            # order (enqueue-then-drain), so the two ingest styles compose
+            # without racing the worker's donated-buffer ownership
+            self._async.update_blocking(*args, **kwargs)
+            return
         if self._fused is not None:
             self._fused(*args, **kwargs)
             return
@@ -270,6 +312,32 @@ class MetricCollection:
             return self._compute_impl()
 
     def _compute_impl(self) -> Dict[str, Any]:
+        handle = self._async if self._async is not None and not self._async.closed else None
+        if handle is not None:
+            # bounded-staleness snapshot: wait only until at most
+            # max_staleness accepted batches remain unapplied (0 = full
+            # drain); no device barrier is forced
+            handle._before_compute()
+            applied_mark = handle.applied
+            try:
+                # a positive staleness bound lets the worker keep applying
+                # while we compute, but on donating backends a dispatch's
+                # buffers-dead-until-reinstalled window must stay exclusive:
+                # the snapshot may be *stale*, never deleted
+                with handle.snapshot():
+                    return self._compute_metrics()
+            finally:
+                if handle.applied != applied_mark:
+                    # batches landed WHILE computing: each install cleared
+                    # `_computed`, but a compute finishing afterwards writes
+                    # its (now stale) value back into the cache — and with
+                    # no later update to clear it, the next compute() would
+                    # serve the stale snapshot as the drained answer
+                    for m in self._metrics.values():
+                        m._computed = None
+        return self._compute_metrics()
+
+    def _compute_metrics(self) -> Dict[str, Any]:
         if self._enable_compute_groups and self._groups_checked:
             for cg in self._groups.values():
                 m0 = self._metrics[cg[0]]
@@ -315,6 +383,24 @@ class MetricCollection:
         """
         from metrics_tpu.core.fused import FusedUpdate
 
+        # idempotent warm reuse: reset() keeps the handle, so an epoch
+        # loop's reset(); compile_update[_async]() must not discard a warm
+        # compile cache and pay a fresh XLA build (membership changes go
+        # through add_metrics()/clone(), which drop the handle)
+        if self._fused is not None and self._fused.config_matches(
+            buckets=buckets, donate=donate, use_manifest=use_manifest
+        ):
+            return self._fused
+        if self._async is not None and not self._async.closed:
+            # a config-changing rebuild under a live worker would install a
+            # second fused handle the async path never routes to — and
+            # dispatching it directly would race the worker's donation
+            # window on the same state arrays
+            raise MetricsUserError(
+                "compile_update() with a different config while an async"
+                " handle is open; close() the handle (or reset(), or call"
+                " compile_update_async() with the new config) first"
+            )
         self._fused = FusedUpdate(self, buckets=buckets, donate=donate, use_manifest=use_manifest)
         return self._fused
 
@@ -322,6 +408,74 @@ class MetricCollection:
     def fused_update(self):
         """The active :class:`FusedUpdate` handle, or ``None`` (eager)."""
         return self._fused
+
+    def compile_update_async(
+        self,
+        buckets=None,
+        donate=None,
+        use_manifest=None,
+        *,
+        queue_depth: int = 2,
+        policy: str = "block",
+        max_staleness: int = 0,
+    ):
+        """Compile the fused update AND layer the async ingest pipeline on
+        top: returns a :class:`metrics_tpu.core.pipeline.AsyncUpdateHandle`
+        whose ``update_async(batch)`` enqueues into a bounded
+        double-buffered queue (depth ``queue_depth``, default 2) and
+        returns immediately; a worker thread drains the queue through the
+        fused single-dispatch kernel, so host ingest overlaps device
+        compute and the serving loop never stalls on metrics accounting.
+
+        ``buckets``/``donate``/``use_manifest`` are forwarded to
+        :meth:`compile_update`. ``policy`` picks the backpressure behavior
+        when the queue is full (``"block"`` waits, ``"drop"`` discards and
+        counts, ``"error"`` raises ``AsyncQueueFull``); ``max_staleness``
+        is the default ``compute()`` staleness bound in accepted-but-
+        unapplied batches (0 = drain-then-compute).
+
+        While the handle is open, blocking ``update()`` calls route through
+        it (enqueue-then-drain, FIFO with queued async batches), ``compute``
+        honors the staleness bound, and ``forward`` drains first.
+        ``reset()``/``add_metrics()`` close and invalidate the handle (as
+        they invalidate ``compile_update``'s); ``clone()`` drops it (worker
+        threads are not copyable). See docs/async_updates.md.
+        """
+        from metrics_tpu.core.pipeline import AsyncUpdateHandle
+
+        if self._async is not None:
+            # a poisoned handle must surface its captured AsyncWorkerError
+            # here, not vanish: close() never raises by contract, so
+            # re-compiling over a failed handle would silently discard the
+            # error AND the queued batches the failure stranded (reset() is
+            # the documented way to discard and recover)
+            self._async._raise_pending_error()
+            self._async.close(drain=True)
+        fused = self.compile_update(buckets=buckets, donate=donate, use_manifest=use_manifest)
+        self._async = AsyncUpdateHandle(
+            self,
+            fused,
+            queue_depth=queue_depth,
+            policy=policy,
+            max_staleness=max_staleness,
+        )
+        return self._async
+
+    @property
+    def async_update(self):
+        """The active :class:`AsyncUpdateHandle`, or ``None``."""
+        return self._async
+
+    def update_async(self, *args: Any, **kwargs: Any) -> bool:
+        """Enqueue one batch into the async pipeline and return immediately
+        (see :meth:`compile_update_async`); ``True`` if accepted, ``False``
+        if the ``drop`` backpressure policy discarded it."""
+        if self._async is None or self._async.closed:
+            raise MetricsUserError(
+                "update_async() requires an open async handle; call"
+                " compile_update_async() first"
+            )
+        return self._async.update_async(*args, **kwargs)
 
     def state_reductions(self) -> Dict[str, Dict[str, Any]]:
         """Per-metric reducer specs (name -> ``Metric.state_reductions()``)
@@ -331,11 +485,34 @@ class MetricCollection:
 
     def reset(self) -> None:
         """Reset all metrics; discovered compute groups are kept (parity with
-        reference collections.py — discovery cost is amortized across epochs)."""
+        reference collections.py — discovery cost is amortized across epochs).
+
+        An open async handle is closed (queued batches DISCARDED — the
+        states are being wiped anyway) and invalidated, so a worker cannot
+        apply a stale batch on top of freshly-reset states; call
+        :meth:`compile_update_async` again to resume async ingest."""
+        if self._async is not None:
+            self._async.close(drain=False)
+            self._async = None
         for m in self._metrics.values():
             m.reset()
 
+    def _drain_async(self) -> None:
+        """State-access guard: drain the open async handle before reading,
+        copying, or replacing metric state. Without it a checkpoint or
+        clone races the worker — on donating backends the dispatch window's
+        dead arrays raise 'Array has been deleted', and on any backend the
+        copied/serialized state silently misses the queued batches (or,
+        for load_state_dict, stale queued batches land on top of the
+        freshly loaded state). Re-raises a captured worker error, like
+        every other drain. Uses the event-free drain: the flushes counter
+        tracks explicit flush() calls and draining closes, not internal
+        guards (forward() routes through here per batch)."""
+        if self._async is not None and not self._async.closed:
+            self._async._wait_drained()
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        self._drain_async()
         mc = deepcopy(self)
         if prefix:
             mc.prefix = self._check_arg(prefix, "prefix")
@@ -348,12 +525,16 @@ class MetricCollection:
             m.persistent(mode)
 
     def state_dict(self) -> Dict[str, Any]:
+        self._drain_async()
         destination: Dict[str, Any] = {}
         for name, m in self._metrics.items():
             m.state_dict(destination, prefix=f"{name}.")
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        # drain applies already-accepted batches to the OLD state before it
+        # is replaced — same ordering a blocking loop would have produced
+        self._drain_async()
         for name, m in self._metrics.items():
             m.load_state_dict(state_dict, prefix=f"{name}.")
 
@@ -368,19 +549,33 @@ class MetricCollection:
     def total_state_bytes(self) -> int:
         """Total UNIQUE state bytes: once compute groups are discovered, only
         each group's leader contributes (members borrow the leader's arrays
-        at compute time, so counting them would double-book the memory)."""
+        at compute time, so counting them would double-book the memory).
+
+        While an async handle is open, the bytes pinned by queued batch
+        payloads and by donated state buffers still owned by an in-flight
+        fused dispatch are counted too (``AsyncUpdateHandle.in_flight_bytes``)
+        — without them the footprint silently undercounts exactly when
+        memory pressure peaks (the same bytes feed the telemetry footprint
+        high-water mark via the ``async_in_flight`` label)."""
         if self._enable_compute_groups and self._groups_checked:
             names = [cg[0] for cg in self._groups.values()]
         else:
             names = list(self._metrics)
-        return sum(self._metrics[name].total_state_bytes() for name in names)
+        total = sum(self._metrics[name].total_state_bytes() for name in names)
+        if self._async is not None and not self._async.closed:
+            total += self._async.in_flight_bytes
+        return total
 
     def to_device(self, device: Any) -> "MetricCollection":
+        # replaces every state array: must not race the worker's donation
+        # window, and queued batches must land on the pre-move state
+        self._drain_async()
         for m in self._metrics.values():
             m.to_device(device)
         return self
 
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
+        self._drain_async()  # same state-replacement guard as to_device
         for m in self._metrics.values():
             m.set_dtype(dst_type)
         return self
@@ -408,29 +603,31 @@ class MetricCollection:
                 f" with first passed dictionary {metrics} so they will be ignored."
             )
 
-        if isinstance(metrics, dict):
-            for name in sorted(metrics.keys()):
-                metric = metrics[name]
-                if not isinstance(metric, Metric):
-                    raise ValueError(f"Value {metric} belonging to key {name} is not an instance of `Metric`")
-                self[name] = metric
-        elif isinstance(metrics, Sequence):
-            for metric in metrics:
-                if not isinstance(metric, Metric):
-                    raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `Metric`")
-                name = metric.__class__.__name__
-                if name in self:
-                    raise ValueError(f"Encountered two metrics both named {name}")
-                self[name] = metric
-        else:
-            raise ValueError("Unknown input to MetricCollection.")
+        # defer the shared membership handler to one run after the whole
+        # batch of inserts: an explicit compute_groups list validates its
+        # names against the membership, which is incomplete mid-loop
+        self._bulk_insert = True
+        try:
+            if isinstance(metrics, dict):
+                for name in sorted(metrics.keys()):
+                    metric = metrics[name]
+                    if not isinstance(metric, Metric):
+                        raise ValueError(f"Value {metric} belonging to key {name} is not an instance of `Metric`")
+                    self[name] = metric
+            elif isinstance(metrics, Sequence):
+                for metric in metrics:
+                    if not isinstance(metric, Metric):
+                        raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `Metric`")
+                    name = metric.__class__.__name__
+                    if name in self:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self[name] = metric
+            else:
+                raise ValueError("Unknown input to MetricCollection.")
+        finally:
+            self._bulk_insert = False
 
-        self._groups_checked = False
-        self._fused = None  # membership changed: any compiled fused update is stale
-        if self._enable_compute_groups:
-            self._init_compute_groups()
-        else:
-            self._groups = {}
+        self._on_membership_change()
 
     def _init_compute_groups(self) -> None:
         if isinstance(self._enable_compute_groups, list):

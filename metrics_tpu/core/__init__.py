@@ -1,4 +1,17 @@
 from metrics_tpu.core.fused import FUSED_ENTRY, FusedUpdate  # noqa: F401
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401
+from metrics_tpu.core.pipeline import (  # noqa: F401
+    AsyncQueueFull,
+    AsyncUpdateHandle,
+    AsyncWorkerError,
+)
 
-__all__ = ["CompositionalMetric", "FUSED_ENTRY", "FusedUpdate", "Metric"]
+__all__ = [
+    "AsyncQueueFull",
+    "AsyncUpdateHandle",
+    "AsyncWorkerError",
+    "CompositionalMetric",
+    "FUSED_ENTRY",
+    "FusedUpdate",
+    "Metric",
+]

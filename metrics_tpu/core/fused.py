@@ -155,6 +155,12 @@ class FusedUpdate:
         # static-manifest consultation default-on; METRICS_TPU_NO_MANIFEST
         # (handled inside manifest.py) or use_manifest=False turn it off
         self._use_manifest = True if use_manifest is None else bool(use_manifest)
+        #: the config as REQUESTED — `_use_manifest` can be demoted to False
+        #: at runtime by the stale-manifest safety net, and warm reuse must
+        #: keep matching the original request or an epoch loop rebuilds a
+        #: fresh manifest-trusting handle that re-hits the same stale
+        #: manifest (and re-warns, and re-probes) every epoch
+        self._requested_manifest = self._use_manifest
         self._cache: Dict[Tuple, _CacheEntry] = {}
         self._fusible: Dict[Tuple, bool] = {}
         #: (name, sig) keys whose fusibility came from the static manifest
@@ -165,6 +171,13 @@ class FusedUpdate:
         self._bucket_ok: Dict[Tuple[str, ...], bool] = {}
         self._bucket_warned = False
         self.n_compiles = 0
+        #: members the runtime probe (or a manifest demotion) routed to the
+        #: eager fallback for at least one signature — their buffers stay
+        #: alive through an eager update, so donated_state_bytes() must not
+        #: count them as dispatch-owned. Grows monotonically; its size is
+        #: part of the donated-bytes cache key.
+        self._eager_names: set = set()
+        self._donated_bytes_cache: Optional[Tuple[Tuple[bool, int], int]] = None
 
     # compiled executables (and the collection back-reference) must not be
     # deep-copied: MetricCollection.clone() drops the handle and the clone
@@ -176,16 +189,96 @@ class FusedUpdate:
     def cache_size(self) -> int:
         return len(self._cache)
 
+    @property
+    def donating(self) -> bool:
+        """Whether dispatches donate the state buffers (in-place accumulator
+        updates). While a donating dispatch is in flight the PREVIOUS state
+        arrays are dead — the async pipeline (core/pipeline.py) keys its
+        in-flight buffer-ownership accounting on this flag."""
+        return self._donate
+
+    def config_matches(
+        self,
+        buckets: Optional[Sequence[int]] = None,
+        donate: Optional[bool] = None,
+        use_manifest: Optional[bool] = None,
+    ) -> bool:
+        """True when a ``compile_update(...)`` request resolves to this
+        handle's exact config — the warm-reuse test that lets an epoch
+        loop's ``reset(); compile_update_async()`` keep the compile cache
+        instead of paying a fresh XLA build."""
+        want_buckets = tuple(sorted(int(b) for b in buckets)) if buckets else ()
+        want_donate = _supports_donation() if donate is None else bool(donate)
+        want_manifest = True if use_manifest is None else bool(use_manifest)
+        return (
+            self._buckets == want_buckets
+            and self._donate == want_donate
+            # compare the REQUEST, not the live flag: a runtime stale-
+            # manifest demotion must survive warm reuse, not be rebuilt away
+            and self._requested_manifest == want_manifest
+        )
+
+    def donated_state_bytes(self) -> int:
+        """Unique state bytes a donating dispatch takes ownership of:
+        compute-group leaders only (members borrow the leader's arrays, so
+        counting them would double-book the same buffers), and only members
+        that can reach the fused kernel — eager fallbacks (jit-unsafe,
+        wrapper, list-state, and members the runtime probe or a manifest
+        demotion rejected) update in the calling thread and keep their
+        buffers alive throughout. The async worker calls this per batch, so
+        the O(n_metrics) state walk is cached — fused state shapes are fixed
+        by contract, and the only structural shifts while a handle is open
+        are group discovery flipping ``_groups_checked`` and probe demotions
+        growing ``_eager_names``, both part of the cache key (membership
+        changes go through add_metrics/reset, which drop the handle)."""
+        col = self._collection
+        key = (col._groups_checked, len(self._eager_names))
+        cached = self._donated_bytes_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        if col._groups_checked:
+            names = [cg[0] for cg in col._groups.values()]
+        else:
+            names = list(col._metrics)
+        total = 0
+        for name in names:
+            if self._never_fused(name):
+                continue
+            total += col._metrics[name].total_state_bytes()
+        self._donated_bytes_cache = (key, total)
+        return total
+
+    @staticmethod
+    def _static_unfusible(m: Any) -> bool:
+        """The structural exclusions shared by the fusibility check and
+        donated-byte accounting — ``__jit_unsafe__``, wrapper children,
+        list-valued state (declared default or current value). One
+        predicate so the two call sites cannot drift."""
+        if getattr(m, "__jit_unsafe__", False) or m._children:
+            return True
+        return any(isinstance(v, list) for v in m._defaults.values()) or any(
+            isinstance(getattr(m, k), list) for k in m._defaults
+        )
+
+    def _never_fused(self, name: str) -> bool:
+        """Static exclusions plus learned ones: members the runtime probe
+        (or a manifest demotion) already routed to the eager fallback for
+        some signature. A member excluded here updates eagerly, keeps its
+        buffers alive, and must never be booked as dispatch-owned.
+        (Per-name and conservative on purpose — ``_is_fusible`` stays
+        per-signature, so a mixed-signature member may still fuse for
+        other signatures while its bytes are left uncounted.)"""
+        return (
+            self._static_unfusible(self._collection._metrics[name])
+            or name in self._eager_names
+        )
+
     # ------------------------------------------------------------------
     # fusibility / bucket eligibility
     # ------------------------------------------------------------------
     def _is_fusible(self, name: str, args: Tuple, kwargs: Dict[str, Any], sig: Tuple) -> bool:
         m = self._collection._metrics[name]
-        if getattr(m, "__jit_unsafe__", False) or m._children:
-            return False
-        if any(isinstance(v, list) for v in m._defaults.values()) or any(
-            isinstance(getattr(m, k), list) for k in m._defaults
-        ):
+        if self._static_unfusible(m):
             return False
         key = (name, sig)
         cached = self._fusible.get(key)
@@ -220,6 +313,8 @@ class FusedUpdate:
                     UserWarning,
                 )
         self._fusible[key] = ok
+        if not ok:
+            self._eager_names.add(name)
         return ok
 
     def _bucket_eligible(self, names: List[str]) -> bool:
@@ -248,6 +343,17 @@ class FusedUpdate:
     # call path
     # ------------------------------------------------------------------
     def __call__(self, *args: Any, **kwargs: Any) -> None:
+        self.dispatch(args, kwargs)
+
+    def dispatch(self, args: Tuple, kwargs: Dict[str, Any]) -> None:
+        """Non-blocking fused dispatch on a pre-packed ``(args, kwargs)``
+        batch — the entry point the async pipeline's worker calls. Returns
+        as soon as XLA has enqueued the kernel (JAX's async dispatch): no
+        ``block_until_ready``, no scalar readback, so the caller (a worker
+        thread overlapping ingest with device compute) never stalls on
+        device completion. The only host-synchronizing work on this path is
+        one-time: first-call compute-group discovery and eager fallbacks
+        for jit-unsafe members, both of which run in the calling thread."""
         col = self._collection
         rec = _TELEMETRY if _TELEMETRY.enabled else None
         t0 = time.perf_counter() if rec is not None else 0.0

@@ -1,0 +1,633 @@
+"""Async update pipeline: double-buffered, backpressured metric ingest that
+never stalls the serving loop.
+
+The fused path (``core/fused.py``) solved the *dispatch* side — one XLA
+dispatch per batch instead of N — but the host still serializes: every
+``collection.update(batch)`` pays the fused call's host work (coercion,
+cache lookup, state-pytree packing, dispatch) inline, and any ``compute()``
+or telemetry readback is a full sync barrier. This module moves that host
+work off the hot path:
+
+* :meth:`MetricCollection.compile_update_async` returns an
+  :class:`AsyncUpdateHandle` layered on the existing :class:`FusedUpdate`
+  kernel. ``update_async(batch)`` enqueues the batch into a **bounded
+  double-buffered queue** (depth 2 by default) and returns in microseconds;
+  a single worker thread drains the queue and issues the already-compiled
+  fused kernel. JAX's async dispatch does the device-side pipelining — the
+  point is to get the host out of the way: step k+1's ingest overlaps step
+  k's dispatch and compute, and the hot path never performs a blocking
+  readback (enforced at review time by tracelint rule **TL-BLOCK**).
+* **Backpressure** is the bounded queue depth with a ``block`` / ``drop`` /
+  ``error`` policy: ``block`` waits for a slot (lossless, the default),
+  ``drop`` discards the batch and counts it (telemetry's dropped-batches
+  counter), ``error`` raises :class:`AsyncQueueFull` at the call site.
+* ``compute()`` reads a **bounded-staleness snapshot**: it waits only until
+  at most ``max_staleness`` accepted batches remain unapplied (default 0 =
+  drain-then-compute) and never calls ``block_until_ready`` itself. With a
+  positive bound the snapshot is *stale but batch-atomic*: the state lock
+  serializes each batch's dispatch-and-install against the read, so the
+  snapshot sits between whole batches — up to the bound behind, never
+  mid-install, never a donating dispatch's dead buffers.
+* ``flush()`` / ``close()`` give a deterministic drain for epoch
+  boundaries and tests; ``close()`` joins the worker so no thread leaks.
+* **Worker exceptions** are captured with the originating batch index and
+  re-raised at the next ``update_async``/``flush`` call site as
+  :class:`AsyncWorkerError` (chained to the original). A failed handle is
+  poisoned: later queued batches are discarded, never half-applied.
+* **Buffer ownership under donation**: while a batch is in flight the
+  worker owns the collection's state arrays — on donating backends the
+  previous buffers are dead the moment the kernel is dispatched. All state
+  access therefore funnels through the handle: blocking
+  ``collection.update()`` calls enqueue-then-drain (FIFO order with queued
+  async batches), ``forward`` and ``compute`` drain first, and the bytes
+  pinned by queued batches + donated in-flight state are accounted by
+  :meth:`AsyncUpdateHandle.in_flight_bytes` into
+  ``MetricCollection.total_state_bytes`` and the telemetry footprint
+  high-water mark.
+
+Single-producer contract: ``update_async`` may be called from one thread at
+a time (the serving loop). The worker is the only thread that mutates
+metric state between drains.
+"""
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Dict, Optional, Tuple
+
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
+from metrics_tpu.observability.recorder import _nbytes
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+#: queue sentinel: instructs the worker to exit (close())
+_SHUTDOWN = object()
+
+#: accepted backpressure policies for a full queue
+POLICIES = ("block", "drop", "error")
+
+
+class AsyncQueueFull(MetricsUserError):
+    """Raised by ``update_async`` under the ``error`` backpressure policy
+    when the bounded queue is full — the producer outran the device and
+    asked to be told instead of blocked."""
+
+
+class AsyncWorkerError(RuntimeError):
+    """A batch failed inside the async worker.
+
+    Raised at the next ``update_async``/``flush``/``compute`` call site,
+    carrying :attr:`batch_index` (the 0-based accepted-batch index that
+    failed) and chained to the original exception (``__cause__``). The
+    handle is poisoned afterwards: queued batches are discarded and every
+    later call re-raises, so a partially-applied epoch cannot silently
+    masquerade as a complete one — ``reset()`` + a fresh
+    ``compile_update_async()`` recovers.
+    """
+
+    def __init__(self, batch_index: int, original: BaseException) -> None:
+        self.batch_index = batch_index
+        self.original = original
+        super().__init__(
+            f"async metric update failed on batch {batch_index}: {original!r}"
+            " (the handle is now poisoned; reset() and re-compile to recover)"
+        )
+
+
+def _wake_worker(q: "queue.Queue") -> None:
+    """GC fallback (``weakref.finalize``) for a handle abandoned without
+    ``close()``: wake the worker parked in ``q.get()`` so it notices the
+    dead handle and exits instead of leaking as a daemon thread. Non-
+    blocking on purpose — a full queue means the worker is active and will
+    re-check its weakref at the next loop iteration anyway."""
+    try:
+        q.put_nowait(_SHUTDOWN)
+    except queue.Full:
+        pass
+
+
+def _worker_main(handle_ref: "weakref.ref", q: "queue.Queue") -> None:
+    """Queue drain loop, deliberately a module-level function: the thread
+    must NOT hold a strong reference to the handle while parked in
+    ``q.get()``, or an abandoned handle (and through it the collection,
+    the fused compile cache, and every device state array) would be
+    pinned by its own worker forever. The strong ref is taken per item
+    and dropped before parking; ``_wake_worker`` (a ``weakref.finalize``)
+    unblocks the park when the handle is collected."""
+    while True:
+        handle = handle_ref()
+        if handle is None:
+            return
+        handle._yield_to_snapshot_waiters()
+        del handle
+        item = q.get()
+        if item is _SHUTDOWN:
+            return
+        handle = handle_ref()
+        if handle is None:
+            return
+        handle._drain_item(item)
+        del handle
+
+
+def _payload_nbytes(args: Tuple, kwargs: Dict[str, Any]) -> int:
+    """Best-effort bytes held by a queued batch payload (array leaves only;
+    static scalars/strings are free). Host-side attribute reads — never a
+    device sync."""
+    total = 0
+
+    def walk(obj: Any) -> None:
+        nonlocal total
+        nb = _nbytes(obj)
+        if nb:
+            total += nb
+        elif isinstance(obj, (list, tuple)):
+            for o in obj:
+                walk(o)
+        elif isinstance(obj, dict):
+            for o in obj.values():
+                walk(o)
+
+    walk(args)
+    if kwargs:
+        walk(kwargs)
+    return total
+
+
+class AsyncUpdateHandle:
+    """Handle returned by :meth:`MetricCollection.compile_update_async`.
+
+    ``update_async(batch)`` enqueues and returns immediately; a worker
+    thread drains the bounded queue through the fused kernel. See the
+    module docstring for the queue model, staleness contract, and
+    ownership rules, and ``docs/async_updates.md`` for the user guide.
+    """
+
+    def __init__(
+        self,
+        collection: Any,
+        fused: Any,
+        queue_depth: int = 2,
+        policy: str = "block",
+        max_staleness: int = 0,
+    ) -> None:
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got {policy!r}")
+        if int(queue_depth) < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        if int(max_staleness) < 0:
+            raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
+        self._collection = collection
+        self._fused = fused
+        self.queue_depth = int(queue_depth)
+        self.policy = policy
+        self.max_staleness = int(max_staleness)
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self._cond = threading.Condition()
+        self._state_lock = threading.Lock()
+        self._snapshot_waiters = 0  # computes waiting for the next lock window
+        self._pending = 0  # accepted batches not yet applied (queued or in hand)
+        self._in_flight_bytes = 0
+        self._attempts = 0  # monotonic batch-index source; drops consume one
+        self._enqueued = 0  # accepted batches ever
+        self._applied = 0
+        self._dropped = 0
+        self._error: Optional[Tuple[int, BaseException]] = None
+        self._closed = False
+        self._discard = False  # close(drain=False): worker drops queued items
+        self._staleness_override: Optional[int] = None
+        # the worker targets a module-level function holding only a weakref
+        # to this handle: a handle abandoned without close() must not be
+        # pinned forever by its own parked worker (see _worker_main);
+        # _wake_worker is the GC fallback that unblocks the park
+        self._thread = threading.Thread(
+            target=_worker_main,
+            args=(weakref.ref(self), self._queue),
+            name="metrics-tpu-async-update",
+            daemon=True,
+        )
+        self._thread.start()
+        self._finalizer = weakref.finalize(self, _wake_worker, self._queue)
+
+    # the worker thread and compiled executables cannot be copied:
+    # MetricCollection.clone() drops the handle (same contract as
+    # FusedUpdate) and the clone re-compiles on its own
+    def __deepcopy__(self, memo: Dict) -> None:
+        return None
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def pending(self) -> int:
+        """Accepted batches not yet applied to the metric states."""
+        with self._cond:
+            return self._pending
+
+    @property
+    def dropped(self) -> int:
+        """Batches discarded by the ``drop`` backpressure policy."""
+        with self._cond:
+            return self._dropped
+
+    @property
+    def enqueued(self) -> int:
+        """Batches accepted into the queue over the handle's lifetime."""
+        with self._cond:
+            return self._enqueued
+
+    @property
+    def applied(self) -> int:
+        """Batches successfully applied to the metric states."""
+        with self._cond:
+            return self._applied
+
+    @property
+    def state_lock(self) -> "threading.Lock":
+        """Serializes a donating dispatch's buffers-dead-until-reinstalled
+        window against state readers. A ``compute()`` under a positive
+        staleness bound is allowed to see *stale* states — never deleted
+        ones: on donating backends the old arrays are dead from the moment
+        the kernel is enqueued until the new ones are installed. Readers
+        should use :meth:`snapshot` rather than taking the lock raw: a bare
+        acquire races the worker's immediate re-acquire (``threading.Lock``
+        has no fairness), and losing that race every round degenerates a
+        bounded-staleness read into a full drain."""
+        return self._state_lock
+
+    @contextlib.contextmanager
+    def snapshot(self):
+        """Priority window for state readers: registers as a waiter (the
+        worker yields the lock between batches instead of re-acquiring in
+        its tight loop), takes the state lock, and deregisters on exit.
+        ``MetricCollection.compute()`` wraps its metric reads in this."""
+        with self._cond:
+            self._snapshot_waiters += 1
+        try:
+            with self._state_lock:
+                yield
+        finally:
+            with self._cond:
+                self._snapshot_waiters -= 1
+                self._cond.notify_all()
+
+    @property
+    def in_flight_bytes(self) -> int:
+        """Bytes pinned by queued batch payloads plus (on donating backends)
+        the state buffers owned by the batch currently being applied —
+        exactly the memory ``state_footprint()`` used to undercount while a
+        fused/async update was in flight."""
+        with self._cond:
+            return self._in_flight_bytes
+
+    # ------------------------------------------------------------------
+    # hot path
+    # ------------------------------------------------------------------
+    def _accept(self, name: str, args: Tuple, kwargs: Dict[str, Any]) -> Tuple:
+        """Shared accept path: error/closed checks, then reserve the batch
+        index and accounting slot. Returns the queue item."""
+        self._raise_pending_error()
+        if self._closed:
+            raise MetricsUserError(
+                f"{name}() on a closed AsyncUpdateHandle; call"
+                " compile_update_async() again after reset()/close()"
+            )
+        nbytes = _payload_nbytes(args, kwargs)
+        with self._cond:
+            # the batch index comes from a monotonic attempt counter that a
+            # rejected (dropped/errored) batch still consumes: an operator
+            # correlating events must never see one index both dropped and
+            # applied, so indexes are unique even though `enqueued` (the
+            # ACCEPTED count) is rolled back on rejection
+            idx = self._attempts
+            self._attempts += 1
+            self._enqueued += 1
+            self._pending += 1
+            self._in_flight_bytes += nbytes
+        return (idx, args, kwargs, nbytes)
+
+    def _record_enqueue(self, idx: int) -> None:
+        """Exactly one ``enqueue`` event per ACCEPTED batch (the
+        observability guard pins this)."""
+        if _TELEMETRY.enabled:
+            with self._cond:
+                depth = self._pending
+                inflight = self._in_flight_bytes
+            _TELEMETRY.record_async_event(
+                "enqueue", batch_index=idx, queue_depth=depth, in_flight_bytes=inflight
+            )
+
+    def update_async(self, *args: Any, **kwargs: Any) -> bool:
+        """Enqueue one batch and return immediately.
+
+        Returns ``True`` when the batch was accepted, ``False`` when the
+        ``drop`` policy discarded it. Re-raises a captured worker exception
+        (:class:`AsyncWorkerError`) before touching the queue. Never
+        performs a blocking device readback (TL-BLOCK-enforced).
+        """
+        item = self._accept("update_async", args, kwargs)
+        idx, _, _, nbytes = item
+        # The enqueue event is recorded BEFORE queue.put so the worker's
+        # matching dequeue event can never precede it in the stream. Under
+        # the single-producer contract the ``full()`` precheck is stable:
+        # only the worker mutates the queue concurrently, and it only
+        # drains, so not-full cannot flip to full before our put.
+        if self.policy != "block" and self._queue.full():
+            with self._cond:
+                self._enqueued -= 1
+                self._pending -= 1
+                self._in_flight_bytes -= nbytes
+                if self.policy == "drop":
+                    self._dropped += 1
+                inflight = self._in_flight_bytes
+            if self.policy == "error":
+                raise AsyncQueueFull(
+                    f"async update queue is full (depth {self.queue_depth});"
+                    " the producer outran the device — flush(), raise"
+                    " queue_depth, or use the 'block'/'drop' policy"
+                )
+            if _TELEMETRY.enabled:
+                # counter-only: the enqueue-event-per-accepted-batch
+                # guard stays exact
+                _TELEMETRY.record_async_event(
+                    "drop", batch_index=idx, in_flight_bytes=inflight
+                )
+            return False
+        self._enqueue_lossless(item)
+        return True
+
+    def _enqueue_lossless(self, item: Tuple) -> None:
+        """Wait for a queue slot (lossless), then record the enqueue event
+        and put. The slot wait runs BEFORE the event (the event marks an
+        ACCEPTED batch, and recording it first keeps dequeue-after-enqueue
+        ordering in the stream) and carries a worker-liveness check: a dead
+        worker (interpreter teardown is the realistic cause — in-loop
+        failures poison the handle instead) would otherwise leave the
+        producer parked in ``queue.put`` forever. The worker notifies
+        ``_cond`` after each item it removes from the queue."""
+        idx, _, _, nbytes = item
+        with self._cond:
+            while self._queue.full():
+                if not self._thread.is_alive():
+                    self._enqueued -= 1
+                    self._pending -= 1
+                    self._in_flight_bytes -= nbytes
+                    raise MetricsUserError(
+                        "async update worker thread is not running; the"
+                        " queue cannot drain (was the interpreter shutting"
+                        " down?)"
+                    )
+                self._cond.wait(timeout=0.1)
+        self._record_enqueue(idx)
+        # single-producer contract: after the not-full observation only the
+        # worker mutates the queue, and it only drains — put cannot block
+        self._queue.put(item)
+
+    def update_blocking(self, *args: Any, **kwargs: Any) -> None:
+        """Apply one batch synchronously, preserving FIFO order with any
+        queued async batches: a forced (lossless) enqueue followed by a
+        drain. This is what ``collection.update()`` routes through while
+        the handle is open, so blocking and async ingest interleave without
+        reordering or racing the worker's buffer ownership."""
+        item = self._accept("update_blocking", args, kwargs)
+        self._enqueue_lossless(item)  # policy-exempt
+        # drain WITHOUT a flush event: per-batch blocking updates are not
+        # epoch-boundary flushes, and counting them would make the flushes
+        # counter track batch count under mixed ingest
+        self._wait_drained()
+
+    # ------------------------------------------------------------------
+    # drain / snapshot
+    # ------------------------------------------------------------------
+    def flush(self, timeout: Optional[float] = None) -> int:
+        """Block until every accepted batch has been applied (deterministic
+        drain for epoch boundaries). Idempotent: a drained handle returns
+        immediately. Returns the number of batches that were pending when
+        the flush began; re-raises any worker exception — including one
+        raised by a batch that was applied *during* this flush."""
+        rec = _TELEMETRY if _TELEMETRY.enabled else None
+        t0 = time.perf_counter() if rec is not None else 0.0
+        waited = self._wait_drained(timeout)
+        if rec is not None:
+            rec.record_async_event(
+                "flush",
+                batches_drained=waited,
+                dur_ms=round((time.perf_counter() - t0) * 1e3, 4),
+                queue_depth=0,
+                in_flight_bytes=self.in_flight_bytes,
+            )
+        return waited
+
+    def _wait_drained(self, timeout: Optional[float] = None) -> int:
+        """The drain wait shared by ``flush()`` (which additionally records
+        the flush event) and ``update_blocking`` (which must not — a
+        per-batch blocking update is not an epoch-boundary flush)."""
+        self._raise_pending_error()
+        with self._cond:
+            waited = self._pending
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while self._pending > 0 and self._error is None:
+                if not self._thread.is_alive():
+                    raise MetricsUserError(
+                        "async update worker thread is not running; the handle"
+                        " cannot drain (was the interpreter shutting down?)"
+                    )
+                remaining = 0.1 if deadline is None else min(0.1, deadline - time.monotonic())
+                if remaining <= 0:
+                    raise MetricsUserError(
+                        f"flush() timed out with {self._pending} batches still pending"
+                    )
+                self._cond.wait(timeout=remaining)
+        self._raise_pending_error()
+        return waited
+
+    def compute(self, max_staleness: Optional[int] = None) -> Dict[str, Any]:
+        """Bounded-staleness snapshot compute: wait only until at most
+        ``max_staleness`` accepted batches remain unapplied (the handle's
+        default when ``None``; 0 = drain-then-compute), then run the
+        collection's ordinary ``compute()``. No device barrier is forced —
+        only the host-side drain the bound requires."""
+        if max_staleness is not None and int(max_staleness) < 0:
+            raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
+        if self._closed or getattr(self._collection, "_async", None) is not self:
+            # the collection consults ITS current handle for the staleness
+            # bound — an override set on a replaced/closed handle would be
+            # silently ignored and return a snapshot staler than asked for
+            raise MetricsUserError(
+                "compute() on a closed or replaced AsyncUpdateHandle; use"
+                " the collection's current handle (collection.async_update)"
+            )
+        self._staleness_override = None if max_staleness is None else int(max_staleness)
+        try:
+            return self._collection.compute()
+        finally:
+            self._staleness_override = None
+
+    def _before_compute(self) -> None:
+        """Collection-compute hook: enforce the staleness bound and record
+        the snapshot's staleness gauge."""
+        self._raise_pending_error()
+        bound = (
+            self.max_staleness
+            if self._staleness_override is None
+            else self._staleness_override
+        )
+        with self._cond:
+            while self._pending > bound and self._error is None:
+                if not self._thread.is_alive():
+                    raise MetricsUserError(
+                        "async update worker thread is not running; compute()"
+                        " cannot reach its staleness bound"
+                    )
+                self._cond.wait(timeout=0.1)
+            staleness = self._pending
+        self._raise_pending_error()
+        if _TELEMETRY.enabled:
+            _TELEMETRY.record_async_event("snapshot", staleness_steps=staleness)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker and release the handle. ``drain=True`` (default)
+        applies every queued batch first; ``drain=False`` discards queued
+        batches (reset/add_metrics invalidation — the states are about to
+        be wiped or restructured anyway). Idempotent; never raises on a
+        poisoned handle (the error already surfaced, or will at the owner's
+        next call). Joins the worker thread, so ``threading.active_count()``
+        is restored."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._cond:
+            waited = self._pending
+        if not drain:
+            # flag FIRST: the worker checks it per item, so a batch the
+            # worker wins from the queue while we drain below is discarded
+            # there rather than applied — the documented contract is that
+            # QUEUED batches never land (the one already mid-dispatch is in
+            # flight, not queued, and completes either way)
+            self._discard = True
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _SHUTDOWN:
+                    continue
+                with self._cond:
+                    self._pending -= 1
+                    self._in_flight_bytes -= item[3]
+                    self._cond.notify_all()
+        # liveness-guarded: with drain=True the queue may still be full and
+        # the sentinel put waits for the worker's FIFO drain to open a slot
+        # — but a DEAD worker (interpreter teardown) never will, and an
+        # atexit/finally close() must not park here forever
+        while True:
+            try:
+                self._queue.put(_SHUTDOWN, timeout=0.1)
+                break
+            except queue.Full:
+                if not self._thread.is_alive():
+                    break
+        self._thread.join(timeout=60.0)
+        self._finalizer.detach()  # worker is gone; no GC wake-up needed
+        # only a DRAINING close is a flush; close(drain=False) discards its
+        # queued batches, and counting it would let an operator read
+        # "flushes" as deterministic drains that never happened
+        if drain and _TELEMETRY.enabled:
+            _TELEMETRY.record_async_event(
+                "flush", batches_drained=waited, queue_depth=0,
+                in_flight_bytes=0, closed=True,
+            )
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            err = self._error
+        if err is not None:
+            idx, original = err
+            raise AsyncWorkerError(idx, original) from original
+
+    def _yield_to_snapshot_waiters(self) -> None:
+        """Yield the lock window to any waiting compute() BEFORE pulling
+        the next batch: the bare lock has no fairness, and the drain loop
+        re-acquires so quickly that a reader could starve until the queue
+        ran dry — a full drain in all but name."""
+        with self._cond:
+            while self._snapshot_waiters and self._error is None:
+                self._cond.wait(timeout=0.1)
+
+    def _drain_item(self, item: Tuple) -> None:
+        """Apply one dequeued batch. Owns the collection's state arrays
+        between dequeue and install; must stay readback-free (TL-BLOCK) —
+        the fused dispatch it calls returns as soon as XLA has enqueued the
+        kernel. EVERYTHING fallible runs inside the error capture: a raise
+        anywhere (donation accounting, dispatch, telemetry) must poison the
+        handle and release waiters, never kill the worker with ``_pending``
+        stuck — block-policy producers and ``flush()`` wait on it."""
+        idx, args, kwargs, nbytes = item
+        # the queue slot freed at q.get(): wake a block-policy producer
+        # parked in _enqueue_lossless NOW, not at the post-dispatch
+        # bookkeeping notify — overlapping the next batch's ingest with
+        # this batch's dispatch is the pipeline's entire point
+        with self._cond:
+            self._cond.notify_all()
+        rec = None
+        t0 = 0.0
+        donated = 0
+        err: Optional[BaseException] = None
+        # a poisoned handle discards instead of half-applying; so does
+        # close(drain=False), whichever thread wins the queue race
+        poisoned = self._error is not None or self._discard
+        if not poisoned:
+            try:
+                rec = _TELEMETRY if _TELEMETRY.enabled else None
+                t0 = time.perf_counter() if rec is not None else 0.0
+                if self._fused.donating:
+                    # the dispatched kernel owns (donates) the current state
+                    # buffers until the new ones are installed below; count
+                    # them as in flight so footprint accounting sees them
+                    donated = self._fused.donated_state_bytes()
+                    with self._cond:
+                        self._in_flight_bytes += donated
+                # exclusive vs compute(): a bounded-staleness snapshot
+                # must never traverse the donation window's dead arrays
+                with self._state_lock:
+                    self._fused.dispatch(args, kwargs)
+            except BaseException as e:  # noqa: BLE001 — re-raised at the call site
+                err = e
+        with self._cond:
+            self._pending -= 1
+            self._in_flight_bytes -= nbytes + donated
+            if err is not None and self._error is None:
+                self._error = (idx, err)
+            if err is None and not poisoned:
+                self._applied += 1
+            depth = self._pending
+            inflight = self._in_flight_bytes
+            self._cond.notify_all()
+        if rec is not None and err is None and not poisoned:
+            try:
+                # no staleness_steps here: that gauge tracks COMPUTE-SNAPSHOT
+                # staleness (the "snapshot" event in _before_compute feeds
+                # it); stamping queue depth into it would report every
+                # drained compute as queue_depth-stale
+                rec.record_async_event(
+                    "dequeue",
+                    batch_index=idx,
+                    queue_depth=depth,
+                    in_flight_bytes=inflight,
+                    dur_ms=round((time.perf_counter() - t0) * 1e3, 4),
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced, not fatal
+                with self._cond:
+                    if self._error is None:
+                        self._error = (idx, e)
+                    self._cond.notify_all()

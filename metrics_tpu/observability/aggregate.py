@@ -53,6 +53,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "compile_counts": dict(rec.compile_counts()),
         "compile_times": dict(rec.compile_times()),
         "fused_update_totals": dict(rec.fused_update_totals()),
+        "async_totals": dict(rec.async_totals()),
         "dropped_events": rec.dropped_events(),
     }
 
@@ -98,9 +99,24 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # extensive, like the call counts they mirror (older payloads from
         # pre-fused ranks simply contribute nothing)
         "fused_update_totals": _merge_sum([p.get("fused_update_totals", {}) for p in payloads]),
+        "async_totals": _merge_async([p.get("async_totals", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
         "processes": list(payloads),
     }
+
+
+#: async-pipeline counter keys that are extensive batch counts (summed);
+#: every other key in the payload is a gauge/high-water mark (maxed)
+_ASYNC_SUM_KEYS = ("enqueued", "applied", "dropped", "flushes")
+
+
+def _merge_async(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Async totals mix extensive counts (batches moved — summed) with
+    gauges and high-water marks (queue depth, staleness, in-flight bytes —
+    maxed, same semantics as the footprint HWMs)."""
+    sums = _merge_sum([{k: v for k, v in m.items() if k in _ASYNC_SUM_KEYS} for m in maps])
+    maxes = _merge_max([{k: v for k, v in m.items() if k not in _ASYNC_SUM_KEYS} for m in maps])
+    return {**maxes, **sums}
 
 
 def aggregate_across_hosts(recorder: Optional[Any] = None) -> Dict[str, Any]:

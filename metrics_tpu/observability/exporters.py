@@ -191,6 +191,59 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             lines.append(
                 f"metrics_tpu_compile_seconds_total{_labels(entry=entry, **proc_label(payload))} {t:.6f}"
             )
+    # disjoint terminal outcomes only (applied + dropped): every accepted-or-
+    # rejected batch lands in exactly one, so sum()/rate() over the family is
+    # meaningful. Ingress (enqueued, a superset of applied) and flush
+    # operations (not batches at all) get their own families.
+    lines.append("# HELP metrics_tpu_async_batches_total Async-pipeline batches by terminal outcome (applied|dropped; disjoint).")
+    lines.append("# TYPE metrics_tpu_async_batches_total counter")
+    for payload in per_proc:
+        totals = payload.get("async_totals", {})
+        for outcome in ("applied", "dropped"):
+            lines.append(
+                f"metrics_tpu_async_batches_total"
+                f"{_labels(outcome=outcome, **proc_label(payload))} {totals.get(outcome, 0)}"
+            )
+    lines.append("# HELP metrics_tpu_async_enqueued_total Batches accepted into the async update queue (ingress; applied is a subset).")
+    lines.append("# TYPE metrics_tpu_async_enqueued_total counter")
+    for payload in per_proc:
+        totals = payload.get("async_totals", {})
+        lines.append(
+            f"metrics_tpu_async_enqueued_total{_labels(**proc_label(payload))}"
+            f" {totals.get('enqueued', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_async_flushes_total Deterministic drains (flush() calls and draining close()).")
+    lines.append("# TYPE metrics_tpu_async_flushes_total counter")
+    for payload in per_proc:
+        totals = payload.get("async_totals", {})
+        lines.append(
+            f"metrics_tpu_async_flushes_total{_labels(**proc_label(payload))}"
+            f" {totals.get('flushes', 0)}"
+        )
+    # each family's HELP/TYPE must sit directly above its own samples: the
+    # exposition format requires all lines of a metric as one contiguous
+    # group, and strict consumers (promtool, OpenMetrics scrapers) reject
+    # interleaved headers
+    for family, key, help_text in (
+        ("metrics_tpu_async_queue_depth", "queue_depth",
+         "Outstanding async batches: accepted but not yet applied, including"
+         " the one in the worker's hand — may exceed the configured queue"
+         " depth by one (last seen / high-water)."),
+        ("metrics_tpu_async_staleness_steps", "staleness_steps",
+         "Compute-snapshot staleness in unapplied batches (last seen / high-water)."),
+        ("metrics_tpu_async_in_flight_bytes", "in_flight_bytes",
+         "Bytes pinned by queued batches and donated in-flight state (last seen / high-water)."),
+    ):
+        lines.append(f"# HELP {family} {help_text}")
+        lines.append(f"# TYPE {family} gauge")
+        for payload in per_proc:
+            totals = payload.get("async_totals", {})
+            lines.append(
+                f"{family}{_labels(window='last', **proc_label(payload))} {totals.get(key, 0)}"
+            )
+            lines.append(
+                f"{family}{_labels(window='max', **proc_label(payload))} {totals.get('max_' + key, 0)}"
+            )
     lines.append("# HELP metrics_tpu_dropped_events_total Events discarded past the buffer cap.")
     lines.append("# TYPE metrics_tpu_dropped_events_total counter")
     lines.append(f"metrics_tpu_dropped_events_total {dropped}")
@@ -246,6 +299,16 @@ def summary(recorder: Optional[Any] = None) -> str:
         f"sync: {sync['sync_events']} events, {sync['gather_bytes']} gather bytes,"
         f" {sync['pad_waste_bytes']} pad-waste bytes"
     )
+    async_totals = rec.async_totals()
+    if async_totals.get("enqueued") or async_totals.get("dropped"):
+        lines.append(
+            f"async pipeline: {async_totals['enqueued']} enqueued,"
+            f" {async_totals['applied']} applied, {async_totals['dropped']} dropped,"
+            f" {async_totals['flushes']} flushes; queue depth max"
+            f" {async_totals['max_queue_depth']}, staleness max"
+            f" {async_totals['max_staleness_steps']} steps, in-flight max"
+            f" {async_totals['max_in_flight_bytes']} bytes"
+        )
     dropped = rec.dropped_events()
     if dropped:
         lines.append(

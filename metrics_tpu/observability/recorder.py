@@ -57,9 +57,33 @@ def current_span_id() -> Optional[int]:
 TELEMETRY_ENV_VAR = "METRICS_TPU_TELEMETRY"
 
 #: core lifecycle event types; auxiliary events ("recompile_warning",
-#: "footprint", "tracker_increment", "span", "compile", "fused_update")
-#: ride the same stream
+#: "footprint", "tracker_increment", "span", "compile", "fused_update",
+#: and the async-pipeline "enqueue"/"dequeue"/"flush") ride the same stream
 EVENT_TYPES = ("update", "compute", "forward", "sync")
+
+#: footprint-HWM label for bytes pinned by the async update pipeline
+#: (queued batch payloads + donated in-flight state buffers) — the memory
+#: ``state_footprint()`` alone undercounts while an update is in flight
+ASYNC_IN_FLIGHT_LABEL = "async_in_flight"
+
+
+def _new_async_totals() -> Dict[str, int]:
+    """Zeroed async-pipeline counters: extensive batch counts (enqueued/
+    applied/dropped/flushes — summed across hosts) plus last-seen and
+    high-water gauges for queue depth, compute staleness, and in-flight
+    bytes."""
+    return {
+        "enqueued": 0,
+        "applied": 0,
+        "dropped": 0,
+        "flushes": 0,
+        "queue_depth": 0,
+        "max_queue_depth": 0,
+        "staleness_steps": 0,
+        "max_staleness_steps": 0,
+        "in_flight_bytes": 0,
+        "max_in_flight_bytes": 0,
+    }
 
 
 def _signature_of(args: Any, kwargs: Any) -> Tuple:
@@ -91,7 +115,19 @@ def _signature_of(args: Any, kwargs: Any) -> Tuple:
 
 
 def _nbytes(value: Any) -> int:
-    """Best-effort nbytes of an array (works on tracers: static shape*itemsize)."""
+    """Best-effort nbytes of an array (works on tracers: static shape*itemsize).
+
+    Deleted arrays count 0: a donated buffer mid-dispatch pins no memory of
+    its own (XLA aliases it into the kernel's output), so counting its
+    metadata nbytes would double-book the bytes the async pipeline already
+    reports as donated in-flight state."""
+    is_deleted = getattr(value, "is_deleted", None)
+    if callable(is_deleted):
+        try:
+            if is_deleted():
+                return 0
+        except Exception:  # noqa: BLE001 — foreign array types may refuse
+            pass
     nb = getattr(value, "nbytes", None)
     if isinstance(nb, int):
         return nb
@@ -158,6 +194,7 @@ class MetricRecorder:
         self._fused_updates = 0
         self._fused_metric_updates = 0
         self._fused_fallback_updates = 0
+        self._async = _new_async_totals()
         # per-thread compute-group attribution: a shared field would let
         # concurrent MetricCollection.update calls cross-attribute events
         self._group_local = threading.local()
@@ -203,6 +240,7 @@ class MetricRecorder:
             self._fused_updates = 0
             self._fused_metric_updates = 0
             self._fused_fallback_updates = 0
+            self._async = _new_async_totals()
             self._group_local = threading.local()
         return self
 
@@ -257,6 +295,13 @@ class MetricRecorder:
                 "fused_metric_updates": self._fused_metric_updates,
                 "fallback_metric_updates": self._fused_fallback_updates,
             }
+
+    def async_totals(self) -> Dict[str, int]:
+        """Async-pipeline counters: batches enqueued/applied/dropped and
+        flush count (extensive), plus last-seen and high-water gauges for
+        queue depth, compute-snapshot staleness, and in-flight bytes."""
+        with self._lock:
+            return dict(self._async)
 
     def dropped_events(self) -> int:
         """Events discarded after the MAX_EVENTS buffer cap (aggregate
@@ -506,6 +551,70 @@ class MetricRecorder:
                 "n_fallback": int(n_fallback),
                 "dur_ms": round(duration_s * 1e3, 4),
             }
+            event.update(extra)
+            self._append(event)
+
+    def record_async_event(
+        self,
+        kind: str,
+        batch_index: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        staleness_steps: Optional[int] = None,
+        in_flight_bytes: Optional[int] = None,
+        dur_ms: Optional[float] = None,
+        **extra: Any,
+    ) -> None:
+        """Record one async-pipeline transition (core/pipeline.py hooks).
+
+        ``kind`` is one of the typed events — ``"enqueue"`` (exactly one per
+        ACCEPTED batch: the per-batch observability contract the guard test
+        in tests/bases/test_pipeline.py pins), ``"dequeue"`` (one per applied
+        batch), ``"flush"`` (one per drain) — or a counter/gauge-only update:
+        ``"drop"`` (a batch the drop policy discarded) and ``"snapshot"``
+        (a bounded-staleness compute), which bump totals without adding an
+        event. In-flight bytes also feed the footprint high-water mark under
+        the ``async_in_flight`` label, so the memory pinned by queued
+        batches and donated in-flight state shows up next to the per-metric
+        state HWMs instead of being invisible exactly when pressure peaks.
+        """
+        with self._lock:
+            totals = self._async
+            if kind == "enqueue":
+                totals["enqueued"] += 1
+            elif kind == "dequeue":
+                totals["applied"] += 1
+            elif kind == "flush":
+                totals["flushes"] += 1
+            elif kind == "drop":
+                totals["dropped"] += 1
+            if queue_depth is not None:
+                totals["queue_depth"] = int(queue_depth)
+                totals["max_queue_depth"] = max(totals["max_queue_depth"], int(queue_depth))
+            if staleness_steps is not None:
+                totals["staleness_steps"] = int(staleness_steps)
+                totals["max_staleness_steps"] = max(
+                    totals["max_staleness_steps"], int(staleness_steps)
+                )
+            if in_flight_bytes is not None:
+                totals["in_flight_bytes"] = int(in_flight_bytes)
+                totals["max_in_flight_bytes"] = max(
+                    totals["max_in_flight_bytes"], int(in_flight_bytes)
+                )
+                if int(in_flight_bytes) > self._footprint_hwm.get(ASYNC_IN_FLIGHT_LABEL, -1):
+                    self._footprint_hwm[ASYNC_IN_FLIGHT_LABEL] = int(in_flight_bytes)
+            if kind in ("drop", "snapshot"):
+                return  # counter/gauge-only: no event in the stream
+            event: Dict[str, Any] = {"type": kind, "t": round(time.time() - self._t0, 6)}
+            if batch_index is not None:
+                event["batch_index"] = int(batch_index)
+            if queue_depth is not None:
+                event["queue_depth"] = int(queue_depth)
+            if staleness_steps is not None:
+                event["staleness_steps"] = int(staleness_steps)
+            if in_flight_bytes is not None:
+                event["in_flight_bytes"] = int(in_flight_bytes)
+            if dur_ms is not None:
+                event["dur_ms"] = dur_ms
             event.update(extra)
             self._append(event)
 

@@ -60,11 +60,23 @@ def load_records(path: str) -> Dict[str, Dict[str, Any]]:
 #: eager, bucketed shapes stop sharing a compile, or the fusibility
 #: manifest stops pre-seeding the probes and cold starts regress) is a
 #: regression even when raw wall throughput still passes
+#: the async ingest bench (``collection_async_update_throughput``) likewise
+#: carries its speedup over the blocking fused loop and the p99 enqueue
+#: latency; async dropping below blocking, or the hot-path enqueue growing a
+#: blocking wait, is a regression even when raw throughput still passes
 AUX_FIELDS: Dict[str, str] = {
     "fused_vs_eager": "higher",
     "bucketed_compiles": "lower",
     "fused_first_batch_ms": "lower",
+    "async_vs_blocking": "higher",
+    "update_async_p99_ms": "lower",
 }
+
+#: boolean invariants gated whenever the CURRENT record carries them — a
+#: bench that reports a false parity bit (async final states diverged from
+#: the blocking path) is broken no matter how fast it ran, and the
+#: ratio/wall checks above would pass it silently
+BOOL_FIELDS: Tuple[str, ...] = ("states_bit_identical",)
 
 
 def _lower_is_better(record: Dict[str, Any]) -> bool:
@@ -88,6 +100,16 @@ def compare(
     notes: List[str] = []
     for name in sorted(set(current) | set(baseline)):
         cur, base = current.get(name), baseline.get(name)
+        # boolean invariants gate on the CURRENT record alone, BEFORE the
+        # both-sides requirement: a brand-new bench (no baseline anchor
+        # committed yet) must still fail on a false parity bit
+        if cur is not None and "error" not in cur:
+            for field in BOOL_FIELDS:
+                flag = cur.get(field)
+                if flag is False:
+                    regressions.append(f"{name}: {field} is false — invariant broken")
+                elif flag is True:
+                    notes.append(f"{name}: {field} ok")
         if cur is None or base is None:
             notes.append(f"{name}: only in {'baseline' if cur is None else 'current'} — skipped")
             continue

@@ -1,0 +1,999 @@
+"""Async double-buffered update pipeline (ISSUE 7 tentpole).
+
+Pins the AsyncUpdateHandle contract: bit-identical final states vs the
+blocking fused path across sum/max/mean/custom reducers, the three
+backpressure policies (block/drop/error), bounded-staleness ``compute()``
+semantics, worker-exception re-raise with the originating batch index,
+``flush()`` idempotence, reset/add_metrics invalidation, no thread leak
+after ``close()``, in-flight byte accounting, and the exactly-one-
+``enqueue``-event-per-accepted-batch observability guard.
+
+Every wait in this file is bounded (handle drains use internal timeouts),
+so a deadlocked queue fails the test instead of hanging tier-1.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import Accuracy, ConfusionMatrix
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.pipeline import AsyncQueueFull, AsyncUpdateHandle, AsyncWorkerError
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+#: per-batch worker delay for the backpressure/staleness tests — long
+#: enough to dominate scheduling jitter, short enough to keep the file fast
+_SLOW = 0.05
+
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.reset()
+
+
+def _cls_batch(rng, n=64, c=3):
+    preds = rng.rand(n, c).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    return jnp.asarray(preds), jnp.asarray(rng.randint(0, c, n))
+
+
+class _MaxAbs(Metric):
+    """max-reduced state."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("biggest", default=jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def _update(self, preds, target):
+        self.biggest = jnp.maximum(self.biggest, jnp.max(jnp.abs(preds)))
+
+    def _compute(self):
+        return self.biggest
+
+
+class _RunningMean(Metric):
+    """mean-reduced state — exercises the in-kernel `_n_updates` bump."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+    def _update(self, preds, target):
+        self.avg = (self.avg + jnp.mean(preds)) / 2
+
+    def _compute(self):
+        return self.avg
+
+
+def _colsum(stacked):
+    return jnp.sum(stacked, axis=0)
+
+
+class _CustomReduced(Metric):
+    """custom-callable reducer over a vector state."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("cols", default=jnp.zeros(3), dist_reduce_fx=_colsum)
+
+    def _update(self, preds, target):
+        self.cols = self.cols + jnp.sum(preds, axis=0)
+
+    def _compute(self):
+        return self.cols
+
+
+class _SlowSum(Metric):
+    """Counts applied batches with a deliberately slow eager update — the
+    controllable consumer for the backpressure and staleness tests."""
+
+    __jit_unsafe__ = True
+
+    def __init__(self, delay=_SLOW):
+        super().__init__()
+        self.delay = delay
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _update(self, preds, target):
+        time.sleep(self.delay)
+        self.total = self.total + 1.0
+
+    def _compute(self):
+        return self.total
+
+
+class _ProbeFail(Metric):
+    """Passes every static fusibility filter (no ``__jit_unsafe__``, no
+    wrapper children, no list state) but fails the runtime ``eval_shape``
+    probe: a host branch on a traced value. The fused path demotes it to
+    the eager fallback — its buffers are never donated."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.zeros(64), dist_reduce_fx="sum")
+
+    def _update(self, preds, target):
+        if float(jnp.max(preds)) >= 0:  # host readback: unfusible
+            self.total = self.total + jnp.sum(preds) + jnp.zeros(64)
+
+    def _compute(self):
+        return self.total
+
+
+class _ExplodingSum(Metric):
+    """Raises when fed the poison marker (first element negative)."""
+
+    __jit_unsafe__ = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _update(self, preds, target):
+        if float(preds.reshape(-1)[0]) < 0:
+            raise ValueError("poison batch")
+        self.total = self.total + 1.0
+
+    def _compute(self):
+        return self.total
+
+
+def _reducer_collection():
+    return MetricCollection(
+        [
+            Accuracy(),
+            ConfusionMatrix(num_classes=3),
+            _MaxAbs(),
+            _RunningMean(),
+            _CustomReduced(),
+        ]
+    )
+
+
+def _state_items(col):
+    for name, m in col.items(keep_base=True):
+        for sname in m._defaults:
+            yield f"{name}.{sname}", np.asarray(getattr(m, sname))
+
+
+# ---------------------------------------------------------------------------
+# parity vs the blocking fused path
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_bit_identical_states_across_reducers(self):
+        rng = np.random.RandomState(0)
+        batches = [_cls_batch(rng) for _ in range(6)]
+        blocking, asynchronous = _reducer_collection(), _reducer_collection()
+        blocking.update(*batches[0])  # discovery
+        asynchronous.update(*batches[0])
+        blocking.compile_update()
+        handle = asynchronous.compile_update_async(queue_depth=2)
+        for b in batches[1:]:
+            blocking.update(*b)
+            assert handle.update_async(*b) is True
+        handle.flush()
+        for (ka, va), (kb, vb) in zip(
+            _state_items(asynchronous), _state_items(blocking)
+        ):
+            assert ka == kb
+            assert np.array_equal(va, vb), f"{ka}: async {va} != blocking {vb}"
+        res_b, res_a = blocking.compute(), asynchronous.compute()
+        assert res_b.keys() == res_a.keys()
+        for key in res_b:
+            assert bool(jnp.array_equal(res_b[key], res_a[key])), key
+        handle.close()
+
+    def test_blocking_update_interleaves_fifo(self):
+        rng = np.random.RandomState(1)
+        batches = [_cls_batch(rng) for _ in range(5)]
+        reference, mixed = _reducer_collection(), _reducer_collection()
+        reference.update(*batches[0])
+        mixed.update(*batches[0])
+        reference.compile_update()
+        handle = mixed.compile_update_async()
+        for i, b in enumerate(batches[1:]):
+            reference.update(*b)
+            if i % 2 == 0:
+                handle.update_async(*b)
+            else:
+                mixed.update(*b)  # routes through the handle, FIFO-ordered
+        handle.flush()
+        for (ka, va), (kb, vb) in zip(_state_items(mixed), _state_items(reference)):
+            assert np.array_equal(va, vb), ka
+        handle.close()
+
+    def test_compute_default_drains_everything(self):
+        rng = np.random.RandomState(2)
+        col = MetricCollection([_SlowSum(delay=0.01)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=4)
+        for _ in range(4):
+            handle.update_async(*_cls_batch(rng))
+        # no explicit flush: default max_staleness=0 drains then computes
+        assert float(col.compute()["_SlowSum"]) == 5.0
+        assert handle.pending == 0
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# backpressure policies
+# ---------------------------------------------------------------------------
+
+class TestBackpressure:
+    def test_block_policy_is_lossless_and_blocks(self):
+        rng = np.random.RandomState(3)
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=1, policy="block")
+        t0 = time.perf_counter()
+        for _ in range(4):
+            handle.update_async(*_cls_batch(rng))
+        elapsed = time.perf_counter() - t0
+        # depth-1 queue + slow worker: the later puts must have waited
+        assert elapsed >= _SLOW, f"update_async never blocked ({elapsed:.3f}s)"
+        handle.flush()
+        assert handle.enqueued == 4
+        assert handle.applied == 4
+        assert handle.dropped == 0
+        assert float(col.compute()["_SlowSum"]) == 5.0
+        handle.close()
+
+    def test_drop_policy_discards_and_counts(self):
+        rng = np.random.RandomState(4)
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=1, policy="drop")
+        accepted = sum(handle.update_async(*_cls_batch(rng)) for _ in range(8))
+        handle.flush()
+        assert accepted < 8, "a depth-1 queue with a slow worker must drop"
+        assert handle.dropped == 8 - accepted
+        assert handle.enqueued == accepted
+        assert handle.applied == accepted
+        # exactly the accepted batches landed in the state (plus discovery)
+        assert float(col.compute()["_SlowSum"]) == accepted + 1
+        handle.close()
+
+    def test_error_policy_raises_queue_full(self):
+        rng = np.random.RandomState(5)
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=1, policy="error")
+        with pytest.raises(AsyncQueueFull):
+            for _ in range(10):
+                handle.update_async(*_cls_batch(rng))
+        handle.flush()  # the accepted prefix still drains cleanly
+        handle.close()
+
+    def test_block_policy_raises_when_worker_dead(self):
+        """A dead worker (realistically: interpreter teardown — every
+        in-loop failure poisons the handle instead) must surface as an
+        error at the producer, never an unbounded queue-slot wait."""
+        from metrics_tpu.core.pipeline import _SHUTDOWN
+
+        rng = np.random.RandomState(34)
+        col = MetricCollection([_SlowSum(delay=0.0)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=1, policy="block")
+        handle.flush()
+        handle._queue.put(_SHUTDOWN)  # kill the worker out-of-band
+        handle._thread.join(timeout=5.0)
+        assert not handle._thread.is_alive()
+        assert handle.update_async(*_cls_batch(rng))  # empty queue: accepted
+        with pytest.raises(MetricsUserError):
+            handle.update_async(*_cls_batch(rng))  # full queue, dead worker
+        # a draining close on the full queue must ALSO not deadlock: the
+        # sentinel put is liveness-guarded (an atexit/finally close() is
+        # exactly where a dead worker shows up)
+        handle.close()
+        assert handle.closed
+
+    def test_invalid_policy_and_depth_rejected(self):
+        col = MetricCollection([Accuracy()])
+        with pytest.raises(ValueError):
+            col.compile_update_async(policy="spill")
+        with pytest.raises(ValueError):
+            col.compile_update_async(queue_depth=0)
+        with pytest.raises(ValueError):
+            col.compile_update_async(max_staleness=-1)
+        # the failed constructions must not leave a live handle behind
+        if col.async_update is not None:
+            col.async_update.close()
+
+
+# ---------------------------------------------------------------------------
+# bounded-staleness compute
+# ---------------------------------------------------------------------------
+
+class TestStaleness:
+    def test_bounded_staleness_returns_early(self):
+        rng = np.random.RandomState(6)
+        delay = 0.1  # big enough that blocking for the full drain (0.6s+)
+        # is clearly separable from the bounded wait (~2 applications plus
+        # at most one in-flight dispatch's state-lock hold plus jitter)
+        col = MetricCollection([_SlowSum(delay=delay)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8, max_staleness=0)
+        for _ in range(6):
+            handle.update_async(*_cls_batch(rng))
+        t0 = time.perf_counter()
+        res = handle.compute(max_staleness=4)
+        t_bounded = time.perf_counter() - t0
+        assert float(res["_SlowSum"]) >= 3.0  # discovery + at least 2 applied
+        assert handle.pending <= 4
+        t1 = time.perf_counter()
+        handle.flush()
+        t_flush = time.perf_counter() - t1
+        # waited for AT MOST (6 - 4) applications, never the full drain:
+        # either the bounded wait released quickly, or — when the whole box
+        # is scheduler-stalled and wall bounds lie — real drain work
+        # demonstrably remained for flush() afterwards. A compute() that
+        # wrongly blocked for the full drain fails BOTH (long wait AND an
+        # instant residual flush).
+        assert t_bounded < 5 * delay or t_flush > delay, (
+            f"bounded compute drained fully"
+            f" (bounded={t_bounded:.3f}s, residual flush={t_flush:.3f}s)"
+        )
+        # the default bound (0) then gives the exact drained answer
+        assert float(handle.compute()["_SlowSum"]) == 7.0
+        assert handle.pending == 0
+        handle.close()
+
+    def test_stale_compute_cache_invalidated_by_inflight_batches(self):
+        """A bounded-staleness compute overlapping in-flight batches must
+        not leave its stale value in the `_computed` cache: each install
+        clears the cache, but a compute FINISHING afterwards writes the old
+        snapshot back with no later update to clear it — the next (drained)
+        compute would then serve the stale answer."""
+
+        class _SlowCompute(Metric):
+            __jit_unsafe__ = True
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def _update(self, preds, target):
+                time.sleep(0.02)
+                self.total = self.total + 1.0
+
+            def _compute(self):
+                snap = self.total  # snapshot BEFORE the slow part
+                time.sleep(0.15)   # batches land while this compute runs
+                return snap
+
+        rng = np.random.RandomState(22)
+        col = MetricCollection([_SlowCompute()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(6):
+            handle.update_async(*_cls_batch(rng))
+        stale = float(handle.compute(max_staleness=4)["_SlowCompute"])
+        assert stale <= 7.0
+        handle.flush()
+        # the drained compute must reflect every batch, not the cache
+        assert float(col.compute()["_SlowCompute"]) == 7.0
+        handle.close()
+
+    def test_compute_never_overlaps_inflight_dispatch(self):
+        """On donating backends a dispatch's old state buffers are dead
+        until the new ones are installed — reading them raises, it does not
+        return stale values. A bounded-staleness compute() whose bound is
+        already satisfied must therefore still wait out an in-flight
+        dispatch's ownership window (stale reads are allowed, deleted reads
+        are not)."""
+        rng = np.random.RandomState(30)
+        col = MetricCollection([Accuracy()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=4, max_staleness=8)
+        in_dispatch = threading.Event()
+        release = threading.Event()
+        real = handle._fused.dispatch
+
+        def gated(args, kwargs):
+            in_dispatch.set()
+            assert release.wait(5), "test gate never released"
+            real(args, kwargs)
+
+        handle._fused.dispatch = gated
+        try:
+            handle.update_async(*_cls_batch(rng))
+            assert in_dispatch.wait(5)
+            # pending (1) is already within the bound (8): compute must
+            # block on the dispatch window, not interleave with it
+            out = {}
+            t = threading.Thread(target=lambda: out.setdefault("res", col.compute()))
+            t.start()
+            t.join(0.3)
+            assert t.is_alive(), "compute() overlapped an in-flight dispatch"
+            release.set()
+            t.join(5)
+            assert not t.is_alive() and "res" in out
+        finally:
+            release.set()
+            handle._fused.dispatch = real
+        handle.flush()
+        handle.close()
+
+    def test_stale_handle_compute_rejected(self):
+        # the collection consults ITS current handle for the staleness
+        # bound; a per-call override on a replaced handle would be silently
+        # ignored and hand back a staler snapshot than the caller asked for
+        rng = np.random.RandomState(43)
+        col = MetricCollection([_SlowSum(delay=0.0)])
+        col.update(*_cls_batch(rng))
+        h1 = col.compile_update_async()
+        h2 = col.compile_update_async()  # drains + replaces h1
+        with pytest.raises(MetricsUserError):
+            h1.compute(max_staleness=0)
+        assert "_SlowSum" in h2.compute()
+        h2.close()
+        with pytest.raises(MetricsUserError):
+            h2.compute()  # closed is stale too
+
+    def test_negative_bound_rejected(self):
+        rng = np.random.RandomState(7)
+        col = MetricCollection([Accuracy()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        with pytest.raises(ValueError):
+            handle.compute(max_staleness=-2)
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# worker-exception propagation
+# ---------------------------------------------------------------------------
+
+class TestWorkerErrors:
+    def _poison_batch(self, rng):
+        preds, target = _cls_batch(rng)
+        return preds.at[0, 0].set(-1.0), target
+
+    def test_reraise_with_batch_index_and_cause(self):
+        rng = np.random.RandomState(8)
+        col = MetricCollection([_ExplodingSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        # the error surfaces at the NEXT call site after the worker hits the
+        # poison — usually flush(), but a fast worker may beat a later
+        # enqueue to it; both are the documented contract
+        with pytest.raises(AsyncWorkerError) as err:
+            for i in range(5):
+                batch = self._poison_batch(rng) if i == 3 else _cls_batch(rng)
+                handle.update_async(*batch)
+            handle.flush()
+        assert err.value.batch_index == 3
+        assert isinstance(err.value.__cause__, ValueError)
+        # sticky poison: the next ingest raises too, and queued batches
+        # after the failure were discarded, never half-applied
+        with pytest.raises(AsyncWorkerError):
+            handle.update_async(*_cls_batch(rng))
+        assert handle.applied == 3
+        handle.close()
+
+    def test_compute_also_reraises(self):
+        rng = np.random.RandomState(9)
+        col = MetricCollection([_ExplodingSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        handle.update_async(*self._poison_batch(rng))
+        with pytest.raises(AsyncWorkerError):
+            col.compute()
+        handle.close()
+
+    def test_recompile_surfaces_pending_worker_error(self):
+        rng = np.random.RandomState(38)
+        col = MetricCollection([_ExplodingSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        handle.update_async(*self._poison_batch(rng))
+        deadline = time.monotonic() + 5
+        while handle.pending and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # periodic re-compile without reset(): the captured error must
+        # surface here, not vanish into a close() that never raises while
+        # the poisoned worker silently discards the queued batches
+        with pytest.raises(AsyncWorkerError) as err:
+            col.compile_update_async()
+        assert err.value.batch_index == 0
+        # reset() is the documented recovery: discard, then re-arm cleanly
+        col.reset()
+        h2 = col.compile_update_async()
+        assert h2 is not handle and not h2.closed
+        h2.close()
+
+
+# ---------------------------------------------------------------------------
+# flush / close / lifecycle invalidation
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_flush_is_idempotent(self):
+        rng = np.random.RandomState(10)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        for _ in range(3):
+            handle.update_async(*_cls_batch(rng))
+        assert handle.flush() >= 0
+        assert handle.flush() == 0  # drained: returns immediately
+        assert handle.flush() == 0
+        assert handle.applied == 3
+        handle.close()
+
+    def test_no_thread_leak_after_close(self):
+        rng = np.random.RandomState(11)
+        before = threading.active_count()
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        assert threading.active_count() == before + 1
+        handle.update_async(*_cls_batch(rng))
+        handle.close()
+        assert threading.active_count() == before
+        handle.close()  # idempotent
+        assert threading.active_count() == before
+
+    def test_close_drains_by_default(self):
+        rng = np.random.RandomState(12)
+        col = MetricCollection([_SlowSum(delay=0.01)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(4):
+            handle.update_async(*_cls_batch(rng))
+        handle.close()  # drain=True
+        assert handle.applied == 4
+        assert float(col.compute()["_SlowSum"]) == 5.0
+
+    def test_worker_discards_when_flagged(self):
+        """close(drain=False) may lose the queue race to the worker; the
+        worker must then discard the item it won, never apply it — queued
+        batches landing on reset/add_metrics would be nondeterministic."""
+        rng = np.random.RandomState(32)
+        col = MetricCollection([_SlowSum(delay=0.0)])
+        col.update(*_cls_batch(rng))
+        before = float(col.compute()["_SlowSum"])
+        handle = col.compile_update_async(queue_depth=4)
+        handle._discard = True  # the close(drain=False) race window
+        handle.update_async(*_cls_batch(rng))
+        handle.flush()
+        assert handle.applied == 0
+        handle._discard = False
+        assert float(col.compute()["_SlowSum"]) == before
+        handle.close()
+
+    def test_abandoned_handle_does_not_leak_worker(self):
+        """A handle dropped WITHOUT close() must not be pinned forever by
+        its own parked worker: the thread holds only a weakref, and a GC
+        finalizer wakes the ``queue.get()`` park so it exits — N abandoned
+        per-job collections would otherwise leak N daemon threads plus
+        every collection's device state."""
+        import gc
+
+        rng = np.random.RandomState(33)
+        before = threading.active_count()
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        handle.update_async(*_cls_batch(rng))
+        handle.flush()
+        thread = handle._thread
+        del handle, col  # abandoned: no close(), refs dropped
+        gc.collect()
+        thread.join(timeout=5.0)
+        assert not thread.is_alive()
+        assert threading.active_count() == before
+
+    def test_closed_handle_rejects_updates(self):
+        rng = np.random.RandomState(13)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        handle.close()
+        with pytest.raises(MetricsUserError):
+            handle.update_async(*_cls_batch(rng))
+        # the collection falls back to the blocking fused path
+        col.update(*_cls_batch(rng))
+
+    def test_reset_invalidates_and_discards(self):
+        rng = np.random.RandomState(14)
+        before = threading.active_count()
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(4):
+            handle.update_async(*_cls_batch(rng))
+        col.reset()
+        assert col.async_update is None
+        assert handle.closed
+        assert threading.active_count() == before
+        with pytest.raises(MetricsUserError):
+            handle.update_async(*_cls_batch(rng))
+        # states are pristine: only post-reset updates count
+        col.update(*_cls_batch(rng))
+        assert float(col.compute()["_SlowSum"]) == 1.0
+
+    def test_add_metrics_invalidates(self):
+        rng = np.random.RandomState(15)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        col.add_metrics({"extra": _SlowSum(delay=0.0)})
+        assert col.async_update is None
+        assert handle.closed
+        assert col.fused_update is None  # same invalidation as compile_update
+
+    def test_clone_drops_handle(self):
+        rng = np.random.RandomState(16)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        clone = col.clone(prefix="c_")
+        assert clone.async_update is None
+        assert clone.fused_update is None
+        clone.update(*_cls_batch(rng))  # eager path works on the clone
+        handle.close()
+
+    def test_setitem_invalidates_handles(self):
+        # mc["name"] = metric is the dict-style membership change: it must
+        # invalidate exactly like add_metrics(), or the worker keeps
+        # writing through the stale fused kernel in the background
+        rng = np.random.RandomState(41)
+        col = MetricCollection([_SlowSum(delay=0.0)])
+        col.update(*_cls_batch(rng))  # discovers groups for the old set
+        handle = col.compile_update_async()
+        col["extra"] = _MaxAbs()
+        assert handle.closed
+        assert col.async_update is None and col.fused_update is None
+        with pytest.raises(MetricsUserError):
+            col.update_async(*_cls_batch(rng))
+        # the compute groups were reseeded, NOT merged from the pre-insert
+        # set: the new member must keep receiving updates after rediscovery
+        col.update(*_cls_batch(rng))  # re-discovery pass
+        col.update(*_cls_batch(rng))  # grouped pass
+        assert any("extra" in cg for cg in col.compute_groups.values())
+        assert float(col.compute()["extra"]) > 0.0
+
+    def test_compile_update_config_change_rejected_while_async_open(self):
+        # a config-changing rebuild under a live worker would install a
+        # second fused handle the async path never routes to (and racing
+        # dispatches on the same state arrays); same-config warm reuse is
+        # fine, and a closed handle lifts the restriction
+        rng = np.random.RandomState(44)
+        col = MetricCollection([_SlowSum(delay=0.0)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        assert col.compile_update() is col.fused_update  # matching config
+        with pytest.raises(MetricsUserError):
+            col.compile_update(use_manifest=False)
+        handle.close()
+        assert col.compile_update(use_manifest=False) is col.fused_update
+
+    def test_update_async_without_handle_raises(self):
+        col = _reducer_collection()
+        # same typed misuse error as the handle's own methods, so callers
+        # can catch the package's user-error type uniformly
+        with pytest.raises(MetricsUserError):
+            col.update_async(jnp.zeros((2, 3)), jnp.zeros(2, jnp.int32))
+
+    def test_epoch_resume_reuses_warm_fused_handle(self):
+        # reset(); compile_update_async() must NOT discard the warm compile
+        # cache — an epoch loop would otherwise pay a fresh XLA build of the
+        # fused kernel every epoch while the blocking path resumed for free
+        rng = np.random.RandomState(30)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        h1 = col.compile_update_async()
+        fused1 = col.fused_update
+        h1.update_async(*_cls_batch(rng))
+        col.reset()
+        h2 = col.compile_update_async()
+        assert h2 is not h1 and h1.closed
+        assert col.fused_update is fused1
+        h2.update_async(*_cls_batch(rng))
+        h2.flush()
+        h2.close()
+        # a runtime stale-manifest demotion flips the live flag but not the
+        # REQUEST: warm reuse must keep matching, or every epoch rebuilds a
+        # fresh manifest-trusting handle that re-hits the stale manifest
+        fused1._use_manifest = False
+        assert col.compile_update() is fused1
+        # a different requested config is a real rebuild, never a stale reuse
+        f2 = col.compile_update(use_manifest=False)
+        assert f2 is not fused1
+
+
+# ---------------------------------------------------------------------------
+# in-flight byte accounting (the state_footprint undercount fix)
+# ---------------------------------------------------------------------------
+
+class TestInFlightAccounting:
+    def test_deleted_arrays_pin_no_footprint(self):
+        # a donated buffer mid-dispatch is DELETED (XLA aliases it into the
+        # kernel output) — its metadata nbytes must count 0, or
+        # total_state_bytes() double-books the bytes the handle already
+        # reports as donated in-flight state
+        from metrics_tpu.observability.recorder import _nbytes
+
+        x = jnp.arange(16, dtype=jnp.float32)
+        assert _nbytes(x) == 64
+        x.delete()
+        assert _nbytes(x) == 0
+
+    def test_total_state_bytes_includes_queued_batches(self):
+        rng = np.random.RandomState(17)
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        base = col.total_state_bytes()
+        handle = col.compile_update_async(queue_depth=8)
+        batch = _cls_batch(rng)
+        batch_bytes = sum(int(np.asarray(b).nbytes) for b in batch)
+        for _ in range(3):
+            handle.update_async(*batch)
+        inflated = col.total_state_bytes()
+        assert handle.in_flight_bytes >= batch_bytes  # >=1 batch still queued
+        assert inflated >= base + handle.in_flight_bytes - 1
+        handle.flush()
+        assert handle.in_flight_bytes == 0
+        assert col.total_state_bytes() == base
+        handle.close()
+
+    def test_donated_state_bytes_dedups_groups_and_skips_eager(self):
+        from metrics_tpu.classification import Precision, Recall
+
+        rng = np.random.RandomState(29)
+        col = MetricCollection(
+            [
+                Precision(num_classes=3, average="macro"),
+                Recall(num_classes=3, average="macro"),
+                _SlowSum(delay=0.0),  # jit-unsafe: buffers never donated
+            ]
+        )
+        col.update(*_cls_batch(rng))  # group discovery
+        fused = col.compile_update()
+        assert col._groups_checked and any(len(cg) > 1 for cg in col._groups.values())
+        donated = fused.donated_state_bytes()
+        leaders = [cg[0] for cg in col._groups.values()]
+        expect = sum(
+            col._metrics[n].total_state_bytes()
+            for n in leaders
+            if not getattr(col._metrics[n], "__jit_unsafe__", False)
+        )
+        assert donated == expect
+        # strictly less than the naive per-metric sum the worker used to
+        # book: group members would double-count the leader's arrays and
+        # the eager member's buffers are never owned by the kernel
+        assert donated < sum(m.total_state_bytes() for m in col.values())
+
+    def test_donated_state_bytes_excludes_probe_failed_members(self):
+        """A member that passes the static filters but fails the runtime
+        eval_shape probe updates eagerly — its buffers stay alive through
+        the whole batch, so counting them as dispatch-owned would book the
+        same bytes twice (live state + donated in-flight) on every batch."""
+        rng = np.random.RandomState(39)
+        col = MetricCollection([_MaxAbs(), _ProbeFail()])
+        col.update(*_cls_batch(rng))  # group discovery
+        fused = col.compile_update()
+        naive = fused.donated_state_bytes()  # probe hasn't run yet
+        col.update(*_cls_batch(rng))  # fused path probes, demotes _ProbeFail
+        assert "_ProbeFail" in fused._eager_names
+        donated = fused.donated_state_bytes()
+        assert donated == naive - col._metrics["_ProbeFail"].total_state_bytes()
+        assert donated == col._metrics["_MaxAbs"].total_state_bytes()
+
+    def test_footprint_hwm_carries_async_label(self, recorder):
+        from metrics_tpu.observability.recorder import ASYNC_IN_FLIGHT_LABEL
+
+        rng = np.random.RandomState(18)
+        col = MetricCollection([_SlowSum(delay=0.01)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=4)
+        for _ in range(4):
+            handle.update_async(*_cls_batch(rng))
+        handle.flush()
+        hwm = recorder.footprint_high_water_marks()
+        assert hwm.get(ASYNC_IN_FLIGHT_LABEL, 0) > 0
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# observability guard
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_exactly_one_enqueue_event_per_accepted_batch(self, recorder):
+        rng = np.random.RandomState(19)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=2)
+        n = 5
+        for _ in range(n):
+            handle.update_async(*_cls_batch(rng))
+        handle.flush()
+        events = recorder.events()
+        assert sum(1 for e in events if e["type"] == "enqueue") == n
+        assert sum(1 for e in events if e["type"] == "dequeue") == n
+        assert sum(1 for e in events if e["type"] == "flush") >= 1
+        totals = recorder.async_totals()
+        assert totals["enqueued"] == n
+        assert totals["applied"] == n
+        assert totals["dropped"] == 0
+        assert totals["max_in_flight_bytes"] > 0
+        handle.close()
+
+    def test_dropped_batches_counted_not_evented(self, recorder):
+        rng = np.random.RandomState(20)
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=1, policy="drop")
+        accepted = sum(handle.update_async(*_cls_batch(rng)) for _ in range(8))
+        handle.flush()
+        events = recorder.events()
+        assert sum(1 for e in events if e["type"] == "enqueue") == accepted
+        totals = recorder.async_totals()
+        assert totals["dropped"] == 8 - accepted
+        assert totals["dropped"] > 0
+        handle.close()
+
+    def test_dropped_batch_index_never_reused(self, recorder):
+        """A dropped batch consumes its index (monotonic attempt counter):
+        an operator correlating the event stream must never see one
+        batch_index both dropped and applied."""
+        rng = np.random.RandomState(35)
+        col = MetricCollection([_SlowSum(delay=0.0)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=1, policy="drop")
+        in_dispatch = threading.Event()
+        release = threading.Event()
+        real = handle._fused.dispatch
+
+        def gated(args, kwargs):
+            in_dispatch.set()
+            assert release.wait(5), "test gate never released"
+            return real(args, kwargs)
+
+        handle._fused.dispatch = gated
+        assert handle.update_async(*_cls_batch(rng))  # idx 0: worker takes it
+        assert in_dispatch.wait(5)
+        assert handle.update_async(*_cls_batch(rng))  # idx 1: queued (full)
+        assert not handle.update_async(*_cls_batch(rng))  # idx 2: dropped
+        release.set()
+        handle.flush()
+        assert handle.update_async(*_cls_batch(rng))  # idx 3, NOT a reused 2
+        handle.flush()
+        events = recorder.events()
+        enq = [e["batch_index"] for e in events if e["type"] == "enqueue"]
+        deq = [e["batch_index"] for e in events if e["type"] == "dequeue"]
+        assert enq == [0, 1, 3] == deq  # the dropped batch consumed index 2
+        assert handle.dropped == 1 and handle.enqueued == 3
+        handle.close()
+
+    def test_discard_close_is_not_a_flush(self, recorder):
+        rng = np.random.RandomState(31)
+        col = MetricCollection([_SlowSum()])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        handle.update_async(*_cls_batch(rng))
+        handle.flush()
+        assert recorder.async_totals()["flushes"] == 1
+        # per-batch blocking updates drain but are NOT epoch-boundary
+        # flushes — counting them would make the counter track batch count
+        col.update(*_cls_batch(rng))
+        assert recorder.async_totals()["flushes"] == 1
+        handle.update_async(*_cls_batch(rng))
+        # reset() -> close(drain=False): batches are DISCARDED, so counting
+        # it as a flush would report deterministic drains that never happened
+        col.reset()
+        assert recorder.async_totals()["flushes"] == 1
+        # a draining close IS a deterministic drain and does count
+        h2 = col.compile_update_async()
+        h2.close(drain=True)
+        assert recorder.async_totals()["flushes"] == 2
+
+    def test_prometheus_and_aggregate_carry_async_counters(self, recorder):
+        from metrics_tpu.observability import aggregate_across_hosts
+
+        rng = np.random.RandomState(21)
+        col = _reducer_collection()
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async()
+        handle.update_async(*_cls_batch(rng))
+        handle.flush()
+        page = recorder.render_prometheus()
+        # terminal outcomes stay disjoint (applied|dropped); ingress and
+        # flush operations are their own families so sum() over the batch
+        # family never double-counts
+        assert 'metrics_tpu_async_batches_total{outcome="applied"} 1' in page
+        assert 'outcome="enqueued"' not in page
+        assert 'outcome="flushes"' not in page
+        assert "metrics_tpu_async_enqueued_total 1" in page
+        assert "metrics_tpu_async_flushes_total 1" in page
+        assert "metrics_tpu_async_queue_depth" in page
+        assert "metrics_tpu_async_in_flight_bytes" in page
+        agg = aggregate_across_hosts(recorder)
+        assert agg["async_totals"]["enqueued"] == 1
+        assert agg["async_totals"]["applied"] == 1
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / copy guards — state access drains the open handle
+# ---------------------------------------------------------------------------
+
+class TestStateAccessGuards:
+    def test_state_dict_drains_open_handle(self):
+        """A mid-epoch checkpoint must include every accepted batch — and on
+        a donating backend, must not serialize the dispatch window's dead
+        arrays ('Array has been deleted')."""
+        rng = np.random.RandomState(36)
+        col = MetricCollection([_SlowSum(delay=0.02)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(4):
+            handle.update_async(*_cls_batch(rng))
+        sd = col.state_dict()
+        assert handle.pending == 0
+        assert float(np.asarray(sd["_SlowSum.total"])) == 5.0
+        handle.close()
+
+    def test_load_state_dict_applies_queued_batches_first(self):
+        """Accepted-but-queued batches land on the OLD state before the load
+        replaces it — the ordering a blocking loop would have produced; a
+        stale batch applied on top of freshly loaded state is corruption."""
+        rng = np.random.RandomState(37)
+        clean = MetricCollection([_SlowSum(delay=0.0)]).state_dict()
+        col = MetricCollection([_SlowSum(delay=0.02)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(3):
+            handle.update_async(*_cls_batch(rng))
+        col.load_state_dict(clean)
+        assert handle.pending == 0  # drained BEFORE the load, not after
+        assert float(col.compute()["_SlowSum"]) == 0.0
+        handle.close()
+
+    def test_to_device_and_set_dtype_drain(self):
+        # both replace every state array: queued batches must land on the
+        # pre-move state, never race the worker's donation window
+        import jax
+
+        rng = np.random.RandomState(42)
+        col = MetricCollection([_SlowSum(delay=0.02)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(3):
+            handle.update_async(*_cls_batch(rng))
+        col.set_dtype(jnp.float32)
+        assert handle.pending == 0
+        for _ in range(2):
+            handle.update_async(*_cls_batch(rng))
+        col.to_device(jax.devices()[0])
+        assert handle.pending == 0
+        assert float(col.compute()["_SlowSum"]) == 6.0
+        handle.close()
+
+    def test_clone_drains_open_handle(self):
+        rng = np.random.RandomState(40)
+        col = MetricCollection([_SlowSum(delay=0.02)])
+        col.update(*_cls_batch(rng))
+        handle = col.compile_update_async(queue_depth=8)
+        for _ in range(3):
+            handle.update_async(*_cls_batch(rng))
+        mc = col.clone()
+        # the copy carries every accepted batch and no live handle/thread
+        assert mc.async_update is None
+        assert float(mc.compute()["_SlowSum"]) == 4.0
+        handle.close()

@@ -487,6 +487,105 @@ def f():
 
 
 # ---------------------------------------------------------------------------
+# TL-BLOCK
+# ---------------------------------------------------------------------------
+
+class TestBlockRule:
+    def test_item_in_pipeline_worker_flags(self):
+        kept, _ = _check(
+            """
+class H:
+    def _worker(self):
+        while True:
+            batch = self._queue.get()
+            self.total = batch.item()
+""",
+            relpath="core/pipeline.py",
+        )
+        assert "TL-BLOCK" in _rules_of(kept)
+
+    def test_block_until_ready_in_async_function_flags_anywhere(self):
+        kept, _ = _check(
+            """
+def send_async(preds):
+    preds.block_until_ready()
+    return preds
+""",
+            relpath="classification/accuracy.py",
+        )
+        assert "TL-BLOCK" in _rules_of(kept)
+
+    def test_device_get_in_enqueue_path_flags(self):
+        kept, _ = _check(
+            """
+class H:
+    def _enqueue(self, batch):
+        host = jax.device_get(batch)
+        self._queue.put(host)
+""",
+            relpath="core/pipeline.py",
+        )
+        assert "TL-BLOCK" in _rules_of(kept)
+
+    def test_float_on_batch_value_in_update_async_flags(self):
+        kept, _ = _check(
+            """
+def update_async(self, preds):
+    return float(jnp.sum(preds))
+""",
+            relpath="core/pipeline.py",
+        )
+        assert "TL-BLOCK" in _rules_of(kept)
+
+    def test_non_hot_function_in_pipeline_passes(self):
+        # flush() is the sanctioned drain point: blocking there is the API
+        kept, _ = _check(
+            """
+class H:
+    def flush(self, value):
+        return float(value)
+""",
+            relpath="core/pipeline.py",
+        )
+        assert "TL-BLOCK" not in _rules_of(kept)
+
+    def test_host_scalar_cast_passes(self):
+        # int() on a host constant is not a readback even on the hot path
+        kept, _ = _check(
+            """
+def update_async(self, preds):
+    depth = int(2)
+    self._queue.put((depth, preds))
+""",
+            relpath="core/pipeline.py",
+        )
+        assert "TL-BLOCK" not in _rules_of(kept)
+
+    def test_worker_outside_pipeline_not_scoped(self):
+        # the worker/enqueue name tokens only bind inside core/pipeline.py
+        kept, _ = _check(
+            """
+class Exporter:
+    def _worker(self):
+        return self._value.item()
+""",
+            relpath="observability/exporters.py",
+        )
+        assert "TL-BLOCK" not in _rules_of(kept)
+
+    def test_pragma_suppresses_block(self):
+        kept, suppressed = _check(
+            """
+def update_async(self, preds):
+    return preds.item()  # tracelint: disable=TL-BLOCK — documented cold path
+""",
+            relpath="core/pipeline.py",
+        )
+        assert "TL-BLOCK" not in _rules_of(kept)
+        assert "TL-BLOCK" in _rules_of(suppressed)
+
+
+# ---------------------------------------------------------------------------
 # suppression pragmas
 # ---------------------------------------------------------------------------
 
@@ -702,6 +801,7 @@ class TestPackageGate:
             "TL-PRINT",
             "TL-DECL",
             "TL-FLOW",
+            "TL-BLOCK",
         }
 
     def test_cli_script_exits_zero_on_package(self):
