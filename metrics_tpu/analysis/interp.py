@@ -1319,12 +1319,23 @@ class StateEntry:
     dtype: Optional[str]
     dist_reduce_fx: Optional[str]  # "sum"/"mean"/... | "custom" | None
 
+    @property
+    def sliceable(self) -> bool:
+        """Whether the leaf admits an exact slice-axis scatter: a
+        ``sum``/``max``/``min`` reducer over an array state maps onto
+        ``segment_sum`` / scatter-max / scatter-min along a leading ``[S]``
+        dimension (``metrics_tpu/sliced/``); mean/cat/custom/None reducers
+        and list states have no per-slice decomposition, and an unknown
+        container is conservatively not sliceable."""
+        return self.container == _CONTAINER_ARRAY and self.dist_reduce_fx in _SLICEABLE_REDUCERS
+
     def to_dict(self) -> Dict[str, object]:
         return {
             "container": self.container,
             "shape": self.shape,
             "dtype": self.dtype,
             "dist_reduce_fx": self.dist_reduce_fx,
+            "sliceable": self.sliceable,
         }
 
 
@@ -1410,6 +1421,9 @@ def _infer_default(
 
 
 _STRING_REDUCERS = {"sum", "mean", "max", "min", "cat"}
+
+#: reducers with an exact slice-axis scatter (see StateEntry.sliceable)
+_SLICEABLE_REDUCERS = {"sum", "max", "min"}
 
 
 def _reducer_of(call: ast.Call) -> Optional[str]:

@@ -95,6 +95,11 @@ _STATE_WRITE_METHODS = {"__init__", "set_dtype", "to_device", "shard_states", "s
 #: round-trip (shape/dtype-derived control flow compiles away)
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
 
+#: jnp/np module members that are host-static METADATA predicates, not
+#: array producers: branching on `jnp.issubdtype(x.dtype, ...)` or comparing
+#: `jnp.result_type(...)`s compiles away exactly like a `.dtype` read
+_STATIC_MODULE_CALLS = {"issubdtype", "result_type"}
+
 #: builtins whose results are host/static values, not traced reads
 _STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "type", "range", "enumerate", "zip"}
 
@@ -287,15 +292,19 @@ class _TracedNames:
                 return False
             # a jnp.* call produces a traced array by construction — whether
             # spelled via the module alias or a direct member import
-            # (`from jax.numpy import concatenate`)
+            # (`from jax.numpy import concatenate`) — EXCEPT the dtype/shape
+            # metadata predicates, which are host-static by definition
             if (
                 isinstance(func, ast.Attribute)
                 and isinstance(func.value, ast.Name)
                 and func.value.id in self.ctx.jnp_aliases
             ):
-                return True
+                return func.attr not in _STATIC_MODULE_CALLS
             if isinstance(func, ast.Name) and func.id in self.ctx.jnp_member_imports:
-                return True
+                # the member-import spelling must exempt the same static
+                # predicates as the alias spelling, keyed on the ORIGINAL
+                # member name (`from jax.numpy import issubdtype as isd`)
+                return self.ctx.jnp_member_imports[func.id] not in _STATIC_MODULE_CALLS
             # a method on a traced object (x.astype, x.at[...].set) is traced;
             # any OTHER call (host helper) breaks taint on purpose
             if isinstance(func, ast.Attribute) and self.mentions(func.value):

@@ -42,6 +42,15 @@ _ADDITIVE_AUG_OPS = (ast.Add, ast.Sub)
 _EXTREMUM_FNS = {"maximum", "minimum", "max", "min"}
 _ADD_METHOD_NAMES = {"add"}  # self.x.at[idx].add(v)
 
+#: slice-axis scatter reducers (metrics_tpu/sliced/): a `segment_sum` of
+#: per-row deltas combined with the prior value IS additive accumulation,
+#: and `segment_max`/`segment_min` results folded through the matching
+#: extremum are extremum-consistent — but a scatter-EXTREMUM write
+#: (`self.x.at[ids].max(v)`, or a segment_max folded into a sum leaf)
+#: silently breaks the additivity the cross-rank sum relies on
+_SEGMENT_EXTREMUM_FNS = {"segment_max": "max", "segment_min": "min"}
+_SCATTER_EXTREMUM_METHODS = {"max": "max", "min": "min"}
+
 
 @dataclass(frozen=True)
 class FlowFinding:
@@ -126,6 +135,50 @@ def _is_extremum_rhs(rhs: ast.AST, attr: str) -> bool:
     return any(_mentions_self_attr(a, attr) for a in rhs.args)
 
 
+def _scatter_extremum_kind(rhs: ast.AST, attr: str) -> Optional[str]:
+    """``"max"``/``"min"`` when the RHS is a slice-axis scatter-extremum
+    over ``self.<attr>`` — ``self.x.at[ids].max(v)`` / ``.min(v)``, or a
+    ``segment_max``/``segment_min`` call anywhere in an expression that
+    also reads the prior value (``jnp.maximum(self.x, segment_max(...))``
+    is caught by the top-level extremum check; this covers the ``.at``
+    scatter spelling that check cannot see)."""
+    if (
+        isinstance(rhs, ast.Call)
+        and isinstance(rhs.func, ast.Attribute)
+        and rhs.func.attr in _SCATTER_EXTREMUM_METHODS
+        and _mentions_self_attr(rhs.func.value, attr)
+    ):
+        return _SCATTER_EXTREMUM_METHODS[rhs.func.attr]
+    return None
+
+
+def _segment_extremum_name(rhs: ast.AST) -> Optional[str]:
+    """The first ``segment_max``/``segment_min`` call name inside ``rhs``."""
+    for sub in ast.walk(rhs):
+        if isinstance(sub, ast.Call):
+            name = _last_call_name(sub)
+            if name in _SEGMENT_EXTREMUM_FNS:
+                return name
+    return None
+
+
+def _additive_segment_extremum(rhs: ast.AST) -> Optional[str]:
+    """The ``segment_max``/``segment_min`` call name when it is a TOP-LEVEL
+    additive operand (``self.x + segment_max(...)``): summing a scattered
+    extremum reads the prior value, so the overwrite check passes it, yet
+    the accumulated quantity is an extremum — not additive across ranks.
+    Only the direct-operand shape is flagged; an extremum buried deeper
+    (e.g. an indicator derived from one) may legitimately be additive."""
+    if not (isinstance(rhs, ast.BinOp) and isinstance(rhs.op, _ADDITIVE_AUG_OPS)):
+        return None
+    for side in (rhs.left, rhs.right):
+        if isinstance(side, ast.Call):
+            name = _last_call_name(side)
+            if name in _SEGMENT_EXTREMUM_FNS:
+                return name
+    return None
+
+
 def _is_additive_rhs(rhs: ast.AST, attr: str) -> bool:
     """Additive accumulation forms: ``self.x + e`` / ``e + self.x`` /
     ``self.x - e`` (top-level BinOp) or ``self.x.at[...].add(...)``."""
@@ -188,7 +241,29 @@ def _check_update_writes(
 
         if reducer == "sum":
             if kind == "assign":
-                if rhs is not None and _is_extremum_rhs(rhs, attr):
+                scatter = _scatter_extremum_kind(rhs, attr) if rhs is not None else None
+                seg_add = _additive_segment_extremum(rhs) if rhs is not None else None
+                if seg_add is not None:
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"sum\"`-reduced state `{attr}` accumulates a `{seg_add}` "
+                        f"result in `{method.name}`; a scattered extremum summed into "
+                        "the state is not additive across ranks — segment-SUM the "
+                        "per-slice deltas, or declare the state "
+                        '`dist_reduce_fx="max"/"min"` and fold through the extremum',
+                    )
+                elif scatter is not None:
+                    seg = _segment_extremum_name(rhs)
+                    spelled = f"`segment_{scatter}`" if seg else f"`.at[...].{scatter}(...)`"
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"sum\"`-reduced state `{attr}` updated with a slice-axis "
+                        f"scatter-extremum ({spelled}) in `{method.name}`; scattered "
+                        "extrema are not additive across ranks — declare the state "
+                        '`dist_reduce_fx="max"/"min"` or segment-SUM the per-slice '
+                        "deltas instead",
+                    )
+                elif rhs is not None and _is_extremum_rhs(rhs, attr):
                     yield FlowFinding(
                         stmt,
                         f"`\"sum\"`-reduced state `{attr}` updated with an extremum "
@@ -215,6 +290,9 @@ def _check_update_writes(
             additive = (kind in ("Add", "Sub")) or (
                 kind == "assign" and rhs is not None and _is_additive_rhs(rhs, attr)
             )
+            scatter = (
+                _scatter_extremum_kind(rhs, attr) if kind == "assign" and rhs is not None else None
+            )
             if additive:
                 yield FlowFinding(
                     stmt,
@@ -222,6 +300,16 @@ def _check_update_writes(
                     f"`{method.name}`; an extremum-reduced leaf must be updated with "
                     f"`jnp.{'maximum' if reducer == 'max' else 'minimum'}(self.{attr}, ...)` "
                     "or its cross-rank reduction is meaningless",
+                )
+            elif scatter is not None and scatter != reducer:
+                # a matching scatter-extremum (`.at[ids].max` into a
+                # "max"-reduced leaf) is the reducer-consistent sliced form
+                # and passes; only the MISMATCHED direction is flagged
+                yield FlowFinding(
+                    stmt,
+                    f"`\"{reducer}\"`-reduced state `{attr}` updated with a "
+                    f"`.at[...].{scatter}(...)` scatter in `{method.name}`; the scatter "
+                    f"direction contradicts the declared `\"{reducer}\"` reduction",
                 )
 
 

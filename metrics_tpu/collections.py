@@ -225,6 +225,17 @@ class MetricCollection:
         paths may diverge on later batches, so they must not share a group
         even when their states coincide on the first one.
         """
+        # sliced metrics keep their real update config on the wrapped
+        # TEMPLATE (an underscored attribute the public-attr walk below
+        # skips): two SlicedMetrics over same-shape states but differently
+        # configured inner metrics (e.g. thresholds) must not share a group
+        t1 = getattr(metric1, "_template", None)
+        t2 = getattr(metric2, "_template", None)
+        if (t1 is None) != (t2 is None):
+            return False
+        if isinstance(t1, Metric) and isinstance(t2, Metric):
+            if type(t1) is not type(t2) or not MetricCollection._equal_update_attrs(t1, t2):
+                return False
         skip = set(metric1._defaults) | set(metric2._defaults)
         attrs1 = {k: v for k, v in vars(metric1).items() if not k.startswith("_") and k not in skip}
         attrs2 = {k: v for k, v in vars(metric2).items() if not k.startswith("_") and k not in skip}

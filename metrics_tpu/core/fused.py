@@ -47,6 +47,15 @@ function, so the whole collection's update is a single device dispatch:
 The auto-registered ``_n_updates`` mean-merge counter is bumped INSIDE the
 kernel (once per batch, sentinel-preserving), eliminating the per-metric
 ``jnp.where`` dispatch of the eager path.
+
+Sliced metrics (``metrics_tpu/sliced/``) ride this path unchanged: a
+``SlicedMetric``'s update is a pure segment-scatter over fixed-shape
+``[S]``-leading states, so it fuses, donates, and AOT-caches like any other
+member — one dispatch ingests a batch spanning thousands of slices. The
+pad-and-mask bucket correction stays exact for it too: an edge-padded row
+replicates the last real row *including its slice id*, so the
+``k * delta(last_row)`` subtraction lands in exactly the slice the pad rows
+polluted (and a replicated row cannot move a per-slice extremum).
 """
 from __future__ import annotations
 
@@ -452,6 +461,15 @@ class FusedUpdate:
                 bucket=bucket,
                 cache_entries=len(self._cache),
                 cache_hit=cache_hit,
+                # sliced members served by this dispatch (duck-typed on the
+                # slice-count attribute to keep the hot path import-free):
+                # one fused kernel ingesting a batch that fans out across
+                # num_slices segments per such member
+                n_sliced=sum(
+                    1
+                    for n in fused_names
+                    if getattr(col._metrics[n], "num_slices", None) is not None
+                ),
             )
 
     def _run_fused(

@@ -945,6 +945,31 @@ class Metric(ABC):
 
         return lookup_class(cls)
 
+    def static_sliceability(self) -> Optional[Dict[str, bool]]:
+        """Per-leaf ``sliceable`` verdicts from the tracelint manifest, or
+        None when the class has no entry (user subclasses).
+
+        A leaf is statically sliceable when the abstract interpreter
+        extracted a ``sum``/``max``/``min`` reducer over an array state —
+        exactly the leaves :class:`metrics_tpu.sliced.SlicedMetric` can
+        segment-scatter along a leading ``[S]`` slice axis.
+        ``SlicedMetric`` consults this at construction to put the
+        machine-derived reason in its rejection error; the runtime
+        ``_reductions`` registry stays the authority (an instance method,
+        not a classmethod, because reducers can be config-dependent —
+        StatScores' ``"cat"``-or-``"sum"`` idiom).
+        """
+        entry = type(self).static_fusibility()
+        if not entry:
+            return None
+        states = entry.get("states")
+        if not isinstance(states, dict):
+            return None
+        out: Dict[str, bool] = {}
+        for name, leaf in states.items():
+            out[name] = bool(isinstance(leaf, dict) and leaf.get("sliceable"))
+        return out
+
     # ------------------------------------------------------------------
     # misc
     # ------------------------------------------------------------------

@@ -54,6 +54,8 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "compile_times": dict(rec.compile_times()),
         "fused_update_totals": dict(rec.fused_update_totals()),
         "async_totals": dict(rec.async_totals()),
+        "sliced_totals": dict(rec.sliced_totals()),
+        "sliced_slice_counts": dict(rec.footprint_slice_counts()),
         "dropped_events": rec.dropped_events(),
     }
 
@@ -100,6 +102,10 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # pre-fused ranks simply contribute nothing)
         "fused_update_totals": _merge_sum([p.get("fused_update_totals", {}) for p in payloads]),
         "async_totals": _merge_async([p.get("async_totals", {}) for p in payloads]),
+        "sliced_totals": _merge_sliced([p.get("sliced_totals", {}) for p in payloads]),
+        # slice counts are a structural property (same SlicedMetric config
+        # on every rank) — max is the safe reconciliation if they skew
+        "sliced_slice_counts": _merge_max([p.get("sliced_slice_counts", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
         "processes": list(payloads),
     }
@@ -116,6 +122,17 @@ def _merge_async(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
     maxed, same semantics as the footprint HWMs)."""
     sums = _merge_sum([{k: v for k, v in m.items() if k in _ASYNC_SUM_KEYS} for m in maps])
     maxes = _merge_max([{k: v for k, v in m.items() if k not in _ASYNC_SUM_KEYS} for m in maps])
+    return {**maxes, **sums}
+
+
+#: sliced-scatter counter keys that are extensive (summed); max_slices is
+#: a high-water mark
+_SLICED_SUM_KEYS = ("scatter_events", "rows")
+
+
+def _merge_sliced(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    sums = _merge_sum([{k: v for k, v in m.items() if k in _SLICED_SUM_KEYS} for m in maps])
+    maxes = _merge_max([{k: v for k, v in m.items() if k not in _SLICED_SUM_KEYS} for m in maps])
     return {**maxes, **sums}
 
 

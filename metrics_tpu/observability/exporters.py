@@ -244,6 +244,30 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             lines.append(
                 f"{family}{_labels(window='max', **proc_label(payload))} {totals.get('max_' + key, 0)}"
             )
+    lines.append("# HELP metrics_tpu_sliced_scatter_total Slice-axis segment-scatter updates (eager: per update; fused: per compilation).")
+    lines.append("# TYPE metrics_tpu_sliced_scatter_total counter")
+    for payload in per_proc:
+        totals = payload.get("sliced_totals", {})
+        lines.append(
+            f"metrics_tpu_sliced_scatter_total{_labels(**proc_label(payload))}"
+            f" {totals.get('scatter_events', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_sliced_rows_total Batch rows scattered into slice states.")
+    lines.append("# TYPE metrics_tpu_sliced_rows_total counter")
+    for payload in per_proc:
+        totals = payload.get("sliced_totals", {})
+        lines.append(
+            f"metrics_tpu_sliced_rows_total{_labels(**proc_label(payload))}"
+            f" {totals.get('rows', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_sliced_slices Largest slice count seen on a sliced metric (high-water).")
+    lines.append("# TYPE metrics_tpu_sliced_slices gauge")
+    for payload in per_proc:
+        totals = payload.get("sliced_totals", {})
+        lines.append(
+            f"metrics_tpu_sliced_slices{_labels(**proc_label(payload))}"
+            f" {totals.get('max_slices', 0)}"
+        )
     lines.append("# HELP metrics_tpu_dropped_events_total Events discarded past the buffer cap.")
     lines.append("# TYPE metrics_tpu_dropped_events_total counter")
     lines.append(f"metrics_tpu_dropped_events_total {dropped}")
@@ -309,6 +333,12 @@ def summary(recorder: Optional[Any] = None) -> str:
             f" {async_totals['max_staleness_steps']} steps, in-flight max"
             f" {async_totals['max_in_flight_bytes']} bytes"
         )
+    sliced_totals = rec.sliced_totals()
+    if sliced_totals.get("scatter_events"):
+        lines.append(
+            f"sliced scatter: {sliced_totals['scatter_events']} events,"
+            f" {sliced_totals['rows']} rows, max {sliced_totals['max_slices']} slices"
+        )
     dropped = rec.dropped_events()
     if dropped:
         lines.append(
@@ -324,9 +354,19 @@ def summary(recorder: Optional[Any] = None) -> str:
         for entry, n in sorted(compiles.items(), key=lambda kv: -compile_times.get(kv[0], 0.0)):
             lines.append(f"  {entry}: {n} compiles, {compile_times.get(entry, 0.0) * 1e3:.1f} ms")
     if hwm:
+        slice_counts = rec.footprint_slice_counts()
         lines.append("state-footprint high-water marks:")
         for metric, nbytes in sorted(hwm.items(), key=lambda kv: -kv[1]):
-            lines.append(f"  {metric}: {nbytes} bytes")
+            n_slices = slice_counts.get(metric)
+            if n_slices:
+                # sliced-state marks carry the per-slice average so slice-
+                # count growth reads differently from per-slice state growth
+                lines.append(
+                    f"  {metric}: {nbytes} bytes"
+                    f" ({nbytes / n_slices:.1f} B/slice over {n_slices} slices)"
+                )
+            else:
+                lines.append(f"  {metric}: {nbytes} bytes")
     return "\n".join(lines)
 
 

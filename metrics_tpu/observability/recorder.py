@@ -66,6 +66,18 @@ EVENT_TYPES = ("update", "compute", "forward", "sync")
 #: ``state_footprint()`` alone undercounts while an update is in flight
 ASYNC_IN_FLIGHT_LABEL = "async_in_flight"
 
+#: footprint keys under this prefix (SlicedMetric's [S]-leading states) are
+#: attributed to a separate `<Metric>[sliced]` HWM label, so slice-axis
+#: growth never masquerades as base-state growth in the high-water marks
+SLICED_FOOTPRINT_PREFIX = "sliced/"
+
+#: HWM-label suffix for the sliced split of a metric's footprint
+SLICED_LABEL_SUFFIX = "[sliced]"
+
+
+def _new_sliced_totals() -> Dict[str, int]:
+    return {"scatter_events": 0, "rows": 0, "max_slices": 0}
+
 
 def _new_async_totals() -> Dict[str, int]:
     """Zeroed async-pipeline counters: extensive batch counts (enqueued/
@@ -195,6 +207,8 @@ class MetricRecorder:
         self._fused_metric_updates = 0
         self._fused_fallback_updates = 0
         self._async = _new_async_totals()
+        self._sliced = _new_sliced_totals()
+        self._sliced_slice_counts: Dict[str, int] = {}
         # per-thread compute-group attribution: a shared field would let
         # concurrent MetricCollection.update calls cross-attribute events
         self._group_local = threading.local()
@@ -241,6 +255,8 @@ class MetricRecorder:
             self._fused_metric_updates = 0
             self._fused_fallback_updates = 0
             self._async = _new_async_totals()
+            self._sliced = _new_sliced_totals()
+            self._sliced_slice_counts = {}
             self._group_local = threading.local()
         return self
 
@@ -302,6 +318,19 @@ class MetricRecorder:
         queue depth, compute-snapshot staleness, and in-flight bytes."""
         with self._lock:
             return dict(self._async)
+
+    def sliced_totals(self) -> Dict[str, int]:
+        """Sliced-scatter counters: segment-scatter updates recorded (once
+        per eager update, once per TRACE under the fused kernel), total rows
+        scattered, and the largest slice count seen."""
+        with self._lock:
+            return dict(self._sliced)
+
+    def footprint_slice_counts(self) -> Dict[str, int]:
+        """``num_slices`` per ``<Metric>[sliced]`` HWM label — what the
+        summary exporter divides by for the per-slice average."""
+        with self._lock:
+            return dict(self._sliced_slice_counts)
 
     def dropped_events(self) -> int:
         """Events discarded after the MAX_EVENTS buffer cap (aggregate
@@ -494,18 +523,39 @@ class MetricRecorder:
     def record_footprint(self, metric: Any, footprint: Dict[str, int], **extra: Any) -> None:
         """Record a state-memory snapshot and maintain the per-metric high
         water mark; warn once (rank-zero) when ``footprint_warn_bytes`` is
-        configured and crossed — the unbounded-cat-state guard."""
+        configured and crossed — the unbounded-cat-state guard.
+
+        Keys under ``sliced/`` (a ``SlicedMetric``'s [S]-leading states)
+        are split out to a separate ``<Metric>[sliced]`` HWM label with the
+        metric's ``num_slices`` remembered alongside, so the summary
+        exporter can show a per-slice average and slice-axis growth never
+        silently mixes with base-state growth under one mark."""
         label = type(metric).__name__
         total = int(sum(footprint.values()))
+        sliced_bytes = int(
+            sum(v for k, v in footprint.items() if k.startswith(SLICED_FOOTPRINT_PREFIX))
+        )
+        base_bytes = total - sliced_bytes
+        n_slices = getattr(metric, "num_slices", None) if sliced_bytes else None
         with self._lock:
-            if total > self._footprint_hwm.get(label, -1):
-                self._footprint_hwm[label] = total
+            if sliced_bytes:
+                sliced_label = label + SLICED_LABEL_SUFFIX
+                if sliced_bytes > self._footprint_hwm.get(sliced_label, -1):
+                    self._footprint_hwm[sliced_label] = sliced_bytes
+                if isinstance(n_slices, int) and n_slices > 0:
+                    self._sliced_slice_counts[sliced_label] = n_slices
+            if (base_bytes or not sliced_bytes) and base_bytes > self._footprint_hwm.get(label, -1):
+                self._footprint_hwm[label] = base_bytes
             event = {
                 "type": "footprint",
                 "metric": label,
                 "total_bytes": total,
                 "t": round(time.time() - self._t0, 6),
             }
+            if sliced_bytes:
+                event["sliced_bytes"] = sliced_bytes
+                if isinstance(n_slices, int):
+                    event["n_slices"] = n_slices
             event.update(extra)
             self._append(event)
             warn = (
@@ -550,6 +600,40 @@ class MetricRecorder:
                 "n_fused": int(n_fused),
                 "n_fallback": int(n_fallback),
                 "dur_ms": round(duration_s * 1e3, 4),
+            }
+            event.update(extra)
+            self._append(event)
+
+    def record_sliced_scatter(
+        self,
+        metric: Any,
+        n_rows: int,
+        n_slices: int,
+        n_leaves: int,
+        in_jit: bool = False,
+        **extra: Any,
+    ) -> None:
+        """Record one slice-axis segment-scatter (``SlicedMetric._update``).
+
+        On the eager path this is once per update; under the fused kernel
+        the hook runs at TRACE time — once per compilation, not per executed
+        batch (shapes are static), the same convention the in-jit sync-byte
+        accounting uses. The counters are therefore dispatch-shaped on the
+        eager path and compile-shaped on the fused one; ``bench.py sliced``
+        reads the fused handle's ``n_compiles`` for the hard compile gate.
+        """
+        with self._lock:
+            self._sliced["scatter_events"] += 1
+            self._sliced["rows"] += int(n_rows)
+            self._sliced["max_slices"] = max(self._sliced["max_slices"], int(n_slices))
+            event: Dict[str, Any] = {
+                "type": "sliced_scatter",
+                "metric": type(metric).__name__,
+                "n_rows": int(n_rows),
+                "n_slices": int(n_slices),
+                "n_leaves": int(n_leaves),
+                "in_jit": bool(in_jit),
+                "t": round(time.time() - self._t0, 6),
             }
             event.update(extra)
             self._append(event)

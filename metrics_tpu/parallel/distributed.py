@@ -291,10 +291,26 @@ _FUSED_REDUCERS = {
 }
 
 
+def _spec_shards_axis(spec: Any, axis_name: str) -> bool:
+    """True when a ``PartitionSpec`` (or spec-like tuple) places
+    ``axis_name`` on some array dimension — the leaf's rows are then owned
+    DISJOINTLY across the mesh axis and a cross-axis reduction would mix
+    unrelated shards."""
+    if spec is None:
+        return False
+    for entry in tuple(spec):
+        if entry == axis_name:
+            return True
+        if isinstance(entry, (tuple, list)) and axis_name in entry:
+            return True
+    return False
+
+
 def sync_pytree_in_mesh(
     state: Dict[str, Any],
     reductions: Dict[str, Any],
     axis_name: str,
+    partition_specs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Fused in-mesh sync: a WHOLE (possibly nested) state pytree — e.g.
     every metric of a ``MetricCollection`` — in one collective round.
@@ -308,17 +324,32 @@ def sync_pytree_in_mesh(
     Leaves whose reduction is ``"cat"``/``None``/callable (and list states)
     fall back to the per-state :func:`sync_in_mesh` machinery.
 
+    ``partition_specs`` — optional pytree of ``jax.sharding.PartitionSpec``
+    nested like ``reductions``. A leaf whose spec places ``axis_name`` on an
+    array dimension (a ``SlicedMetric``'s ``[S]`` slice axis sharded over
+    the mesh — see ``metrics_tpu/sliced/sharding.py``) is owned disjointly
+    by each mesh position: there is nothing to reduce across the axis, so
+    the leaf passes through untouched — ZERO cross-host traffic for its
+    sharded dimension, and the reduction applies only to the replicated
+    (non-slice) leaves. Missing/None specs keep the ordinary behavior.
+
     ``state``/``reductions`` are matching flat or nested string-keyed dicts
     (``MetricCollection.state_reductions()`` produces the nested form).
     With telemetry enabled, ONE ``sync`` event per trace records the total
-    gather bytes and the number of collective rounds actually launched.
+    gather bytes, the number of collective rounds actually launched, and
+    how many slice-sharded leaves were passed through traffic-free.
     """
     leaves = list(_iter_state_leaves(state))
     groups: Dict[tuple, List[tuple]] = {}
     fallback: List[tuple] = []
+    sharded: List[tuple] = []
     for path, value in leaves:
         red = _path_get(reductions, path)
-        if isinstance(value, jnp.ndarray) and not isinstance(value, list) and red in _FUSED_REDUCERS:
+        if partition_specs is not None and _spec_shards_axis(
+            _path_get(partition_specs, path), axis_name
+        ):
+            sharded.append(path)
+        elif isinstance(value, jnp.ndarray) and not isinstance(value, list) and red in _FUSED_REDUCERS:
             groups.setdefault((red, jnp.asarray(value).dtype), []).append(path)
         else:
             fallback.append(path)
@@ -345,6 +376,10 @@ def sync_pytree_in_mesh(
                     offset += part.size
                 if record:
                     gather_bytes += _nbytes(buf)  # all-reduced: one payload
+            for path in sharded:
+                # slice-sharded leaves: each mesh position owns disjoint
+                # rows — identity, no collective, no bytes moved
+                _path_set(out, path, _path_get(state, path))
             for path in fallback:
                 value = _path_get(state, path)
                 red = _path_get(reductions, path)
@@ -366,6 +401,7 @@ def sync_pytree_in_mesh(
             in_jit=True,
             collective_rounds=len(groups) + len(fallback),
             n_states=len(leaves),
+            sliced_passthrough=len(sharded),
         )
     return out
 

@@ -1,0 +1,30 @@
+"""Sliced metric state: one metric tracked across many shardable slices.
+
+The reference library's answer to "the same metric over many groups" is
+``ClasswiseWrapper``-style object fan-out — N metric objects, N states, N
+dispatches — which caps out at tens of groups. :class:`SlicedMetric` gives
+any fusible metric a leading ``[S]`` slice dimension on every state leaf
+instead: one state pytree, one segment-scatter update per batch (inside the
+fused single-dispatch kernel), one vmapped compute — per-tenant /
+per-cohort / per-model-version metrics at 10^5–10^6 slices on one pod, with
+the slice axis shardable across a device mesh via the partition rules in
+:mod:`metrics_tpu.sliced.sharding`.
+"""
+from metrics_tpu.sliced.metric import SLICED_FOOTPRINT_PREFIX, SlicedMetric
+from metrics_tpu.sliced.sharding import (
+    get_naive_slice_sharding,
+    match_partition_rules,
+    shard_sliced_states,
+    slice_partition_rules,
+    sliced_partition_specs,
+)
+
+__all__ = [
+    "SLICED_FOOTPRINT_PREFIX",
+    "SlicedMetric",
+    "get_naive_slice_sharding",
+    "match_partition_rules",
+    "shard_sliced_states",
+    "slice_partition_rules",
+    "sliced_partition_specs",
+]

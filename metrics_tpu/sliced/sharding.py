@@ -1,0 +1,196 @@
+"""Partition rules for sliced metric state: state-leaf paths -> ``PartitionSpec``.
+
+The slice axis is the natural partition axis for ``[S]``-leading state: at
+10^5–10^6 slices the state pytree no longer fits (or no longer belongs)
+replicated on one chip. This module maps state-leaf *paths* to
+``PartitionSpec``s with regex rules (the ``match_partition_rules`` /
+``get_naive_sharding`` patterns from large-model parameter sharding, applied
+to metric state) and places the arrays under ``NamedSharding``s on a mesh:
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("slices",))
+    shard_sliced_states(metric, mesh)          # [S] leaves split over "slices"
+
+A leaf sharded along the mesh axis is owned disjointly by each mesh
+position, so :func:`metrics_tpu.parallel.distributed.sync_pytree_in_mesh`
+with ``partition_specs=`` skips the collective for it entirely — slice-
+sharded leaves sync with zero cross-host traffic for their sharded
+dimension, while replicated (non-slice) leaves keep the ordinary fused
+all-reduce.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from metrics_tpu.observability.recorder import SLICED_FOOTPRINT_PREFIX
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+Array = jax.Array
+
+#: default name of the mesh axis the slice dimension shards over
+SLICE_AXIS = "slices"
+
+
+def slice_partition_rules(axis_name: str = SLICE_AXIS) -> Tuple[Tuple[str, PartitionSpec], ...]:
+    """Default rules for sliced metric state: every leaf registered by a
+    ``SlicedMetric`` (its ``state_footprint`` paths carry the
+    ``SLICED_FOOTPRINT_PREFIX``, and plain state names match the
+    catch-all) shards its leading ``[S]`` dimension over ``axis_name``;
+    anything else replicates."""
+    return (
+        (rf"(^|/){re.escape(SLICED_FOOTPRINT_PREFIX)}", PartitionSpec(axis_name)),
+        (r"(^|/)_slice_rows$", PartitionSpec(axis_name)),
+        (r".*", PartitionSpec()),
+    )
+
+
+def _iter_paths(tree: Any, path: str = "", sep: str = "/"):
+    if isinstance(tree, dict):
+        for key, value in tree.items():
+            sub = f"{path}{sep}{key}" if path else str(key)
+            yield from _iter_paths(value, sub, sep)
+    else:
+        yield path, tree
+
+
+def _rebuild(tree: Any, flat: Dict[str, Any], path: str = "", sep: str = "/") -> Any:
+    if isinstance(tree, dict):
+        return {
+            key: _rebuild(value, flat, f"{path}{sep}{key}" if path else str(key), sep)
+            for key, value in tree.items()
+        }
+    return flat[path]
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, PartitionSpec]],
+    tree: Dict[str, Any],
+    sep: str = "/",
+) -> Dict[str, Any]:
+    """A pytree of ``PartitionSpec`` matching ``tree`` (nested string-keyed
+    dicts of arrays — the shape ``Metric.state_dict()`` /
+    ``MetricCollection.state_reductions()`` produce), chosen by the first
+    rule whose regex searches the ``sep``-joined leaf path. Scalars (and
+    one-element arrays) never partition. Raises when no rule matches — a
+    silent replicate-by-default would hide a typo'd rule."""
+    flat: Dict[str, Any] = {}
+    for path, leaf in _iter_paths(tree, sep=sep):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            flat[path] = PartitionSpec()
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, path) is not None:
+                flat[path] = spec
+                break
+        else:
+            raise MetricsUserError(f"no partition rule matched state leaf {path!r}")
+    return _rebuild(tree, flat, sep=sep)
+
+
+def get_naive_slice_sharding(
+    x: Array, mesh: Mesh, axis_name: str = SLICE_AXIS
+) -> NamedSharding:
+    """Shard ``x``'s leading dimension over ``axis_name`` when it divides
+    evenly, else replicate — the ``get_naive_sharding`` pattern specialized
+    to the slice axis."""
+    axis_size = mesh.shape[axis_name]
+    shape = getattr(x, "shape", ())
+    if len(shape) >= 1 and shape[0] % axis_size == 0 and shape[0] >= axis_size:
+        return NamedSharding(mesh, PartitionSpec(axis_name))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sliced_partition_specs(
+    metric: Any,
+    mesh: Mesh,
+    axis_name: str = SLICE_AXIS,
+) -> Dict[str, Any]:
+    """Partition specs for a metric's (or collection's) state pytree:
+    ``{leaf: PartitionSpec}`` nested like ``state_reductions()`` — the
+    shape ``sync_pytree_in_mesh(partition_specs=...)`` consumes.
+
+    ``mesh`` is REQUIRED and must be the mesh you shard over (the one
+    given to :func:`shard_sliced_states`): a ``SlicedMetric`` leaf is
+    claimed slice-sharded only when the naive-sharding divisibility rule
+    actually shards it; leaves the fallback left replicated get
+    ``PartitionSpec()``, so the sync still reduces them across the axis.
+    An unconditional claim on a replicated leaf would make the sync pass
+    it through untouched and silently drop the cross-rank reduction its
+    replication requires — exactly the wrong-answer mode this signature
+    exists to prevent. Non-sliced metrics replicate everywhere."""
+    from metrics_tpu.sliced.metric import SlicedMetric
+
+    def spec_for(m: Any) -> Dict[str, Any]:
+        if isinstance(m, SlicedMetric):
+            return {
+                name: get_naive_slice_sharding(
+                    jnp.asarray(getattr(m, name)), mesh, axis_name=axis_name
+                ).spec
+                for name in m._defaults
+            }
+        return {name: PartitionSpec() for name in m._defaults}
+
+    if hasattr(metric, "_metrics"):  # MetricCollection duck-type
+        return {name: spec_for(m) for name, m in metric._metrics.items()}
+    return spec_for(metric)
+
+
+def shard_sliced_states(
+    metric: Any,
+    mesh: Mesh,
+    axis_name: str = SLICE_AXIS,
+    rules: Optional[Sequence[Tuple[str, PartitionSpec]]] = None,
+) -> Dict[str, Any]:
+    """Place a metric's (or collection's) array states under mesh shardings
+    derived from ``rules`` (default: :func:`slice_partition_rules`) and
+    return the applied ``{state: NamedSharding}`` pytree.
+
+    Uses ``Metric.shard_states`` underneath, so reset defaults are re-placed
+    too and ``reset()`` preserves the layout. Leaves whose leading dimension
+    does not divide the mesh axis stay replicated rather than erroring —
+    pad ``num_slices`` up to a multiple of the mesh axis to shard evenly.
+    A rule's spec names the mesh axis for the LEADING (slice) dimension;
+    specs without a named axis replicate, and other placements are out of
+    scope here (use ``Metric.shard_states`` directly for exotic layouts).
+    """
+    rules = tuple(rules) if rules is not None else slice_partition_rules(axis_name)
+
+    def place(m: Any) -> Dict[str, Any]:
+        state = {
+            name: getattr(m, name)
+            for name in m._defaults
+            if not isinstance(m._defaults[name], list)
+        }
+        # footprint keys carry the SLICED_FOOTPRINT_PREFIX for SlicedMetric
+        # leaves; rule-match against those paths with the SAME matcher (and
+        # the same raise-on-no-match contract) as match_partition_rules,
+        # then strip back to state names
+        by_path = {
+            key: jnp.asarray(state[name])
+            for key in m.state_footprint(include_children=False)
+            if (name := key.split("/", 1)[1] if key.startswith(SLICED_FOOTPRINT_PREFIX) else key)
+            in state
+        }
+        spec_by_path = match_partition_rules(rules, by_path)
+        shardings: Dict[str, NamedSharding] = {}
+        for key, spec in spec_by_path.items():
+            name = key.split("/", 1)[1] if key.startswith(SLICED_FOOTPRINT_PREFIX) else key
+            # a rule spec names at most one mesh axis for the leading dim;
+            # anything without a named leading axis replicates
+            axis = next((a for a in tuple(spec) if isinstance(a, str)), None)
+            if axis is None:
+                shardings[name] = NamedSharding(mesh, PartitionSpec())
+                continue
+            shardings[name] = get_naive_slice_sharding(by_path[key], mesh, axis_name=axis)
+        m.shard_states(shardings)
+        return shardings
+
+    if hasattr(metric, "_metrics"):  # MetricCollection duck-type
+        return {name: place(m) for name, m in metric._metrics.items()}
+    return place(metric)

@@ -64,12 +64,19 @@ def load_records(path: str) -> Dict[str, Dict[str, Any]]:
 #: carries its speedup over the blocking fused loop and the p99 enqueue
 #: latency; async dropping below blocking, or the hot-path enqueue growing a
 #: blocking wait, is a regression even when raw throughput still passes
+#: the sliced bench (``sliced_update_throughput``) carries its speedup over
+#: the S-object fan-out and its compile count across bucketed ragged shapes;
+#: sliced dropping toward object-fan-out territory, or the scatter kernel
+#: recompiling per batch shape, is a regression even when raw wall
+#: throughput still passes
 AUX_FIELDS: Dict[str, str] = {
     "fused_vs_eager": "higher",
     "bucketed_compiles": "lower",
     "fused_first_batch_ms": "lower",
     "async_vs_blocking": "higher",
     "update_async_p99_ms": "lower",
+    "sliced_vs_fanout": "higher",
+    "sliced_scatter_compiles": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a

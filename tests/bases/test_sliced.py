@@ -1,0 +1,576 @@
+"""Sliced metric state (ISSUE 8 tentpole).
+
+Parity suite: a ``SlicedMetric(m, S)`` fed interleaved slice batches must be
+BIT-identical to S independent metric objects — across sum/max/min-reduced
+(and mean-style sum/sum) metrics, eager and fused, through reset / merge /
+``state_dict`` round-trips and ``compile_update_async``; the slice axis must
+shard over a multi-device CPU mesh and sync traffic-free through the
+generalized ``sync_pytree_in_mesh(partition_specs=...)``; and non-sliceable
+metrics must be rejected with a clear error instead of mis-scattering.
+
+Parity data uses integer-valued floats on purpose: every partial sum is
+exact in float32, so any accumulation ORDER produces identical bits and the
+bit-equality assertions test the scatter arithmetic, not summation
+bracketing.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    Accuracy,
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MeanSquaredError,
+    MetricCollection,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+from metrics_tpu.sliced import (
+    SlicedMetric,
+    get_naive_slice_sharding,
+    match_partition_rules,
+    shard_sliced_states,
+    slice_partition_rules,
+    sliced_partition_specs,
+)
+from metrics_tpu.utils.compat import shard_map
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.wrappers import ClasswiseWrapper
+
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.reset()
+
+
+def _state_of(m: Metric):
+    return {k: getattr(m, k) for k in m._defaults}
+
+
+def _assert_states_bit_identical(a: Metric, b: Metric):
+    for k in a._defaults:
+        va, vb = getattr(a, k), getattr(b, k)
+        assert bool(jnp.array_equal(jnp.asarray(va), jnp.asarray(vb))), (
+            f"state {k!r} diverged"
+        )
+
+
+# ---------------------------------------------------------------------------
+# interleaved-batch generators (integer-valued -> exact float arithmetic)
+# ---------------------------------------------------------------------------
+
+def _reg_batches(rng, S, n_batches, rows_per_batch):
+    """(ids, preds, target) regression batches, integer-valued floats."""
+    out = []
+    for _ in range(n_batches):
+        ids = rng.randint(0, S, rows_per_batch)
+        preds = rng.randint(0, 8, rows_per_batch).astype(np.float32)
+        target = rng.randint(0, 8, rows_per_batch).astype(np.float32)
+        out.append((jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target)))
+    return out
+
+
+def _cls_batches(rng, S, n_batches, rows_per_batch, n_classes=4):
+    out = []
+    for _ in range(n_batches):
+        ids = rng.randint(0, S, rows_per_batch)
+        preds = rng.rand(rows_per_batch, n_classes).astype(np.float32)
+        preds /= preds.sum(-1, keepdims=True)
+        target = rng.randint(0, n_classes, rows_per_batch)
+        out.append((jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target)))
+    return out
+
+
+def _fanout_apply(objs, ids, *args):
+    """Feed S independent objects the same rows, ONE ROW AT A TIME in row
+    order — the accumulation order the per-row segment scatter reproduces."""
+    ids = np.asarray(ids)
+    for r, i in enumerate(ids):
+        objs[int(i)].update(*(jnp.asarray(a)[r : r + 1] for a in args))
+
+
+# ---------------------------------------------------------------------------
+# parity: sliced vs S independent objects
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "make,batches",
+    [
+        (lambda: MeanSquaredError(), "reg"),
+        (lambda: SumMetric(nan_strategy="ignore"), "agg"),
+        (lambda: MaxMetric(nan_strategy="ignore"), "agg"),
+        (lambda: MinMetric(nan_strategy="ignore"), "agg"),
+        (lambda: MeanMetric(nan_strategy="ignore"), "agg"),
+    ],
+    ids=["mse-sum", "sum", "max", "min", "mean"],
+)
+def test_small_s_parity_across_reducers(make, batches):
+    """sum / max / min / mean-style reducers, multiple rows per slice per
+    batch, eager path."""
+    S = 8
+    rng = np.random.RandomState(3)
+    sliced = SlicedMetric(make(), num_slices=S)
+    objs = [make() for _ in range(S)]
+    expected_counts = np.zeros(S, np.int64)
+    for _ in range(4):
+        ids = rng.randint(0, S, 32)
+        vals = rng.randint(0, 9, 32).astype(np.float32)
+        expected_counts += np.bincount(ids, minlength=S)
+        if batches == "reg":
+            target = rng.randint(0, 9, 32).astype(np.float32)
+            sliced.update(jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(target))
+            _fanout_apply(objs, ids, vals, target)
+        else:
+            sliced.update(jnp.asarray(ids), jnp.asarray(vals))
+            _fanout_apply(objs, ids, vals)
+    per_slice = sliced.compute()
+    ref = jnp.stack([o.compute() for o in objs])
+    assert bool(jnp.array_equal(per_slice, ref))
+    # per-slice row counts match the rows each object saw
+    assert np.array_equal(np.asarray(sliced.slice_counts), expected_counts)
+
+
+def test_mean_metric_weighted_parity():
+    """MeanMetric's weight kwarg rides the row alignment too."""
+    S = 4
+    rng = np.random.RandomState(5)
+    sliced = SlicedMetric(MeanMetric(), num_slices=S)
+    objs = [MeanMetric() for _ in range(S)]
+    for _ in range(3):
+        ids = rng.randint(0, S, 16)
+        vals = rng.randint(0, 9, 16).astype(np.float32)
+        w = rng.randint(1, 4, 16).astype(np.float32)
+        sliced.update(jnp.asarray(ids), jnp.asarray(vals), weight=jnp.asarray(w))
+        ids_np = np.asarray(ids)
+        for r, i in enumerate(ids_np):
+            objs[int(i)].update(jnp.asarray(vals)[r : r + 1], weight=jnp.asarray(w)[r : r + 1])
+    assert bool(jnp.array_equal(sliced.compute(), jnp.stack([o.compute() for o in objs])))
+
+
+@pytest.mark.parametrize("fused", [False, True], ids=["eager", "fused"])
+def test_s1000_parity_classification_regression_aggregation(fused):
+    """The acceptance-criterion parity: S=1000 slices, one classification +
+    one regression + one aggregation metric, bit-identical to 1000
+    independent objects, eager AND fused, with the slice states synced
+    through the generalized ``sync_pytree_in_mesh`` on the 8-device CPU
+    mesh (slice-sharded leaves pass through traffic-free, bit-identically).
+    """
+    S = 1000
+    rng = np.random.RandomState(11)
+    n_classes = 4
+    makes = {
+        "acc": lambda: Accuracy(),
+        "mse": lambda: MeanSquaredError(),
+        "sum": lambda: SumMetric(nan_strategy="ignore"),
+    }
+    sliced = {k: SlicedMetric(mk(), num_slices=S) for k, mk in makes.items()}
+    objs = {k: [mk() for _ in range(S)] for k, mk in makes.items()}
+
+    # 2 interleaved batches of 1000 rows: every slice sees exactly one row
+    # per batch (a permutation), so the object side gets one single-row
+    # update per batch — same accumulation order as the segment scatter
+    cols = {}
+    if fused:
+        for k in makes:
+            cols[k] = MetricCollection({k: sliced[k]})
+    for _ in range(2):
+        ids = rng.permutation(S)
+        preds_c = rng.rand(S, n_classes).astype(np.float32)
+        preds_c /= preds_c.sum(-1, keepdims=True)
+        target_c = rng.randint(0, n_classes, S)
+        preds_r = rng.randint(0, 8, S).astype(np.float32)
+        target_r = rng.randint(0, 8, S).astype(np.float32)
+        batch = {
+            "acc": (jnp.asarray(preds_c), jnp.asarray(target_c)),
+            "mse": (jnp.asarray(preds_r), jnp.asarray(target_r)),
+            "sum": (jnp.asarray(preds_r),),
+        }
+        for k in makes:
+            if fused:
+                cols[k].update(jnp.asarray(ids), *batch[k])
+                if cols[k].fused_update is None:
+                    cols[k].compile_update()
+            else:
+                sliced[k].update(jnp.asarray(ids), *batch[k])
+        for k in makes:
+            for r, i in enumerate(ids):
+                objs[k][int(i)].update(*(a[r : r + 1] for a in batch[k]))
+
+    # mesh round-trip: shard the slice axis over the 8 CPU devices and run
+    # the generalized sync — slice-sharded leaves are identity (zero
+    # cross-host traffic for the sharded dimension)
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("slices",))
+    for k in makes:
+        m = sliced[k]
+        shard_sliced_states(m, mesh)
+        state = _state_of(m)
+        reductions = m.state_reductions()
+        specs = sliced_partition_specs(m, mesh=mesh)
+        leaves = sorted(state)
+        body = lambda *vals: tuple(  # noqa: E731
+            sync_pytree_in_mesh(
+                dict(zip(leaves, vals)), reductions, "slices", partition_specs=specs
+            )[n]
+            for n in leaves
+        )
+        synced = jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=tuple(P("slices") for _ in leaves),
+                out_specs=tuple(P("slices") for _ in leaves),
+            )
+        )(*(state[n] for n in leaves))
+        for name, out in zip(leaves, synced):
+            assert bool(jnp.array_equal(out, state[name])), (k, name)
+            object.__setattr__(m, name, out)
+
+    for k in makes:
+        per_slice = sliced[k].compute()
+        ref = jnp.stack([o.compute() for o in objs[k]])
+        assert bool(jnp.array_equal(per_slice, ref)), k
+        assert np.asarray(sliced[k].slice_counts).sum() == 2 * S
+
+
+# ---------------------------------------------------------------------------
+# fused path: single dispatch, bucketing, async
+# ---------------------------------------------------------------------------
+
+def test_fused_parity_and_bucketed_single_compile(recorder):
+    """Ragged batch sizes share ONE compilation through pad-and-mask
+    bucketing, and the fused states stay bit-identical to an eager twin fed
+    the same (unpadded) batches — the pad rows' scatter contribution is
+    subtracted exactly, slice ids included."""
+    S = 64
+    rng = np.random.RandomState(7)
+    eager = SlicedMetric(MeanSquaredError(), num_slices=S)
+    col = MetricCollection({"m": SlicedMetric(MeanSquaredError(), num_slices=S)})
+
+    sizes = (96, 112, 128)
+    batches = []
+    for n in (128, *sizes * 3):
+        ids = rng.randint(0, S, n)
+        preds = rng.randint(0, 8, n).astype(np.float32)
+        target = rng.randint(0, 8, n).astype(np.float32)
+        batches.append((jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target)))
+
+    col.update(*batches[0])  # discovery batch
+    eager.update(*batches[0])
+    handle = col.compile_update(buckets=(128,))
+    for b in batches[1:]:
+        col.update(*b)
+        eager.update(*b)
+    assert handle.n_compiles == 1, "bucketed ragged shapes must share one compile"
+    _assert_states_bit_identical(col["m"], eager)
+    ev = [e for e in recorder.events() if e["type"] == "fused_update"]
+    assert len(ev) == len(batches) - 1
+    assert all(e["n_sliced"] == 1 for e in ev)
+
+
+def test_async_parity(recorder):
+    """compile_update_async ingests sliced batches bit-identically to the
+    blocking eager path."""
+    S = 16
+    rng = np.random.RandomState(9)
+    eager = SlicedMetric(MeanSquaredError(), num_slices=S)
+    col = MetricCollection({"m": SlicedMetric(MeanSquaredError(), num_slices=S)})
+    batches = _reg_batches(rng, S, 8, 32)
+    col.update(*batches[0])
+    eager.update(*batches[0])
+    handle = col.compile_update_async(queue_depth=2)
+    try:
+        for b in batches[1:]:
+            col.update_async(*b)
+            eager.update(*b)
+        handle.flush()
+        _assert_states_bit_identical(col["m"], eager)
+        assert bool(jnp.array_equal(col.compute()["m"], eager.compute()))
+    finally:
+        handle.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle round-trips
+# ---------------------------------------------------------------------------
+
+def test_reset_merge_state_dict_round_trips():
+    S = 8
+    rng = np.random.RandomState(13)
+    batches = _reg_batches(rng, S, 6, 24)
+
+    # one metric over all batches == merge of two halves
+    whole = SlicedMetric(MeanSquaredError(), num_slices=S)
+    for b in batches:
+        whole.update(*b)
+    ha = SlicedMetric(MeanSquaredError(), num_slices=S)
+    hb = SlicedMetric(MeanSquaredError(), num_slices=S)
+    for b in batches[:3]:
+        ha.update(*b)
+    for b in batches[3:]:
+        hb.update(*b)
+    merged = ha.merge_states(_state_of(ha), _state_of(hb))
+    for k, v in merged.items():
+        assert bool(jnp.array_equal(v, getattr(whole, k))), k
+
+    # state_dict round-trip preserves bits
+    sd = whole.state_dict()
+    restored = SlicedMetric(MeanSquaredError(), num_slices=S)
+    restored.load_state_dict(sd)
+    _assert_states_bit_identical(whole, restored)
+    assert bool(jnp.array_equal(restored.compute(), whole.compute()))
+
+    # reset restores defaults (incl. the row counter) and re-accumulates
+    # identically
+    whole.reset()
+    assert int(np.asarray(whole.slice_counts).sum()) == 0
+    for b in batches:
+        whole.update(*b)
+    _assert_states_bit_identical(whole, restored)
+
+
+def test_forward_returns_batch_value_and_keeps_accumulation():
+    S = 4
+    m = SlicedMetric(SumMetric(nan_strategy="ignore"), num_slices=S)
+    m.update(jnp.array([0, 1]), jnp.array([1.0, 2.0]))
+    batch_val = m(jnp.array([0, 3]), jnp.array([5.0, 7.0]))
+    assert bool(jnp.array_equal(batch_val, jnp.array([5.0, 0.0, 0.0, 7.0])))
+    assert bool(jnp.array_equal(m.compute(), jnp.array([6.0, 2.0, 0.0, 7.0])))
+
+
+def test_clone_is_independent():
+    m = SlicedMetric(SumMetric(nan_strategy="ignore"), num_slices=2)
+    m.update(jnp.array([0]), jnp.array([1.0]))
+    c = m.clone()
+    c.update(jnp.array([1]), jnp.array([5.0]))
+    assert bool(jnp.array_equal(m.compute(), jnp.array([1.0, 0.0])))
+    assert bool(jnp.array_equal(c.compute(), jnp.array([1.0, 5.0])))
+
+
+# ---------------------------------------------------------------------------
+# compute subsetting / top-k
+# ---------------------------------------------------------------------------
+
+def test_compute_subset_and_top_k():
+    S = 16
+    rng = np.random.RandomState(17)
+    m = SlicedMetric(MeanSquaredError(), num_slices=S)
+    for b in _reg_batches(rng, S, 4, 32):
+        m.update(*b)
+    full = m.compute()
+    ids = jnp.array([3, 0, 11])
+    assert bool(jnp.array_equal(m.compute(slice_ids=ids), full[ids]))
+
+    k = 4
+    top_ids, top_vals = m.compute(top_k=k)
+    counts = np.asarray(m.slice_counts)
+    assert len(top_ids) == k
+    # the selected slices carry the k largest row counts
+    assert counts[np.asarray(top_ids)].min() >= np.sort(counts)[::-1][k - 1]
+    assert bool(jnp.array_equal(top_vals, full[top_ids]))
+
+    with pytest.raises(MetricsUserError, match="not both"):
+        m.compute(slice_ids=ids, top_k=2)
+    with pytest.raises(MetricsUserError, match="positive int"):
+        m.compute(top_k=0)
+    # gathers CLAMP out-of-range indices (unlike update's scatter, which
+    # drops them) — an off-by-one must raise, not return slice S-1's value
+    with pytest.raises(MetricsUserError, match="out of range"):
+        m.compute(slice_ids=jnp.array([S]))
+    with pytest.raises(MetricsUserError, match="out of range"):
+        m.compute(slice_ids=jnp.array([-1]))
+
+
+# ---------------------------------------------------------------------------
+# construction-time rejection of non-sliceable metrics
+# ---------------------------------------------------------------------------
+
+class _RunningMean(Metric):
+    """A genuinely mean-REDUCED leaf: no exact per-slice scatter exists."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+    def _update(self, v):
+        self.avg = (self.avg + jnp.mean(v)) / 2
+
+    def _compute(self):
+        return self.avg
+
+
+def test_rejects_non_sliceable_metrics():
+    with pytest.raises(MetricsUserError, match="list \\('cat'\\) state"):
+        SlicedMetric(CatMetric(), num_slices=4)
+    with pytest.raises(MetricsUserError, match="only sum/max/min"):
+        SlicedMetric(_RunningMean(), num_slices=4)
+    with pytest.raises(MetricsUserError, match="__jit_unsafe__"):
+        SlicedMetric(ClasswiseWrapper(Accuracy(num_classes=3, average="none")), num_slices=4)
+    with pytest.raises(MetricsUserError, match="cannot wrap another"):
+        SlicedMetric(SlicedMetric(MeanSquaredError(), num_slices=2), num_slices=2)
+    with pytest.raises(MetricsUserError, match="positive int"):
+        SlicedMetric(MeanSquaredError(), num_slices=0)
+
+
+def test_update_validates_slice_ids():
+    m = SlicedMetric(MeanSquaredError(), num_slices=4)
+    with pytest.raises(MetricsUserError, match="1-D integer"):
+        m.update(jnp.zeros((2, 2), jnp.int32), jnp.zeros(2), jnp.zeros(2))
+    with pytest.raises(MetricsUserError, match="integer-typed"):
+        m.update(jnp.array([0.0, 1.0]), jnp.zeros(2), jnp.zeros(2))
+    with pytest.raises(MetricsUserError, match="row-aligned"):
+        m.update(jnp.array([0, 1]), jnp.zeros(3), jnp.zeros(3))
+
+
+def test_out_of_range_ids_are_dropped():
+    """XLA scatter semantics: ids outside [0, S) contribute nothing."""
+    m = SlicedMetric(SumMetric(nan_strategy="ignore"), num_slices=2)
+    m.update(jnp.array([0, 5, -1]), jnp.array([1.0, 100.0, 100.0]))
+    assert bool(jnp.array_equal(m.compute(), jnp.array([1.0, 0.0])))
+    assert np.array_equal(np.asarray(m.slice_counts), [1, 0])
+
+
+# ---------------------------------------------------------------------------
+# compute groups: differently-configured inner metrics must not merge
+# ---------------------------------------------------------------------------
+
+def test_compute_groups_respect_template_config():
+    a = SlicedMetric(Accuracy(threshold=0.3), num_slices=4)
+    b = SlicedMetric(Accuracy(threshold=0.7), num_slices=4)
+    assert not MetricCollection._equal_metric_states(a, b)
+    c = SlicedMetric(Accuracy(threshold=0.3), num_slices=4)
+    assert MetricCollection._equal_update_attrs(a, c)
+
+
+# ---------------------------------------------------------------------------
+# sharding helpers + generalized mesh sync
+# ---------------------------------------------------------------------------
+
+def test_match_partition_rules_paths():
+    tree = {
+        "m": {"sliced/total": jnp.zeros(16), "scalar": jnp.asarray(0.0), "plain": jnp.zeros(3)}
+    }
+    specs = match_partition_rules(slice_partition_rules("slices"), tree)
+    assert specs["m"]["sliced/total"] == P("slices")
+    assert specs["m"]["scalar"] == P()  # scalars never partition
+    assert specs["m"]["plain"] == P()  # catch-all replicates
+    with pytest.raises(MetricsUserError, match="no partition rule"):
+        match_partition_rules(((r"^only-this$", P()),), {"other": jnp.zeros(4)})
+
+
+def test_naive_slice_sharding_divisibility():
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("slices",))
+    sharded = get_naive_slice_sharding(jnp.zeros(16), mesh)
+    assert sharded.spec == P("slices")
+    replicated = get_naive_slice_sharding(jnp.zeros(10), mesh)  # 10 % 8 != 0
+    assert replicated.spec == P()
+
+
+def test_partition_specs_follow_replication_fallback():
+    """When num_slices does not divide the mesh axis, shard_sliced_states
+    replicates — and the mesh-aware spec tree must say replicated TOO, or
+    sync_pytree_in_mesh would pass the leaves through as disjointly owned
+    and silently skip the cross-rank reduction replication requires."""
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("slices",))
+    m = SlicedMetric(MeanSquaredError(), num_slices=10)  # 10 % 8 != 0
+    shardings = shard_sliced_states(m, mesh)
+    assert all(s.spec == P() for s in shardings.values())
+    specs = sliced_partition_specs(m, mesh)
+    assert all(s == P() for s in specs.values())
+    # and a divisible metric claims sharded under the same mesh
+    ok = SlicedMetric(MeanSquaredError(), num_slices=16)
+    assert all(s == P("slices") for s in sliced_partition_specs(ok, mesh).values())
+
+
+def test_shard_sliced_states_survives_update_and_reset():
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("slices",))
+    m = SlicedMetric(MeanSquaredError(), num_slices=16)
+    shardings = shard_sliced_states(m, mesh)
+    assert all(s.spec == P("slices") for s in shardings.values())
+    m.update(jnp.arange(16), jnp.arange(16, dtype=jnp.float32), jnp.zeros(16))
+    assert m.sum_squared_error.sharding.spec == P("slices")
+    m.reset()
+    assert m.sum_squared_error.sharding.spec == P("slices")
+
+
+def test_sync_pytree_partition_specs_mixed_tree():
+    """Slice-sharded leaves pass through untouched while replicated leaves
+    in the SAME pytree still reduce across the axis."""
+    n_dev = 8
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("slices",))
+    S = 16
+    sliced_leaf = jnp.arange(S, dtype=jnp.float32)
+    per_rank = jnp.arange(n_dev, dtype=jnp.float32)[:, None]
+
+    def body(sl, scalar):
+        out = sync_pytree_in_mesh(
+            {"m": {"sl": sl, "scalar": scalar[0]}},
+            {"m": {"sl": "sum", "scalar": "sum"}},
+            "slices",
+            partition_specs={"m": {"sl": P("slices"), "scalar": P()}},
+        )
+        return out["m"]["sl"], out["m"]["scalar"]
+
+    out_sl, out_scalar = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P("slices"), P("slices")),
+            out_specs=(P("slices"), P()),
+        )
+    )(sliced_leaf, per_rank)
+    assert bool(jnp.array_equal(out_sl, sliced_leaf))  # identity: disjoint owners
+    assert float(np.asarray(out_scalar).reshape(-1)[0]) == float(per_rank.sum())
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_footprint_sliced_label_and_per_slice_average(recorder):
+    recorder.enable(footprint_warn_bytes=10**9)
+    S = 100
+    m = SlicedMetric(MeanSquaredError(), num_slices=S)
+    m.update(jnp.array([0, 1]), jnp.array([1.0, 2.0]), jnp.zeros(2))
+    hwm = recorder.footprint_high_water_marks()
+    assert "SlicedMetric[sliced]" in hwm
+    assert "SlicedMetric" not in hwm  # no base-state bytes to misattribute
+    assert hwm["SlicedMetric[sliced]"] == sum(m.state_footprint().values())
+    assert recorder.footprint_slice_counts()["SlicedMetric[sliced]"] == S
+    summary = recorder.summary()
+    assert "B/slice over 100 slices" in summary
+    ev = [e for e in recorder.events() if e["type"] == "footprint"]
+    assert ev and ev[-1]["sliced_bytes"] == hwm["SlicedMetric[sliced]"]
+    assert ev[-1]["n_slices"] == S
+
+
+def test_scatter_events_and_prometheus(recorder):
+    m = SlicedMetric(SumMetric(nan_strategy="ignore"), num_slices=32)
+    m.update(jnp.array([0, 1, 2]), jnp.array([1.0, 2.0, 3.0]))
+    m.update(jnp.array([4, 5]), jnp.array([1.0, 2.0]))
+    totals = recorder.sliced_totals()
+    assert totals["scatter_events"] == 2
+    assert totals["rows"] == 5
+    assert totals["max_slices"] == 32
+    page = recorder.render_prometheus()
+    assert "metrics_tpu_sliced_scatter_total 2" in page
+    assert "metrics_tpu_sliced_rows_total 5" in page
+    assert "metrics_tpu_sliced_slices 32" in page
+    from metrics_tpu.observability.aggregate import aggregate_across_hosts
+
+    agg = aggregate_across_hosts()
+    assert agg["sliced_totals"]["scatter_events"] == 2

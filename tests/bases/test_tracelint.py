@@ -131,6 +131,46 @@ class M(Metric):
         )
         assert "TL-TRACE" not in _rules_of(kept)
 
+    def test_issubdtype_predicate_is_static(self):
+        """`jnp.issubdtype(x.dtype, ...)` is dtype metadata, not a traced
+        value — branching on it (the SlicedMetric slice-id validation
+        idiom) compiles away exactly like a `.dtype` read."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, ids, preds):
+        if not jnp.issubdtype(ids.dtype, jnp.integer):
+            raise ValueError("ids must be integers")
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
+    def test_issubdtype_member_import_is_static_too(self):
+        """The member-import spelling must get the same static-predicate
+        exemption as the jnp-alias spelling."""
+        kept, _ = _check(
+            """
+from jax.numpy import issubdtype
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, ids, preds):
+        if not issubdtype(ids.dtype, jnp.integer):
+            raise ValueError("ids must be integers")
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-TRACE" not in _rules_of(kept)
+
     def test_is_concrete_guard_exempts(self):
         """The eager-only guard pattern (utils/checks.py) must not flag."""
         kept, _ = _check(
@@ -1232,6 +1272,120 @@ class M(Metric):
 """
         )
         assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_segment_sum_scatter_into_sum_state_passes(self):
+        """The sliced subsystem's canonical write: per-row deltas
+        segment-summed into a slice axis, combined additively — reducer-
+        consistent, no finding (and no pragma in metrics_tpu/sliced/)."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("totals", default=jnp.zeros(16), dist_reduce_fx="sum")
+    def _update(self, slice_ids, vals):
+        self.totals = self.totals + jax.ops.segment_sum(vals, slice_ids, num_segments=16)
+    def _compute(self):
+        return self.totals
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_segment_max_folded_into_max_state_passes(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("peaks", default=jnp.zeros(16), dist_reduce_fx="max")
+    def _update(self, slice_ids, vals):
+        self.peaks = jnp.maximum(self.peaks, jax.ops.segment_max(vals, slice_ids, num_segments=16))
+    def _compute(self):
+        return self.peaks
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_scatter_extremum_into_sum_state_flags(self):
+        """`.at[ids].max(...)` reads the prior value syntactically, so the
+        plain overwrite check cannot see it — the scatter-extremum check
+        must: scattered extrema are not additive across ranks."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("totals", default=jnp.zeros(16), dist_reduce_fx="sum")
+    def _update(self, slice_ids, vals):
+        self.totals = self.totals.at[slice_ids].max(vals)
+    def _compute(self):
+        return self.totals
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_scatter_extremum_into_matching_state_passes(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("peaks", default=jnp.zeros(16), dist_reduce_fx="max")
+    def _update(self, slice_ids, vals):
+        self.peaks = self.peaks.at[slice_ids].max(vals)
+    def _compute(self):
+        return self.peaks
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_scatter_extremum_mismatched_direction_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("peaks", default=jnp.zeros(16), dist_reduce_fx="max")
+    def _update(self, slice_ids, vals):
+        self.peaks = self.peaks.at[slice_ids].min(vals)
+    def _compute(self):
+        return self.peaks
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_scatter_add_into_max_state_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("peaks", default=jnp.zeros(16), dist_reduce_fx="max")
+    def _update(self, slice_ids, vals):
+        self.peaks = self.peaks.at[slice_ids].add(vals)
+    def _compute(self):
+        return self.peaks
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_summed_segment_extremum_into_sum_state_flags(self):
+        """`self.x + segment_max(...)` reads the prior value, so the plain
+        overwrite check passes it — but the accumulated quantity is an
+        extremum, not additive across ranks."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("totals", default=jnp.zeros(16), dist_reduce_fx="sum")
+    def _update(self, slice_ids, vals):
+        self.totals = self.totals + jax.ops.segment_max(vals, slice_ids, num_segments=16)
+    def _compute(self):
+        return self.totals
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
 
 
 # ---------------------------------------------------------------------------
