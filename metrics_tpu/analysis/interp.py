@@ -134,6 +134,12 @@ _BOOLISH_MEMBERS = {
 _HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "__array__"}
 _DATA_DEP_METHODS = {"nonzero"}
 
+#: methods of the `.at[...]` functional-update namespace (pure traced
+#: scatter ops; receiver taint is irrelevant)
+_AT_UPDATE_METHODS = {
+    "set", "add", "subtract", "multiply", "divide", "power", "max", "min", "get", "apply",
+}
+
 #: builtins whose results are host/static values (superset of the rule-side
 #: set: pure readers plus shape-free constructors)
 _SAFE_HOST_BUILTINS = {
@@ -285,6 +291,10 @@ class _Value:
     tainted: bool = False
     noneness: str = _MAYBE
     boolish: bool = False
+    #: element-wise values when this abstracts a tuple (a canonicalizer's
+    #: `(preds, target, mode)` return) — lets tuple unpacking keep a host
+    #: element (the mode enum) untainted beside traced arrays
+    elts: Optional[List["_Value"]] = None
 
 
 _HOST = _Value(tainted=False, noneness=_NOT_NONE)
@@ -497,6 +507,7 @@ class _Scanner:
         self.signals: List[Signal] = []
         self.return_value = _Value(tainted=False, noneness=_NOT_NONE)
         self._saw_return = False
+        self._returned_once = False
         #: >0 while scanning a `try` body that has except handlers: callees'
         #: trace-time raises are caught here, so their "trace-raise" signals
         #: are dropped at this call site
@@ -572,12 +583,30 @@ class _Scanner:
                 self._saw_return = True
                 if stmt.value is not None:
                     value = self._eval(stmt.value, env, conditional)
+                    if not self._returned_once:
+                        merged_elts = value.elts
+                    elif (
+                        self.return_value.elts is not None
+                        and value.elts is not None
+                        and len(self.return_value.elts) == len(value.elts)
+                    ):
+                        merged_elts = [
+                            _Value(
+                                tainted=a.tainted or b.tainted,
+                                noneness=a.noneness if a.noneness == b.noneness else _MAYBE,
+                            )
+                            for a, b in zip(self.return_value.elts, value.elts)
+                        ]
+                    else:
+                        merged_elts = None  # mixed return shapes: whole-tuple taint
                     self.return_value = _Value(
                         tainted=self.return_value.tainted or value.tainted,
                         noneness=value.noneness if not self._saw_return else _MAYBE
                         if self.return_value.noneness != value.noneness
                         else value.noneness,
+                        elts=merged_elts,
                     )
+                    self._returned_once = True
             elif isinstance(stmt, ast.Expr):
                 self._eval(stmt.value, env, conditional)
             elif isinstance(stmt, ast.Assert):
@@ -597,9 +626,40 @@ class _Scanner:
             else:
                 continue
 
+    #: when set (the class's __exact_mode_attr__), branches testing
+    #: `self.<attr>` are the opt-in exact mode: runtime-guarded, excluded
+    #: from the default-mode verdict this scan produces
+    exact_attr: Optional[str] = None
+
+    def _exact_branch_side(self, test: ast.AST) -> Optional[str]:
+        """\"body\" when `if self.<exact_attr>:` selects the exact mode in
+        its body, \"orelse\" for the negated spelling, None otherwise."""
+        attr = self.exact_attr
+        if attr is None:
+            return None
+
+        def is_exact_ref(node: ast.AST) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr == attr:
+                return isinstance(node.value, ast.Name) and node.value.id == "self"
+            return isinstance(node, ast.Name) and node.id == attr
+
+        if is_exact_ref(test):
+            return "body"
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) and is_exact_ref(test.operand):
+            return "orelse"
+        return None
+
     def _scan_if(self, stmt: ast.If, env: _Env, conditional: bool) -> bool:
         """Returns True when the remainder of the enclosing block is
         eager-only (the ``if not _is_concrete(...): raise`` idiom)."""
+        exact_side = self._exact_branch_side(stmt.test)
+        if exact_side is not None:
+            # declared mode split: only the default (sketch) side counts
+            # toward the class verdict; the exact side is runtime-guarded
+            self._scan_stmts(
+                stmt.orelse if exact_side == "body" else stmt.body, env, conditional
+            )
+            return False
         if _mentions_concrete_guard(stmt.test):
             # guarded side is host-only by contract; the else side traces
             self._scan_stmts(stmt.orelse, env, conditional)
@@ -687,6 +747,12 @@ class _Scanner:
         if isinstance(tgt, ast.Name):
             env.bind(tgt.id, value)
         elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if value.elts is not None and len(value.elts) == len(tgt.elts):
+                # element-wise tuple taint (a resolved callee returning
+                # `(traced, traced, host_mode)` must not taint the mode)
+                for el, ev in zip(tgt.elts, value.elts):
+                    self._bind_target(el, ev, env)
+                return
             for el in tgt.elts:
                 self._bind_target(el, _Value(tainted=value.tainted, noneness=_MAYBE), env)
         elif isinstance(tgt, ast.Starred):
@@ -742,6 +808,13 @@ class _Scanner:
             return _Value(tainted=base.tainted, noneness=_MAYBE)
         if isinstance(node, ast.Call):
             return self._eval_call(node, env, conditional)
+        if isinstance(node, (ast.Tuple, ast.List)) and not isinstance(node.ctx, ast.Store):
+            elts = [self._eval(e, env, conditional) for e in node.elts]
+            return _Value(
+                tainted=any(v.tainted for v in elts),
+                noneness=_NOT_NONE,
+                elts=elts if isinstance(node, ast.Tuple) else None,
+            )
         if isinstance(node, ast.Compare):
             values = [self._eval(node.left, env, conditional)] + [
                 self._eval(c, env, conditional) for c in node.comparators
@@ -947,6 +1020,10 @@ class _Scanner:
             if root is not None and len(chain) >= 2:
                 if root in self.ctx.jnp_aliases and len(chain) == 2:
                     return self._jnp_call(member, node, arg_values, kw_values, env, conditional)
+                if root in self.ctx.jnp_aliases and len(chain) > 2:
+                    # jnp submodule ops (jnp.linalg.norm, jnp.fft.*): ordinary
+                    # traced-pure array programs, like their top-level kin
+                    return _Value(tainted=True, noneness=_NOT_NONE)
                 if root in self.ctx.lax_aliases or (
                     len(chain) >= 3 and root in self.ctx.jax_aliases and chain[1] == "lax"
                 ):
@@ -991,6 +1068,16 @@ class _Scanner:
                         node,
                     )
                 return _Value(tainted=False, noneness=_MAYBE)
+            # `x.at[idx].set/add/...` — jax's pure functional scatter-update
+            # namespace: a traced array op whatever the receiver's taint
+            if (
+                member in _AT_UPDATE_METHODS
+                and isinstance(func.value, ast.Subscript)
+                and isinstance(func.value.value, ast.Attribute)
+                and func.value.value.attr == "at"
+            ):
+                self._eval(func.value, env, conditional)
+                return _Value(tainted=True, noneness=_NOT_NONE)
             # method on an evaluated receiver
             receiver = self._eval(func.value, env, conditional)
             if (
@@ -1055,6 +1142,10 @@ class _Scanner:
         conditional: bool,
     ) -> _Value:
         if member in _DATA_DEP_MEMBERS:
+            if member in ("nonzero", "flatnonzero") and "size" in kw_values:
+                # `size=` pads/truncates to a STATIC length — the fixed-shape
+                # scatter-index idiom the capacity buffers and sketches use
+                return _Value(tainted=True, noneness=_NOT_NONE)
             self._emit(
                 REASON_DATA_SHAPE,
                 f"`jnp.{member}` has a data-dependent output shape",
@@ -1242,7 +1333,7 @@ def summarize_function(
     )
     cached = project._summary_cache.get(key)
     if cached is not None:
-        return list(cached[0]), _Value(tainted=cached[1], noneness=cached[2])
+        return list(cached[0]), _Value(tainted=cached[1], noneness=cached[2], elts=cached[3])
     if key in project._in_progress:
         return [], _Value(tainted=True, noneness=_MAYBE)  # recursion: optimistic
     project._in_progress.add(key)
@@ -1250,9 +1341,16 @@ def summarize_function(
         scanner = _Scanner(project, ctx, depth)
         env = _Env(traced=set(tainted), noneness=dict(noneness))
         scanner.scan(fn, env)
-        result = (scanner.signals, scanner.return_value.tainted, scanner.return_value.noneness)
-        project._summary_cache[key] = (list(scanner.signals), result[1], result[2])
-        return result[0], _Value(tainted=result[1], noneness=result[2])
+        ret = scanner.return_value
+        # element values survive memoization WITHOUT nested elts (one level
+        # is what tuple unpacking at the call site consumes)
+        elts = (
+            [_Value(tainted=e.tainted, noneness=e.noneness) for e in ret.elts]
+            if ret.elts is not None
+            else None
+        )
+        project._summary_cache[key] = (list(scanner.signals), ret.tainted, ret.noneness, elts)
+        return list(scanner.signals), _Value(tainted=ret.tainted, noneness=ret.noneness, elts=elts)
     finally:
         project._in_progress.discard(key)
 
@@ -1268,6 +1366,10 @@ _CONTAINER_UNKNOWN = "unknown"
 
 #: jnp constructors whose first argument is the shape
 _SHAPED_CTORS = {"zeros", "ones", "empty", "full"}
+
+#: metrics_tpu/sketches/ state initializers: fixed-shape float32 leaves
+#: with the capacity as the leading dim
+_SKETCH_INIT_CTORS = {"qsketch_init", "ranksketch_init", "reservoir_init", "hist_init"}
 
 _DTYPE_DEFAULTS = {"zeros": "float32", "ones": "float32", "empty": "float32", "full": None}
 
@@ -1302,8 +1404,11 @@ def _dtype_name(node: Optional[ast.AST]) -> Optional[str]:
     if node is None:
         return None
     name = _last_name(node)
-    if name and (name.startswith(("int", "uint", "float", "bfloat", "complex")) or name == "bool_"):
-        return "bool" if name == "bool_" else name
+    if name and (
+        name.startswith(("int", "uint", "float", "bfloat", "complex"))
+        or name in ("bool_", "bool")
+    ):
+        return "bool" if name in ("bool_", "bool") else name
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
     return None
@@ -1394,6 +1499,12 @@ def _infer_default(
     if isinstance(expr, ast.Call):
         member = _last_name(expr.func)
         dtype_kw = next((kw.value for kw in expr.keywords if kw.arg == "dtype"), None)
+        if member in _SKETCH_INIT_CTORS:
+            # the sketches/ initializers return fixed float32 arrays whose
+            # leading dim is the capacity argument (metrics register their
+            # defaults through them; column count is layout-derived)
+            dim0 = _dim_of(expr.args[0]) if expr.args else "?"
+            return _CONTAINER_ARRAY, [dim0, "?"], "float32"
         if member in _SHAPED_CTORS:
             shape = _shape_of(expr.args[0]) if expr.args else None
             dtype = _dtype_name(dtype_kw) or (
@@ -1420,7 +1531,7 @@ def _infer_default(
     return _CONTAINER_UNKNOWN, None, None
 
 
-_STRING_REDUCERS = {"sum", "mean", "max", "min", "cat"}
+_STRING_REDUCERS = {"sum", "mean", "max", "min", "cat", "merge"}
 
 #: reducers with an exact slice-axis scatter (see StateEntry.sliceable)
 _SLICEABLE_REDUCERS = {"sum", "max", "min"}
@@ -1442,6 +1553,13 @@ def _reducer_of(call: ast.Call) -> Optional[str]:
             return None
         if isinstance(fx.value, str) and fx.value in _STRING_REDUCERS:
             return fx.value
+    if isinstance(fx, ast.Call):
+        # the sketch modules' tagged merge reducers (`sketch_merge_fx()`,
+        # `reservoir_merge_fx()`, `ranksketch_merge_fx()`): a self-merging
+        # leaf, distinct from an arbitrary custom callable
+        name = _last_name(fx.func)
+        if name is not None and name.endswith("merge_fx"):
+            return "merge"
     return "custom"
 
 
@@ -1504,6 +1622,29 @@ class ClassFacts:
     update: Optional[Tuple[FileContext, ast.FunctionDef]]
     chain: List[Tuple[FileContext, ast.ClassDef]]
     is_metric: bool
+    exact_attr: Optional[str] = None  # __exact_mode_attr__ declaration
+
+
+def _exact_mode_attr(class_node: ast.ClassDef) -> Optional[str]:
+    """The ``__exact_mode_attr__ = "<attr>"`` declaration, if present.
+
+    The mode-split contract for sketch-converted metrics: branches testing
+    ``self.<attr>`` (and states registered only there) belong to the opt-in
+    exact mode, which is runtime-guarded (live list states + instance-level
+    ``__jit_unsafe__``) — the class-level verdict describes the DEFAULT
+    (sketch) mode, so the scanner skips the declared exact branches.
+    """
+    for stmt in class_node.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "__exact_mode_attr__"
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            return stmt.value.value
+    return None
 
 
 def _own_declaration(class_node: ast.ClassDef) -> Tuple[Optional[bool], bool]:
@@ -1613,6 +1754,11 @@ def class_facts(project: Project, ctx: FileContext, class_node: ast.ClassDef) ->
             break
 
     declared_here, computed_here = _own_declaration(class_node)
+    exact_attr = None
+    for cur_ctx, cur_node in chain:
+        exact_attr = _exact_mode_attr(cur_node)
+        if exact_attr is not None:
+            break
     return ClassFacts(
         name=class_node.name,
         relpath=ctx.relpath,
@@ -1624,6 +1770,7 @@ def class_facts(project: Project, ctx: FileContext, class_node: ast.ClassDef) ->
         update=update,
         chain=chain,
         is_metric=is_metric,
+        exact_attr=exact_attr,
     )
 
 
@@ -1693,6 +1840,7 @@ def classify(project: Project, ctx: FileContext, class_node: ast.ClassDef) -> Tu
         )
     scanner = _Scanner(project, up_ctx, _DEPTH_BUDGET)
     scanner._method_resolver = _method_resolver_for(project, facts)
+    scanner.exact_attr = facts.exact_attr
     params = {a.arg for a in list(up_fn.args.posonlyargs) + list(up_fn.args.args) if a.arg != "self"}
     params.update(a.arg for a in up_fn.args.kwonlyargs)
     if up_fn.args.vararg:

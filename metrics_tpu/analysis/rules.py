@@ -13,6 +13,7 @@ import ast
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
 from .engine import FileContext, Violation
+from .interp import _always_raises, _is_not_concrete_test
 
 RULE_REGISTRY: Dict[str, "Rule"] = {}
 
@@ -82,13 +83,15 @@ def _attr_chain(node: ast.AST) -> List[str]:
 
 
 #: string reducers ``add_state`` accepts (core/metric.py:244-255)
-KNOWN_REDUCERS = {"sum", "mean", "max", "min", "cat"}
+KNOWN_REDUCERS = {"sum", "mean", "max", "min", "cat", "merge"}
 
 #: methods whose bodies are trace-scoped (the jit/fusion surface)
 TRACED_METHODS = {"_update", "_compute", "update", "compute", "update_state", "compute_state"}
 
 #: method-name patterns allowed to assign registered state
-_STATE_WRITE_TOKENS = ("update", "reset", "sync", "bind", "restore", "merge", "load", "init")
+_STATE_WRITE_TOKENS = (
+    "update", "reset", "sync", "bind", "restore", "merge", "load", "init", "insert",
+)
 _STATE_WRITE_METHODS = {"__init__", "set_dtype", "to_device", "shard_states", "state_dict"}
 
 #: attributes that are static under tracing — touching them is NOT a host
@@ -393,6 +396,11 @@ class TraceRule(Rule):
                     # eager-only branch: host syncs here are the sanctioned
                     # pattern; the else branch is the traced path
                     yield from self._scan_stmts(ctx, stmt.orelse, traced)
+                    if _is_not_concrete_test(stmt.test) and _always_raises(stmt.body):
+                        # `if not _is_concrete(...): raise` — everything after
+                        # this statement is eager-only by construction (the
+                        # sketch-compute host-readback idiom)
+                        return
                     continue
                 # isinstance-bearing tests are host type-dispatch (the
                 # list-vs-array state idiom), not value reads

@@ -76,7 +76,7 @@ def _state_reducers(class_node: ast.ClassDef) -> Dict[str, str]:
             continue
         if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):
             reducer = _reducer_of(node)
-            if isinstance(reducer, str) and reducer in {"sum", "mean", "max", "min", "cat"}:
+            if isinstance(reducer, str) and reducer in {"sum", "mean", "max", "min", "cat", "merge"}:
                 out[node.args[0].value] = reducer
     return out
 
@@ -285,6 +285,39 @@ def _check_update_writes(
                     f"`\"sum\"`-reduced state `{attr}` mutated with `{kind}` in "
                     f"`{method.name}`; only additive accumulation keeps per-rank values "
                     "summable across the mesh",
+                )
+        elif reducer == "merge":
+            # sketch leaves (metrics_tpu/sketches/): the leaf is a PACKED
+            # structure whose only consistent accumulation is a self-merging
+            # transform — an insert/merge call that receives the prior leaf.
+            # Element-wise arithmetic corrupts the (weight, key, payload)
+            # layout the cross-rank merge reducer trusts.
+            if kind in ("Add", "Sub") or (
+                kind == "assign"
+                and isinstance(rhs, ast.BinOp)
+                and isinstance(rhs.op, _ADDITIVE_AUG_OPS)
+            ):
+                yield FlowFinding(
+                    stmt,
+                    f"`\"merge\"`-reduced sketch state `{attr}` accumulated additively in "
+                    f"`{method.name}`; a packed sketch leaf is not element-wise summable — "
+                    "route the batch through the sketch's insert/merge transform "
+                    f"(`self.{attr} = qsketch_insert(self.{attr}, ...)`)",
+                )
+            elif kind == "assign" and rhs is not None and not rhs_reads_prior(rhs):
+                yield FlowFinding(
+                    stmt,
+                    f"`\"merge\"`-reduced sketch state `{attr}` overwritten in "
+                    f"`{method.name}` without reading its prior value; the overwrite "
+                    "discards earlier batches on this rank — insert into the prior leaf "
+                    "instead",
+                )
+            elif kind not in ("assign", "Add", "Sub"):
+                yield FlowFinding(
+                    stmt,
+                    f"`\"merge\"`-reduced sketch state `{attr}` mutated with `{kind}` in "
+                    f"`{method.name}`; only the sketch's own insert/merge transforms keep "
+                    "the packed layout mergeable across ranks",
                 )
         elif reducer in ("max", "min"):
             additive = (kind in ("Add", "Sub")) or (

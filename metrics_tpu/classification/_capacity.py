@@ -16,7 +16,6 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.exact_curve import curve_buffer_init
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 try:  # jax.core.is_concrete moved across versions; checks has the shim
@@ -48,14 +47,21 @@ class CapacityCurveMixin:
         self._capacity = capacity
         self._capacity_cols = num_cols
         self._capacity_multilabel = multilabel
-        buf = curve_buffer_init(capacity)
-        preds_default = buf["preds"] if num_cols is None else jnp.zeros((capacity, num_cols), jnp.float32)
+        # defaults spelled as the zeros arrays curve_buffer_init produces so
+        # the abstract interpreter reads container/shape/dtype statically
+        preds_default = (
+            jnp.zeros((capacity,), dtype=jnp.float32)
+            if num_cols is None
+            else jnp.zeros((capacity, num_cols), dtype=jnp.float32)
+        )
         target_default = (
-            jnp.zeros((capacity, num_cols), jnp.int32) if multilabel else buf["target"]
+            jnp.zeros((capacity, num_cols), dtype=jnp.int32)
+            if multilabel
+            else jnp.zeros((capacity,), dtype=jnp.int32)
         )
         self.add_state("preds", default=preds_default, dist_reduce_fx="cat")
         self.add_state("target", default=target_default, dist_reduce_fx="cat")
-        self.add_state("valid", default=buf["valid"], dist_reduce_fx="cat")
+        self.add_state("valid", default=jnp.zeros((capacity,), dtype=bool), dist_reduce_fx="cat")
         # overflow tally: counts samples dropped by the `mode='drop'` scatter
         # when the fill count is traced (inside jit the eager raise below
         # cannot fire); compute NaN-poisons / raises when it is non-zero so a

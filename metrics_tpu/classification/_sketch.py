@@ -1,0 +1,211 @@
+"""Sketch-backed streaming mode for the curve metric classes.
+
+The DEFAULT mode of AUROC / ROC / PrecisionRecallCurve / AveragePrecision:
+instead of appending unbounded cat-lists, canonicalized batches stream into
+one packed quantile-sketch leaf (``metrics_tpu/sketches/quantile.py``) —
+O(capacity) memory, fixed-shape jit-safe update (so the metric fuses,
+buckets via the ``n_valid`` pad-mask contract, and rides the async
+pipeline), and a ``"merge"`` reducer that syncs across ranks in the
+existing collective round.
+
+Row layouts (column 0 is always the weight):
+
+* binary:       ``[capacity, 3]``       — (w, score, y)
+* per-class:    ``[capacity, 2 + 2C]``  — (w, max-score key, C scores,
+  C one-hot/indicator columns)
+
+Targets are stored as (possibly fractional, post-compaction) positive-class
+indicator mass: pair collapse preserves every weighted TP/FP functional
+exactly, so only score displacement inside a collapsed pair — the quantile
+sketch's bounded rank error — degrades the curves.
+
+**Lossless window / bit parity.** Until the first compaction
+(``fill == n_seen``) the sketch holds the exact canonicalized stream in
+arrival order; compute reconstructs the arrays and runs the SAME unbounded
+kernels as ``exact=True``, reproducing yesterday's default bit-for-bit.
+Past capacity the weighted kernels (``functional/classification/
+sketch_curve.py``) take over under the advertised rank-error envelope.
+
+``__exact_mode_attr__ = "_exact"`` declares the mode split to the
+tracelint abstract interpreter: the class-level verdict describes THIS
+default mode; ``exact=True`` instances register list states through
+``sketches.compat`` and flip instance-level ``__jit_unsafe__``, which the
+fused path's structural check guards before any manifest lookup.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.sketches.quantile import (
+    qsketch_fill,
+    qsketch_init,
+    qsketch_insert,
+    sketch_merge_fx,
+)
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+try:
+    from metrics_tpu.utils.checks import _is_concrete
+except ImportError:  # pragma: no cover
+    def _is_concrete(*arrays):
+        return True
+
+Array = jax.Array
+
+#: default quantile-sketch capacity for the curve family — 3 float32
+#: columns at 8192 rows is ~96 KiB (binary case) for <0.05% relative rank
+#: error, and every stream that fits stays bit-exact
+DEFAULT_SKETCH_CAPACITY = 8192
+
+
+class SketchCurveMixin:
+    """Adds the sketch-backed default mode. Call ``_init_sketch_curve`` in
+    ``__init__`` for the default (non-exact, non-capacity) configuration;
+    guard ``_update``/``_compute`` with ``self._sketch_capacity``."""
+
+    _sketch_capacity: Optional[int] = None
+    _sketch_cols: Optional[int] = None  # None = binary; C = per-class rows
+    _sketch_tgt_kind: Optional[str] = None  # "int" (one-hot) | "indicator"
+    _exact: bool = False
+
+    def _init_sketch_curve(self, sketch_capacity: int, num_classes: Optional[int]) -> None:
+        if not (isinstance(sketch_capacity, int) and sketch_capacity > 0):
+            raise ValueError(
+                f"Argument `sketch_capacity` must be a positive int, got {sketch_capacity}"
+            )
+        self._sketch_capacity = sketch_capacity
+        self._sketch_cols = num_classes if (num_classes is not None and num_classes >= 2) else None
+        payload = 1 if self._sketch_cols is None else 2 * self._sketch_cols
+        self.add_state(
+            "csketch",
+            default=qsketch_init(sketch_capacity, payload_cols=payload),
+            dist_reduce_fx=sketch_merge_fx(),
+        )
+        self.add_state("n_seen", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    _sketch_case_locked: bool = False
+
+    def _rebuild_sketch_case(self, num_cols: Optional[int]) -> None:
+        """Re-register the sketch for the case the first batch actually has
+        (mirrors the unbounded path's first-update mode inference). Only
+        legal before any row landed: the host-side case lock (set by the
+        first successful insert) raises the unbounded path's mode-change
+        error afterwards, and a concretely non-empty sketch (e.g. restored
+        from a checkpoint) refuses too."""
+        if self._sketch_case_locked:
+            raise ValueError(
+                "The mode of data (binary, multi-label, multi-class) should be constant,"
+                " but changed between batches"
+            )
+        fill = qsketch_fill(self.csketch)
+        if _is_concrete(fill) and int(fill) > 0:
+            raise ValueError(
+                "The mode of data (binary, multi-label, multi-class) should be constant,"
+                " but changed between batches"
+            )
+        self._sketch_cols = num_cols
+        self._sketch_tgt_kind = None
+        payload = 1 if num_cols is None else 2 * num_cols
+        self.add_state(
+            "csketch",
+            default=qsketch_init(self._sketch_capacity, payload_cols=payload),
+            dist_reduce_fx=sketch_merge_fx(),
+        )
+
+    # ------------------------------------------------------------------
+    # update
+    # ------------------------------------------------------------------
+    def _sketch_insert_canonical(
+        self,
+        preds: Array,
+        target: Array,
+        pos_label: Optional[int],
+        n_valid: Optional[Array] = None,
+    ) -> None:
+        """Insert one canonicalized batch (the `_*_update` kernel outputs:
+        flat binary scores + integer targets, or ``[N, C]`` score rows with
+        integer labels / indicator rows)."""
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if preds.ndim == 1:
+            if self._sketch_cols is not None:
+                self._rebuild_sketch_case(None)
+            pl = 1 if pos_label is None else pos_label
+            y = (target == pl).astype(jnp.float32)
+            self.csketch = qsketch_insert(
+                self.csketch, preds, payload=y[:, None], n_valid=n_valid
+            )
+        else:
+            c = preds.shape[1]
+            if self._sketch_cols != c:
+                self._rebuild_sketch_case(c)
+            if target.ndim == 1:
+                tgt_kind = "int"
+                ytab = (
+                    target[:, None] == jnp.arange(c, dtype=target.dtype)[None, :]
+                ).astype(jnp.float32)
+            else:
+                tgt_kind = "indicator"
+                ytab = target.astype(jnp.float32)
+            if self._sketch_tgt_kind is not None and self._sketch_tgt_kind != tgt_kind:
+                raise ValueError(
+                    "The mode of data (binary, multi-label, multi-class) should be"
+                    " constant, but changed between batches"
+                )
+            self._sketch_tgt_kind = tgt_kind
+            key = jnp.max(preds.astype(jnp.float32), axis=1)
+            payload = jnp.concatenate([preds.astype(jnp.float32), ytab], axis=1)
+            self.csketch = qsketch_insert(self.csketch, key, payload=payload, n_valid=n_valid)
+        self.n_seen = self.n_seen + preds.shape[0]
+        # host-side case lock: later batches of a DIFFERENT case raise the
+        # mode-change error even where the fill count is not concretely
+        # readable (inside jit)
+        self._sketch_case_locked = True
+
+    # ------------------------------------------------------------------
+    # compute-side views (host only — the readbacks the update path never pays)
+    # ------------------------------------------------------------------
+    def _sketch_is_lossless(self) -> bool:
+        """No compaction has ever dropped a row: the sketch IS the stream
+        (weights 1, arrival order), so the exact kernels apply bit-for-bit."""
+        fill = qsketch_fill(self.csketch)
+        n_seen = jnp.asarray(self.n_seen)
+        if not _is_concrete(fill, n_seen):
+            raise MetricsUserError(
+                "sketch-backed curve compute reads the occupancy on the host and cannot"
+                " run under jit; compute eagerly (update_state/FusedUpdate remain jit-safe)"
+            )
+        return int(fill) == int(n_seen)
+
+    def _sketch_rows(self):
+        """Occupied rows as ``(w, key, payload)`` host-sliced arrays."""
+        leaf = jnp.asarray(self.csketch)
+        n = int(qsketch_fill(leaf))
+        rows = leaf[:n]
+        return rows[:, 0], rows[:, 1], rows[:, 2:]
+
+    def _sketch_exact_arrays(self):
+        """Reconstruct the canonicalized stream inside the lossless window:
+        ``(preds, target, pos_label_for_compute)`` exactly as the unbounded
+        path would have accumulated them (targets come back as the stored
+        indicators, so the positive class is 1 by construction)."""
+        _, key, payload = self._sketch_rows()
+        if self._sketch_cols is None:
+            return key, payload[:, 0].astype(jnp.int32), 1
+        c = self._sketch_cols
+        scores = payload[:, :c]
+        ytab = payload[:, c:]
+        if self._sketch_tgt_kind == "indicator":
+            return scores, ytab.astype(jnp.int32), 1
+        return scores, jnp.argmax(ytab, axis=1).astype(jnp.int32), None
+
+    def _sketch_weighted_arrays(self):
+        """Post-compaction view: ``(scores, y, w)`` with y the (possibly
+        fractional) per-row positive mass; per-class case returns
+        ``([n, C] scores, [n, C] y, [n] w)``."""
+        w, key, payload = self._sketch_rows()
+        if self._sketch_cols is None:
+            return key, payload[:, 0], w
+        c = self._sketch_cols
+        return payload[:, :c], payload[:, c:], w

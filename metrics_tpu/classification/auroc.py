@@ -1,7 +1,17 @@
-"""Modular AUROC (cat-state, exact sorted mode).
+"""Modular AUROC (sketch-backed streaming default; exact modes opt-in).
 
 Behavior parity with /root/reference/torchmetrics/classification/auroc.py:27-181,
-including the memory-footprint warning (auroc.py:146-149) and mode locking.
+including mode locking. Three state modes:
+
+* **default** — quantile-sketch streaming state (``metrics_tpu/sketches/``):
+  O(``sketch_capacity``) memory, fixed-shape jit-safe update (fusible /
+  bucketable / async-capable), ``"merge"``-reduced across ranks. Bit-equal
+  to ``exact=True`` for every stream that fits the capacity (the lossless
+  window); beyond it, weighted kernels under the sketch's rank-error bound.
+* ``exact=True`` — the reference's unbounded cat-state path (and its
+  memory-footprint warning, auroc.py:146-149), bit-for-bit.
+* ``capacity=N`` — the static exact buffer mode (jit-safe exact curves,
+  raises on overflow; see classification/_capacity.py).
 """
 from typing import Any, Optional
 
@@ -9,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.classification._capacity import CapacityCurveMixin
+from metrics_tpu.classification._sketch import DEFAULT_SKETCH_CAPACITY, SketchCurveMixin
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import (
     _auroc_compute,
@@ -16,14 +27,20 @@ from metrics_tpu.functional.classification.auroc import (
     auroc_rank_multiclass_masked,
 )
 from metrics_tpu.functional.classification.exact_curve import binary_auroc_fixed
+from metrics_tpu.functional.classification.sketch_curve import (
+    average_class_scores,
+    binary_auroc_max_fpr_weighted,
+    binary_auroc_weighted,
+    weighted_class_supports,
+)
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
 from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.enums import AverageMethod
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.enums import AverageMethod, DataType
 
 Array = jax.Array
 
 
-class AUROC(CapacityCurveMixin, Metric):
+class AUROC(SketchCurveMixin, CapacityCurveMixin, Metric):
     """Computes the Area Under the Receiver Operating Characteristic Curve.
 
     Example:
@@ -35,7 +52,9 @@ class AUROC(CapacityCurveMixin, Metric):
         Array(0.5, dtype=float32)
     """
 
-    __jit_unsafe__ = True
+    __jit_unsafe__ = False  # sketch default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"  # tracelint: classify the default mode
+    __fused_mask_valid__ = True  # bucketed pads mask out via n_valid
     is_differentiable = False
     higher_is_better = True
 
@@ -46,6 +65,8 @@ class AUROC(CapacityCurveMixin, Metric):
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
         capacity: Optional[int] = None,
+        exact: bool = False,
+        sketch_capacity: int = DEFAULT_SKETCH_CAPACITY,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -62,8 +83,11 @@ class AUROC(CapacityCurveMixin, Metric):
 
         if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+        if exact and capacity is not None:
+            raise ValueError("`exact=True` and `capacity` are mutually exclusive state modes")
 
         self.mode = None
+        self._exact = bool(exact)
         if capacity is not None:
             # TPU-native exact mode: static [capacity] buffers, fully jit-safe.
             # Binary (num_classes None/1) uses the curve-buffer triple;
@@ -83,31 +107,32 @@ class AUROC(CapacityCurveMixin, Metric):
             else:
                 self._init_capacity(capacity)
                 self._multiclass_capacity = False
+        elif self._exact:
+            register_exact_list_states(self, ("preds", "target"))
+            warn_exact_buffer("AUROC")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
-
-            rank_zero_warn(
-                "Metric `AUROC` will save all targets and predictions in buffer."
-                " For large datasets this may lead to large memory footprint."
-            )
+            self._init_sketch_curve(sketch_capacity, num_classes)
 
     _multiclass_capacity: bool = False
 
-    def _update(self, preds: Array, target: Array) -> None:
+    def _update(self, preds: Array, target: Array, n_valid: Optional[Array] = None) -> None:
         if self._capacity is not None:
             self._capacity_update(
                 preds, target, pos_label=None if self._multiclass_capacity else self.pos_label
             )
             return
         preds, target, mode = _auroc_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
-
         if self.mode and self.mode != mode:
             raise ValueError(
                 "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
                 f" between batches from {self.mode} to {mode}"
+            )
+        if self._exact:
+            self.preds.append(preds)
+            self.target.append(target)
+        else:
+            self._sketch_insert_canonical(
+                preds, target, self.pos_label if mode == DataType.BINARY else 1, n_valid=n_valid
             )
         self.mode = mode
 
@@ -121,14 +146,38 @@ class AUROC(CapacityCurveMixin, Metric):
             return binary_auroc_fixed(*self._capacity_buffers())
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        return _auroc_compute(
-            preds,
-            target,
-            self.mode,
-            self.num_classes,
-            self.pos_label,
-            self.average,
-            self.max_fpr,
-        )
+        if self._exact:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return _auroc_compute(
+                preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
+            )
+        if self._sketch_is_lossless():
+            preds, target, pos_label = self._sketch_exact_arrays()
+            return _auroc_compute(
+                preds, target, self.mode, self.num_classes, pos_label, self.average, self.max_fpr
+            )
+        return self._sketch_approx_compute()
+
+    def _sketch_approx_compute(self) -> Array:
+        """Weighted AUROC from the compacted sketch rows (beyond the
+        lossless window; error bounded by the sketch's rank-error envelope)."""
+        scores, y, w = self._sketch_weighted_arrays()
+        if self.max_fpr is not None and self.mode != DataType.BINARY:
+            # the exact/lossless paths raise this inside _auroc_compute; the
+            # misconfiguration must stay loud past the window too
+            raise ValueError(
+                "Partial AUC computation not available in multilabel/multiclass setting,"
+                f" 'max_fpr' must be set to `None`, received `{self.max_fpr}`."
+            )
+        if self.mode == DataType.BINARY:
+            if self.max_fpr is not None and self.max_fpr < 1:
+                return binary_auroc_max_fpr_weighted(scores, y, w, self.max_fpr)
+            return binary_auroc_weighted(scores, y, w)
+        if self.mode == DataType.MULTILABEL and self.average == AverageMethod.MICRO:
+            flat_w = jnp.broadcast_to(w[:, None], y.shape).reshape(-1)
+            return binary_auroc_weighted(scores.reshape(-1), y.reshape(-1), flat_w)
+        per_class = jax.vmap(binary_auroc_weighted, in_axes=(1, 1, None))(scores, y, w)
+        supports = weighted_class_supports(y, w)
+        average = None if self.average == AverageMethod.NONE else self.average
+        return average_class_scores(per_class, supports, average)

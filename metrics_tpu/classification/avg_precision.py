@@ -1,12 +1,18 @@
-"""Modular AveragePrecision (cat-state, exact sorted mode).
+"""Modular AveragePrecision (sketch-backed streaming default).
 
 Behavior parity with /root/reference/torchmetrics/classification/avg_precision.py:28-143.
+State modes as in auroc.py: streaming quantile sketch by default (bit-equal
+to ``exact=True`` inside the lossless window, weighted step-sum beyond),
+``exact=True`` for the unbounded cat-state path, ``capacity=N`` for the
+static exact buffers.
 """
 from typing import Any, List, Optional, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.classification._capacity import CapacityCurveMixin
+from metrics_tpu.classification._sketch import DEFAULT_SKETCH_CAPACITY, SketchCurveMixin
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.exact_curve import (
     binary_average_precision_fixed,
@@ -16,12 +22,18 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
+from metrics_tpu.functional.classification.sketch_curve import (
+    average_class_scores,
+    binary_average_precision_weighted,
+    weighted_class_supports,
+)
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
-class AveragePrecision(CapacityCurveMixin, Metric):
+class AveragePrecision(SketchCurveMixin, CapacityCurveMixin, Metric):
     """Computes the average precision score.
 
     Example:
@@ -33,7 +45,9 @@ class AveragePrecision(CapacityCurveMixin, Metric):
         Array(1., dtype=float32)
     """
 
-    __jit_unsafe__ = True
+    __jit_unsafe__ = False  # sketch default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"
+    __fused_mask_valid__ = True
     is_differentiable = False
     higher_is_better = True
 
@@ -44,6 +58,8 @@ class AveragePrecision(CapacityCurveMixin, Metric):
         average: Optional[str] = "macro",
         capacity: Optional[int] = None,
         multilabel: bool = False,
+        exact: bool = False,
+        sketch_capacity: int = DEFAULT_SKETCH_CAPACITY,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -53,6 +69,9 @@ class AveragePrecision(CapacityCurveMixin, Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
+        self._exact = bool(exact)
+        if exact and capacity is not None:
+            raise ValueError("`exact=True` and `capacity` are mutually exclusive state modes")
         # TPU-native exact mode: static [capacity] buffers, fully jit-safe.
         # Binary keeps the flat triple; num_classes >= 2 keeps [capacity, C]
         # score rows (one-vs-rest AP per class); `multilabel=True`
@@ -69,18 +88,26 @@ class AveragePrecision(CapacityCurveMixin, Metric):
             raise ValueError("Cannot use `micro` average with multi-class input")
         self._init_capacity_case(capacity, num_classes, multilabel)
         if capacity is None:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            if self._exact:
+                register_exact_list_states(self, ("preds", "target"))
+                warn_exact_buffer("AveragePrecision")
+            else:
+                self._init_sketch_curve(sketch_capacity, num_classes)
 
-    def _update(self, preds: Array, target: Array) -> None:
+    def _update(self, preds: Array, target: Array, n_valid: Optional[Array] = None) -> None:
         if self._capacity is not None:
             self._capacity_update(preds, target, pos_label=self.pos_label)
             return
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )
-        self.preds.append(preds)
-        self.target.append(target)
+        if self._exact:
+            self.preds.append(preds)
+            self.target.append(target)
+        else:
+            self._sketch_insert_canonical(
+                preds, target, pos_label if preds.ndim == 1 else 1, n_valid=n_valid
+            )
         self.num_classes = num_classes
         self.pos_label = pos_label
 
@@ -94,6 +121,23 @@ class AveragePrecision(CapacityCurveMixin, Metric):
                     multilabel=self._capacity_multilabel,
                 )
             return binary_average_precision_fixed(*self._capacity_buffers())
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)
+        if self._exact:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)
+        if self._sketch_is_lossless():
+            preds, target, pos_label = self._sketch_exact_arrays()
+            return _average_precision_compute(preds, target, self.num_classes, pos_label, self.average)
+        return self._sketch_approx_compute()
+
+    def _sketch_approx_compute(self):
+        """Weighted average precision from the compacted sketch rows."""
+        scores, y, w = self._sketch_weighted_arrays()
+        if self._sketch_cols is None:
+            return binary_average_precision_weighted(scores, y, w)
+        if self.average == "micro":
+            flat_w = jnp.broadcast_to(w[:, None], y.shape).reshape(-1)
+            return binary_average_precision_weighted(scores.reshape(-1), y.reshape(-1), flat_w)
+        per_class = jax.vmap(binary_average_precision_weighted, in_axes=(1, 1, None))(scores, y, w)
+        supports = weighted_class_supports(y, w)
+        return average_class_scores(per_class, supports, self.average)

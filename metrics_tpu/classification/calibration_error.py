@@ -1,15 +1,25 @@
-"""Modular CalibrationError.
+"""Modular CalibrationError (binned streaming default; exact opt-in).
 
 Behavior parity with /root/reference/torchmetrics/classification/
-calibration_error.py:24-110.
+calibration_error.py:24-110. The exact compute bins confidences into
+``n_bins`` anyway, so the per-bin weighted sums (count / confidence /
+accuracy — ``metrics_tpu/sketches/histogram.py``) are SUFFICIENT
+statistics: the default streaming state is O(n_bins), exact for every
+stream length (up to float summation order; bit-exact when scores align
+to bin boundaries — pinned in tests), and made of plain ``"sum"``-reduced
+leaves, so it fuses, buckets with the exact ``k * delta`` pad correction,
+slices per-tenant, and mesh-syncs in the fused all-reduce round with zero
+new plumbing. ``exact=True`` restores the unbounded cat-state path.
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.calibration_error import _ce_compute, _ce_update
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
+from metrics_tpu.sketches.histogram import hist_bin_index
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
@@ -29,13 +39,14 @@ class CalibrationError(Metric):
 
     DISTANCES = {"l1", "l2", "max"}
     is_differentiable = False
-    #: list-append update traces; the cat states exclude it from fusion anyway
-    __jit_unsafe__ = False
+    __jit_unsafe__ = False  # binned default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"
 
     def __init__(
         self,
         n_bins: int = 15,
         norm: str = "l1",
+        exact: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -48,16 +59,45 @@ class CalibrationError(Metric):
         self.n_bins = n_bins
         self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
         self.norm = norm
+        self._exact = bool(exact)
 
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        if self._exact:
+            register_exact_list_states(self, ("confidences", "accuracies"))
+            warn_exact_buffer("CalibrationError", "confidences and accuracies")
+        else:
+            self.add_state("bin_count", default=jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("bin_conf", default=jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("bin_acc", default=jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
 
     def _update(self, preds: Array, target: Array) -> None:
         confidences, accuracies = _ce_update(preds, target)
-        self.confidences.append(confidences)
-        self.accuracies.append(accuracies)
+        if self._exact:
+            self.confidences.append(confidences)
+            self.accuracies.append(accuracies)
+            return
+        idx = hist_bin_index(self.bin_boundaries, confidences)
+        ones = jnp.ones_like(confidences)
+        self.bin_count = self.bin_count.at[idx].add(ones)
+        self.bin_conf = self.bin_conf.at[idx].add(confidences)
+        self.bin_acc = self.bin_acc.at[idx].add(accuracies)
 
     def _compute(self) -> Array:
-        confidences = dim_zero_cat(self.confidences)
-        accuracies = dim_zero_cat(self.accuracies)
-        return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)
+        if self._exact:
+            confidences = dim_zero_cat(self.confidences)
+            accuracies = dim_zero_cat(self.accuracies)
+            return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)
+        # the exact compute's per-bin means/proportions from the streamed sums
+        count = self.bin_count
+        safe = jnp.where(count == 0, 1.0, count)
+        conf_bin = jnp.where(count == 0, 0.0, self.bin_conf / safe)
+        acc_bin = jnp.where(count == 0, 0.0, self.bin_acc / safe)
+        prop_bin = count / jnp.clip(jnp.sum(count), 1.0, None)
+        if self.norm == "l1":
+            return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+        if self.norm == "max":
+            return jnp.max(jnp.abs(acc_bin - conf_bin))
+        ce = jnp.sum(jnp.square(acc_bin - conf_bin) * prop_bin)
+        return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+    # NOTE: the binned path is exact — the sums are sufficient statistics
+    # for every norm — so there is no `sketch_capacity` knob here; `exact`
+    # only exists to reproduce the reference's storage behavior bit-for-bit.

@@ -1,13 +1,18 @@
-"""Modular PrecisionRecallCurve (cat-state, exact sorted mode).
+"""Modular PrecisionRecallCurve (sketch-backed streaming default).
 
 Behavior parity with /root/reference/torchmetrics/classification/
-precision_recall_curve.py:28-145.
+precision_recall_curve.py:28-145. State modes as in auroc.py: streaming
+quantile sketch by default (bit-equal to ``exact=True`` inside the lossless
+window, weighted curve points beyond), ``exact=True`` for the unbounded
+cat-state path, ``capacity=N`` for the static exact buffers.
 """
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.classification._capacity import CapacityCurveMixin
+from metrics_tpu.classification._sketch import DEFAULT_SKETCH_CAPACITY, SketchCurveMixin
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.exact_curve import (
     binary_precision_recall_curve_fixed,
@@ -17,12 +22,14 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
+from metrics_tpu.functional.classification.sketch_curve import binary_prc_weighted
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
-class PrecisionRecallCurve(CapacityCurveMixin, Metric):
+class PrecisionRecallCurve(SketchCurveMixin, CapacityCurveMixin, Metric):
     """Computes precision-recall pairs for different thresholds.
 
     Example:
@@ -35,7 +42,9 @@ class PrecisionRecallCurve(CapacityCurveMixin, Metric):
         Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
     """
 
-    __jit_unsafe__ = True  # exact curve mode has data-dependent output shapes
+    __jit_unsafe__ = False  # sketch default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"
+    __fused_mask_valid__ = True
     is_differentiable = False
 
     def __init__(
@@ -44,29 +53,42 @@ class PrecisionRecallCurve(CapacityCurveMixin, Metric):
         pos_label: Optional[int] = None,
         capacity: Optional[int] = None,
         multilabel: bool = False,
+        exact: bool = False,
+        sketch_capacity: int = DEFAULT_SKETCH_CAPACITY,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
+        self._exact = bool(exact)
+        if exact and capacity is not None:
+            raise ValueError("`exact=True` and `capacity` are mutually exclusive state modes")
         # TPU-native exact mode: static [capacity] buffers, fully jit-safe.
         # Binary keeps the flat triple; num_classes >= 2 keeps [capacity, C]
         # score rows (one-vs-rest curves per class); `multilabel=True`
         # additionally stores [capacity, C] indicator targets.
         self._init_capacity_case(capacity, num_classes, multilabel)
         if capacity is None:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            if self._exact:
+                register_exact_list_states(self, ("preds", "target"))
+                warn_exact_buffer("PrecisionRecallCurve")
+            else:
+                self._init_sketch_curve(sketch_capacity, num_classes)
 
-    def _update(self, preds: Array, target: Array) -> None:
+    def _update(self, preds: Array, target: Array, n_valid: Optional[Array] = None) -> None:
         if self._capacity is not None:
             self._capacity_update(preds, target, pos_label=self.pos_label)
             return
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
-        self.preds.append(preds)
-        self.target.append(target)
+        if self._exact:
+            self.preds.append(preds)
+            self.target.append(target)
+        else:
+            self._sketch_insert_canonical(
+                preds, target, pos_label if preds.ndim == 1 else 1, n_valid=n_valid
+            )
         self.num_classes = num_classes
         self.pos_label = pos_label
 
@@ -89,6 +111,30 @@ class PrecisionRecallCurve(CapacityCurveMixin, Metric):
                     multilabel=self._capacity_multilabel,
                 )
             return binary_precision_recall_curve_fixed(*self._capacity_buffers())
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)
+        if self._exact:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)
+        if self._sketch_is_lossless():
+            preds, target, pos_label = self._sketch_exact_arrays()
+            return _precision_recall_curve_compute(preds, target, self.num_classes, pos_label)
+        return self._sketch_approx_compute()
+
+    def _sketch_approx_compute(self):
+        """Weighted PR points from the compacted sketch rows, reversed and
+        endpoint-appended host-side to the unbounded output contract."""
+        scores, y, w = self._sketch_weighted_arrays()
+
+        def _one(s, yy, ww):
+            prec, rec, thr, mask = binary_prc_weighted(s, yy, ww)
+            keep = jnp.asarray(mask)
+            return (
+                jnp.concatenate([prec[keep][::-1], jnp.ones(1)]),
+                jnp.concatenate([rec[keep][::-1], jnp.zeros(1)]),
+                thr[keep][::-1],
+            )
+
+        if self._sketch_cols is None:
+            return _one(scores, y, w)
+        curves = [_one(scores[:, c], y[:, c], w) for c in range(self._sketch_cols)]
+        return [c[0] for c in curves], [c[1] for c in curves], [c[2] for c in curves]

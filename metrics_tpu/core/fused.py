@@ -338,9 +338,16 @@ class FusedUpdate:
             m = self._collection._metrics[name]
             if getattr(m, "__fused_bucket_unsafe__", False):
                 return False
+            mask_valid = bool(getattr(m, "__fused_mask_valid__", False))
             for sname, red in m._reductions.items():
                 if sname == _AUTO_COUNT:
                     continue  # bumped once per batch; padding cannot skew it
+                if getattr(red, "merge_like", False) and mask_valid:
+                    # sketch leaves on a metric that accepts the n_valid
+                    # pad-mask kwarg: edge-pad rows insert with weight 0
+                    # instead of needing an (impossible) subtraction — see
+                    # _one_metric, which threads n_valid into the update
+                    continue
                 if red not in (dim_zero_sum, dim_zero_max, dim_zero_min):
                     return False
                 default = m._defaults[sname]
@@ -603,6 +610,13 @@ class FusedUpdate:
             m = col_metrics[name]
             args, kwargs = rebuild(dyn_leaves)
             fkw = m._filter_kwargs(**kwargs)
+            if k_pad is not None and getattr(m, "__fused_mask_valid__", False):
+                # pad-and-mask for merge-leaf (sketch) states: the metric's
+                # update masks rows past n_valid to weight 0, so pad rows
+                # never enter the sketch; its sum-reduced leaves still take
+                # the ordinary k * delta correction below
+                fkw = dict(fkw)
+                fkw["n_valid"] = jnp.asarray(bucket, jnp.int32) - k_pad
             new = _pure_update(m, state, args, fkw)
             if k_pad is not None:
                 # pad rows replicate the last real row: their contribution to

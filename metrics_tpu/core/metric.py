@@ -51,7 +51,7 @@ from metrics_tpu.utils.data import (
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
-from metrics_tpu.observability.recorder import _nbytes
+from metrics_tpu.observability.recorder import SKETCH_FOOTPRINT_PREFIX, _nbytes
 from metrics_tpu.observability.trace import span as _span
 from metrics_tpu.parallel.distributed import distributed_available as _dist_available
 from metrics_tpu.parallel.distributed import gather_all_arrays
@@ -251,8 +251,19 @@ class Metric(ABC):
             dist_reduce_fx = dim_zero_min
         elif dist_reduce_fx == "cat":
             dist_reduce_fx = dim_zero_cat
+        elif dist_reduce_fx == "merge":
+            # sketch-leaf states (metrics_tpu/sketches/): the leaf carries its
+            # own cross-rank merge. The string form covers the standard packed
+            # quantile-sketch layout; other kinds pass their tagged
+            # ``*_merge_fx()`` callable directly.
+            from metrics_tpu.sketches.quantile import sketch_merge_fx
+
+            dist_reduce_fx = sketch_merge_fx()
         elif dist_reduce_fx is not None and not callable(dist_reduce_fx):
-            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+            raise ValueError(
+                "`dist_reduce_fx` must be callable or one of"
+                " ['mean', 'sum', 'cat', 'min', 'max', 'merge', None]"
+            )
 
         if isinstance(default, list):
             setattr(self, name, [])
@@ -420,6 +431,11 @@ class Metric(ABC):
                 self._computed = _squeeze_if_scalar(value)
             if rec is not None:
                 rec.record_call("compute", self, time.perf_counter() - t0)
+                # sketch occupancy is read on the cold compute path only
+                # (it syncs the leaf); no-op for metrics without sketch leaves
+                ratios = self.sketch_fill_ratios()
+                if ratios:
+                    rec.record_sketch_fill(self, ratios)
         return self._computed
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
@@ -519,6 +535,13 @@ class Metric(ABC):
             if not (callable(reduction_fn) or reduction_fn is None):
                 raise TypeError("reduction_fn must be callable or None")
             reduced = reduction_fn(output_dict[attr]) if reduction_fn is not None else output_dict[attr]
+            if getattr(reduction_fn, "merge_like", False) and _TELEMETRY.enabled:
+                n_ranks = (
+                    output_dict[attr].shape[0]
+                    if isinstance(output_dict[attr], jnp.ndarray) and output_dict[attr].ndim >= 3
+                    else 1
+                )
+                _TELEMETRY.record_sketch_merge(max(n_ranks - 1, 1))
             object.__setattr__(self, attr, reduced)
 
     def sync(
@@ -757,6 +780,12 @@ class Metric(ABC):
                 out[name] = jnp.maximum(va, vb)
             elif red == dim_zero_min:
                 out[name] = jnp.minimum(va, vb)
+            elif getattr(red, "merge_like", False):
+                # sketch leaves merge through their own reducer (the same
+                # stacked-leaves contract the distributed sync delivers)
+                out[name] = red(jnp.stack([jnp.asarray(va), jnp.asarray(vb)]))
+                if _TELEMETRY.enabled:
+                    _TELEMETRY.record_sketch_merge(1)
             elif red is None:
                 raise MetricsUserError(
                     f"Cannot merge tensor state {name!r} with reduction None (gathered-not-reduced"
@@ -787,7 +816,16 @@ class Metric(ABC):
             elif isinstance(val, int):
                 out[name] = 4  # host-resident int32 counter (eager fast path)
             else:
-                out[name] = _nbytes(val)
+                # sketch leaves (merge-like reducer) report under their own
+                # prefix: their bytes are the FIXED O(capacity) budget, not a
+                # growing accumulation, and the telemetry HWM labelling keys
+                # on the prefix (see observability/recorder.py)
+                key = (
+                    f"{SKETCH_FOOTPRINT_PREFIX}{name}"
+                    if getattr(self._reductions.get(name), "merge_like", False)
+                    else name
+                )
+                out[key] = _nbytes(val)
         if include_children:
             for cname, child in self._iter_child_metrics():
                 for key, nb in child.state_footprint().items():
@@ -797,6 +835,28 @@ class Metric(ABC):
     def total_state_bytes(self) -> int:
         """Total bytes held by this metric's (and its children's) states."""
         return sum(self.state_footprint().values())
+
+    def sketch_fill_ratios(self) -> Dict[str, float]:
+        """Occupancy per sketch-leaf state (``occupied slots / capacity``)
+        — the number that says whether a sketch is still inside its
+        lossless window (< 1.0 with no compactions) or how aggressively the
+        capacity budget is being spent. Empty for metrics without sketch
+        leaves. Host-syncing (reads the leaf); telemetry calls it from the
+        cold compute path only."""
+        out: Dict[str, float] = {}
+        for name, red in self._reductions.items():
+            if not getattr(red, "merge_like", False):
+                continue
+            val = getattr(self, name)
+            if not isinstance(val, jnp.ndarray) or isinstance(val, jax.core.Tracer) or val.ndim < 2:
+                continue
+            occupied = (
+                val[:, 0] > -jnp.inf
+                if getattr(red, "sketch_kind", "") == "reservoir"
+                else val[:, 0] > 0
+            )
+            out[name] = float(jnp.sum(occupied)) / float(val.shape[0])
+        return out
 
     # ------------------------------------------------------------------
     # persistence

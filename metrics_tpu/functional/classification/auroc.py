@@ -11,7 +11,11 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.auc import _auc_compute_without_check
 from metrics_tpu.functional.classification.roc import roc
-from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.checks import (
+    _input_format_classification,
+    _is_concrete,
+    _score_mode_static,
+)
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.utils.data import _bincount, stable_sort_with_payloads
 from metrics_tpu.utils.enums import AverageMethod, DataType
@@ -20,8 +24,15 @@ Array = jax.Array
 
 
 def _auroc_update(preds: Array, target: Array) -> Tuple[Array, Array, DataType]:
-    # use _input_format_classification for validating the input and getting the mode
-    _, _, mode = _input_format_classification(preds, target)
+    # concrete inputs take the fully-validating formatter; under tracing the
+    # mode comes from the shape-only deduction (value validation is host
+    # work by contract — the capacity-buffer split, now shared by the
+    # sketch-backed update so it stays jit-safe)
+    if _is_concrete(preds, target):
+        # use _input_format_classification for validating the input and getting the mode
+        _, _, mode = _input_format_classification(preds, target)
+    else:
+        mode = _score_mode_static(preds, target)
 
     if mode == DataType.MULTIDIM_MULTICLASS:
         n_classes = preds.shape[1]

@@ -10,7 +10,11 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.checks import (
+    _input_format_classification,
+    _is_concrete,
+    _score_mode_static,
+)
 from metrics_tpu.utils.enums import DataType
 
 Array = jax.Array
@@ -60,7 +64,13 @@ def _ce_compute(
 
 
 def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
-    _, _, mode = _input_format_classification(preds, target)
+    # concrete inputs take the fully-validating formatter; under tracing the
+    # mode comes from the shape-only deduction (value validation is host
+    # work by contract — keeps the binned streaming update jit-safe)
+    if _is_concrete(preds, target):
+        _, _, mode = _input_format_classification(preds, target)
+    else:
+        mode = _score_mode_static(preds, target)
 
     if mode == DataType.BINARY:
         confidences, accuracies = preds, target

@@ -1,0 +1,165 @@
+"""Weighted curve kernels for sketch-backed threshold metrics.
+
+The quantile-sketch conversion (``metrics_tpu/sketches/``) leaves curve
+metrics holding WEIGHTED rows ``(score, y, w)`` where ``y`` may be
+fractional (pair collapse averages indicator payloads — first moments are
+preserved exactly, see sketches/quantile.py). These kernels generalize the
+exact-curve cumulant machinery (``exact_curve.py``) from counts to weight
+masses: ``tps = cumsum(w * y)``, ``fps = cumsum(w * (1 - y))``, with the
+same descending-score sort, tie-run deduplication, and reference endpoint
+conventions — at unit weights and crisp labels they reduce bit-for-bit to
+the unweighted kernels.
+
+Only the sketch compute paths call these (the lossless window runs the
+exact unbounded kernels instead); they are shape-polymorphic jnp programs
+usable both eagerly on host-sliced rows and under jit on masked buffers.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.data import stable_sort_with_payloads
+
+Array = jax.Array
+
+
+def _weighted_sorted_cumulants(
+    scores: Array, y: Array, w: Array
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Descending-score sort (zero-weight rows last) with weighted run-end
+    cumulants; the weighted twin of ``exact_curve._masked_sorted_cumulants``."""
+    valid = w > 0
+    key = jnp.where(valid, scores.astype(jnp.float32), -jnp.inf)
+    sorted_key, sorted_wy, sorted_w = stable_sort_with_payloads(
+        key, (w * y).astype(jnp.float32), jnp.where(valid, w, 0.0).astype(jnp.float32), descending=True
+    )
+    tps = jnp.cumsum(sorted_wy)
+    fps = jnp.cumsum(sorted_w - sorted_wy)
+
+    n = sorted_key.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    boundary = sorted_key[1:] != sorted_key[:-1]
+    is_run_last = jnp.concatenate([boundary, jnp.ones(1, bool)])
+    is_run_first = jnp.concatenate([jnp.ones(1, bool), boundary])
+    run_end = jax.lax.cummin(jnp.where(is_run_last, idx, n - 1)[::-1])[::-1]
+    run_start = jax.lax.cummax(jnp.where(is_run_first, idx, 0))
+    return sorted_key, sorted_w > 0, tps, fps, run_end, run_start
+
+
+def binary_auroc_weighted(scores: Array, y: Array, w: Array) -> Array:
+    """Weighted binary AUROC (trapezoid over run-end ROC points); NaN when
+    either class carries no weight."""
+    _, _, tps, fps, run_end, _ = _weighted_sorted_cumulants(scores, y, w)
+    total_pos, total_neg = tps[-1], fps[-1]
+    tpr = tps[run_end] / jnp.clip(total_pos, 1e-12, None)
+    fpr = fps[run_end] / jnp.clip(total_neg, 1e-12, None)
+    first = 0.5 * tpr[0] * fpr[0]
+    rest = jnp.sum(0.5 * (tpr[1:] + tpr[:-1]) * (fpr[1:] - fpr[:-1]))
+    return jnp.where((total_pos > 0) & (total_neg > 0), first + rest, jnp.nan)
+
+
+def binary_auroc_max_fpr_weighted(scores: Array, y: Array, w: Array, max_fpr: float) -> Array:
+    """Weighted partial AUC with the reference's McClish standardization
+    (functional/classification/auroc.py max_fpr tail): the ROC is linearly
+    interpolated at ``max_fpr``, integrated on ``[0, max_fpr]``, and mapped
+    to ``0.5 * (1 + (pauc - min) / (max - min))``."""
+    _, valid, tps, fps, run_end, _ = _weighted_sorted_cumulants(scores, y, w)
+    total_pos, total_neg = tps[-1], fps[-1]
+    tpr = jnp.concatenate([jnp.zeros(1), tps[run_end] / jnp.clip(total_pos, 1e-12, None)])
+    fpr = jnp.concatenate([jnp.zeros(1), fps[run_end] / jnp.clip(total_neg, 1e-12, None)])
+    is_point = jnp.concatenate([jnp.ones(1, bool), (run_end == jnp.arange(run_end.shape[0])) & valid])
+    # clamp the curve to fpr <= max_fpr: points beyond collapse onto the
+    # interpolated boundary point, so the trapezoid over ALL points equals
+    # the truncated integral (non-points repeat their run-end neighbor)
+    fpr_m = jnp.where(is_point, fpr, -jnp.inf)
+    fpr_mono = jax.lax.cummax(fpr_m)  # carry last real point forward
+    tpr_mono = jnp.where(is_point, tpr, 0.0)
+    tpr_mono = jax.lax.cummax(tpr_mono)  # tpr is nondecreasing along points
+    below = fpr_mono <= max_fpr
+    # interpolated tpr at max_fpr between the straddling points
+    idx_hi = jnp.clip(jnp.sum(below), 1, fpr_mono.shape[0] - 1)
+    f_lo, f_hi = fpr_mono[idx_hi - 1], fpr_mono[idx_hi]
+    t_lo, t_hi = tpr_mono[idx_hi - 1], tpr_mono[idx_hi]
+    t_at = jnp.where(
+        f_hi > f_lo, t_lo + (t_hi - t_lo) * (max_fpr - f_lo) / jnp.clip(f_hi - f_lo, 1e-12, None), t_lo
+    )
+    fpr_c = jnp.where(below, fpr_mono, max_fpr)
+    tpr_c = jnp.where(below, tpr_mono, t_at)
+    area = jnp.sum(0.5 * (tpr_c[1:] + tpr_c[:-1]) * (fpr_c[1:] - fpr_c[:-1]))
+    min_area = 0.5 * max_fpr * max_fpr
+    max_area = max_fpr
+    pauc = 0.5 * (1.0 + (area - min_area) / jnp.clip(max_area - min_area, 1e-12, None))
+    return jnp.where((total_pos > 0) & (total_neg > 0), pauc, jnp.nan)
+
+
+def binary_roc_weighted(
+    scores: Array, y: Array, w: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Weighted ROC points ``(fpr, tpr, thresholds, point_mask)`` in the
+    fixed-kernel layout (leading implicit (0, 0) at ``thresholds[0] + 1``)."""
+    sorted_key, valid, tps, fps, run_end, _ = _weighted_sorted_cumulants(scores, y, w)
+    total_pos, total_neg = tps[-1], fps[-1]
+    idx = jnp.arange(sorted_key.shape[0])
+    is_threshold = (run_end == idx) & valid
+    tpr = jnp.concatenate([jnp.zeros(1), tps / jnp.clip(total_pos, 1e-12, None)])
+    fpr = jnp.concatenate([jnp.zeros(1), fps / jnp.clip(total_neg, 1e-12, None)])
+    thresholds = jnp.concatenate([sorted_key[:1] + 1.0, sorted_key])
+    point_mask = jnp.concatenate([jnp.any(valid)[None], is_threshold])
+    return fpr, tpr, thresholds, point_mask
+
+
+def binary_prc_weighted(
+    scores: Array, y: Array, w: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Weighted precision-recall points ``(precision, recall, thresholds,
+    point_mask)`` in descending-score order, with the reference's
+    full-recall truncation; callers reverse and append ``(1, 0)``."""
+    sorted_key, valid, tps, fps, run_end, run_start = _weighted_sorted_cumulants(scores, y, w)
+    total_pos = tps[-1]
+    idx = jnp.arange(sorted_key.shape[0])
+    is_threshold = (run_end == idx) & valid
+    prev_end_tps = jnp.where(run_start > 0, tps[jnp.maximum(run_start - 1, 0)], 0.0)
+    # strict comparison needs a tolerance under weighted (inexact) masses
+    is_threshold = is_threshold & (
+        (prev_end_tps < total_pos - 1e-6 * jnp.clip(total_pos, 1.0, None)) | (run_start == 0)
+    )
+    precision = tps / jnp.clip(tps + fps, 1e-12, None)
+    recall = jnp.where(total_pos > 0, tps / jnp.clip(total_pos, 1e-12, None), jnp.nan)
+    return precision, recall, sorted_key, is_threshold
+
+
+def binary_average_precision_weighted(scores: Array, y: Array, w: Array) -> Array:
+    """Weighted average precision (step-wise sum over deduped thresholds)."""
+    _, valid, tps, fps, run_end, _ = _weighted_sorted_cumulants(scores, y, w)
+    total_pos = tps[-1]
+    precision = tps / jnp.clip(tps + fps, 1e-12, None)
+    contributions = jnp.diff(tps, prepend=0.0) * precision[run_end] * valid
+    return jnp.where(total_pos > 0, jnp.sum(contributions) / jnp.clip(total_pos, 1e-12, None), jnp.nan)
+
+
+def weighted_class_supports(y_cols: Array, w: Array) -> Array:
+    """Per-class positive weight mass ``[C]`` for weighted averaging."""
+    return jnp.sum(w[:, None] * y_cols, axis=0)
+
+
+def average_class_scores(
+    scores_per_class: Array, supports: Array, average: Optional[str]
+) -> Array:
+    """macro / weighted / none averaging over per-class scalar scores,
+    excluding classes with zero positive mass (the capacity-mode
+    convention: absent tail classes must not poison sharded evals)."""
+    defined = supports > 0
+    any_defined = jnp.any(defined)
+    if average in (None, "none"):
+        return scores_per_class
+    if average == "macro":
+        val = jnp.sum(jnp.where(defined, scores_per_class, 0.0)) / jnp.maximum(jnp.sum(defined), 1)
+        return jnp.where(any_defined, val, jnp.nan)
+    if average == "weighted":
+        wts = jnp.where(defined, supports, 0.0)
+        val = jnp.sum(jnp.where(defined, scores_per_class, 0.0) * wts) / jnp.clip(jnp.sum(wts), 1e-12, None)
+        return jnp.where(any_defined, val, jnp.nan)
+    raise ValueError(
+        f"Argument `average` expected to be one of ('macro', 'weighted', 'none', None) but got {average}"
+    )

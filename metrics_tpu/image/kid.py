@@ -3,6 +3,16 @@
 Behavior parity with /root/reference/torchmetrics/image/kid.py:29-269.
 ``feature`` accepts any callable ``imgs -> [N, d]`` or an int depth for the
 bundled Flax InceptionV3 (see fid.py).
+
+State modes: by DEFAULT extracted features stream into two fixed-size
+Gumbel-key reservoirs (``metrics_tpu/sketches/reservoir.py``) of
+``reservoir_size`` rows each — O(k·d) memory however long the stream, with
+a ``"merge"``-reduced leaf that unions across ranks. While a stream fits
+its reservoir the rows are the exact features in arrival order, so the
+subset draws (host RNG, unchanged) reproduce the cat-state path
+bit-for-bit; beyond it, subsets come from a uniform k-row sample of the
+stream. ``exact=True`` restores the reference's unbounded feature lists
+(and its large-memory warning — fired only on that path).
 """
 from typing import Any, Callable, Optional, Tuple, Union
 
@@ -11,8 +21,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
+from metrics_tpu.sketches.reservoir import (
+    reservoir_fill,
+    reservoir_init,
+    reservoir_insert,
+    reservoir_merge_fx,
+    reservoir_rows,
+)
 from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -51,7 +68,10 @@ def poly_mmd(
 class KernelInceptionDistance(Metric):
     """Computes KID (mean and std of polynomial MMD over random subsets)."""
 
+    #: the feature extractor is an arbitrary host callable (Flax model or
+    #: user function) — the update cannot be traced whatever the state mode
     __jit_unsafe__ = True
+    __exact_mode_attr__ = "_exact"
     is_differentiable = False
     higher_is_better = False
 
@@ -65,16 +85,13 @@ class KernelInceptionDistance(Metric):
         coef: float = 1.0,
         seed: Optional[int] = None,
         feature_extractor_weights_path: str = None,
+        exact: bool = False,
+        reservoir_size: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
 
-        rank_zero_warn(
-            "Metric `KernelInceptionDistance` will save all extracted features in buffer."
-            " For large datasets this may lead to large memory footprint.",
-            UserWarning,
-        )
-
+        feature_dim: Optional[int] = None
         if isinstance(feature, int):
             valid_int_input = (64, 192, 768, 2048)
             if feature not in valid_int_input:
@@ -84,6 +101,7 @@ class KernelInceptionDistance(Metric):
             from metrics_tpu.models.inception import build_fid_inception
 
             self.inception = build_fid_inception(feature, feature_extractor_weights_path)
+            feature_dim = feature  # the bundled heads emit [N, depth] features
         elif callable(feature):
             self.inception = feature
         else:
@@ -106,20 +124,93 @@ class KernelInceptionDistance(Metric):
         self.coef = coef
         self._rng = np.random.RandomState(seed)
 
-        self.add_state("real_features", [], dist_reduce_fx=None)
-        self.add_state("fake_features", [], dist_reduce_fx=None)
+        self._exact = bool(exact)
+        if reservoir_size is None:
+            reservoir_size = max(2 * subset_size, 2048)
+        if not (isinstance(reservoir_size, int) and reservoir_size >= subset_size):
+            raise ValueError(
+                "Argument `reservoir_size` expected to be an int >= `subset_size`,"
+                f" got {reservoir_size}"
+            )
+        self._reservoir_size = reservoir_size
+        # per-rank key stream: identical seeds across ranks would draw
+        # identical priorities and bias the cross-rank reservoir union
+        self._key_seed = (0 if seed is None else int(seed)) * 1_000_003 + jax.process_index()
+
+        if self._exact:
+            register_exact_list_states(self, ("real_features", "fake_features"), dist_reduce_fx=None)
+            warn_exact_buffer("KernelInceptionDistance", "extracted features")
+        elif feature_dim is not None:
+            self._init_reservoirs(feature_dim)
+        # callable extractors leave the feature dimension unknown until the
+        # first (host-side; the metric is declared jit-unsafe) update
+
+    def _init_reservoirs(self, feature_dim: int) -> None:
+        self._feature_dim = feature_dim
+        self.add_state(
+            "real_features",
+            default=reservoir_init(self._reservoir_size, feature_dim),
+            dist_reduce_fx=reservoir_merge_fx(),
+        )
+        self.add_state(
+            "fake_features",
+            default=reservoir_init(self._reservoir_size, feature_dim),
+            dist_reduce_fx=reservoir_merge_fx(),
+        )
+        self.add_state("n_seen_real", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("n_seen_fake", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    _feature_dim: Optional[int] = None
+
+    def load_state_dict(self, state_dict, prefix: str = "") -> None:
+        """Checkpoint restore must work before the first update even for
+        callable extractors (whose feature dimension is otherwise learned
+        lazily): the reservoir layout is recovered from the saved leaf's
+        column count, then the ordinary restore applies."""
+        if not self._exact and self._feature_dim is None:
+            saved = state_dict.get(prefix + "real_features")
+            if saved is not None and getattr(saved, "ndim", 0) == 2:
+                self._init_reservoirs(int(saved.shape[1]) - 1)
+        super().load_state_dict(state_dict, prefix=prefix)
 
     def _update(self, imgs: Array, real: bool) -> None:
         features = self.inception(imgs)
+        if self._exact:
+            if real:
+                self.real_features.append(features)
+            else:
+                self.fake_features.append(features)
+            return
+        if self._feature_dim is None:
+            self._init_reservoirs(int(jnp.asarray(features).shape[-1]))
         if real:
-            self.real_features.append(features)
+            self.real_features = reservoir_insert(
+                self.real_features, features, self.n_seen_real, seed=self._key_seed
+            )
+            self.n_seen_real = self.n_seen_real + jnp.asarray(features).shape[0]
         else:
-            self.fake_features.append(features)
+            self.fake_features = reservoir_insert(
+                self.fake_features, features, self.n_seen_fake, seed=self._key_seed + 1
+            )
+            self.n_seen_fake = self.n_seen_fake + jnp.asarray(features).shape[0]
+
+    def _pool(self, real: bool) -> Array:
+        """The sampled feature pool: the exact stream (arrival order) inside
+        the lossless window, a uniform ``k``-row sample beyond it."""
+        leaf = jnp.asarray(self.real_features if real else self.fake_features)
+        n = int(reservoir_fill(leaf))
+        return reservoir_rows(leaf)[:n]
 
     def _compute(self) -> Tuple[Array, Array]:
         getattr(self.inception, "finalize", lambda: None)()  # flush async range check of the last batch
-        real_features = dim_zero_cat(self.real_features)
-        fake_features = dim_zero_cat(self.fake_features)
+        if self._exact:
+            real_features = dim_zero_cat(self.real_features)
+            fake_features = dim_zero_cat(self.fake_features)
+        else:
+            if self._feature_dim is None:
+                raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+            real_features = self._pool(real=True)
+            fake_features = self._pool(real=False)
 
         n_samples_real = real_features.shape[0]
         if n_samples_real < self.subset_size:

@@ -56,6 +56,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "async_totals": dict(rec.async_totals()),
         "sliced_totals": dict(rec.sliced_totals()),
         "sliced_slice_counts": dict(rec.footprint_slice_counts()),
+        "sketch_totals": dict(rec.sketch_totals()),
         "dropped_events": rec.dropped_events(),
     }
 
@@ -106,6 +107,7 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # slice counts are a structural property (same SlicedMetric config
         # on every rank) — max is the safe reconciliation if they skew
         "sliced_slice_counts": _merge_max([p.get("sliced_slice_counts", {}) for p in payloads]),
+        "sketch_totals": _merge_sketch([p.get("sketch_totals", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
         "processes": list(payloads),
     }
@@ -133,6 +135,17 @@ _SLICED_SUM_KEYS = ("scatter_events", "rows")
 def _merge_sliced(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
     sums = _merge_sum([{k: v for k, v in m.items() if k in _SLICED_SUM_KEYS} for m in maps])
     maxes = _merge_max([{k: v for k, v in m.items() if k not in _SLICED_SUM_KEYS} for m in maps])
+    return {**maxes, **sums}
+
+
+#: sketch counter keys that are extensive (summed); the fill ratios are
+#: gauges/high-water marks (maxed)
+_SKETCH_SUM_KEYS = ("merges",)
+
+
+def _merge_sketch(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    sums = _merge_sum([{k: v for k, v in m.items() if k in _SKETCH_SUM_KEYS} for m in maps])
+    maxes = _merge_max([{k: v for k, v in m.items() if k not in _SKETCH_SUM_KEYS} for m in maps])
     return {**maxes, **sums}
 
 

@@ -268,6 +268,26 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             f"metrics_tpu_sliced_slices{_labels(**proc_label(payload))}"
             f" {totals.get('max_slices', 0)}"
         )
+    lines.append("# HELP metrics_tpu_sketch_merges_total Cross-rank/pairwise sketch-state merges performed.")
+    lines.append("# TYPE metrics_tpu_sketch_merges_total counter")
+    for payload in per_proc:
+        totals = payload.get("sketch_totals", {})
+        lines.append(
+            f"metrics_tpu_sketch_merges_total{_labels(**proc_label(payload))}"
+            f" {totals.get('merges', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_sketch_fill_ratio Sketch capacity-fill ratio (occupied slots / capacity) reported at compute.")
+    lines.append("# TYPE metrics_tpu_sketch_fill_ratio gauge")
+    for payload in per_proc:
+        totals = payload.get("sketch_totals", {})
+        lines.append(
+            f"metrics_tpu_sketch_fill_ratio{_labels(window='last', **proc_label(payload))}"
+            f" {totals.get('fill_ratio', 0.0)}"
+        )
+        lines.append(
+            f"metrics_tpu_sketch_fill_ratio{_labels(window='max', **proc_label(payload))}"
+            f" {totals.get('max_fill_ratio', 0.0)}"
+        )
     lines.append("# HELP metrics_tpu_dropped_events_total Events discarded past the buffer cap.")
     lines.append("# TYPE metrics_tpu_dropped_events_total counter")
     lines.append(f"metrics_tpu_dropped_events_total {dropped}")

@@ -75,8 +75,24 @@ SLICED_FOOTPRINT_PREFIX = "sliced/"
 SLICED_LABEL_SUFFIX = "[sliced]"
 
 
+#: footprint keys under this prefix (fixed-capacity sketch leaves,
+#: metrics_tpu/sketches/) are a BOUNDED budget, not an accumulation — the
+#: HWM label split keeps them from masquerading as cat-state growth
+SKETCH_FOOTPRINT_PREFIX = "sketch/"
+
+#: HWM-label suffix for the sketch split of a metric's footprint
+SKETCH_LABEL_SUFFIX = "[sketch]"
+
+
 def _new_sliced_totals() -> Dict[str, int]:
     return {"scatter_events": 0, "rows": 0, "max_slices": 0}
+
+
+def _new_sketch_totals() -> Dict[str, float]:
+    """Zeroed sketch counters: cross-rank/pairwise sketch merges performed
+    (extensive — summed across hosts) plus last-seen and high-water
+    capacity-fill ratio gauges (maxed across hosts)."""
+    return {"merges": 0, "fill_ratio": 0.0, "max_fill_ratio": 0.0}
 
 
 def _new_async_totals() -> Dict[str, int]:
@@ -209,6 +225,7 @@ class MetricRecorder:
         self._async = _new_async_totals()
         self._sliced = _new_sliced_totals()
         self._sliced_slice_counts: Dict[str, int] = {}
+        self._sketch = _new_sketch_totals()
         # per-thread compute-group attribution: a shared field would let
         # concurrent MetricCollection.update calls cross-attribute events
         self._group_local = threading.local()
@@ -257,6 +274,7 @@ class MetricRecorder:
             self._async = _new_async_totals()
             self._sliced = _new_sliced_totals()
             self._sliced_slice_counts = {}
+            self._sketch = _new_sketch_totals()
             self._group_local = threading.local()
         return self
 
@@ -325,6 +343,13 @@ class MetricRecorder:
         scattered, and the largest slice count seen."""
         with self._lock:
             return dict(self._sliced)
+
+    def sketch_totals(self) -> Dict[str, float]:
+        """Sketch-state counters: cross-rank/pairwise sketch merges
+        performed, plus the last-seen and high-water capacity-fill ratios
+        reported from the compute path."""
+        with self._lock:
+            return dict(self._sketch)
 
     def footprint_slice_counts(self) -> Dict[str, int]:
         """``num_slices`` per ``<Metric>[sliced]`` HWM label — what the
@@ -535,7 +560,10 @@ class MetricRecorder:
         sliced_bytes = int(
             sum(v for k, v in footprint.items() if k.startswith(SLICED_FOOTPRINT_PREFIX))
         )
-        base_bytes = total - sliced_bytes
+        sketch_bytes = int(
+            sum(v for k, v in footprint.items() if k.startswith(SKETCH_FOOTPRINT_PREFIX))
+        )
+        base_bytes = total - sliced_bytes - sketch_bytes
         n_slices = getattr(metric, "num_slices", None) if sliced_bytes else None
         with self._lock:
             if sliced_bytes:
@@ -544,7 +572,14 @@ class MetricRecorder:
                     self._footprint_hwm[sliced_label] = sliced_bytes
                 if isinstance(n_slices, int) and n_slices > 0:
                     self._sliced_slice_counts[sliced_label] = n_slices
-            if (base_bytes or not sliced_bytes) and base_bytes > self._footprint_hwm.get(label, -1):
+            if sketch_bytes:
+                # sketch leaves are a FIXED budget: the split keeps the
+                # bounded bytes from tripping the cat-state growth warning's
+                # mental model, and the HWM simply pins the budget
+                sketch_label = label + SKETCH_LABEL_SUFFIX
+                if sketch_bytes > self._footprint_hwm.get(sketch_label, -1):
+                    self._footprint_hwm[sketch_label] = sketch_bytes
+            if (base_bytes or not (sliced_bytes or sketch_bytes)) and base_bytes > self._footprint_hwm.get(label, -1):
                 self._footprint_hwm[label] = base_bytes
             event = {
                 "type": "footprint",
@@ -556,6 +591,8 @@ class MetricRecorder:
                 event["sliced_bytes"] = sliced_bytes
                 if isinstance(n_slices, int):
                     event["n_slices"] = n_slices
+            if sketch_bytes:
+                event["sketch_bytes"] = sketch_bytes
             event.update(extra)
             self._append(event)
             warn = (
@@ -600,6 +637,35 @@ class MetricRecorder:
                 "n_fused": int(n_fused),
                 "n_fallback": int(n_fallback),
                 "dur_ms": round(duration_s * 1e3, 4),
+            }
+            event.update(extra)
+            self._append(event)
+
+    def record_sketch_merge(self, n_merges: int = 1, **extra: Any) -> None:
+        """Record ``n_merges`` pairwise sketch merges (cross-rank sync folds,
+        ``merge_states`` calls). Counter-only — merges run inside sync/merge
+        cold paths and inside traced collectives (where this hook fires once
+        per TRACE, the in-jit accounting convention), so no event row is
+        appended on their behalf."""
+        with self._lock:
+            self._sketch["merges"] += int(n_merges)
+
+    def record_sketch_fill(self, metric: Any, ratios: Dict[str, float], **extra: Any) -> None:
+        """Record capacity-fill ratios for a metric's sketch leaves (hooked
+        from the cold ``compute`` path — reading occupancy syncs the leaf,
+        which the update hot path must never do). Keeps last-seen and
+        high-water gauges plus one ``sketch_fill`` event."""
+        if not ratios:
+            return
+        worst = max(ratios.values())
+        with self._lock:
+            self._sketch["fill_ratio"] = worst
+            self._sketch["max_fill_ratio"] = max(self._sketch["max_fill_ratio"], worst)
+            event: Dict[str, Any] = {
+                "type": "sketch_fill",
+                "metric": type(metric).__name__,
+                "ratios": {k: round(float(v), 6) for k, v in ratios.items()},
+                "t": round(time.time() - self._t0, 6),
             }
             event.update(extra)
             self._append(event)

@@ -341,6 +341,7 @@ def sync_pytree_in_mesh(
     """
     leaves = list(_iter_state_leaves(state))
     groups: Dict[tuple, List[tuple]] = {}
+    merge_groups: Dict[Any, List[tuple]] = {}
     fallback: List[tuple] = []
     sharded: List[tuple] = []
     for path, value in leaves:
@@ -351,6 +352,15 @@ def sync_pytree_in_mesh(
             sharded.append(path)
         elif isinstance(value, jnp.ndarray) and not isinstance(value, list) and red in _FUSED_REDUCERS:
             groups.setdefault((red, jnp.asarray(value).dtype), []).append(path)
+        elif (
+            isinstance(value, jnp.ndarray)
+            and not isinstance(value, list)
+            and getattr(red, "merge_like", False)
+        ):
+            # sketch leaves: gathered together in ONE collective round per
+            # dtype, then merged locally (deterministically, so every rank
+            # lands on the same merged sketch)
+            merge_groups.setdefault(jnp.asarray(value).dtype, []).append(path)
         else:
             fallback.append(path)
 
@@ -376,6 +386,28 @@ def sync_pytree_in_mesh(
                     offset += part.size
                 if record:
                     gather_bytes += _nbytes(buf)  # all-reduced: one payload
+            for dtype, paths in merge_groups.items():
+                # one fused all-gather moves every sketch leaf of this dtype
+                # in a single round; each leaf's own merge reducer then folds
+                # the [world, ...] stack back to one sketch
+                parts = [jnp.asarray(_path_get(state, p)) for p in paths]
+                buf = (
+                    jnp.concatenate([p.ravel() for p in parts])
+                    if len(parts) > 1
+                    else parts[0].ravel()
+                )
+                gathered = all_gather_replicated(buf, axis_name, tiled=False)
+                offset = 0
+                world_n = gathered.shape[0]
+                for path, part in zip(paths, parts):
+                    stack = jax.lax.slice_in_dim(gathered, offset, offset + part.size, axis=1)
+                    stack = stack.reshape((world_n,) + part.shape)
+                    red = _path_get(reductions, path)
+                    _path_set(out, path, red(stack))
+                    offset += part.size
+                if record:
+                    gather_bytes += _nbytes(buf) * world
+                    _TELEMETRY.record_sketch_merge(max(world - 1, 1) * len(paths))
             for path in sharded:
                 # slice-sharded leaves: each mesh position owns disjoint
                 # rows — identity, no collective, no bytes moved
@@ -399,9 +431,10 @@ def sync_pytree_in_mesh(
             world_size=world,
             axis=axis_name,
             in_jit=True,
-            collective_rounds=len(groups) + len(fallback),
+            collective_rounds=len(groups) + len(merge_groups) + len(fallback),
             n_states=len(leaves),
             sliced_passthrough=len(sharded),
+            sketch_merged=sum(len(p) for p in merge_groups.values()),
         )
     return out
 

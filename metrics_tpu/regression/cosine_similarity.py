@@ -1,16 +1,25 @@
-"""Modular CosineSimilarity (cat-state).
+"""Modular CosineSimilarity (streaming sums for 'sum'/'mean' reductions).
 
 Behavior parity with /root/reference/torchmetrics/regression/cosine_similarity.py:24-89.
+The reference stores EVERY (pred, target) row and reduces at compute time;
+but for ``reduction='sum'/'mean'`` the per-sample similarities are reduced
+by a plain sum, so a running scalar sum + count is an EXACT fixed-shape
+streaming state — O(1) memory, fusible/bucketable/sliceable with zero new
+machinery. ``reduction='none'`` genuinely returns per-sample values, so it
+keeps the cat-state path (as does ``exact=True``, which restores the
+reference storage for the reduced modes too).
 """
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.cosine_similarity import (
     _cosine_similarity_compute,
     _cosine_similarity_update,
 )
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
@@ -30,24 +39,44 @@ class CosineSimilarity(Metric):
 
     is_differentiable = True
     higher_is_better = True
-    #: list-append update traces; the cat states exclude it from fusion anyway
-    __jit_unsafe__ = False
+    __jit_unsafe__ = False  # streaming-sum default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"
 
-    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+    def __init__(self, reduction: Optional[str] = "sum", exact: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         allowed_reduction = ("sum", "mean", "none", None)
         if reduction not in allowed_reduction:
             raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
         self.reduction = reduction
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        # 'none' returns per-sample values: only the cat-state path can
+        # represent that; the reduced modes stream exactly
+        self._exact = bool(exact) or reduction in ("none", None)
+        if self._exact:
+            register_exact_list_states(self, ("preds", "target"))
+            if exact:
+                warn_exact_buffer("CosineSimilarity")
+        else:
+            self.add_state("sim_sum", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
     def _update(self, preds: Array, target: Array) -> None:
         preds, target = _cosine_similarity_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        if self._exact:
+            self.preds.append(preds)
+            self.target.append(target)
+            return
+        # the same per-sample similarity the compute kernel derives, reduced
+        # incrementally — exact for 'sum'/'mean' (addition is associative up
+        # to float rounding, within the documented batch-order tolerance)
+        sim = _cosine_similarity_compute(preds, target, None)
+        self.sim_sum = self.sim_sum + jnp.sum(sim)
+        self.total = self.total + sim.reshape(-1).shape[0]
 
     def _compute(self) -> Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        return _cosine_similarity_compute(preds, target, self.reduction)
+        if self._exact:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return _cosine_similarity_compute(preds, target, self.reduction)
+        if self.reduction == "mean":
+            return self.sim_sum / jnp.clip(self.total.astype(jnp.float32), 1.0, None)
+        return self.sim_sum

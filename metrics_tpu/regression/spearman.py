@@ -1,17 +1,46 @@
-"""Modular SpearmanCorrCoef (cat-state + vectorized rank transform).
+"""Modular SpearmanCorrCoef (rank-sketch streaming default; exact opt-in).
 
 Behavior parity with /root/reference/torchmetrics/regression/spearman.py:25-92.
+The default state is a fixed-capacity rank/co-moment sketch
+(``metrics_tpu/sketches/rank.py``): O(``sketch_capacity``) memory, a
+fixed-shape jit-safe update (fusible / bucketable / async-capable), and a
+``"merge"``-reduced leaf that syncs across ranks in the existing
+collective round. Inside the lossless window (stream fits the capacity)
+compute runs the exact tie-averaged rank kernel bit-for-bit; beyond it the
+weighted-midrank estimator takes over under the quantile sketch's
+rank-error envelope. ``exact=True`` restores the reference's unbounded
+cat-state path (and its large-memory warning — which is why the warning is
+gated on that flag rather than fired unconditionally).
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
+from metrics_tpu.sketches.rank import (
+    ranksketch_init,
+    ranksketch_insert,
+    ranksketch_merge_fx,
+    ranksketch_spearman,
+)
+from metrics_tpu.sketches.reservoir import reservoir_fill
 from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+try:
+    from metrics_tpu.utils.checks import _is_concrete
+except ImportError:  # pragma: no cover
+    def _is_concrete(*arrays):
+        return True
 
 Array = jax.Array
+
+#: default rank-sketch capacity — (pred, target) pairs at 8192 rows are
+#: ~96 KiB for <0.05% relative rank error; smaller streams stay bit-exact
+DEFAULT_RANK_CAPACITY = 8192
 
 
 class SpearmanCorrCoef(Metric):
@@ -28,24 +57,61 @@ class SpearmanCorrCoef(Metric):
 
     is_differentiable = False
     higher_is_better = True
-    #: list-append update traces; the cat states exclude it from fusion anyway
-    __jit_unsafe__ = False
+    __jit_unsafe__ = False  # sketch default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"
+    __fused_mask_valid__ = True
 
-    def __init__(self, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        exact: bool = False,
+        sketch_capacity: int = DEFAULT_RANK_CAPACITY,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
-        rank_zero_warn(
-            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
-            " For large datasets, this may lead to a large memory footprint."
-        )
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self._exact = bool(exact)
+        if self._exact:
+            register_exact_list_states(self, ("preds", "target"))
+            warn_exact_buffer("SpearmanCorrcoef", "targets and predictions")
+        else:
+            if not (isinstance(sketch_capacity, int) and sketch_capacity > 0):
+                raise ValueError(
+                    f"Argument `sketch_capacity` must be a positive int, got {sketch_capacity}"
+                )
+            self.add_state(
+                "rsketch", default=ranksketch_init(sketch_capacity), dist_reduce_fx=ranksketch_merge_fx()
+            )
+            self.add_state("n_seen", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        # per-rank priority stream: identical seeds across ranks would draw
+        # identical reservoir priorities and bias the cross-rank union
+        self._key_seed = jax.process_index()
 
-    def _update(self, preds: Array, target: Array) -> None:
+    def _update(self, preds: Array, target: Array, n_valid: Optional[Array] = None) -> None:
         preds, target = _spearman_corrcoef_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        if self._exact:
+            self.preds.append(preds)
+            self.target.append(target)
+            return
+        self.rsketch = ranksketch_insert(
+            self.rsketch, preds, target, self.n_seen, seed=self._key_seed, n_valid=n_valid
+        )
+        self.n_seen = self.n_seen + preds.reshape(-1).shape[0]
 
     def _compute(self) -> Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        return _spearman_corrcoef_compute(preds, target)
+        if self._exact:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return _spearman_corrcoef_compute(preds, target)
+        leaf = jnp.asarray(self.rsketch)
+        fill = reservoir_fill(leaf)
+        n_seen = jnp.asarray(self.n_seen)
+        if not _is_concrete(fill, n_seen):
+            raise MetricsUserError(
+                "sketch-backed SpearmanCorrCoef compute reads the occupancy on the host and"
+                " cannot run under jit; compute eagerly (update_state/FusedUpdate stay jit-safe)"
+            )
+        n = int(fill)
+        if n == int(n_seen):
+            # lossless window: rows are the exact stream in arrival order
+            rows = leaf[:n]
+            return _spearman_corrcoef_compute(rows[:, 1], rows[:, 2])
+        return ranksketch_spearman(leaf)

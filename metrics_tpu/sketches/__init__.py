@@ -1,0 +1,88 @@
+"""Fixed-capacity streaming sketch states (``metrics_tpu.sketches``).
+
+The subsystem that retires cat-state: pure, fixed-shape, trace-safe
+streaming structures with a common contract —
+
+* ``*_init(capacity, ...) -> state leaf`` (plain float32 array)
+* ``*_insert(state, ...) -> state``  — pure, jit-safe, ``n_valid``-maskable
+* ``*_merge(a, b) -> state``         — the ``dist_reduce_fx`` operation
+* per-sketch queries (quantiles/CDF/histogram, sample rows, Spearman)
+
+Three families:
+
+* :mod:`.quantile` — mergeable weighted quantile/stream sketch (packed
+  ``[capacity, 2+P]`` leaf, pair-collapse compaction). Powers the sketched
+  threshold curves (AUROC / ROC / PRC / AveragePrecision).
+* :mod:`.reservoir` — Gumbel-key weighted reservoir (``[k, 1+P]`` leaf,
+  top-k replacement). Powers KID subset selection.
+* :mod:`.histogram` — static-edge weighted histogram (exact sufficient
+  statistics for binned metrics). Powers CalibrationError.
+* :mod:`.rank` — (pred, target) quantile sketch + weighted midrank
+  Spearman, for streaming SpearmanCorrCoef.
+
+See ``docs/sketch_states.md`` for the accuracy contract, the lossless
+window, capacity tuning, and the mergeability story.
+"""
+from .histogram import hist_bin_index, hist_init, hist_insert, hist_merge
+from .quantile import (
+    QSKETCH_RANK_EPS,
+    qsketch_cdf,
+    qsketch_fill,
+    qsketch_histogram,
+    qsketch_init,
+    qsketch_insert,
+    qsketch_merge,
+    qsketch_quantile,
+    qsketch_rank,
+    qsketch_total_weight,
+    rank_error_bound,
+    sketch_merge_fx,
+)
+from .rank import (
+    ranksketch_init,
+    ranksketch_insert,
+    ranksketch_merge,
+    ranksketch_merge_fx,
+    ranksketch_spearman,
+)
+from .reservoir import (
+    reservoir_fill,
+    reservoir_init,
+    reservoir_insert,
+    reservoir_merge,
+    reservoir_merge_fx,
+    reservoir_rows,
+)
+from .compat import register_exact_list_states, warn_exact_buffer
+
+__all__ = [
+    "QSKETCH_RANK_EPS",
+    "hist_bin_index",
+    "hist_init",
+    "hist_insert",
+    "hist_merge",
+    "qsketch_cdf",
+    "qsketch_fill",
+    "qsketch_histogram",
+    "qsketch_init",
+    "qsketch_insert",
+    "qsketch_merge",
+    "qsketch_quantile",
+    "qsketch_rank",
+    "qsketch_total_weight",
+    "rank_error_bound",
+    "ranksketch_init",
+    "ranksketch_insert",
+    "ranksketch_merge",
+    "ranksketch_merge_fx",
+    "ranksketch_spearman",
+    "register_exact_list_states",
+    "reservoir_fill",
+    "reservoir_init",
+    "reservoir_insert",
+    "reservoir_merge",
+    "reservoir_merge_fx",
+    "reservoir_rows",
+    "sketch_merge_fx",
+    "warn_exact_buffer",
+]

@@ -1,0 +1,38 @@
+"""Exact-mode compatibility shims for sketch-converted metrics.
+
+Converted metrics keep yesterday's unbounded cat-state behavior behind
+``exact=True``. The registration lives HERE, as a module-level function,
+on purpose: the tracelint abstract interpreter classifies a metric class
+from the ``self.add_state(...)`` calls in its class-body AST, and the
+exact mode's list states belong to an opt-in configuration the class-level
+verdict must not describe (the class contract — declared via
+``__exact_mode_attr__`` — is that the DEFAULT mode is the fixed-shape
+sketch one). Exact instances are still fully guarded at runtime: they
+carry live list states and flip instance-level ``__jit_unsafe__`` to
+True, which ``FusedUpdate._static_unfusible`` checks BEFORE consulting
+the manifest — a stale-looking ``fusible`` class verdict can never put an
+exact instance on the fused path.
+"""
+from typing import Sequence
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def register_exact_list_states(
+    metric, names: Sequence[str], dist_reduce_fx: str = "cat"
+) -> None:
+    """Register the opt-in exact mode's unbounded list states and mark the
+    instance jit-unsafe (list growth cannot trace; the instance flag keeps
+    exact metrics on the eager path whatever the class-level verdict says)."""
+    for name in names:
+        metric.add_state(name, default=[], dist_reduce_fx=dist_reduce_fx)
+    metric.__dict__["__jit_unsafe__"] = True
+
+
+def warn_exact_buffer(cls_name: str, what: str = "targets and predictions") -> None:
+    """The reference's large-memory-footprint warning — fired only for
+    ``exact=True`` instances (the sketch default is O(capacity))."""
+    rank_zero_warn(
+        f"Metric `{cls_name}` with `exact=True` will save all {what} in buffer."
+        " For large datasets this may lead to large memory footprint."
+    )

@@ -1,0 +1,65 @@
+"""Static-edge weighted histogram: the degenerate-but-exact sketch.
+
+When the downstream statistic only ever reads BINNED aggregates (top-label
+calibration error bins confidences into ``n_bins`` before comparing
+accuracy and confidence), the fixed-shape streaming state is not an
+approximation at all: per-bin weighted sums are sufficient statistics, so
+the converted metric is exact for every stream length at ``O(n_bins)``
+memory — and because the state leaves are plain ``"sum"``-reduced arrays,
+they ride every existing layer (fused dispatch with exact pad-and-mask
+correction, ``SlicedMetric`` per-leaf scatter, ``sync_pytree_in_mesh``'s
+fused all-reduce round) with zero new plumbing.
+
+Contract mirrors the other sketches: ``init -> leaf``, pure jit-safe
+``insert``, trivial ``merge`` (addition). The bin-index convention is the
+calibration kernel's ``searchsorted(side='left') - 1`` (see
+``functional/classification/calibration_error.py``) so binned states are
+bit-compatible with the exact compute's bucketize.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def hist_init(n_bins: int, n_stats: int = 1) -> Array:
+    """Fresh ``[n_stats, n_bins]`` zero histogram (rows are independent
+    per-bin weighted sums, e.g. count / confidence-sum / accuracy-sum)."""
+    if not (isinstance(n_bins, int) and n_bins > 0):
+        raise ValueError(f"`n_bins` must be a positive int, got {n_bins}")
+    if not (isinstance(n_stats, int) and n_stats > 0):
+        raise ValueError(f"`n_stats` must be a positive int, got {n_stats}")
+    return jnp.zeros((n_stats, n_bins), jnp.float32)
+
+
+def hist_bin_index(edges: Array, x: Array) -> Array:
+    """Bin index per sample under the calibration bucketize convention."""
+    n_bins = edges.shape[0] - 1
+    return jnp.clip(jnp.searchsorted(edges, x, side="left") - 1, 0, n_bins - 1)
+
+
+def hist_insert(
+    hist: Array,
+    bin_idx: Array,
+    stats: Array,
+    weights: Optional[Array] = None,
+    n_valid: Optional[Array] = None,
+) -> Array:
+    """Scatter-add ``[n_stats, B]`` per-sample statistics into their bins;
+    pure and jit-safe. ``n_valid`` masks trailing pad rows (fused
+    pad-and-mask contract) — though for purely additive histogram states
+    the fused path's ``k * delta`` sum correction is equally exact."""
+    stats = jnp.asarray(stats, jnp.float32)
+    if stats.ndim == 1:
+        stats = stats[None, :]
+    w = jnp.ones(stats.shape[1], jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
+    if n_valid is not None:
+        w = w * (jnp.arange(stats.shape[1]) < n_valid)
+    return hist.at[:, bin_idx].add(w[None, :] * stats)
+
+
+def hist_merge(a: Array, b: Array) -> Array:
+    """Histograms merge by addition (the ``"sum"`` reducer IS the merge)."""
+    return a + b

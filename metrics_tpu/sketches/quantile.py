@@ -1,0 +1,302 @@
+"""Mergeable weighted quantile/stream sketch with FIXED-shape state.
+
+The cat-state metrics (exact curves, Spearman, calibration) accumulate
+unbounded ``[N]`` arrays — O(dataset) memory and permanent exclusion from
+``FusedUpdate``/``compile_update_async`` because list-state is dynamic
+shape. This module is the replacement: a **packed single-leaf sketch**,
+
+    ``[capacity, 2 + payload_cols]`` float32
+    column 0: weight  (``> 0`` ⇒ occupied slot)
+    column 1: key     (the value the sketch orders/quantiles by)
+    columns 2..: payload riding with each key (labels, one-hot rows, ...)
+
+with three pure, jit-safe, fixed-shape transforms:
+
+* ``qsketch_init(capacity, payload_cols) -> leaf``
+* ``qsketch_insert(leaf, key, payload, weights, n_valid) -> leaf``
+* ``qsketch_merge(a, b) -> leaf``   (``dist_reduce_fx`` material)
+
+**Lossless window.** Inserts append into the first free slots (stable
+pack: insertion order is preserved), so while the total inserted row
+count fits in ``capacity`` the sketch holds the exact stream — weights
+all 1, rows in arrival order. Converted metrics exploit this: inside the
+window they reconstruct the original arrays and run the exact unbounded
+kernels bit-for-bit; only past capacity do the weighted approximate
+kernels engage.
+
+**Compaction.** On overflow the occupied rows compact by a fully
+vectorized merging-t-digest pass: rows sort by key, map through the
+tail-adaptive quantile scale ``k1(q) = (capacity / 2π) · asin(2q − 1)``,
+and rows sharing a scale bucket merge into one weighted centroid
+(``weight`` summed, key/payload weighted-MEAN). Weighted means preserve
+every first moment exactly (``sum(w * payload)`` is invariant), so curve
+statistics built from linear functionals of the payload (weighted TP/FP
+masses, rank co-moments) lose accuracy only through key displacement
+inside a bucket — narrowest at the tails, and bounded by the rank-error
+envelope :func:`rank_error_bound` advertises and the property tests pin
+across adversarial orderings.
+
+**Merge.** ``merge(a, b)`` concatenates rows and runs the same
+pack-or-compact step. Below combined capacity it is exact; above, both
+orders produce the same key-sorted collapsed rows for distinct keys
+(commutativity is pinned in tests as multiset equality of rows).
+
+Everything is a plain ``jnp`` program — no host syncs, no data-dependent
+shapes — so metrics whose update is one ``qsketch_insert`` fuse, bucket
+(via ``n_valid`` pad masking), vmap, and mesh-sync like any sum-state
+metric.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: empirical compaction constant for :func:`rank_error_bound` — the
+#: adversarial-ordering property tests (tests/sketches/) pin measured rank
+#: error under this envelope
+QSKETCH_RANK_EPS = 4.0
+
+
+def rank_error_bound(n: int, capacity: int) -> float:
+    """Advertised ABSOLUTE rank-error bound after ``n`` unit-weight inserts.
+
+    Zero inside the lossless window (``n <= capacity``); beyond it, pair
+    collapse displaces a query rank by at most the collapsed pair weights
+    crossing it — empirically bounded by ``QSKETCH_RANK_EPS * n /
+    capacity`` across adversarial orderings (sorted, reversed, organ-pipe,
+    interleaved; see the property tests). Relative rank error is therefore
+    ``QSKETCH_RANK_EPS / capacity`` — capacity IS the accuracy knob.
+    """
+    if n <= capacity:
+        return 0.0
+    return QSKETCH_RANK_EPS * float(n) / float(capacity) + 2.0
+
+
+def qsketch_init(capacity: int, payload_cols: int = 0) -> Array:
+    """Fresh empty sketch leaf ``[capacity, 2 + payload_cols]``."""
+    if not (isinstance(capacity, int) and capacity > 0):
+        raise ValueError(f"sketch `capacity` must be a positive int, got {capacity}")
+    if not (isinstance(payload_cols, int) and payload_cols >= 0):
+        raise ValueError(f"`payload_cols` must be a non-negative int, got {payload_cols}")
+    return jnp.zeros((capacity, 2 + payload_cols), jnp.float32)
+
+
+def _pack_rows(rows: Array) -> Array:
+    """Occupied rows first, preserving their relative order (stable)."""
+    n = rows.shape[0]
+    occ = rows[:, 0] > 0
+    # composite integer key makes the pack order-stable without relying on
+    # argsort's stability kwarg across jax versions
+    order = jnp.argsort(jnp.where(occ, 0, 1) * n + jnp.arange(n, dtype=jnp.int32))
+    return rows[order]
+
+
+def _compact_rows(rows: Array, capacity: int) -> Array:
+    """One merging-t-digest compaction pass, fully vectorized.
+
+    Occupied rows (weighted centroids) are sorted by key; each row's
+    mid-quantile position ``q`` maps through the tail-adaptive scale
+    ``k1(q) = (capacity / 2π) · asin(2q − 1)`` to an integer bucket, and
+    rows sharing a bucket merge into one centroid (``segment_sum``: weight
+    summed, key/payload weighted-mean — every first moment preserved
+    exactly). The scale allots bucket widths ∝ ``sqrt(q(1−q))``, so tail
+    quantiles (where threshold curves live) get the narrowest buckets and
+    the post-pass centroid count is ≤ capacity/2 + 4 whatever the input.
+    One sort + one segment-sum — no data-dependent shapes, no host reads.
+    """
+    n = rows.shape[0]
+    w = rows[:, 0]
+    occ = w > 0
+    key = jnp.where(occ, rows[:, 1], jnp.inf)
+    order = jnp.lexsort((jnp.arange(n, dtype=jnp.int32), key))
+    srt = rows[order]
+    sw = srt[:, 0]
+    total = jnp.clip(jnp.sum(sw), 1e-30, None)
+    cum = jnp.cumsum(sw)
+    q = jnp.clip((cum - sw / 2.0) / total, 0.0, 1.0)
+    scale = capacity / (2.0 * jnp.pi)
+    k = scale * jnp.arcsin(2.0 * q - 1.0)  # in [-capacity/4, capacity/4]
+    n_seg = capacity // 2 + 4
+    bucket = jnp.clip(
+        jnp.floor(k).astype(jnp.int32) + capacity // 4 + 1, 0, n_seg - 1
+    )
+    seg_w = jax.ops.segment_sum(sw, bucket, num_segments=n_seg)
+    seg_vals = jax.ops.segment_sum(sw[:, None] * srt[:, 1:], bucket, num_segments=n_seg)
+    seg_vals = seg_vals / jnp.clip(seg_w[:, None], 1e-30, None)
+    merged = jnp.concatenate([seg_w[:, None], seg_vals], axis=1)
+    out = jnp.zeros_like(rows)
+    out = out.at[:n_seg].set(merged.astype(rows.dtype))
+    return _pack_rows(out)
+
+
+@jax.jit
+def _absorb(sketch: Array, new_rows: Array) -> Array:
+    """Shared insert/merge core: concatenate, pack, compact iff the
+    occupied rows overflow capacity (``lax.cond`` — the compaction branch
+    only runs on overflow, so in-window streams never pay the sort).
+    Jitted on its own so EAGER metric updates pay one cached dispatch per
+    (capacity, batch) signature instead of tens of small op dispatches; the
+    raises below are host-static shape checks that fire at trace time."""
+    capacity = sketch.shape[0]
+    if new_rows.shape[0] > capacity:
+        raise ValueError(
+            f"cannot absorb {new_rows.shape[0]} rows into a capacity-{capacity} sketch in one"
+            " pass; chunk the batch to at most `capacity` rows"
+        )
+    if capacity < 8:
+        raise ValueError(f"sketch capacity must be at least 8, got {capacity}")
+    rows = jnp.concatenate([sketch, new_rows.astype(sketch.dtype)], axis=0)
+    packed = _pack_rows(rows)
+    n_occ = jnp.sum(packed[:, 0] > 0)
+    return jax.lax.cond(
+        n_occ > capacity,
+        lambda r: _compact_rows(r, capacity),
+        lambda r: r,
+        packed,
+    )[:capacity]
+
+
+def qsketch_insert(
+    sketch: Array,
+    key: Array,
+    payload: Optional[Array] = None,
+    weights: Optional[Array] = None,
+    n_valid: Optional[Array] = None,
+) -> Array:
+    """Insert a batch of keyed rows; pure and jit-safe.
+
+    ``key`` is ``[B]``; ``payload`` is ``[B, payload_cols]`` (or None for a
+    payload-less sketch); ``weights`` default to 1. ``n_valid`` masks
+    trailing rows to weight 0 — the pad-and-mask contract of the fused
+    bucketed dispatch (``__fused_mask_valid__``): edge-pad rows beyond
+    ``n_valid`` are dropped instead of inserted. Batches larger than
+    ``capacity`` are absorbed in capacity-sized chunks (host loop over
+    static slices).
+    """
+    key = jnp.asarray(key, jnp.float32).reshape(-1)
+    b = key.shape[0]
+    w = jnp.ones((b,), jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32).reshape(-1)
+    if n_valid is not None:
+        w = w * (jnp.arange(b) < n_valid)
+    expect = sketch.shape[1] - 2
+    if payload is None:
+        if expect != 0:
+            raise ValueError(
+                f"payload has 0 column(s) but the sketch was initialized with {expect}"
+            )
+        rows = jnp.concatenate([w[:, None], key[:, None]], axis=1)
+    else:
+        payload = jnp.asarray(payload, jnp.float32).reshape(b, -1)
+        if payload.shape[1] != expect:
+            raise ValueError(
+                f"payload has {payload.shape[1]} column(s) but the sketch was initialized"
+                f" with {expect}"
+            )
+        rows = jnp.concatenate([w[:, None], key[:, None], payload], axis=1)
+    capacity = sketch.shape[0]
+    for lo in range(0, b, capacity):
+        sketch = _absorb(sketch, rows[lo : lo + capacity])
+    return sketch
+
+
+def qsketch_merge(a: Array, b: Array) -> Array:
+    """Merge two sketches into one of ``a``'s capacity (``dist_reduce_fx``
+    material: pure, associative up to collapse rounding, commutative as a
+    row multiset). Exact while the combined occupancy fits."""
+    if a.ndim != 2 or a.shape[1:] != b.shape[1:]:
+        raise ValueError(f"cannot merge sketches with layouts {a.shape} and {b.shape}")
+    out = a
+    for lo in range(0, b.shape[0], a.shape[0]):
+        out = _absorb(out, b[lo : lo + a.shape[0]])
+    return out
+
+
+class _QSketchReduce:
+    """``dist_reduce_fx`` for quantile-sketch leaves: takes the stacked
+    per-rank leaves ``[world, capacity, cols]`` (the contract both
+    ``Metric._sync_dist`` and the callable-reducer leg of ``sync_in_mesh``
+    deliver) and folds :func:`qsketch_merge` across ranks in rank order —
+    inside the lossless window this reproduces the cat-state gather's
+    concatenation order bit-for-bit.
+
+    A module-level class (not a closure) so metric instances carrying it
+    pickle/deepcopy; tagged ``merge_like`` / ``sketch_kind`` so
+    ``merge_states``, ``sync_pytree_in_mesh``'s fused gather round,
+    tracelint's TL-FLOW, and the footprint accounting all recognize sketch
+    leaves without importing this module.
+    """
+
+    merge_like = True
+    sketch_kind = "quantile"
+    __name__ = "qsketch_reduce"
+
+    def __call__(self, stacked: Array) -> Array:
+        stacked = jnp.asarray(stacked)
+        if stacked.ndim == 2:  # single-rank passthrough
+            return stacked
+        out = stacked[0]
+        for i in range(1, stacked.shape[0]):
+            out = qsketch_merge(out, stacked[i])
+        return out
+
+
+_QSKETCH_REDUCE = _QSketchReduce()
+
+
+def sketch_merge_fx() -> _QSketchReduce:
+    """The shared quantile-sketch ``dist_reduce_fx`` (see
+    :class:`_QSketchReduce`)."""
+    return _QSKETCH_REDUCE
+
+
+# ---------------------------------------------------------------------------
+# queries (pure; fixed-shape unless noted)
+# ---------------------------------------------------------------------------
+
+
+def qsketch_fill(sketch: Array) -> Array:
+    """Number of occupied slots (int32 scalar)."""
+    return jnp.sum(sketch[:, 0] > 0).astype(jnp.int32)
+
+
+def qsketch_total_weight(sketch: Array) -> Array:
+    """Total inserted weight surviving in the sketch."""
+    return jnp.sum(sketch[:, 0])
+
+
+def qsketch_rank(sketch: Array, xs: Array) -> Array:
+    """Estimated rank (weighted count of keys ``<= x``) per query point."""
+    w, key = sketch[:, 0], sketch[:, 1]
+    xs = jnp.asarray(xs, jnp.float32).reshape(-1)
+    return jnp.sum(w[None, :] * (key[None, :] <= xs[:, None]), axis=1)
+
+
+def qsketch_cdf(sketch: Array, xs: Array) -> Array:
+    """Estimated CDF at each query point (rank / total weight)."""
+    total = jnp.clip(qsketch_total_weight(sketch), 1e-12, None)
+    return qsketch_rank(sketch, xs) / total
+
+
+def qsketch_quantile(sketch: Array, q: Array) -> Array:
+    """Estimated quantile(s): smallest key whose cumulative weight reaches
+    ``q`` of the total."""
+    w, key = sketch[:, 0], sketch[:, 1]
+    order = jnp.argsort(jnp.where(w > 0, key, jnp.inf))
+    sk, sw = key[order], w[order]
+    cum = jnp.cumsum(sw)
+    total = jnp.clip(cum[-1], 1e-12, None)
+    q = jnp.asarray(q, jnp.float32).reshape(-1)
+    idx = jnp.clip(jnp.searchsorted(cum / total, q, side="left"), 0, sk.shape[0] - 1)
+    return sk[idx]
+
+
+def qsketch_histogram(sketch: Array, edges: Array) -> Array:
+    """Weighted histogram of the keys over ``len(edges) - 1`` bins, using
+    the same ``searchsorted(side='left')`` convention as the calibration
+    binning kernel."""
+    w, key = sketch[:, 0], sketch[:, 1]
+    n_bins = edges.shape[0] - 1
+    idx = jnp.clip(jnp.searchsorted(edges, key, side="left") - 1, 0, n_bins - 1)
+    return jnp.zeros(n_bins, jnp.float32).at[idx].add(w)

@@ -1,0 +1,80 @@
+"""Rank/co-moment sketch: streaming Spearman over a pair reservoir.
+
+Spearman needs the JOINT rank distribution of (pred, target). A quantile
+sketch keyed on pred cannot carry it — collapsing rows adjacent in pred
+averages their targets, which deletes the conditional spread of target
+given pred and inflates the estimated correlation toward the correlation
+of conditional means (measured: +0.18 on a ρ=0.8 stream). The sound
+fixed-memory estimator is a UNIFORM SAMPLE of pairs: Spearman computed on
+a k-row reservoir is unbiased with standard error ~(1 − ρ²)/√k (≈0.004 at
+the default capacity 8192), and inside the lossless window (stream ≤ k)
+the reservoir IS the stream, so the exact tie-averaged kernel applies
+bit-for-bit.
+
+State is a :mod:`.reservoir` leaf ``[capacity, 3]`` (priority, pred,
+target); :func:`ranksketch_spearman` is the jit-safe fixed-shape query
+(weighted midranks with occupancy weights — at unit weights it reduces to
+the classic tie-averaged rank transform).
+"""
+import jax
+import jax.numpy as jnp
+
+from .reservoir import reservoir_init, reservoir_insert, reservoir_merge, reservoir_merge_fx
+
+Array = jax.Array
+
+
+def ranksketch_init(capacity: int) -> Array:
+    """Fresh ``[capacity, 3]`` (priority, pred, target) reservoir leaf."""
+    return reservoir_init(capacity, payload_cols=2)
+
+
+def ranksketch_insert(
+    sketch: Array, preds: Array, target: Array, seen, seed: int = 0, n_valid=None
+) -> Array:
+    """Insert (pred, target) pairs; pure and jit-safe. ``seen`` is the
+    caller's monotone inserted-row counter (seeds the priority draw)."""
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target, jnp.float32).reshape(-1)
+    rows = jnp.stack([preds, target], axis=1)
+    return reservoir_insert(sketch, rows, seen, seed=seed, n_valid=n_valid)
+
+
+ranksketch_merge = reservoir_merge
+ranksketch_merge_fx = reservoir_merge_fx
+
+
+def _weighted_midranks(values: Array, weights: Array) -> Array:
+    """Weighted tie-averaged midranks: a value group with weight mass ``W``
+    preceded by mass ``S`` ranks at ``S + (W + 1) / 2`` — for unit weights
+    this is exactly the classic average-rank convention the unbounded
+    ``_rank_data`` kernel implements."""
+    n = values.shape[0]
+    order = jnp.lexsort((jnp.arange(n, dtype=jnp.int32), jnp.where(weights > 0, values, jnp.inf)))
+    sv, sw = values[order], weights[order]
+    cum = jnp.cumsum(sw)
+    is_start = jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]])
+    group_id = jnp.cumsum(is_start) - 1
+    group_w = jax.ops.segment_sum(sw, group_id, num_segments=n)
+    group_end = jax.ops.segment_max(cum, group_id, num_segments=n)
+    midrank = (group_end - group_w + (group_w + 1.0) / 2.0)[group_id]
+    return jnp.zeros(n, jnp.float32).at[order].set(midrank)
+
+
+def ranksketch_spearman(sketch: Array, eps: float = 1e-6) -> Array:
+    """Spearman correlation of the sampled pairs (jit-safe, fixed-shape);
+    occupancy-weighted midranks + the exact kernel's eps-regularized,
+    clipped Pearson-of-ranks formula."""
+    w = (sketch[:, 0] > -jnp.inf).astype(jnp.float32)
+    preds, target = sketch[:, 1], sketch[:, 2]
+    total = jnp.clip(jnp.sum(w), 1e-12, None)
+    rp = _weighted_midranks(preds, w)
+    rt = _weighted_midranks(target, w)
+    mp = jnp.sum(w * rp) / total
+    mt = jnp.sum(w * rt) / total
+    dp = jnp.where(w > 0, rp - mp, 0.0)
+    dt = jnp.where(w > 0, rt - mt, 0.0)
+    cov = jnp.sum(w * dp * dt) / total
+    sp = jnp.sqrt(jnp.sum(w * dp * dp) / total)
+    st = jnp.sqrt(jnp.sum(w * dt * dt) / total)
+    return jnp.clip(cov / (sp * st + eps), -1.0, 1.0)

@@ -1,0 +1,162 @@
+"""Weighted reservoir sample with FIXED-shape state and jit-safe replacement.
+
+A uniform (optionally weighted) sample of ``k`` payload rows from an
+unbounded stream, as a packed single-leaf state
+
+    ``[k, 1 + payload_cols]`` float32
+    column 0: priority key (``-inf`` ⇒ empty slot)
+    columns 1..: payload row (feature vector, (pred, target) pair, ...)
+
+Replacement is the Gumbel-key (A-ExpJ) scheme: every inserted row draws a
+deterministic counter-seeded Gumbel priority ``g + log(w)``; the reservoir
+is always the top-``k`` rows by priority, which a single fixed-shape
+``top_k``-style sort maintains under jit — no host RNG, no rejection
+loops, and ``merge(a, b)`` is simply top-``k`` over the concatenated rows
+(two independent reservoirs of the same stream prefix merge into exactly
+the reservoir of the union).
+
+**Lossless window.** While the total row count fits in ``k`` the packed
+leaf holds every row in arrival order (stable pack, no replacement), so
+consumers (KID subset selection) reproduce the cat-state path
+bit-for-bit; only past ``k`` does uniform subsampling engage.
+
+**Determinism & cross-rank merges.** Priorities come from
+``fold_in(PRNGKey(seed), seen_counter)`` — reproducible across runs. Two
+RANKS inserting with the same seed and counters would draw identical
+priorities and bias the merge, so per-rank metrics fold
+``jax.process_index()`` into their seed (see ``image/kid.py``).
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EMPTY = -jnp.inf
+
+
+def reservoir_init(k: int, payload_cols: int) -> Array:
+    """Fresh empty reservoir leaf ``[k, 1 + payload_cols]``."""
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError(f"reservoir size `k` must be a positive int, got {k}")
+    if not (isinstance(payload_cols, int) and payload_cols > 0):
+        raise ValueError(f"`payload_cols` must be a positive int, got {payload_cols}")
+    leaf = jnp.zeros((k, 1 + payload_cols), jnp.float32)
+    return leaf.at[:, 0].set(_EMPTY)
+
+
+@partial(jax.jit, static_argnums=1)
+def _select(rows: Array, k: int) -> Array:
+    """Top-``k`` rows by priority when over-occupied, else stable pack.
+    Jitted (static ``k``) so eager metric updates pay one cached dispatch."""
+    n = rows.shape[0]
+    pri = rows[:, 0]
+    occ = pri > _EMPTY
+    n_occ = jnp.sum(occ)
+
+    def pack(r):
+        order = jnp.argsort(jnp.where(occ, 0, 1) * n + jnp.arange(n, dtype=jnp.int32))
+        return r[order][:k]
+
+    def topk(r):
+        order = jnp.lexsort((jnp.arange(n, dtype=jnp.int32), -pri))
+        return r[order][:k]
+
+    return jax.lax.cond(n_occ > k, topk, pack, rows)
+
+
+def reservoir_insert(
+    reservoir: Array,
+    payload: Array,
+    seen: Array,
+    seed: int = 0,
+    weights: Optional[Array] = None,
+    n_valid: Optional[Array] = None,
+) -> Array:
+    """Insert ``[B, payload_cols]`` rows; pure and jit-safe.
+
+    ``seen`` is the caller-maintained count of rows inserted BEFORE this
+    batch (a sum-reduced int state leaf) — it seeds the per-batch priority
+    draw so replays are deterministic and successive batches never reuse
+    priorities. ``weights`` bias inclusion probability (A-ExpJ:
+    ``priority = gumbel + log(w)``); ``n_valid`` masks trailing pad rows
+    out entirely (the fused pad-and-mask contract).
+    """
+    payload = jnp.asarray(payload, jnp.float32)
+    payload = payload.reshape(payload.shape[0], -1)
+    b = payload.shape[0]
+    if payload.shape[1] != reservoir.shape[1] - 1:
+        raise ValueError(
+            f"payload has {payload.shape[1]} column(s) but the reservoir was initialized"
+            f" with {reservoir.shape[1] - 1}"
+        )
+    if b == 0:
+        return reservoir
+    rng = jax.random.fold_in(jax.random.PRNGKey(seed), jnp.asarray(seen, jnp.int32))
+    pri = jax.random.gumbel(rng, (b,), jnp.float32)
+    if weights is not None:
+        w = jnp.asarray(weights, jnp.float32).reshape(-1)
+        pri = pri + jnp.where(w > 0, jnp.log(jnp.clip(w, 1e-30, None)), _EMPTY)
+    if n_valid is not None:
+        pri = jnp.where(jnp.arange(b) < n_valid, pri, _EMPTY)
+    rows = jnp.concatenate([pri[:, None], payload], axis=1)
+    k = reservoir.shape[0]
+    out = reservoir
+    for lo in range(0, b, k):
+        chunk = rows[lo : lo + k]
+        out = _select(jnp.concatenate([out, chunk], axis=0), k)
+    return out
+
+
+def reservoir_merge(a: Array, b: Array) -> Array:
+    """Merge two reservoirs (top-``k`` of the union by priority); the
+    ``dist_reduce_fx`` operation. Exact (no row lost) while the combined
+    occupancy fits in ``a``'s size."""
+    if a.ndim != 2 or a.shape[1:] != b.shape[1:]:
+        raise ValueError(f"cannot merge reservoirs with layouts {a.shape} and {b.shape}")
+    k = a.shape[0]
+    out = a
+    for lo in range(0, b.shape[0], k):
+        out = _select(jnp.concatenate([out, b[lo : lo + k]], axis=0), k)
+    return out
+
+
+class _ReservoirReduce:
+    """``dist_reduce_fx`` folding :func:`reservoir_merge` over the stacked
+    per-rank leaves ``[world, k, cols]`` — a picklable module-level class
+    tagged like the quantile reducer so the merge plumbing treats both
+    sketch kinds uniformly."""
+
+    merge_like = True
+    sketch_kind = "reservoir"
+    __name__ = "reservoir_reduce"
+
+    def __call__(self, stacked: Array) -> Array:
+        stacked = jnp.asarray(stacked)
+        if stacked.ndim == 2:
+            return stacked
+        out = stacked[0]
+        for i in range(1, stacked.shape[0]):
+            out = reservoir_merge(out, stacked[i])
+        return out
+
+
+_RESERVOIR_REDUCE = _ReservoirReduce()
+
+
+def reservoir_merge_fx() -> _ReservoirReduce:
+    """The shared reservoir ``dist_reduce_fx`` (see :class:`_ReservoirReduce`)."""
+    return _RESERVOIR_REDUCE
+
+
+def reservoir_fill(reservoir: Array) -> Array:
+    """Number of occupied slots (int32 scalar)."""
+    return jnp.sum(reservoir[:, 0] > _EMPTY).astype(jnp.int32)
+
+
+def reservoir_rows(reservoir: Array) -> Array:
+    """The payload rows ``[k, payload_cols]`` (occupied-first slot order;
+    callers slice by :func:`reservoir_fill` on the host)."""
+    return reservoir[:, 1:]

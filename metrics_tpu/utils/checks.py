@@ -233,6 +233,31 @@ def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
     return preds, target
 
 
+def _score_mode_static(preds: Array, target: Array) -> DataType:
+    """Shape-only mode deduction for float-SCORE inputs (the curve /
+    calibration family): the ``DataType`` the full
+    :func:`_input_format_classification` would return, derived from static
+    ranks alone — no value reads, so it is usable on tracers. Callers keep
+    the full validating path for concrete inputs (``if _is_concrete(...)``)
+    and fall back to this under jit, where value validation is host work by
+    contract (the same split the capacity-mode buffers use)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _input_squeeze(preds, target)
+    if preds.ndim == 1 and target.ndim == 1:
+        return DataType.BINARY
+    if preds.ndim == 2 and target.ndim == 1:
+        return DataType.MULTICLASS
+    if preds.ndim == target.ndim and preds.ndim >= 2:
+        return DataType.MULTILABEL
+    if preds.ndim >= 3 and target.ndim == preds.ndim - 1:
+        return DataType.MULTIDIM_MULTICLASS
+    raise ValueError(
+        f"Could not deduce the classification mode from score shapes {preds.shape} /"
+        f" {target.shape}"
+    )
+
+
 def _input_format_classification(
     preds: Array,
     target: Array,

@@ -77,13 +77,16 @@ AUX_FIELDS: Dict[str, str] = {
     "update_async_p99_ms": "lower",
     "sliced_vs_fanout": "higher",
     "sliced_scatter_compiles": "lower",
+    "sketch_state_bytes_frac": "lower",
+    "sketch_auroc_abs_err": "lower",
+    "sketch_fused_compiles": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
 #: bench that reports a false parity bit (async final states diverged from
 #: the blocking path) is broken no matter how fast it ran, and the
 #: ratio/wall checks above would pass it silently
-BOOL_FIELDS: Tuple[str, ...] = ("states_bit_identical",)
+BOOL_FIELDS: Tuple[str, ...] = ("states_bit_identical", "sketch_window_bit_exact")
 
 
 def _lower_is_better(record: Dict[str, Any]) -> bool:

@@ -96,15 +96,17 @@ class TestFreshness:
 #: states) is progress; ADDING to it is a reviewed decision.
 GENUINELY_DYNAMIC = {
     # unbounded cat-state accumulation
+    # (the curve family — AUROC / ROC / PRC / AveragePrecision — left this
+    # list in the sketch-state conversion: their DEFAULT mode is now the
+    # fixed-shape streaming sketch, declared False, with `exact=True`
+    # instances guarded at runtime by instance-level __jit_unsafe__)
     "AUC": ("unsafe", "cat-growth"),
-    "AUROC": ("unsafe", "cat-growth"),
-    "AveragePrecision": ("unsafe", "cat-growth"),
-    "PrecisionRecallCurve": ("unsafe", "cat-growth"),
-    "ROC": ("unsafe", "cat-growth"),
     "MeanAveragePrecision": ("unsafe", "cat-growth"),
     "FrechetInceptionDistance": ("unsafe", "cat-growth"),
     "InceptionScore": ("unsafe", "cat-growth"),
-    "KernelInceptionDistance": ("unsafe", "cat-growth"),
+    # reservoir-backed by default, but the feature extractor is an arbitrary
+    # host callable (Flax model / user function): update is host work
+    "KernelInceptionDistance": ("unsafe", "host-sync"),
     "RetrievalMetric": ("unsafe", "cat-growth"),
     "BERTScore": ("unsafe", "cat-growth"),
     "CHRFScore": ("unsafe", "cat-growth"),

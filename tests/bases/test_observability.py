@@ -140,9 +140,18 @@ def test_sync_byte_accounting_on_mesh(recorder):
 
 
 def test_state_footprint_growth_and_high_water_warning(recorder):
-    """Cat-state curve metrics grow per update; state_footprint sees it and
-    the opt-in high-water mark warns once."""
-    roc = ROC()
+    """Cat-state curve metrics (the `exact=True` opt-out since the sketch
+    conversion) grow per update; state_footprint sees it and the opt-in
+    high-water mark warns once. The sketch DEFAULT is the fix: its bytes
+    stay constant across updates."""
+    sketched = ROC()
+    sk0 = sketched.total_state_bytes()
+    sketched.update(jnp.asarray([0.2, 0.8, 0.5]), jnp.asarray([0, 1, 1]))
+    assert sketched.total_state_bytes() == sk0  # O(capacity), not O(N)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the exact-mode large-buffer warning
+        roc = ROC(exact=True)
     fp0 = sum(roc.state_footprint().values())
     roc.update(jnp.asarray([0.2, 0.8, 0.5]), jnp.asarray([0, 1, 1]))
     fp1 = sum(roc.state_footprint().values())

@@ -108,11 +108,22 @@ def test_recompile_billing_via_profile_compiles(recorder):
 
 
 def test_metric_compile_cost_declines_list_state_metrics(recorder):
-    """Cat-state (list) metrics have no single compiled executable to bill;
-    the hook must decline, never crash the hot path."""
-    roc = ROC()
+    """Cat-state (list) metrics — the `exact=True` opt-out since the sketch
+    conversion — have no single compiled executable to bill; the hook must
+    decline, never crash the hot path. (The sketch DEFAULT has a fixed-shape
+    jit-safe update, so it IS billable now — an upgrade the previous
+    default could never have.)"""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the exact-mode large-buffer warning
+        roc = ROC(exact=True)
     roc.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
     assert metric_compile_cost(roc, (jnp.asarray([0.2]), jnp.asarray([1])), {}) is None
+    sketched = ROC()
+    sketched.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    billed = metric_compile_cost(sketched, (jnp.asarray([0.2]), jnp.asarray([1])), {})
+    assert billed is not None and billed["entry"] == "ROC.update"
 
 
 # ---------------------------------------------------------------------------

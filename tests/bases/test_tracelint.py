@@ -1647,3 +1647,226 @@ class M(Metric):
 """
         )
         assert "TL-FLOW" not in _rules_of(kept)
+
+
+# ---------------------------------------------------------------------------
+# sketch-state teaching (ISSUE 10): "merge" reducers, exact-mode split,
+# fixed-size nonzero, tuple-return taint
+# ---------------------------------------------------------------------------
+
+
+class TestMergeReducerFlow:
+    _SKETCH_PREAMBLE = """
+from metrics_tpu.sketches.quantile import qsketch_init, qsketch_insert, sketch_merge_fx
+"""
+
+    def test_merge_string_reducer_is_known(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("sk", default=jnp.zeros((64, 3)), dist_reduce_fx="merge")
+    def _update(self, preds):
+        self.sk = self.sk.at[0, 0].add(jnp.sum(preds) * 0 + 1)
+    def _compute(self):
+        return jnp.sum(self.sk)
+"""
+        )
+        # no "unknown dist_reduce_fx" complaint for the merge string
+        assert not any("unknown dist_reduce_fx" in v.message for v in kept)
+
+    def test_merge_leaf_insert_transform_passes(self):
+        kept, _ = _check(
+            self._SKETCH_PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("sk", default=qsketch_init(64, payload_cols=0), dist_reduce_fx=sketch_merge_fx())
+    def _update(self, preds):
+        self.sk = qsketch_insert(self.sk, preds)
+    def _compute(self):
+        return jnp.sum(self.sk)
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_merge_leaf_additive_write_flags(self):
+        kept, _ = _check(
+            self._SKETCH_PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("sk", default=qsketch_init(64, payload_cols=0), dist_reduce_fx=sketch_merge_fx())
+    def _update(self, preds):
+        self.sk = self.sk + jnp.sum(preds)
+    def _compute(self):
+        return jnp.sum(self.sk)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+        assert any("not element-wise summable" in v.message for v in kept)
+
+    def test_merge_leaf_overwrite_flags(self):
+        kept, _ = _check(
+            self._SKETCH_PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("sk", default=qsketch_init(64, payload_cols=0), dist_reduce_fx=sketch_merge_fx())
+    def _update(self, preds):
+        self.sk = qsketch_insert(qsketch_init(64, payload_cols=0), preds)
+    def _compute(self):
+        return jnp.sum(self.sk)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+        assert any("without reading its prior value" in v.message for v in kept)
+
+
+class TestSketchInterpTeaching:
+    def _verdict(self, source, relpath="classification/fixture.py"):
+        import ast as _ast
+
+        from metrics_tpu.analysis.engine import FileContext
+        from metrics_tpu.analysis.interp import Project, classify
+
+        ctx = FileContext(None, relpath, _METRIC_PREAMBLE + source)
+        project = Project()
+        node = next(
+            n for n in ctx.tree.body if isinstance(n, _ast.ClassDef) and n.name == "M"
+        )
+        verdict, _ = classify(project, ctx, node)
+        return verdict
+
+    def test_exact_mode_split_default_mode_is_fusible(self):
+        """The __exact_mode_attr__ contract: the exact branch's list appends
+        belong to the runtime-guarded opt-in mode, so the class verdict
+        describes the (fusible) sketch default."""
+        v = self._verdict(
+            """
+from metrics_tpu.sketches.quantile import qsketch_init, qsketch_insert, sketch_merge_fx
+
+class M(Metric):
+    __exact_mode_attr__ = "_exact"
+    def __init__(self, exact=False):
+        super().__init__()
+        self._exact = exact
+        self.add_state("sk", default=qsketch_init(64, payload_cols=0), dist_reduce_fx=sketch_merge_fx())
+    def _update(self, preds):
+        if self._exact:
+            self.preds.append(preds)
+        else:
+            self.sk = qsketch_insert(self.sk, preds)
+    def _compute(self):
+        return jnp.sum(self.sk)
+"""
+        )
+        assert v.status == "fusible", (v.status, v.reason, v.detail)
+
+    def test_same_split_without_declaration_is_not_fusible(self):
+        v = self._verdict(
+            """
+from metrics_tpu.sketches.quantile import qsketch_init, qsketch_insert, sketch_merge_fx
+
+class M(Metric):
+    def __init__(self, exact=False):
+        super().__init__()
+        self._exact = exact
+        self.add_state("sk", default=qsketch_init(64, payload_cols=0), dist_reduce_fx=sketch_merge_fx())
+    def _update(self, preds):
+        if self._exact:
+            self.preds.append(preds)
+        else:
+            self.sk = qsketch_insert(self.sk, preds)
+    def _compute(self):
+        return jnp.sum(self.sk)
+"""
+        )
+        assert v.status != "fusible", v.status
+
+    def test_fixed_size_nonzero_is_fusible(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("buf", default=jnp.zeros((64,)), dist_reduce_fx="sum")
+    def _update(self, preds):
+        idx = jnp.nonzero(preds > 0, size=8, fill_value=64)[0]
+        self.buf = self.buf.at[idx].add(1.0)
+    def _compute(self):
+        return jnp.sum(self.buf)
+"""
+        )
+        assert v.status == "fusible", (v.status, v.reason, v.detail)
+
+    def test_dynamic_nonzero_still_unsafe(self):
+        v = self._verdict(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("buf", default=jnp.zeros((64,)), dist_reduce_fx="sum")
+    def _update(self, preds):
+        idx = jnp.nonzero(preds > 0)[0]
+        self.buf = self.buf.at[idx].add(1.0)
+    def _compute(self):
+        return jnp.sum(self.buf)
+"""
+        )
+        assert v.status == "unsafe" and v.reason == "data-dependent-shape", v
+
+    def test_tuple_return_keeps_host_mode_element_untainted(self):
+        """Element-wise tuple taint: a canonicalizer returning
+        (traced, traced, host_enum) must not taint the mode its caller
+        branches on."""
+        v = self._verdict(
+            """
+def _canon(preds, target):
+    mode = "binary" if preds.ndim == 1 else "cols"
+    return preds, target, mode
+
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds, target):
+        preds, target, mode = _canon(preds, target)
+        if mode == "binary":
+            self.total = self.total + jnp.sum(preds)
+        else:
+            self.total = self.total + jnp.sum(preds[:, 0])
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert v.status == "fusible", (v.status, v.reason, v.detail)
+
+    def test_converted_curve_metrics_are_fusible_in_manifest(self):
+        """The acceptance pin: the sketch-converted classes carry fusible
+        verdicts in the COMMITTED manifest (KID stays unsafe: its feature
+        extractor is an arbitrary host callable)."""
+        import json
+        from pathlib import Path
+
+        manifest = json.loads(Path("scripts/fusibility_manifest.json").read_text())
+        metrics = manifest["metrics"]
+        fusible = {
+            "classification/auroc.py::AUROC",
+            "classification/roc.py::ROC",
+            "classification/precision_recall_curve.py::PrecisionRecallCurve",
+            "classification/avg_precision.py::AveragePrecision",
+            "classification/calibration_error.py::CalibrationError",
+            "regression/spearman.py::SpearmanCorrCoef",
+            "regression/cosine_similarity.py::CosineSimilarity",
+        }
+        for key in fusible:
+            assert metrics[key]["verdict"] == "fusible", (key, metrics[key]["verdict"])
+        kid = metrics["image/kid.py::KernelInceptionDistance"]
+        assert kid["verdict"] == "unsafe" and kid["reason"] == "host-sync"
+        # sketch leaves serialize their merge reducer
+        assert metrics["classification/auroc.py::AUROC"]["states"]["csketch"]["dist_reduce_fx"] == "merge"

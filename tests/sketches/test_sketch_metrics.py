@@ -1,0 +1,522 @@
+"""Sketch-backed metric conversions: parity, fusion, sync, observability.
+
+The acceptance surface of the cat-state conversion: converted classes run
+sketch-backed by DEFAULT with fixed-shape states; ``exact=True`` reproduces
+the old default bit-for-bit; inside the lossless window the sketch default
+is itself bit-equal to exact; beyond it, errors stay inside the advertised
+envelopes; and the fused / bucketed / async / mesh-sync / merge machinery
+built for sum-state metrics serves the converted classes unchanged.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    AveragePrecision,
+    CalibrationError,
+    CosineSimilarity,
+    MetricCollection,
+    PrecisionRecallCurve,
+    ROC,
+    SpearmanCorrCoef,
+)
+from metrics_tpu.image.kid import KernelInceptionDistance
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+from metrics_tpu.utils.compat import shard_map
+
+_rng = np.random.RandomState(7)
+N_BATCHES, BS = 4, 32
+_preds = _rng.rand(N_BATCHES, BS).astype(np.float32)
+_target = _rng.randint(0, 2, (N_BATCHES, BS))
+_preds_mc = _rng.rand(N_BATCHES, BS, 5).astype(np.float32)
+_preds_mc /= _preds_mc.sum(-1, keepdims=True)
+_target_mc = _rng.randint(0, 5, (N_BATCHES, BS))
+_target_ml = _rng.randint(0, 2, (N_BATCHES, BS, 5))
+
+
+def _exact(cls, **kwargs):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return cls(exact=True, **kwargs)
+
+
+def _feed(metric, preds, target):
+    for i in range(preds.shape[0]):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    return metric
+
+
+def _tree_equal(a, b):
+    if isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _tree_equal(x, y)
+    else:
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# lossless-window bit parity: sketch default == exact=True == old default
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "cls,kwargs,preds,target",
+    [
+        (AUROC, {}, _preds, _target),
+        (AUROC, {"num_classes": 5, "average": "macro"}, _preds_mc, _target_mc),
+        (AUROC, {"num_classes": 5, "average": "micro"}, _preds_mc, _target_ml),
+        (AveragePrecision, {"pos_label": 1}, _preds, _target),
+        (AveragePrecision, {"num_classes": 5, "average": "macro"}, _preds_mc, _target_mc),
+        (ROC, {"pos_label": 1}, _preds, _target),
+        (ROC, {"num_classes": 5}, _preds_mc, _target_mc),
+        (ROC, {"num_classes": 5}, _preds_mc, _target_ml),
+        (PrecisionRecallCurve, {"pos_label": 1}, _preds, _target),
+        (PrecisionRecallCurve, {"num_classes": 5}, _preds_mc, _target_mc),
+        (SpearmanCorrCoef, {}, _preds, (_preds * 0.5 + 0.1).astype(np.float32)),
+        (CosineSimilarity, {"reduction": "mean"}, _preds_mc, np.abs(_preds_mc) + 0.1),
+    ],
+    ids=[
+        "auroc-bin", "auroc-mc", "auroc-ml-micro", "ap-bin", "ap-mc",
+        "roc-bin", "roc-mc", "roc-ml", "prc-bin", "prc-mc", "spearman", "cosine",
+    ],
+)
+def test_sketch_default_bit_equal_to_exact_in_window(cls, kwargs, preds, target):
+    sketch = _feed(cls(**kwargs), preds, target)
+    exact = _feed(_exact(cls, **kwargs), preds, target)
+    _tree_equal(sketch.compute(), exact.compute())
+
+
+def test_calibration_binned_default_matches_exact_within_float_order():
+    for norm in ("l1", "l2", "max"):
+        sketch = _feed(CalibrationError(norm=norm), _preds, _target)
+        exact = _feed(_exact(CalibrationError, norm=norm), _preds, _target)
+        np.testing.assert_allclose(
+            float(sketch.compute()), float(exact.compute()), atol=1e-6
+        )
+
+
+def test_calibration_bit_exact_on_bin_aligned_scores():
+    """Scores that are exact binary fractions keep every per-bin float sum
+    exactly representable, so the binned streaming state reproduces the
+    exact cat-state compute BIT-FOR-BIT."""
+    preds = (_rng.randint(0, 9, (3, 64)) / 8.0).astype(np.float32)
+    target = _rng.randint(0, 2, (3, 64))
+    for norm in ("l1", "max"):
+        sketch = _feed(CalibrationError(n_bins=8, norm=norm), preds, target)
+        exact = _feed(_exact(CalibrationError, n_bins=8, norm=norm), preds, target)
+        assert float(sketch.compute()) == float(exact.compute())
+
+
+def test_kid_reservoir_default_bit_equal_to_exact_in_window():
+    feats = _rng.rand(6, 20, 8).astype(np.float32)
+
+    def identity(x):
+        return jnp.asarray(x)
+
+    sk = KernelInceptionDistance(feature=identity, subsets=5, subset_size=10, seed=11)
+    ex = _exact(KernelInceptionDistance, feature=identity, subsets=5, subset_size=10, seed=11)
+    for i in range(6):
+        real = i % 2 == 0
+        sk.update(jnp.asarray(feats[i]), real=real)
+        ex.update(jnp.asarray(feats[i]), real=real)
+    sk_mean, sk_std = sk.compute()
+    ex_mean, ex_std = ex.compute()
+    assert float(sk_mean) == float(ex_mean) and float(sk_std) == float(ex_std)
+
+
+def test_kid_reservoir_bounds_state_beyond_window():
+    def identity(x):
+        return jnp.asarray(x)
+
+    m = KernelInceptionDistance(
+        feature=identity, subsets=4, subset_size=16, reservoir_size=32, seed=0
+    )
+    for _ in range(20):
+        m.update(jnp.asarray(_rng.rand(16, 4).astype(np.float32)), real=True)
+        m.update(jnp.asarray(_rng.rand(16, 4).astype(np.float32)), real=False)
+    bytes_now = m.total_state_bytes()
+    m.update(jnp.asarray(_rng.rand(16, 4).astype(np.float32)), real=True)
+    assert m.total_state_bytes() == bytes_now  # O(k), not O(N)
+    mean, std = m.compute()
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+
+# ---------------------------------------------------------------------------
+# accuracy beyond the lossless window
+# ---------------------------------------------------------------------------
+
+
+def test_sketched_auroc_tolerance_on_large_stream():
+    sk_metrics = pytest.importorskip("sklearn.metrics")
+    n, cap = 50_000, 1024
+    preds = _rng.rand(n).astype(np.float32)
+    target = (_rng.rand(n) < 0.35).astype(np.int32)
+    m = AUROC(sketch_capacity=cap)
+    for lo in range(0, n, 2000):
+        m.update(jnp.asarray(preds[lo : lo + 2000]), jnp.asarray(target[lo : lo + 2000]))
+    got = float(m.compute())
+    want = sk_metrics.roc_auc_score(target, preds)
+    # curve error tracks the sketch's relative rank error (~eps/capacity)
+    assert abs(got - want) < 5e-3, (got, want)
+    # and the state stayed O(capacity)
+    assert m.total_state_bytes() < 64 * cap
+
+
+def test_sketched_average_precision_tolerance_on_large_stream():
+    sk_metrics = pytest.importorskip("sklearn.metrics")
+    n, cap = 50_000, 1024
+    preds = _rng.rand(n).astype(np.float32)
+    target = (_rng.rand(n) < 0.25).astype(np.int32)
+    m = AveragePrecision(pos_label=1, sketch_capacity=cap)
+    for lo in range(0, n, 2000):
+        m.update(jnp.asarray(preds[lo : lo + 2000]), jnp.asarray(target[lo : lo + 2000]))
+    got = float(m.compute())
+    want = sk_metrics.average_precision_score(target, preds)
+    assert abs(got - want) < 5e-3, (got, want)
+
+
+# ---------------------------------------------------------------------------
+# warnings: exact-only (satellite — the unconditional warn is gone)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls", [AUROC, SpearmanCorrCoef, ROC, PrecisionRecallCurve, AveragePrecision])
+def test_buffer_warning_only_on_exact_path(cls):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cls()  # sketch default: NO large-memory warning
+    with pytest.warns(UserWarning, match="memory footprint"):
+        cls(exact=True)
+
+
+def test_kid_buffer_warning_only_on_exact_path():
+    def identity(x):
+        return jnp.asarray(x)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        KernelInceptionDistance(feature=identity)
+    with pytest.warns(UserWarning, match="memory footprint"):
+        KernelInceptionDistance(feature=identity, exact=True)
+
+
+# ---------------------------------------------------------------------------
+# merge / sync plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_merge_states_virtual_ranks_match_full_stream():
+    m = AUROC()
+    states = []
+    for rank in range(2):
+        state = m.init_state()
+        for i in range(rank, N_BATCHES, 2):
+            state = m.update_state(state, jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        states.append(state)
+    merged = m.merge_states(states[0], states[1])
+    got = float(m.compute_state(merged))
+    full = _feed(AUROC(), _preds[[0, 2, 1, 3]], _target[[0, 2, 1, 3]])
+    assert got == float(full.compute())  # rank-order concat, bit-for-bit
+
+
+def test_dist_sync_fn_gather_merges_sketch_states():
+    other = _feed(AUROC(), _preds[2:], _target[2:])
+    other_states = iter([{k: jnp.asarray(getattr(other, k)) for k in other._defaults}])
+
+    def fake_gather(x, group=None):
+        return [x, next(iter(other_states.__next__().values())) if False else x]
+
+    # a simple two-rank gather: rank 0 = local, rank 1 = `other`'s state
+    states = {k: jnp.asarray(getattr(other, k)) for k in other._defaults}
+    per_state = {k: iter([states[k]]) for k in states}
+
+    def gather(x, group=None):
+        for k, it in per_state.items():
+            if jnp.asarray(x).shape == states[k].shape and jnp.asarray(x).dtype == states[k].dtype:
+                try:
+                    return [x, next(it)]
+                except StopIteration:
+                    return [x, x]
+        return [x, x]
+
+    m = _feed(AUROC(dist_sync_fn=gather), _preds[:2], _target[:2])
+    synced = float(m.compute())
+    full = _feed(AUROC(), _preds, _target)
+    assert synced == float(full.compute())
+
+
+def test_sketch_states_mesh_merge_sync():
+    n_dev = 8
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("rank",))
+    per_rank = []
+    template = AUROC(sketch_capacity=256)
+    for r in range(n_dev):
+        m = AUROC(sketch_capacity=256)
+        m.update(jnp.asarray(_rng.rand(20).astype(np.float32)), jnp.asarray(_rng.randint(0, 2, 20)))
+        per_rank.append({k: jnp.asarray(getattr(m, k)) for k in m._defaults})
+    reductions = template.state_reductions()
+    stacked = {k: jnp.stack([s[k] for s in per_rank]) for k in per_rank[0]}
+
+    def body(csk, nseen):
+        out = sync_pytree_in_mesh({"csketch": csk[0], "n_seen": nseen[0][0]}, reductions, "rank")
+        return out["csketch"], out["n_seen"]
+
+    synced_csk, synced_n = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(P("rank"), P("rank")), out_specs=(P(), P()))
+    )(stacked["csketch"], stacked["n_seen"][:, None])
+    ref = reductions["csketch"](stacked["csketch"])
+    np.testing.assert_allclose(np.asarray(synced_csk), np.asarray(ref), atol=1e-6)
+    assert int(synced_n) == n_dev * 20
+    # the synced state is still inside the lossless window: computing from it
+    # equals the exact value over the union of all ranks' streams
+    template.update(jnp.asarray(_preds[0][:1]), jnp.asarray(_target[0][:1]))  # lock mode
+    object.__setattr__(template, "csketch", synced_csk)
+    object.__setattr__(template, "n_seen", synced_n)
+    template._computed = None
+    assert np.isfinite(float(template.compute()))
+
+
+# ---------------------------------------------------------------------------
+# fused dispatch / bucketing / async
+# ---------------------------------------------------------------------------
+
+
+def _ragged_stream(n_shapes=(40, 64, 52)):
+    for n in n_shapes:
+        yield _rng.rand(n).astype(np.float32), _rng.randint(0, 2, n)
+
+
+def test_fused_bucketed_single_compile_bit_parity():
+    col = MetricCollection([Accuracy(), AUROC(), CalibrationError()])
+    handle = col.compile_update(buckets=(64,))
+    eager = {"acc": Accuracy(), "auroc": AUROC(), "ce": CalibrationError()}
+    for p, t in _ragged_stream():
+        col.update(jnp.asarray(p), jnp.asarray(t))
+        for m in eager.values():
+            m.update(jnp.asarray(p), jnp.asarray(t))
+    assert handle.n_compiles == 1, handle.n_compiles  # 3 ragged shapes, ONE compile
+    got = col.compute()
+    assert float(got["AUROC"]) == float(eager["auroc"].compute())
+    assert float(got["CalibrationError"]) == float(eager["ce"].compute())
+    assert float(got["Accuracy"]) == float(eager["acc"].compute())
+
+
+def test_fused_bucketed_spearman_single_compile_bit_parity():
+    # Spearman takes float (pred, target) pairs, so it buckets in its own
+    # collection (the curve family consumes int targets)
+    col = MetricCollection([SpearmanCorrCoef()])
+    handle = col.compile_update(buckets=(64,))
+    eager = SpearmanCorrCoef()
+    for p, _ in _ragged_stream():
+        t = (p * 0.5 + 0.1).astype(np.float32)
+        col.update(jnp.asarray(p), jnp.asarray(t))
+        eager.update(jnp.asarray(p), jnp.asarray(t))
+    assert handle.n_compiles == 1, handle.n_compiles
+    assert float(col.compute()["SpearmanCorrCoef"]) == float(eager.compute())
+
+
+def test_fused_manifest_probe_skip_for_sketch_classes():
+    col = MetricCollection([AUROC(), CalibrationError()])
+    handle = col.compile_update()
+    p, t = _preds[0], _target[0]
+    col.update(jnp.asarray(p), jnp.asarray(t))
+    assert handle.manifest_probe_skips >= 1  # fusible verdicts skipped eval_shape
+
+
+def test_exact_instances_stay_off_the_fused_path():
+    col = MetricCollection([Accuracy(), _exact(AUROC)])
+    col.compile_update()
+    for i in range(2):
+        col.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    exact = _feed(_exact(AUROC), _preds[:2], _target[:2])
+    got = col.compute()
+    assert float(got["AUROC"]) == float(exact.compute())
+
+
+def test_async_pipeline_parity_with_sketch_metrics():
+    col = MetricCollection([Accuracy(), AUROC()])
+    handle = col.compile_update_async(queue_depth=2)
+    blocking = MetricCollection([Accuracy(), AUROC()])
+    for i in range(N_BATCHES):
+        col.update_async(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        blocking.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    handle.flush()
+    got, want = col.compute(), blocking.compute()
+    assert float(got["AUROC"]) == float(want["AUROC"])
+    col.reset()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: state_dict / reset / forward / set_dtype
+# ---------------------------------------------------------------------------
+
+
+def test_state_dict_roundtrip_mid_stream():
+    m = _feed(AUROC(), _preds[:2], _target[:2])
+    restored = AUROC()
+    restored.load_state_dict(m.state_dict())
+    restored = _feed(restored, _preds[2:], _target[2:])
+    full = _feed(AUROC(), _preds, _target)
+    assert float(restored.compute()) == float(full.compute())
+
+
+def test_reset_restores_empty_sketch():
+    m = _feed(AUROC(), _preds, _target)
+    m.reset()
+    assert float(jnp.sum(m.csketch)) == 0.0 and int(m.n_seen) == 0
+    m = _feed(m, _preds, _target)
+    full = _feed(AUROC(), _preds, _target)
+    assert float(m.compute()) == float(full.compute())
+
+
+def test_forward_batch_value_and_accumulation():
+    m = AUROC()
+    batch_val = m(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    single = _feed(AUROC(), _preds[:1], _target[:1])
+    assert float(batch_val) == float(single.compute())
+    m.update(jnp.asarray(_preds[1]), jnp.asarray(_target[1]))
+    two = _feed(AUROC(), _preds[:2], _target[:2])
+    assert float(m.compute()) == float(two.compute())
+
+
+def test_mode_change_raises_like_exact_path():
+    m = _feed(AUROC(), _preds[:1], _target[:1])
+    with pytest.raises(ValueError, match="should be constant"):
+        m.update(jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]))
+
+
+def test_case_inference_rebuilds_before_first_insert():
+    # multilabel inputs to a default-constructed ROC with num_classes: the
+    # canonicalizer infers the case from the first batch, like the old path
+    m = ROC(num_classes=5)
+    m.update(jnp.asarray(_preds_mc[0]), jnp.asarray(_target_ml[0]))
+    exact = _exact(ROC, num_classes=5)
+    exact.update(jnp.asarray(_preds_mc[0]), jnp.asarray(_target_ml[0]))
+    _tree_equal(list(m.compute()), list(exact.compute()))
+
+
+# ---------------------------------------------------------------------------
+# observability: footprint prefix, fill ratios, Prometheus, aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_footprint_reports_sketch_prefix_and_fill_ratio():
+    m = _feed(AUROC(sketch_capacity=256), _preds[:1], _target[:1])
+    fp = m.state_footprint()
+    assert "sketch/csketch" in fp and "n_seen" in fp
+    ratios = m.sketch_fill_ratios()
+    assert ratios["csketch"] == pytest.approx(32 / 256)
+
+
+def test_sketch_telemetry_families_and_aggregate():
+    rec = get_recorder()
+    rec.reset().enable(footprint_warn_bytes=1 << 40)
+    try:
+        m = _feed(AUROC(sketch_capacity=256), _preds[:2], _target[:2])
+        m.compute()  # records fill ratio from the cold path
+        state = {k: jnp.asarray(getattr(m, k)) for k in m._defaults}
+        m.merge_states(state, state)  # one pairwise sketch merge
+        totals = rec.sketch_totals()
+        assert totals["merges"] >= 1
+        assert totals["max_fill_ratio"] == pytest.approx(64 / 256)
+        hwm = rec.footprint_high_water_marks()
+        assert "AUROC[sketch]" in hwm and hwm["AUROC[sketch]"] > 0
+        from metrics_tpu.observability.aggregate import aggregate_across_hosts
+        from metrics_tpu.observability.exporters import render_prometheus
+
+        agg = aggregate_across_hosts(rec)
+        assert agg["sketch_totals"]["merges"] >= 1
+        page = render_prometheus(rec, aggregate=agg)
+        assert "metrics_tpu_sketch_merges_total" in page
+        assert "metrics_tpu_sketch_fill_ratio" in page
+    finally:
+        rec.disable()
+        rec.reset()
+
+
+def test_state_bytes_bounded_at_stream_scale():
+    cap = 512
+    m = AUROC(sketch_capacity=cap)
+    m.update(jnp.asarray(_rng.rand(600).astype(np.float32)), jnp.asarray(_rng.randint(0, 2, 600)))
+    bytes_after_overflow = m.total_state_bytes()
+    for _ in range(10):
+        m.update(jnp.asarray(_rng.rand(600).astype(np.float32)), jnp.asarray(_rng.randint(0, 2, 600)))
+    assert m.total_state_bytes() == bytes_after_overflow  # O(capacity) forever
+
+
+# ---------------------------------------------------------------------------
+# sliced composition: binned CalibrationError is sum-state, so it slices
+# ---------------------------------------------------------------------------
+
+
+def test_sliced_calibration_error_per_tenant():
+    from metrics_tpu.sliced import SlicedMetric
+
+    s = SlicedMetric(CalibrationError(n_bins=10), num_slices=4)
+    ids = _rng.randint(0, 4, 64)
+    preds = _rng.rand(64).astype(np.float32)
+    target = _rng.randint(0, 2, 64)
+    s.update(jnp.asarray(ids), jnp.asarray(preds), jnp.asarray(target))
+    per_slice = s.compute()
+    for tenant in range(4):
+        ref = CalibrationError(n_bins=10)
+        mask = ids == tenant
+        ref.update(jnp.asarray(preds[mask]), jnp.asarray(target[mask]))
+        np.testing.assert_allclose(
+            float(np.asarray(per_slice)[tenant]), float(ref.compute()), atol=1e-6
+        )
+
+
+def test_sliced_rejects_merge_leaf_metrics_with_clear_error():
+    from metrics_tpu.sliced import SlicedMetric
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    with pytest.raises(MetricsUserError, match="csketch"):
+        SlicedMetric(AUROC(), num_slices=4)
+
+
+# ---------------------------------------------------------------------------
+# review-pass regressions
+# ---------------------------------------------------------------------------
+
+
+def test_kid_checkpoint_restores_before_first_update_callable_extractor():
+    """A fresh KID with a callable extractor learns its reservoir layout
+    from the restored leaf's column count — load-then-compute must equal
+    the saved metric (the lazy registration used to silently drop every
+    saved key)."""
+
+    def identity(x):
+        return jnp.asarray(x)
+
+    k = KernelInceptionDistance(feature=identity, subsets=3, subset_size=5, seed=1)
+    for i in range(4):
+        k.update(jnp.asarray(_rng.rand(10, 6).astype(np.float32)), real=(i % 2 == 0))
+    saved = k.state_dict()
+    k2 = KernelInceptionDistance(feature=identity, subsets=3, subset_size=5, seed=1)
+    k2.load_state_dict(saved)
+    k2._update_called = True
+    m1, s1 = k.compute()
+    m2, s2 = k2.compute()
+    assert float(m1) == float(m2) and float(s1) == float(s2)
+
+
+def test_auroc_max_fpr_multiclass_raises_past_the_window_too():
+    """The exact path raises for max_fpr + multiclass; the approximate
+    (post-compaction) path must stay equally loud instead of silently
+    returning the full-range AUROC."""
+    m = AUROC(num_classes=3, max_fpr=0.5, sketch_capacity=16)
+    pm = _rng.rand(200, 3).astype(np.float32)
+    tm = _rng.randint(0, 3, 200)
+    m.update(jnp.asarray(pm), jnp.asarray(tm))
+    with pytest.raises(ValueError, match="Partial AUC"):
+        m.compute()

@@ -1,0 +1,252 @@
+"""Sketch primitives: lossless window, error bounds, merge laws, jit parity.
+
+The accuracy contract of ``metrics_tpu/sketches/`` (docs/sketch_states.md):
+
+* inside the lossless window the sketch IS the stream (order and weights);
+* beyond it, quantile rank error stays under the advertised
+  :func:`rank_error_bound` envelope across ADVERSARIAL orderings;
+* ``merge`` is exact below combined capacity and multiset-commutative
+  always;
+* every transform is pure and jit-safe, bit-identical eager vs jitted.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.sketches import (
+    hist_bin_index,
+    hist_init,
+    hist_insert,
+    hist_merge,
+    qsketch_fill,
+    qsketch_init,
+    qsketch_insert,
+    qsketch_merge,
+    qsketch_quantile,
+    qsketch_rank,
+    qsketch_total_weight,
+    rank_error_bound,
+    ranksketch_init,
+    ranksketch_insert,
+    ranksketch_merge,
+    ranksketch_spearman,
+    reservoir_fill,
+    reservoir_init,
+    reservoir_insert,
+    reservoir_merge,
+    reservoir_rows,
+)
+
+_rng = np.random.default_rng(1234)
+
+
+# ---------------------------------------------------------------------------
+# lossless window
+# ---------------------------------------------------------------------------
+
+
+def test_qsketch_lossless_window_preserves_stream_and_order():
+    sk = qsketch_init(64, payload_cols=1)
+    keys = _rng.random(50).astype(np.float32)
+    payload = _rng.random((50, 1)).astype(np.float32)
+    for lo in range(0, 50, 13):
+        sk = qsketch_insert(sk, jnp.asarray(keys[lo : lo + 13]), jnp.asarray(payload[lo : lo + 13]))
+    assert int(qsketch_fill(sk)) == 50
+    rows = np.asarray(sk)
+    np.testing.assert_array_equal(rows[:50, 0], 1.0)  # unit weights
+    np.testing.assert_array_equal(rows[:50, 1], keys)  # arrival order, bit-exact
+    np.testing.assert_array_equal(rows[:50, 2:], payload)
+    np.testing.assert_array_equal(rows[50:, 0], 0.0)
+
+
+def test_reservoir_lossless_window_preserves_stream_and_order():
+    rs = reservoir_init(32, 3)
+    rows = _rng.random((20, 3)).astype(np.float32)
+    seen = jnp.asarray(0, jnp.int32)
+    for lo in range(0, 20, 7):
+        chunk = rows[lo : lo + 7]
+        rs = reservoir_insert(rs, jnp.asarray(chunk), seen, seed=9)
+        seen = seen + chunk.shape[0]
+    assert int(reservoir_fill(rs)) == 20
+    np.testing.assert_array_equal(np.asarray(reservoir_rows(rs))[:20], rows)
+
+
+# ---------------------------------------------------------------------------
+# quantile rank error: adversarial orderings vs the advertised epsilon
+# ---------------------------------------------------------------------------
+
+
+def _orderings(n):
+    base = _rng.random(n).astype(np.float32)
+    organ = np.sort(base)
+    organ = np.concatenate([organ[::2], organ[1::2][::-1]])  # organ pipe
+    inter = np.empty_like(np.sort(base))
+    srt = np.sort(base)
+    inter[0::2], inter[1::2] = srt[: (n + 1) // 2], srt[(n + 1) // 2:][::-1][: n // 2]
+    ties = np.round(base * 16) / 16  # heavy ties
+    return {
+        "random": base,
+        "sorted": np.sort(base),
+        "reversed": np.sort(base)[::-1],
+        "organ_pipe": organ,
+        "interleaved": inter,
+        "ties": ties.astype(np.float32),
+    }
+
+
+@pytest.mark.parametrize("capacity,batch", [(256, 64), (512, 200)])
+def test_qsketch_rank_error_within_advertised_bound(capacity, batch):
+    n = 8192
+    for name, data in _orderings(n).items():
+        sk = qsketch_init(capacity)
+        for lo in range(0, n, batch):
+            sk = qsketch_insert(sk, jnp.asarray(data[lo : lo + batch]))
+        # weight conservation is exact whatever the ordering
+        np.testing.assert_allclose(float(qsketch_total_weight(sk)), n, rtol=1e-6)
+        qs = np.quantile(data, [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]).astype(np.float32)
+        est = np.asarray(qsketch_rank(sk, jnp.asarray(qs)))
+        true = np.array([(data <= q).sum() for q in qs])
+        err = np.max(np.abs(est - true))
+        bound = rank_error_bound(n, capacity)
+        assert err <= bound, (name, capacity, err, bound)
+
+
+def test_rank_error_bound_zero_inside_window():
+    assert rank_error_bound(100, 256) == 0.0
+    assert rank_error_bound(10_000, 256) > 0.0
+
+
+def test_qsketch_quantile_query_accuracy():
+    n, capacity = 20000, 1024
+    data = _rng.standard_normal(n).astype(np.float32)
+    sk = qsketch_init(capacity)
+    for lo in range(0, n, 500):
+        sk = qsketch_insert(sk, jnp.asarray(data[lo : lo + 500]))
+    for q in (0.1, 0.5, 0.9):
+        est = float(qsketch_quantile(sk, q)[0])
+        lo_ref, hi_ref = np.quantile(data, [max(q - 0.02, 0), min(q + 0.02, 1)])
+        assert lo_ref - 1e-3 <= est <= hi_ref + 1e-3, (q, est, lo_ref, hi_ref)
+
+
+# ---------------------------------------------------------------------------
+# merge laws
+# ---------------------------------------------------------------------------
+
+
+def _sorted_rows(leaf):
+    rows = np.asarray(leaf)
+    return rows[np.lexsort(rows.T[::-1])]
+
+
+def test_qsketch_merge_exact_below_capacity_and_commutative():
+    a = qsketch_insert(qsketch_init(64), jnp.asarray(_rng.random(20).astype(np.float32)))
+    b = qsketch_insert(qsketch_init(64), jnp.asarray(_rng.random(30).astype(np.float32)))
+    m = qsketch_merge(a, b)
+    assert int(qsketch_fill(m)) == 50  # exact: no row lost
+    np.testing.assert_allclose(
+        _sorted_rows(qsketch_merge(a, b)), _sorted_rows(qsketch_merge(b, a)), atol=1e-6
+    )
+
+
+def test_qsketch_merge_commutative_past_capacity():
+    a = qsketch_init(32)
+    b = qsketch_init(32)
+    for lo in range(0, 512, 32):
+        a = qsketch_insert(a, jnp.asarray(_rng.random(32).astype(np.float32)))
+        b = qsketch_insert(b, jnp.asarray(_rng.random(32).astype(np.float32)))
+    np.testing.assert_allclose(
+        _sorted_rows(qsketch_merge(a, b)), _sorted_rows(qsketch_merge(b, a)), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(qsketch_total_weight(qsketch_merge(a, b))),
+        float(qsketch_total_weight(a)) + float(qsketch_total_weight(b)),
+        rtol=1e-6,
+    )
+
+
+def test_reservoir_merge_commutative():
+    a = reservoir_init(16, 2)
+    b = reservoir_init(16, 2)
+    a = reservoir_insert(a, jnp.asarray(_rng.random((40, 2)).astype(np.float32)), jnp.asarray(0), seed=3)
+    b = reservoir_insert(b, jnp.asarray(_rng.random((40, 2)).astype(np.float32)), jnp.asarray(0), seed=4)
+    np.testing.assert_allclose(
+        _sorted_rows(reservoir_merge(a, b)), _sorted_rows(reservoir_merge(b, a)), atol=1e-6
+    )
+
+
+def test_ranksketch_merge_commutative():
+    x = _rng.standard_normal(100).astype(np.float32)
+    y = (x + _rng.standard_normal(100)).astype(np.float32)
+    a = ranksketch_insert(ranksketch_init(32), jnp.asarray(x[:50]), jnp.asarray(y[:50]), jnp.asarray(0), seed=1)
+    b = ranksketch_insert(ranksketch_init(32), jnp.asarray(x[50:]), jnp.asarray(y[50:]), jnp.asarray(0), seed=2)
+    np.testing.assert_allclose(
+        _sorted_rows(ranksketch_merge(a, b)), _sorted_rows(ranksketch_merge(b, a)), atol=1e-6
+    )
+
+
+def test_histogram_merge_commutative_and_exact():
+    edges = jnp.linspace(0, 1, 9)
+    xa = _rng.random(100).astype(np.float32)
+    xb = _rng.random(77).astype(np.float32)
+    a = hist_insert(hist_init(8), hist_bin_index(edges, jnp.asarray(xa)), jnp.ones(100))
+    b = hist_insert(hist_init(8), hist_bin_index(edges, jnp.asarray(xb)), jnp.ones(77))
+    np.testing.assert_array_equal(np.asarray(hist_merge(a, b)), np.asarray(hist_merge(b, a)))
+    assert float(jnp.sum(hist_merge(a, b))) == 177.0
+
+
+# ---------------------------------------------------------------------------
+# jit parity + pad masking
+# ---------------------------------------------------------------------------
+
+
+def test_qsketch_insert_jit_bit_parity():
+    data = _rng.random(100).astype(np.float32)
+    eager = qsketch_insert(qsketch_init(32), jnp.asarray(data))
+    jitted = jax.jit(qsketch_insert)(qsketch_init(32), jnp.asarray(data))
+    np.testing.assert_array_equal(np.asarray(eager), np.asarray(jitted))
+
+
+def test_n_valid_masks_pad_rows():
+    data = jnp.arange(10, dtype=jnp.float32)
+    sk = qsketch_insert(qsketch_init(16), data, n_valid=jnp.asarray(6))
+    assert int(qsketch_fill(sk)) == 6
+    np.testing.assert_array_equal(np.asarray(sk[:6, 1]), np.arange(6, dtype=np.float32))
+    rs = reservoir_insert(
+        reservoir_init(16, 1), data[:, None], jnp.asarray(0), seed=1, n_valid=jnp.asarray(4)
+    )
+    assert int(reservoir_fill(rs)) == 4
+
+
+def test_ranksketch_spearman_matches_scipy_on_large_stream():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    n, capacity = 20000, 1024
+    x = _rng.standard_normal(n).astype(np.float32)
+    y = (0.7 * x + 0.5 * _rng.standard_normal(n)).astype(np.float32)
+    sk = ranksketch_init(capacity)
+    for lo in range(0, n, 500):
+        sk = ranksketch_insert(
+            sk, jnp.asarray(x[lo : lo + 500]), jnp.asarray(y[lo : lo + 500]), jnp.asarray(lo), seed=5
+        )
+    got = float(ranksketch_spearman(sk))
+    want = scipy_stats.spearmanr(x, y)[0]
+    # the pair reservoir is an unbiased sample estimator: se ~ (1-rho^2)/sqrt(k)
+    assert abs(got - want) < 0.05, (got, want)
+
+
+def test_histogram_bin_convention_matches_calibration_kernel():
+    from metrics_tpu.functional.classification.calibration_error import _binning_bucketize
+
+    conf = jnp.asarray(_rng.random(200).astype(np.float32))
+    acc = jnp.asarray((_rng.random(200) < 0.5).astype(np.float32))
+    edges = jnp.linspace(0, 1, 16, dtype=jnp.float32)
+    h = hist_init(15, n_stats=3)
+    idx = hist_bin_index(edges, conf)
+    h = hist_insert(h, idx, jnp.stack([jnp.ones_like(conf), conf, acc]))
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(conf, acc, edges)
+    count = np.asarray(h[0])
+    safe = np.where(count == 0, 1.0, count)
+    np.testing.assert_allclose(np.where(count == 0, 0.0, np.asarray(h[1]) / safe), np.asarray(conf_bin), atol=1e-6)
+    np.testing.assert_allclose(np.where(count == 0, 0.0, np.asarray(h[2]) / safe), np.asarray(acc_bin), atol=1e-6)
+    np.testing.assert_allclose(count / count.sum(), np.asarray(prop_bin), atol=1e-6)
