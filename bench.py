@@ -1287,6 +1287,45 @@ def bench_telemetry() -> None:
         _coerce_foreign(native_args)
     coerce_ns = (time.perf_counter() - t0) / n * 1e9
 
+    # telemetry-overhead regression gate (ISSUE 11 satellite): fused-update
+    # throughput with recorder + windowed time-series ON vs OFF. The live
+    # health layer's whole enablement story is "affordable when on, one bool
+    # check when off" — the ratio (ON/OFF throughput, higher is better) is
+    # AUX-gated vs BENCH_r11.json so a regression in the enabled feed path
+    # (or a leak of cost into the disabled path, caught by the ns/call wall
+    # value above) fails CI rather than silently taxing every serving loop.
+    from metrics_tpu import MeanSquaredError, MetricCollection
+    from metrics_tpu.aggregation import MeanMetric
+
+    col = MetricCollection({"mse": MeanSquaredError(), "mean": MeanMetric()})
+    col.compile_update()
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random(256, dtype=np.float32))
+    target = jnp.asarray(rng.random(256, dtype=np.float32))
+    col.update(preds, target)  # warm: compile + group discovery
+    n_fused = 300
+
+    def fused_updates_per_sec() -> float:
+        best = 0.0
+        for _ in range(3):  # min-of-3: this box's CPU steal is noisy
+            t0 = time.perf_counter()
+            for _ in range(n_fused):
+                col.update(preds, target)
+            best = max(best, n_fused / (time.perf_counter() - t0))
+        return best
+
+    rec.disable()
+    off_ups = fused_updates_per_sec()
+    rec.enable()
+    rec.attach_timeseries(bucket_seconds=1.0, n_buckets=60, sketch_capacity=128)
+    col.update(preds, target)  # warm the series get-or-create path
+    on_ups = fused_updates_per_sec()
+    rec.disable()
+    rec.detach_timeseries()
+    rec.reset()
+    if was_enabled:
+        rec.enable()
+
     print(
         json.dumps(
             {
@@ -1295,6 +1334,9 @@ def bench_telemetry() -> None:
                 "unit": "ns/call",
                 "enabled_ns_per_call": round(enabled_ns, 1),
                 "coerce_fastpath_ns_per_call": round(coerce_ns, 1),
+                "fused_telemetry_on_ratio": round(on_ups / off_ups, 4),
+                "fused_updates_per_sec_off": round(off_ups, 1),
+                "fused_updates_per_sec_on": round(on_ups, 1),
             }
         )
     )

@@ -1,0 +1,355 @@
+"""Serving-loop observatory: live windowed telemetry + SLO health under
+simulated traffic, with fault injection that trips every alarm class.
+
+The demo the live health layer exists for (ROADMAP item 2): a simulated
+heavy-traffic serving loop drives ``compile_update_async`` ingest into a
+fused collection (sketched ``AUROC`` + ``MeanSquaredError``) plus a
+per-tenant ``SlicedMetric``, while a :class:`PeriodicExporter` publishes
+telemetry, windowed quantiles, and health the whole time:
+
+* the recorder's :class:`TimeSeriesRegistry` turns every hot-path signal
+  (update/fused-dispatch wall time, enqueue->apply age, queue depth,
+  drops, recompiles, sketch fill, hot-slice share) into ring-of-buckets
+  windows backed by ``qsketch`` states;
+* a :class:`HealthMonitor` with the six standard alarm classes (queue
+  saturation, staleness, drop-rate SLO burn, recompile storm, sketch-fill
+  ceiling, hot-slice skew) evaluates them continuously, logging every
+  fired/cleared transition to a JSONL alarm log;
+* ``--inject`` drives a fault phase that demonstrably trips the alarms —
+  ``bursts`` (unpaced producer vs a bounded drop-policy queue), ``stall``
+  (a reader holding the state snapshot lock, i.e. a slow consumer),
+  ``recompiles`` (ragged batch shapes), ``skew`` (one hot tenant), or
+  ``all`` — followed by a recovery phase in which every alarm clears.
+
+Artifacts land in ``--out-dir``: ``metrics.prom`` (Prometheus page incl.
+windowed quantiles + health families), ``telemetry.jsonl`` (event log),
+``health_alarms.jsonl`` (alarm transitions), ``trace.json`` (Perfetto,
+with the async worker on its own labeled track), ``health.txt`` (final
+terminal summary), and ``report.json``. Exit status is 0 unless
+``--assert-fired-cleared`` is set and no alarm both fired and cleared
+(the CI smoke contract).
+
+Run::
+
+    python examples/serving_loop.py --duration 10 --inject bursts
+"""
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo root
+
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from metrics_tpu import AUROC, MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.observability import (
+    HealthMonitor,
+    PeriodicExporter,
+    aggregate_across_hosts,
+    default_rules,
+    export_perfetto,
+    get_recorder,
+    render_health,
+    render_prometheus,
+    summary,
+)
+from metrics_tpu.sliced import SlicedMetric
+
+INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "all")
+
+#: phase boundaries as fractions of --duration: steady warmup, fault
+#: injection, recovery (the collection is reset at the recovery boundary —
+#: an epoch boundary — so sketch fill drains and every alarm can clear)
+WARMUP_FRAC, FAULT_END_FRAC = 0.18, 0.45
+
+
+def _make_batch(rng: np.random.Generator, n: int, hot_tenant: bool, tenants: int):
+    """One simulated traffic batch: binary targets, noisy scores, and
+    row-aligned tenant ids (85% to tenant 0 under skew injection)."""
+    target = rng.integers(0, 2, n)
+    preds = np.clip(target * 0.7 + rng.normal(0.3, 0.25, n), 0.0, 1.0)
+    if hot_tenant:
+        ids = np.where(rng.random(n) < 0.85, 0, rng.integers(0, tenants, n))
+    else:
+        ids = rng.integers(0, tenants, n)
+    return (
+        jnp.asarray(preds, jnp.float32),
+        jnp.asarray(target, jnp.int32),
+        jnp.asarray(ids, jnp.int32),
+    )
+
+
+def run(
+    duration: float = 15.0,
+    inject: str = "all",
+    out_dir: str = "serving_artifacts",
+    qps: float = 60.0,
+    batch_size: int = 64,
+    queue_depth: int = 8,
+    sketch_capacity: int = 8192,
+    tenants: int = 64,
+    bucket_seconds: float = 0.5,
+    window_s: float = 4.0,
+    export_interval_s: float = 1.0,
+    seed: int = 0,
+    verbose: bool = True,
+):
+    """Drive the serving loop and return the run report (also written to
+    ``<out_dir>/report.json``)."""
+    if inject not in INJECT_MODES:
+        raise ValueError(f"inject must be one of {INJECT_MODES}, got {inject!r}")
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(seed)
+
+    rec = get_recorder()
+    was_enabled = rec.enabled
+    rec.reset()
+    rec.enable()
+    rec.attach_timeseries(
+        bucket_seconds=bucket_seconds,
+        n_buckets=max(int(3 * window_s / bucket_seconds), 16),
+        sketch_capacity=128,
+    )
+    monitor = HealthMonitor(
+        default_rules(
+            queue_depth_limit=max(queue_depth // 2, 2),
+            staleness_limit_steps=max(queue_depth // 2, 2),
+            drop_budget=0.02,
+            drop_burn_threshold=2.0,
+            recompiles_per_window=8,
+            fill_ceiling=0.5,
+            hot_share_limit=0.5,
+            window_s=window_s,
+        ),
+        recorder=rec,
+        alarm_log_path=str(out / "health_alarms.jsonl"),
+    )
+    exporter = PeriodicExporter(
+        interval_s=export_interval_s,
+        prometheus_path=str(out / "metrics.prom"),
+        jsonl_path=str(out / "telemetry.jsonl"),
+        health=monitor,
+    )
+    exporter.start()
+
+    # the serving metrics: a fused async-ingested collection (sketched
+    # AUROC exercises the fill alarm; MSE rides the same dispatch), a
+    # per-tenant sliced MSE (hot-slice signal), and a deliberately
+    # shape-fragile "canary" whose ragged updates simulate an unpadded
+    # pipeline for the recompile storm
+    auroc = AUROC(pos_label=1, sketch_capacity=sketch_capacity)
+    collection = MetricCollection({"auroc": auroc, "mse": MeanSquaredError()})
+    handle = collection.compile_update_async(queue_depth=queue_depth, policy="drop")
+    per_tenant = SlicedMetric(MeanSquaredError(), num_slices=tenants)
+    canary = SumMetric()
+
+    t_start = time.time()
+    fault_lo, fault_hi = WARMUP_FRAC * duration, FAULT_END_FRAC * duration
+    step = 0
+    did_reset = False
+    last_probe = 0.0
+    ragged_step = 0
+
+    def probe():
+        """Cheap live probes the loop can afford every few hundred ms: the
+        compute-snapshot staleness gauge straight from the handle's pending
+        counter (no drain, no device work) and the sketch fill ratios as a
+        direct leaf read under the snapshot lock (a full compute() would
+        re-trace the curve kernels per fill count — that readback belongs
+        at epoch boundaries, not on the observatory's poll path)."""
+        rec.record_async_event("snapshot", staleness_steps=handle.pending)
+        with handle.snapshot():
+            ratios = auroc.sketch_fill_ratios()
+        if ratios:
+            rec.record_sketch_fill(auroc, ratios)
+        monitor.evaluate()
+
+    try:
+        while True:
+            now = time.time()
+            elapsed = now - t_start
+            if elapsed >= duration:
+                break
+            in_fault = fault_lo <= elapsed < fault_hi
+            skewing = in_fault and inject in ("skew", "all")
+
+            if not did_reset and elapsed >= fault_hi:
+                # recovery boundary = epoch boundary: publish values once
+                # (a real drained compute), reset (sketch fill falls back to
+                # empty), and warm-reuse the compile cache for the fresh
+                # async handle
+                handle.flush()
+                collection.compute()
+                collection.reset()
+                handle = collection.compile_update_async(
+                    queue_depth=queue_depth, policy="drop"
+                )
+                did_reset = True
+
+            preds, target, ids = _make_batch(rng, batch_size, skewing, tenants)
+            if in_fault and inject in ("bursts", "all") and (inject != "all" or step % 2 == 0):
+                # unpaced producer: enqueue as fast as the host allows for
+                # one slice of the fault window — the bounded drop-policy
+                # queue saturates (depth), sheds load (drops), and batches
+                # age in the queue (staleness)
+                burst_until = min(now + 0.2, t_start + fault_hi)
+                while time.time() < burst_until:
+                    handle.update_async(preds, target)
+                probe()
+            elif in_fault and inject in ("stall", "all"):
+                # slow consumer: a reader holds the state snapshot lock, so
+                # the worker cannot install batches while the producer keeps
+                # offering — the queue fills and sheds exactly like a stalled
+                # downstream
+                with handle.snapshot():
+                    rec.record_async_event("snapshot", staleness_steps=handle.pending)
+                    stall_until = min(time.time() + 0.2, t_start + fault_hi)
+                    while time.time() < stall_until:
+                        handle.update_async(preds, target)
+            else:
+                handle.update_async(preds, target)
+                time.sleep(max(0.0, 1.0 / qps))
+            step += 1
+
+            per_tenant.update(ids, preds, target.astype(jnp.float32))
+            if in_fault and inject in ("recompiles", "all"):
+                # ragged shapes: every new length is a new (shape, dtype)
+                # signature — the classic unpadded-pipeline recompile storm
+                # (a few fresh lengths per step, like a real unpadded feed)
+                for j in range(4):
+                    ragged_step += 1
+                    canary.update(jnp.ones((8 + ragged_step,), jnp.float32))
+            else:
+                canary.update(jnp.ones((8,), jnp.float32))
+
+            if now - last_probe >= export_interval_s / 2:
+                last_probe = now
+                probe()
+
+        # epoch-end publish: one full (drained) compute, then the second
+        # epoch boundary — reset so the tail starts with empty sketches
+        # (fill must CLEAR, and a sketch refilled by recovery traffic
+        # would hold the alarm up forever)
+        handle.flush()
+        values = collection.compute()
+        collection.reset()
+        handle = collection.compile_update_async(queue_depth=queue_depth, policy="drop")
+        # quiet tail: light traffic while the windows roll past the last
+        # fault signal, so every alarm that is going to clear has the wall
+        # time to do it
+        tail_end = time.time() + window_s + 2 * bucket_seconds
+        while time.time() < tail_end:
+            preds, target, ids = _make_batch(rng, batch_size, False, tenants)
+            handle.update_async(preds, target)
+            per_tenant.update(ids, preds, target.astype(jnp.float32))
+            canary.update(jnp.ones((8,), jnp.float32))
+            probe()
+            time.sleep(0.1)
+        handle.flush()
+        final = monitor.evaluate()
+    finally:
+        try:
+            handle.close()
+        except Exception:  # noqa: BLE001 — teardown must reach the exporter stop
+            pass
+        exporter.stop()
+
+    # final artifacts: job-wide Prometheus (the aggregate path is a no-op
+    # single-process and the real merge on a multi-process mesh), Perfetto
+    # trace with the worker's labeled track, terminal health summary
+    aggregate = aggregate_across_hosts(rec)
+    prom = render_prometheus(rec, aggregate=aggregate)
+    if prom:
+        prom += "\n".join(monitor.prometheus_lines(final)) + "\n"
+        (out / "metrics.prom").write_text(prom)
+    export_perfetto(str(out / "trace.json"), recorder=rec)
+    health_text = render_health(final)
+    (out / "health.txt").write_text(health_text + "\n")
+
+    async_totals = rec.async_totals()
+    report = {
+        "inject": inject,
+        "duration_s": duration,
+        "steps": step,
+        "final_status": final.status,
+        "final_values": {k: float(v) for k, v in values.items()},
+        "alarms_fired": monitor.fired_ever(),
+        "alarms_fired_and_cleared": monitor.fired_and_cleared(),
+        "transitions": monitor.transitions(),
+        "async": {
+            "enqueued": async_totals["enqueued"],
+            "applied": async_totals["applied"],
+            "dropped": async_totals["dropped"],
+            "max_queue_depth": async_totals["max_queue_depth"],
+            "max_staleness_steps": async_totals["max_staleness_steps"],
+        },
+        "export_errors": rec.export_errors(),
+    }
+    (out / "report.json").write_text(json.dumps(report, indent=2) + "\n")
+    if verbose:
+        print(summary(rec))
+        print(health_text)
+        print(
+            f"serving_loop: {step} steps; alarms fired={report['alarms_fired']}"
+            f" fired_and_cleared={report['alarms_fired_and_cleared']};"
+            f" artifacts in {out}/"
+        )
+
+    rec.disable()
+    rec.detach_timeseries()
+    rec.reset()
+    if was_enabled:
+        rec.enable()
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--duration", type=float, default=15.0, help="traffic seconds (excl. quiet tail)")
+    parser.add_argument("--inject", choices=INJECT_MODES, default="all")
+    parser.add_argument("--out-dir", default="serving_artifacts")
+    parser.add_argument("--qps", type=float, default=60.0)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--queue-depth", type=int, default=8)
+    parser.add_argument("--sketch-capacity", type=int, default=8192)
+    parser.add_argument("--tenants", type=int, default=64)
+    parser.add_argument("--bucket-seconds", type=float, default=0.5)
+    parser.add_argument("--window-seconds", type=float, default=4.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--assert-fired-cleared",
+        action="store_true",
+        help="exit nonzero unless at least one alarm both fired and cleared (CI smoke)",
+    )
+    args = parser.parse_args(argv)
+    report = run(
+        duration=args.duration,
+        inject=args.inject,
+        out_dir=args.out_dir,
+        qps=args.qps,
+        batch_size=args.batch_size,
+        queue_depth=args.queue_depth,
+        sketch_capacity=args.sketch_capacity,
+        tenants=args.tenants,
+        bucket_seconds=args.bucket_seconds,
+        window_s=args.window_seconds,
+        seed=args.seed,
+    )
+    if args.assert_fired_cleared and not report["alarms_fired_and_cleared"]:
+        print("FAIL: no alarm both fired and cleared", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -464,6 +464,12 @@ class FusedUpdate:
                 n_fused=len(fused_names),
                 n_fallback=len(fallback_names),
                 duration_s=time.perf_counter() - t0,
+                # leading-axis row count of the batch (host shape read): the
+                # windowed ingest_rows series turns it into a rolling
+                # rows/sec rate for the serving observatory
+                batch_rows=next(
+                    (int(x.shape[0]) for x in dyn if getattr(x, "ndim", 0) >= 1), None
+                ),
                 n_groups=len(col._groups) if col._groups_checked else None,
                 bucket=bucket,
                 cache_entries=len(self._cache),

@@ -311,7 +311,10 @@ class AsyncUpdateHandle:
             self._enqueued += 1
             self._pending += 1
             self._in_flight_bytes += nbytes
-        return (idx, args, kwargs, nbytes)
+        # the accept timestamp rides with the item: the worker reports the
+        # enqueue->apply age at dequeue — the live staleness signal the
+        # windowed telemetry layer (async_age_ms) alarms on
+        return (idx, args, kwargs, nbytes, time.perf_counter())
 
     def _record_enqueue(self, idx: int) -> None:
         """Exactly one ``enqueue`` event per ACCEPTED batch (the
@@ -333,7 +336,7 @@ class AsyncUpdateHandle:
         performs a blocking device readback (TL-BLOCK-enforced).
         """
         item = self._accept("update_async", args, kwargs)
-        idx, _, _, nbytes = item
+        idx, _, _, nbytes, _ = item
         # The enqueue event is recorded BEFORE queue.put so the worker's
         # matching dequeue event can never precede it in the stream. Under
         # the single-producer contract the ``full()`` precheck is stable:
@@ -372,7 +375,7 @@ class AsyncUpdateHandle:
         failures poison the handle instead) would otherwise leave the
         producer parked in ``queue.put`` forever. The worker notifies
         ``_cond`` after each item it removes from the queue."""
-        idx, _, _, nbytes = item
+        idx, _, _, nbytes, _ = item
         with self._cond:
             while self._queue.full():
                 if not self._thread.is_alive():
@@ -572,7 +575,7 @@ class AsyncUpdateHandle:
         anywhere (donation accounting, dispatch, telemetry) must poison the
         handle and release waiters, never kill the worker with ``_pending``
         stuck — block-policy producers and ``flush()`` wait on it."""
-        idx, args, kwargs, nbytes = item
+        idx, args, kwargs, nbytes, t_accept = item
         # the queue slot freed at q.get(): wake a block-policy producer
         # parked in _enqueue_lossless NOW, not at the post-dispatch
         # bookkeeping notify — overlapping the next batch's ingest with
@@ -625,6 +628,10 @@ class AsyncUpdateHandle:
                     queue_depth=depth,
                     in_flight_bytes=inflight,
                     dur_ms=round((time.perf_counter() - t0) * 1e3, 4),
+                    # enqueue->apply age: how long this batch sat accepted-
+                    # but-unapplied — the wall-clock staleness signal behind
+                    # the windowed async_age_ms series
+                    age_ms=round((time.perf_counter() - t_accept) * 1e3, 4),
                 )
             except BaseException as e:  # noqa: BLE001 — surfaced, not fatal
                 with self._cond:

@@ -31,6 +31,16 @@ from metrics_tpu.observability.exporters import (
     summary,
     write_prometheus,
 )
+from metrics_tpu.observability.health import (
+    AlarmState,
+    BurnRateRule,
+    HealthMonitor,
+    HealthSnapshot,
+    Rule,
+    ThresholdRule,
+    default_rules,
+    render_health,
+)
 from metrics_tpu.observability.profiling import compiled_cost, metric_compile_cost
 from metrics_tpu.observability.recorder import (
     _DEFAULT_RECORDER,
@@ -38,6 +48,13 @@ from metrics_tpu.observability.recorder import (
     TELEMETRY_ENV_VAR,
     MetricRecorder,
     current_span_id,
+)
+from metrics_tpu.observability.timeseries import (
+    TelemetrySeries,
+    TimeSeriesRegistry,
+    merge_registry_payloads,
+    registry_from_payload,
+    series_from_payload,
 )
 from metrics_tpu.observability.trace import export_perfetto, span
 
@@ -63,6 +80,19 @@ __all__ = [
     "aggregate_across_hosts",
     "counter_payload",
     "merge_payloads",
+    "TelemetrySeries",
+    "TimeSeriesRegistry",
+    "merge_registry_payloads",
+    "registry_from_payload",
+    "series_from_payload",
+    "AlarmState",
+    "BurnRateRule",
+    "HealthMonitor",
+    "HealthSnapshot",
+    "Rule",
+    "ThresholdRule",
+    "default_rules",
+    "render_health",
 ]
 
 _RECORDERS: Dict[str, MetricRecorder] = {"default": _DEFAULT_RECORDER}

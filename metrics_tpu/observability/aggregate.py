@@ -43,6 +43,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
     rec = recorder if recorder is not None else _DEFAULT_RECORDER
     from metrics_tpu.parallel.distributed import process_index
 
+    registry = getattr(rec, "timeseries", None)
     return {
         "process": process_index(),
         "call_counts": {_KEY_SEP.join(k): v for k, v in rec.call_counts().items()},
@@ -57,6 +58,11 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "sliced_totals": dict(rec.sliced_totals()),
         "sliced_slice_counts": dict(rec.footprint_slice_counts()),
         "sketch_totals": dict(rec.sketch_totals()),
+        "export_errors": rec.export_errors(),
+        # windowed time series ride the same payload path: per-bucket
+        # sketches serialize JSON-safe and merge by qsketch_merge, so a
+        # fleet-wide windowed p99 is the same fold as every other family
+        "timeseries": registry.payload() if registry is not None else {},
         "dropped_events": rec.dropped_events(),
     }
 
@@ -83,22 +89,27 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
     Returns tuple-keyed counters matching the recorder's accessors, plus
     the raw per-process payloads under ``"processes"`` (per-rank detail for
     the ``process``-labelled Prometheus series and straggler triage).
+
+    Every counter family is read with ``.get`` and an identity default: a
+    heterogeneous fleet (a rank on an older build missing a family, a rank
+    whose workload never touched a subsystem) merges as zero/identity —
+    absent keys are data about that rank, never an error.
     """
     return {
         "world_size": len(payloads),
         "call_counts": {
             tuple(k.split(_KEY_SEP)): v
-            for k, v in _merge_sum([p["call_counts"] for p in payloads]).items()
+            for k, v in _merge_sum([p.get("call_counts", {}) for p in payloads]).items()
         },
         "call_times": {
             tuple(k.split(_KEY_SEP)): v
-            for k, v in _merge_sum([p["call_times"] for p in payloads]).items()
+            for k, v in _merge_sum([p.get("call_times", {}) for p in payloads]).items()
         },
-        "signature_counts": _merge_max([p["signature_counts"] for p in payloads]),
-        "sync_totals": _merge_sum([p["sync_totals"] for p in payloads]),
-        "footprint_hwm": _merge_max([p["footprint_hwm"] for p in payloads]),
-        "compile_counts": _merge_sum([p["compile_counts"] for p in payloads]),
-        "compile_times": _merge_sum([p["compile_times"] for p in payloads]),
+        "signature_counts": _merge_max([p.get("signature_counts", {}) for p in payloads]),
+        "sync_totals": _merge_sum([p.get("sync_totals", {}) for p in payloads]),
+        "footprint_hwm": _merge_max([p.get("footprint_hwm", {}) for p in payloads]),
+        "compile_counts": _merge_sum([p.get("compile_counts", {}) for p in payloads]),
+        "compile_times": _merge_sum([p.get("compile_times", {}) for p in payloads]),
         # extensive, like the call counts they mirror (older payloads from
         # pre-fused ranks simply contribute nothing)
         "fused_update_totals": _merge_sum([p.get("fused_update_totals", {}) for p in payloads]),
@@ -108,9 +119,25 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # on every rank) — max is the safe reconciliation if they skew
         "sliced_slice_counts": _merge_max([p.get("sliced_slice_counts", {}) for p in payloads]),
         "sketch_totals": _merge_sketch([p.get("sketch_totals", {}) for p in payloads]),
+        "export_errors": sum(p.get("export_errors", 0) for p in payloads),
+        "timeseries": _merge_timeseries([p.get("timeseries", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
         "processes": list(payloads),
     }
+
+
+def _merge_timeseries(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Windowed-series fan-in: same-name series merge bucket-by-bucket
+    (counts summed, sketches ``qsketch_merge``d — see
+    ``timeseries.merge_registry_payloads``); a rank without the live layer
+    contributes nothing. Lazy import: payload merging must stay cheap for
+    the (common) case where no rank attached a registry."""
+    maps = [m for m in maps if m]
+    if not maps:
+        return {}
+    from metrics_tpu.observability.timeseries import merge_registry_payloads
+
+    return merge_registry_payloads(maps)
 
 
 #: async-pipeline counter keys that are extensive batch counts (summed);

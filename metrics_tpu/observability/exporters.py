@@ -93,6 +93,73 @@ def _labels(**kv: Any) -> str:
     return "{" + inner + "}" if inner else ""
 
 
+#: default lookback for the windowed (time-series) Prometheus families
+WINDOW_EXPORT_SECONDS = 60.0
+
+#: quantiles rendered per distribution series on the Prometheus page
+WINDOW_EXPORT_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _timeseries_lines(registry: Any, window_s: float = WINDOW_EXPORT_SECONDS) -> List[str]:
+    """Windowed families from a TimeSeriesRegistry (or a registry rebuilt
+    from a merged cross-host payload): per-series observation count and
+    rate, plus p50/p95/p99 for distribution series. One merged-sketch
+    query serves all quantiles of a series.
+
+    Each sample carries a ``window_s`` label with the seconds ACTUALLY
+    covered — the requested window clamped to the series' ring span
+    (``n_buckets * bucket_seconds``): a short-ring registry must not
+    publish numbers labeled as a longer lookback than it holds."""
+    lines: List[str] = []
+    names = registry.names()
+    if not names:
+        return lines
+
+    def eff_window(s: Any) -> float:
+        return min(float(window_s), s.n_buckets * s.bucket_seconds)
+
+    lines.append(
+        "# HELP metrics_tpu_window_count Observations recorded in the trailing window"
+        " (window_s label = seconds covered) per series."
+    )
+    lines.append("# TYPE metrics_tpu_window_count gauge")
+    for name in names:
+        s = registry.get(name)
+        w = eff_window(s)
+        lines.append(
+            f"metrics_tpu_window_count{_labels(series=name, window_s=f'{w:g}')} {s.count(w)}"
+        )
+    lines.append(
+        "# HELP metrics_tpu_window_rate Summed values per second over the trailing window"
+        " (window_s label = seconds covered) per series."
+    )
+    lines.append("# TYPE metrics_tpu_window_rate gauge")
+    for name in names:
+        s = registry.get(name)
+        w = eff_window(s)
+        lines.append(
+            f"metrics_tpu_window_rate{_labels(series=name, window_s=f'{w:g}')} {s.rate(w):g}"
+        )
+    lines.append(
+        "# HELP metrics_tpu_window_quantile Sketch-estimated quantiles over the trailing"
+        " window (window_s label = seconds covered) per distribution series."
+    )
+    lines.append("# TYPE metrics_tpu_window_quantile gauge")
+    for name in names:
+        s = registry.get(name)
+        if s.kind != "distribution":
+            continue
+        w = eff_window(s)
+        vals = s.quantiles(WINDOW_EXPORT_QUANTILES, window_s=w)
+        if vals is None:
+            continue
+        for q, v in zip(WINDOW_EXPORT_QUANTILES, vals):
+            lines.append(
+                f"metrics_tpu_window_quantile{_labels(series=name, q=q, window_s=f'{w:g}')} {v:g}"
+            )
+    return lines
+
+
 def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[str, Any]] = None) -> str:
     """Prometheus text-format rendering of the aggregate counters/gauges.
 
@@ -136,7 +203,7 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
     lines.append("# HELP metrics_tpu_call_seconds_total Cumulative wall time by metric and phase.")
     lines.append("# TYPE metrics_tpu_call_seconds_total counter")
     for payload in per_proc:
-        for key, t in sorted(payload["call_times"].items()):
+        for key, t in sorted(payload.get("call_times", {}).items()):
             metric, phase = key.split("|")
             lines.append(
                 f"metrics_tpu_call_seconds_total"
@@ -147,47 +214,47 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
     for payload in per_proc:
         lines.append(
             f"metrics_tpu_sync_events_total{_labels(**proc_label(payload))}"
-            f" {payload['sync_totals']['sync_events']}"
+            f" {payload.get('sync_totals', {}).get('sync_events', 0)}"
         )
     lines.append("# HELP metrics_tpu_gather_bytes_total Bytes of synced state received per participant.")
     lines.append("# TYPE metrics_tpu_gather_bytes_total counter")
     for payload in per_proc:
         lines.append(
             f"metrics_tpu_gather_bytes_total{_labels(**proc_label(payload))}"
-            f" {payload['sync_totals']['gather_bytes']}"
+            f" {payload.get('sync_totals', {}).get('gather_bytes', 0)}"
         )
     lines.append("# HELP metrics_tpu_pad_waste_bytes_total Pad-to-max padding bytes moved by uneven gathers.")
     lines.append("# TYPE metrics_tpu_pad_waste_bytes_total counter")
     for payload in per_proc:
         lines.append(
             f"metrics_tpu_pad_waste_bytes_total{_labels(**proc_label(payload))}"
-            f" {payload['sync_totals']['pad_waste_bytes']}"
+            f" {payload.get('sync_totals', {}).get('pad_waste_bytes', 0)}"
         )
     lines.append("# HELP metrics_tpu_distinct_signatures Distinct (shape, dtype) call signatures per entry point.")
     lines.append("# TYPE metrics_tpu_distinct_signatures gauge")
     for payload in per_proc:
-        for entry, n in sorted(payload["signature_counts"].items()):
+        for entry, n in sorted(payload.get("signature_counts", {}).items()):
             lines.append(
                 f"metrics_tpu_distinct_signatures{_labels(entry=entry, **proc_label(payload))} {n}"
             )
     lines.append("# HELP metrics_tpu_state_bytes_hwm State-footprint high-water mark per metric.")
     lines.append("# TYPE metrics_tpu_state_bytes_hwm gauge")
     for payload in per_proc:
-        for metric, nbytes in sorted(payload["footprint_hwm"].items()):
+        for metric, nbytes in sorted(payload.get("footprint_hwm", {}).items()):
             lines.append(
                 f"metrics_tpu_state_bytes_hwm{_labels(metric=metric, **proc_label(payload))} {nbytes}"
             )
     lines.append("# HELP metrics_tpu_compiles_total Attributed XLA compilations per entry point.")
     lines.append("# TYPE metrics_tpu_compiles_total counter")
     for payload in per_proc:
-        for entry, n in sorted(payload["compile_counts"].items()):
+        for entry, n in sorted(payload.get("compile_counts", {}).items()):
             lines.append(
                 f"metrics_tpu_compiles_total{_labels(entry=entry, **proc_label(payload))} {n}"
             )
     lines.append("# HELP metrics_tpu_compile_seconds_total Cumulative trace+lower+compile wall time per entry point.")
     lines.append("# TYPE metrics_tpu_compile_seconds_total counter")
     for payload in per_proc:
-        for entry, t in sorted(payload["compile_times"].items()):
+        for entry, t in sorted(payload.get("compile_times", {}).items()):
             lines.append(
                 f"metrics_tpu_compile_seconds_total{_labels(entry=entry, **proc_label(payload))} {t:.6f}"
             )
@@ -288,9 +355,30 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             f"metrics_tpu_sketch_fill_ratio{_labels(window='max', **proc_label(payload))}"
             f" {totals.get('max_fill_ratio', 0.0)}"
         )
+    lines.append("# HELP metrics_tpu_export_errors_total Exporter ticks that raised (artifacts may be stale).")
+    lines.append("# TYPE metrics_tpu_export_errors_total counter")
+    for payload in per_proc:
+        lines.append(
+            f"metrics_tpu_export_errors_total{_labels(**proc_label(payload))}"
+            f" {payload.get('export_errors', 0)}"
+        )
     lines.append("# HELP metrics_tpu_dropped_events_total Events discarded past the buffer cap.")
     lines.append("# TYPE metrics_tpu_dropped_events_total counter")
     lines.append(f"metrics_tpu_dropped_events_total {dropped}")
+    # windowed (time-series) families — present only when the live layer is
+    # attached (single-process: the recorder's registry; aggregate: the
+    # cross-host merged payload rebuilt into a queryable registry)
+    ts_registry = None
+    if aggregate is not None:
+        merged_ts = aggregate.get("timeseries")
+        if merged_ts:
+            from metrics_tpu.observability.timeseries import registry_from_payload
+
+            ts_registry = registry_from_payload(merged_ts)
+    else:
+        ts_registry = rec.timeseries
+    if ts_registry is not None:
+        lines.extend(_timeseries_lines(ts_registry))
     return "\n".join(lines) + "\n"
 
 
@@ -365,6 +453,35 @@ def summary(recorder: Optional[Any] = None) -> str:
             f"WARNING: {dropped} events dropped past the buffer cap"
             " (aggregate counters above still include them)"
         )
+    export_errors = rec.export_errors()
+    if export_errors:
+        lines.append(
+            f"WARNING: {export_errors} exporter tick(s) failed — telemetry"
+            " artifacts may be stale (the exporter keeps retrying)"
+        )
+    registry = rec.timeseries
+    if registry is not None and registry.names():
+        # requested lookback clamped to what the ring actually holds — the
+        # header must not claim a longer window than the series span
+        window_s = min(
+            WINDOW_EXPORT_SECONDS,
+            min(
+                s.n_buckets * s.bucket_seconds
+                for s in (registry.get(n) for n in registry.names())
+            ),
+        )
+        lines.append(f"windowed series (last {window_s:g}s):")
+        for name in registry.names():
+            s = registry.get(name)
+            n = s.count(window_s)
+            if not n:
+                continue
+            if s.kind == "distribution":
+                qs = s.quantiles((0.5, 0.95, 0.99), window_s=window_s)
+                q50, q95, q99 = (f"{v:.4g}" for v in qs) if qs else ("-", "-", "-")
+                lines.append(f"  {name}: n={n} p50={q50} p95={q95} p99={q99}")
+            else:
+                lines.append(f"  {name}: n={n} rate={s.rate(window_s):.4g}/s")
     if sigs:
         lines.append("distinct call signatures per entry point:")
         for entry, n in sorted(sigs.items(), key=lambda kv: -kv[1]):
@@ -408,6 +525,20 @@ class PeriodicExporter:
     Rank-zero gated: on other ranks ``start()`` is a no-op, matching the
     exporters it drives. Restartable: ``start()`` after ``stop()`` begins
     a fresh thread.
+
+    **Hardened against bad ticks**: an exception inside one export tick
+    (ENOSPC, permissions, a non-serializable event field) is caught,
+    counted (``export_errors`` here, ``record_export_error`` on the
+    recorder — surfaced by ``summary()``, the
+    ``metrics_tpu_export_errors_total`` Prometheus family, and the health
+    snapshot), warned once, and the thread KEEPS ticking — continuous
+    export must degrade to stale-but-recovering, never die silently.
+
+    **Health integration**: pass a
+    :class:`~metrics_tpu.observability.health.HealthMonitor` as
+    ``health`` and every tick evaluates it (firing/clearing alarms on
+    schedule even when no new events arrive — clearing is time passing)
+    and appends its Prometheus families to the Prometheus artifact.
     """
 
     def __init__(
@@ -416,6 +547,7 @@ class PeriodicExporter:
         prometheus_path: Optional[str] = None,
         jsonl_path: Optional[str] = None,
         recorder: Optional[Any] = None,
+        health: Optional[Any] = None,
     ) -> None:
         if prometheus_path is None and jsonl_path is None:
             raise ValueError("PeriodicExporter needs a prometheus_path and/or a jsonl_path")
@@ -424,6 +556,8 @@ class PeriodicExporter:
         self.interval_s = float(interval_s)
         self.prometheus_path = prometheus_path
         self.jsonl_path = jsonl_path
+        self.health = health
+        self.export_errors = 0
         self._recorder = recorder
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -455,7 +589,15 @@ class PeriodicExporter:
             except Exception as err:  # noqa: BLE001
                 # one bad tick (ENOSPC, a permissions hiccup, an event with
                 # a non-serializable field) must not kill continuous export
-                # for the rest of the job — warn once and keep ticking
+                # for the rest of the job — count it (visible in summary(),
+                # the Prometheus page, and the health snapshot), warn once,
+                # and keep ticking
+                self.export_errors += 1
+                rec = _resolve(self._recorder)
+                try:
+                    rec.record_export_error(err)
+                except Exception:  # noqa: BLE001 — counting must not re-raise
+                    pass
                 if not self._warned:
                     self._warned = True
                     from metrics_tpu.utils.prints import rank_zero_warn
@@ -463,7 +605,8 @@ class PeriodicExporter:
                     rank_zero_warn(
                         f"Telemetry: a PeriodicExporter tick failed ({err!r});"
                         " the thread keeps running and will retry next tick."
-                        " Further tick failures are not re-warned.",
+                        " Further tick failures are counted (export_errors),"
+                        " not re-warned.",
                         UserWarning,
                     )
 
@@ -475,16 +618,29 @@ class PeriodicExporter:
         atomically — no read-modify-append cycle, and a reader always sees
         a complete artifact. A tick where nothing was recorded since the
         last one skips the writes entirely (after the first tick, which
-        always materializes the artifacts)."""
+        always materializes the artifacts) — UNLESS a health monitor or a
+        time-series registry rides along: windowed stats and alarm states
+        change with the clock, not only with new events, so those ticks
+        always re-evaluate and re-render the Prometheus artifact."""
         rec = _resolve(self._recorder)
         events = rec.events()
+        snapshot = None
+        if self.health is not None:
+            # evaluated OUTSIDE the exporter lock (rule evaluation does
+            # sketch math) and unconditionally: alarms must clear on
+            # schedule even when the job records nothing new
+            snapshot = self.health.evaluate()
         with self._lock:
             state = (len(events), rec.dropped_events())
-            if state == self._exported_state:
+            live_window = self.health is not None or rec.timeseries is not None
+            if state == self._exported_state and not live_window:
                 return
             if self.prometheus_path is not None:
-                _atomic_write(self.prometheus_path, render_prometheus(rec))
-            if self.jsonl_path is not None:
+                text = render_prometheus(rec)
+                if snapshot is not None:
+                    text += "\n".join(self.health.prometheus_lines(snapshot)) + "\n"
+                _atomic_write(self.prometheus_path, text)
+            if state != self._exported_state and self.jsonl_path is not None:
                 _atomic_write(
                     self.jsonl_path, "".join(json.dumps(e) + "\n" for e in events)
                 )

@@ -1,0 +1,580 @@
+"""Declarative health/SLO engine over the windowed telemetry series.
+
+The time-series layer (:mod:`metrics_tpu.observability.timeseries`) answers
+"what is the p99 / rate / max over the last N seconds"; this module turns
+those answers into an operational verdict: a rule set is evaluated against
+the registry and produces a typed :class:`HealthSnapshot` —
+``ok``/``warn``/``critical`` plus the exact alarms firing — exported as
+Prometheus families, appended to a JSONL alarm log on every transition,
+and renderable as a terminal summary (:func:`render_health`).
+
+Two rule shapes cover the standard serving-loop failure modes:
+
+* :class:`ThresholdRule` — a windowed statistic (``p50``/``p95``/``p99``/
+  ``mean``/``max``/``min``/``rate``/``count``) of one series compared
+  against a bound. Backs the queue-saturation, staleness, recompile-storm,
+  sketch-fill-ceiling, and hot-slice-skew alarms.
+* :class:`BurnRateRule` — multiwindow SLO burn: the ratio of a "bad"
+  counter to a "total" counter (e.g. dropped / offered batches) is
+  compared to an error budget over a short AND a long window; the alarm
+  fires only when both burn rates exceed the threshold, the standard
+  fast-burn page condition (short window catches the spike, long window
+  filters blips). Backs the drop-rate alarm.
+
+:func:`default_rules` wires the six standard alarm classes over the
+standard series names the recorder feeds (``SERIES_*`` in
+``recorder.py``); every threshold is a keyword so deployments tune rather
+than reimplement. ``examples/serving_loop.py`` drives the whole layer
+under fault injection. See docs/observability.md for the rule reference.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from metrics_tpu.observability.recorder import (
+    _DEFAULT_RECORDER,
+    SERIES_ASYNC_DROPPED,
+    SERIES_ASYNC_ENQUEUED,
+    SERIES_ASYNC_QUEUE_DEPTH,
+    SERIES_ASYNC_STALENESS,
+    SERIES_HOT_SLICE_SHARE,
+    SERIES_RECOMPILES,
+    SERIES_SKETCH_FILL,
+)
+
+__all__ = [
+    "AlarmState",
+    "BurnRateRule",
+    "HealthMonitor",
+    "HealthSnapshot",
+    "Rule",
+    "ThresholdRule",
+    "default_rules",
+    "render_health",
+]
+
+#: snapshot statuses in escalation order
+STATUSES = ("ok", "warn", "critical")
+
+#: accepted rule severities (a firing critical rule makes the snapshot
+#: critical; warn rules cap at warn)
+SEVERITIES = ("warn", "critical")
+
+#: windowed statistics ThresholdRule understands; pNN spellings map onto
+#: the sketch quantile query
+_STATS = ("p50", "p90", "p95", "p99", "mean", "max", "min", "rate", "count", "total")
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+class Rule:
+    """One health rule: a name, a severity, and an ``evaluate`` returning
+    ``(firing, observed_value, detail)``. Subclass to add shapes beyond
+    threshold/burn-rate; the monitor only needs this interface."""
+
+    def __init__(self, name: str, severity: str = "warn", description: str = "") -> None:
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}, got {severity!r}")
+        self.name = name
+        self.severity = severity
+        self.description = description
+
+    def evaluate(self, registry: Any, now: Optional[float] = None) -> Tuple[bool, Optional[float], str]:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name!r}, severity={self.severity!r})"
+
+
+class ThresholdRule(Rule):
+    """Fire when a windowed statistic of one series crosses a bound.
+
+    ``stat`` is one of ``p50/p90/p95/p99`` (sketch quantiles), ``mean``/
+    ``max``/``min`` (scalar aggregates), ``rate`` (summed values per
+    second), ``count``, or ``total``. An empty window (or an absent
+    series) never fires — silence is not an alarm; pair with a liveness
+    rule if silence should page. ``min_count`` suppresses firing until
+    the window holds at least that many observations (quantiles of three
+    points are noise, not signal)."""
+
+    def __init__(
+        self,
+        name: str,
+        series: str,
+        stat: str,
+        threshold: float,
+        window_s: float = 30.0,
+        op: str = ">",
+        severity: str = "warn",
+        min_count: int = 1,
+        description: str = "",
+    ) -> None:
+        super().__init__(name, severity=severity, description=description)
+        if stat not in _STATS:
+            raise ValueError(f"stat must be one of {_STATS}, got {stat!r}")
+        if op not in _OPS:
+            raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        self.series = series
+        self.stat = stat
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.op = op
+        self.min_count = int(min_count)
+
+    def evaluate(self, registry: Any, now: Optional[float] = None) -> Tuple[bool, Optional[float], str]:
+        s = registry.get(self.series) if registry is not None else None
+        if s is None:
+            return False, None, f"series `{self.series}` absent"
+        n = s.count(self.window_s, now=now)
+        if n < self.min_count:
+            return False, None, f"only {n} observation(s) in window"
+        if self.stat.startswith("p"):
+            value = s.quantile(int(self.stat[1:]) / 100.0, window_s=self.window_s, now=now)
+        elif self.stat == "mean":
+            value = s.mean(self.window_s, now=now)
+        elif self.stat == "max":
+            value = s.value_max(self.window_s, now=now)
+        elif self.stat == "min":
+            value = s.value_min(self.window_s, now=now)
+        elif self.stat == "rate":
+            value = s.rate(self.window_s, now=now)
+        elif self.stat == "total":
+            value = s.total(self.window_s, now=now)
+        else:  # count
+            value = float(n)
+        if value is None:
+            return False, None, "empty window"
+        firing = _OPS[self.op](value, self.threshold)
+        return (
+            bool(firing),
+            float(value),
+            f"{self.stat}({self.series}, {self.window_s:g}s) = {value:.4g} {self.op} {self.threshold:g}",
+        )
+
+
+class BurnRateRule(Rule):
+    """Multiwindow SLO burn-rate alarm over counter series.
+
+    The error ratio ``sum(bad) / sum(total)`` is measured over a short and
+    a long window; each is divided by the error ``budget`` (the SLO's
+    allowed ratio) to get a burn rate, and the alarm fires when BOTH
+    exceed ``burn_threshold`` — the standard fast-burn condition: the
+    short window reacts within seconds, the long window keeps a single
+    bad bucket from paging. ``denominator`` may be several series (their
+    totals add), e.g. offered batches = accepted + dropped."""
+
+    def __init__(
+        self,
+        name: str,
+        numerator: str,
+        denominator: Union[str, Sequence[str]],
+        budget: float,
+        short_window_s: float = 10.0,
+        long_window_s: float = 60.0,
+        burn_threshold: float = 1.0,
+        severity: str = "critical",
+        min_total: int = 1,
+        description: str = "",
+    ) -> None:
+        super().__init__(name, severity=severity, description=description)
+        if not (0 < budget < 1):
+            raise ValueError(f"budget must be a ratio in (0, 1), got {budget}")
+        if short_window_s >= long_window_s:
+            raise ValueError("short_window_s must be smaller than long_window_s")
+        self.numerator = numerator
+        self.denominator = (denominator,) if isinstance(denominator, str) else tuple(denominator)
+        self.budget = float(budget)
+        self.short_window_s = float(short_window_s)
+        self.long_window_s = float(long_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.min_total = int(min_total)
+
+    def _burn(self, registry: Any, window_s: float, now: Optional[float]) -> Optional[float]:
+        num_series = registry.get(self.numerator)
+        bad = num_series.total(window_s, now=now) if num_series is not None else 0.0
+        total = bad
+        for name in self.denominator:
+            s = registry.get(name)
+            if s is not None and s is not num_series:
+                total += s.total(window_s, now=now)
+        if total < self.min_total:
+            return None
+        return (bad / total) / self.budget
+
+    def evaluate(self, registry: Any, now: Optional[float] = None) -> Tuple[bool, Optional[float], str]:
+        if registry is None:
+            return False, None, "no registry"
+        short = self._burn(registry, self.short_window_s, now)
+        long_ = self._burn(registry, self.long_window_s, now)
+        if short is None or long_ is None:
+            return False, None, "no traffic in window"
+        firing = short >= self.burn_threshold and long_ >= self.burn_threshold
+        return (
+            bool(firing),
+            float(short),
+            f"burn {self.short_window_s:g}s={short:.2f}x, {self.long_window_s:g}s={long_:.2f}x"
+            f" of budget {self.budget:g} (threshold {self.burn_threshold:g}x)",
+        )
+
+
+@dataclass(frozen=True)
+class AlarmState:
+    """One rule's state inside a snapshot."""
+
+    name: str
+    severity: str
+    firing: bool
+    value: Optional[float]
+    detail: str
+    fired_at: Optional[float] = None  # wall time the CURRENT firing episode began
+
+
+@dataclass(frozen=True)
+class HealthSnapshot:
+    """Typed verdict of one health evaluation: overall status, every
+    rule's state, and the exporter-error count (a stale-artifact signal is
+    itself a health fact)."""
+
+    status: str
+    t: float
+    alarms: Tuple[AlarmState, ...] = ()
+    export_errors: int = 0
+
+    @property
+    def firing(self) -> Tuple[AlarmState, ...]:
+        return tuple(a for a in self.alarms if a.firing)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "status": self.status,
+            "t": self.t,
+            "export_errors": self.export_errors,
+            "alarms": [
+                {
+                    "name": a.name,
+                    "severity": a.severity,
+                    "firing": a.firing,
+                    "value": a.value,
+                    "detail": a.detail,
+                    "fired_at": a.fired_at,
+                }
+                for a in self.alarms
+            ],
+        }
+
+
+class HealthMonitor:
+    """Evaluates a rule set against a time-series registry and tracks alarm
+    transitions.
+
+    ``evaluate()`` returns a :class:`HealthSnapshot`; each rule's
+    fired/cleared transition is appended to the JSONL alarm log (when
+    configured) and remembered in :meth:`transitions` — so
+    "did every alarm class fire AND clear during this run" is a direct
+    query (:meth:`fired_and_cleared`), which is exactly what the
+    serving-loop fault-injection smoke asserts. Thread-safe: the
+    :class:`~metrics_tpu.observability.exporters.PeriodicExporter` calls
+    ``evaluate()`` from its tick thread while the serving loop polls."""
+
+    #: transition-history cap — health evaluation must stay fixed-memory
+    #: like everything else in the live layer
+    MAX_TRANSITIONS = 10_000
+
+    def __init__(
+        self,
+        rules: Sequence[Rule],
+        registry: Optional[Any] = None,
+        recorder: Optional[Any] = None,
+        alarm_log_path: Optional[str] = None,
+    ) -> None:
+        names = [r.name for r in rules]
+        dup = {n for n in names if names.count(n) > 1}
+        if dup:
+            raise ValueError(f"duplicate rule names: {sorted(dup)}")
+        self.rules = list(rules)
+        self._registry = registry
+        self._recorder = recorder
+        self.alarm_log_path = alarm_log_path
+        self._lock = threading.Lock()
+        #: serializes alarm-log appends — _atomic_append is a read-modify-
+        #: replace, so concurrent evaluates (exporter tick thread + the
+        #: serving loop's probe) would lose rows without it
+        self._log_lock = threading.Lock()
+        self._fired_at: Dict[str, float] = {}
+        self._transitions: List[Dict[str, Any]] = []
+        self._last: Optional[HealthSnapshot] = None
+
+    def _resolve_registry(self) -> Optional[Any]:
+        if self._registry is not None:
+            return self._registry
+        rec = self._recorder if self._recorder is not None else _DEFAULT_RECORDER
+        return rec.timeseries
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> HealthSnapshot:
+        registry = self._resolve_registry()
+        rec = self._recorder if self._recorder is not None else _DEFAULT_RECORDER
+        t = time.time() if now is None else float(now)
+        alarms: List[AlarmState] = []
+        new_transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            for rule in self.rules:
+                try:
+                    firing, value, detail = rule.evaluate(registry, now=now)
+                except Exception as err:  # noqa: BLE001 — one bad rule must not kill the sweep
+                    firing, value, detail = False, None, f"rule evaluation failed: {err!r}"
+                was = rule.name in self._fired_at
+                if firing and not was:
+                    self._fired_at[rule.name] = t
+                    new_transitions.append(
+                        {
+                            "event": "fired",
+                            "alarm": rule.name,
+                            "severity": rule.severity,
+                            "value": value,
+                            "detail": detail,
+                            "t": t,
+                        }
+                    )
+                elif not firing and was:
+                    fired_at = self._fired_at.pop(rule.name)
+                    new_transitions.append(
+                        {
+                            "event": "cleared",
+                            "alarm": rule.name,
+                            "severity": rule.severity,
+                            "value": value,
+                            "duration_s": round(t - fired_at, 3),
+                            "t": t,
+                        }
+                    )
+                alarms.append(
+                    AlarmState(
+                        name=rule.name,
+                        severity=rule.severity,
+                        firing=firing,
+                        value=value,
+                        detail=detail,
+                        fired_at=self._fired_at.get(rule.name),
+                    )
+                )
+            self._transitions.extend(new_transitions)
+            if len(self._transitions) > self.MAX_TRANSITIONS:
+                self._transitions = self._transitions[-self.MAX_TRANSITIONS :]
+            status = "ok"
+            for a in alarms:
+                if a.firing:
+                    if a.severity == "critical":
+                        status = "critical"
+                        break
+                    status = "warn"
+            snap = HealthSnapshot(
+                status=status,
+                t=t,
+                alarms=tuple(alarms),
+                export_errors=rec.export_errors(),
+            )
+            self._last = snap
+        if new_transitions and self.alarm_log_path:
+            from metrics_tpu.observability.exporters import _atomic_append
+            from metrics_tpu.utils.prints import _process_index
+
+            if _process_index() == 0:
+                try:
+                    with self._log_lock:
+                        _atomic_append(
+                            self.alarm_log_path,
+                            "".join(json.dumps(row) + "\n" for row in new_transitions),
+                        )
+                except Exception:  # noqa: BLE001 — the log is an artifact, not the source of truth
+                    pass
+        return snap
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def last_snapshot(self) -> Optional[HealthSnapshot]:
+        with self._lock:
+            return self._last
+
+    def transitions(self) -> List[Dict[str, Any]]:
+        """Every fired/cleared transition observed so far (capped)."""
+        with self._lock:
+            return list(self._transitions)
+
+    def fired_ever(self) -> List[str]:
+        with self._lock:
+            return sorted({r["alarm"] for r in self._transitions if r["event"] == "fired"})
+
+    def fired_and_cleared(self) -> List[str]:
+        """Alarm names that have both fired and subsequently cleared — the
+        fault-injection smoke's acceptance query."""
+        with self._lock:
+            fired = {r["alarm"] for r in self._transitions if r["event"] == "fired"}
+            cleared = {r["alarm"] for r in self._transitions if r["event"] == "cleared"}
+        return sorted(fired & cleared)
+
+    # ------------------------------------------------------------------
+    # exports
+    # ------------------------------------------------------------------
+    def prometheus_lines(self, snapshot: Optional[HealthSnapshot] = None) -> List[str]:
+        """The health families for the Prometheus page (appended by
+        ``PeriodicExporter``/``render_prometheus`` when a monitor rides
+        along): overall status as 0/1/2, one 0/1 firing gauge and one
+        observed-value gauge per alarm."""
+        snap = snapshot if snapshot is not None else self.last_snapshot
+        if snap is None:
+            return []
+        from metrics_tpu.observability.exporters import _labels
+
+        lines = [
+            "# HELP metrics_tpu_health_status Overall health verdict (0=ok, 1=warn, 2=critical).",
+            "# TYPE metrics_tpu_health_status gauge",
+            f"metrics_tpu_health_status {STATUSES.index(snap.status)}",
+            "# HELP metrics_tpu_alarm_firing Whether the alarm rule is currently firing.",
+            "# TYPE metrics_tpu_alarm_firing gauge",
+        ]
+        for a in snap.alarms:
+            lines.append(
+                f"metrics_tpu_alarm_firing{_labels(alarm=a.name, severity=a.severity)}"
+                f" {1 if a.firing else 0}"
+            )
+        lines.append("# HELP metrics_tpu_alarm_value Last observed value of the alarm rule's statistic.")
+        lines.append("# TYPE metrics_tpu_alarm_value gauge")
+        for a in snap.alarms:
+            if a.value is not None:
+                lines.append(f"metrics_tpu_alarm_value{_labels(alarm=a.name)} {a.value:g}")
+        return lines
+
+
+def render_health(snapshot: HealthSnapshot) -> str:
+    """Terminal one-glance rendering of a snapshot: the status line, then
+    one row per alarm (firing rows first)."""
+    lines = [
+        f"health: {snapshot.status.upper()}"
+        f" ({len(snapshot.firing)}/{len(snapshot.alarms)} alarms firing,"
+        f" {snapshot.export_errors} export errors)"
+    ]
+    for a in sorted(snapshot.alarms, key=lambda a: (not a.firing, a.name)):
+        mark = "FIRING" if a.firing else "ok"
+        lines.append(f"  [{mark:>6}] {a.name} ({a.severity}): {a.detail}")
+    return "\n".join(lines)
+
+
+def default_rules(
+    queue_depth_limit: float = 4,
+    staleness_limit_steps: float = 4,
+    drop_budget: float = 0.01,
+    drop_burn_threshold: float = 2.0,
+    recompiles_per_window: float = 4,
+    fill_ceiling: float = 0.9,
+    hot_share_limit: float = 0.5,
+    window_s: float = 30.0,
+    short_window_s: Optional[float] = None,
+    critical_queue_factor: float = 2.0,
+) -> List[Rule]:
+    """The six standard serving-loop alarm classes over the standard
+    recorder-fed series, every threshold tunable:
+
+    * ``queue_saturation`` (warn) / ``queue_saturation_critical`` — p95 /
+      max of the async queue depth against the configured limit.
+    * ``staleness`` — max compute-snapshot staleness in batches.
+    * ``drop_rate`` — multiwindow burn of dropped vs offered batches
+      against the ``drop_budget`` SLO.
+    * ``recompile_storm`` — new-signature count per window.
+    * ``sketch_fill`` — max sketch capacity-fill ratio against the
+      ceiling (past it, compactions are imminent/ongoing and accuracy is
+      being spent).
+    * ``hot_slice_skew`` — p95 of the per-batch hottest-slice row share.
+    """
+    short = short_window_s if short_window_s is not None else max(window_s / 3.0, 1.0)
+    return [
+        ThresholdRule(
+            "queue_saturation",
+            SERIES_ASYNC_QUEUE_DEPTH,
+            stat="p95",
+            threshold=queue_depth_limit,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            min_count=3,
+            description="async ingest queue persistently near capacity",
+        ),
+        ThresholdRule(
+            "queue_saturation_critical",
+            SERIES_ASYNC_QUEUE_DEPTH,
+            stat="p95",
+            threshold=queue_depth_limit * critical_queue_factor,
+            window_s=window_s,
+            op=">=",
+            severity="critical",
+            min_count=3,
+            description="async ingest queue saturated well past its limit",
+        ),
+        ThresholdRule(
+            "staleness",
+            SERIES_ASYNC_STALENESS,
+            stat="max",
+            threshold=staleness_limit_steps,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            description="compute snapshots are further behind ingest than the bound",
+        ),
+        BurnRateRule(
+            "drop_rate",
+            numerator=SERIES_ASYNC_DROPPED,
+            denominator=(SERIES_ASYNC_ENQUEUED, SERIES_ASYNC_DROPPED),
+            budget=drop_budget,
+            short_window_s=short,
+            long_window_s=window_s,
+            burn_threshold=drop_burn_threshold,
+            severity="critical",
+            description="batch drop ratio is burning the SLO error budget",
+        ),
+        ThresholdRule(
+            "recompile_storm",
+            SERIES_RECOMPILES,
+            stat="total",
+            threshold=recompiles_per_window,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            description="new call signatures keep triggering XLA compilation",
+        ),
+        ThresholdRule(
+            "sketch_fill",
+            SERIES_SKETCH_FILL,
+            stat="max",
+            threshold=fill_ceiling,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            description="sketch states near/at capacity — accuracy budget being spent",
+        ),
+        ThresholdRule(
+            "hot_slice_skew",
+            SERIES_HOT_SLICE_SHARE,
+            stat="p95",
+            threshold=hot_share_limit,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            min_count=3,
+            description="one slice is receiving an outsized share of batch rows",
+        ),
+    ]

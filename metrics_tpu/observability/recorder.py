@@ -84,6 +84,59 @@ SKETCH_FOOTPRINT_PREFIX = "sketch/"
 SKETCH_LABEL_SUFFIX = "[sketch]"
 
 
+# ---------------------------------------------------------------------------
+# standard time-series names (fed when a TimeSeriesRegistry is attached via
+# ``attach_timeseries`` — see observability/timeseries.py). Defined HERE, not
+# in timeseries.py, so the jax-free recorder module owns the vocabulary the
+# health rules (observability/health.py) reference, the same way it owns the
+# footprint prefixes.
+# ---------------------------------------------------------------------------
+
+#: per-call wall time distributions (ms) — one series per lifecycle phase
+SERIES_UPDATE_MS = "update_ms"
+SERIES_COMPUTE_MS = "compute_ms"
+SERIES_FORWARD_MS = "forward_ms"
+#: host wall time of one fused collection dispatch (ms)
+SERIES_FUSED_DISPATCH_MS = "fused_dispatch_ms"
+#: batch rows ingested through fused dispatches (counter — rolling rows/sec)
+SERIES_INGEST_ROWS = "ingest_rows"
+#: async pipeline: apply (dequeue->install) wall time per batch (ms)
+SERIES_ASYNC_APPLY_MS = "async_apply_ms"
+#: async pipeline: enqueue->apply age per batch (ms) — the live staleness
+#: signal the bounded-staleness contract is about
+SERIES_ASYNC_AGE_MS = "async_age_ms"
+#: async pipeline: outstanding batches observed at enqueue/dequeue
+SERIES_ASYNC_QUEUE_DEPTH = "async_queue_depth"
+#: async pipeline: compute-snapshot staleness in unapplied batches
+SERIES_ASYNC_STALENESS = "async_staleness_steps"
+#: async pipeline: accepted / dropped batch counters
+SERIES_ASYNC_ENQUEUED = "async_enqueued"
+SERIES_ASYNC_DROPPED = "async_dropped"
+#: new (shape, dtype) signatures at jitted entry points — each one is an
+#: XLA (re)compilation trigger; a storm of them is the classic ragged-batch
+#: failure mode the recompile alarm watches
+SERIES_RECOMPILES = "recompiles"
+#: sketch capacity-fill ratios reported from cold computes
+SERIES_SKETCH_FILL = "sketch_fill_ratio"
+#: sliced scatter: rows ingested (counter) and the per-batch share of rows
+#: landing in the single hottest slice (hot-slice skew signal)
+SERIES_SLICED_ROWS = "sliced_rows"
+SERIES_HOT_SLICE_SHARE = "hot_slice_share"
+#: exporter ticks that raised (PeriodicExporter hardening)
+SERIES_EXPORT_ERRORS = "export_errors"
+
+#: the standard counter-kind series; every other standard series is a
+#: distribution (sketch-backed)
+COUNTER_SERIES = (
+    SERIES_INGEST_ROWS,
+    SERIES_ASYNC_ENQUEUED,
+    SERIES_ASYNC_DROPPED,
+    SERIES_RECOMPILES,
+    SERIES_SLICED_ROWS,
+    SERIES_EXPORT_ERRORS,
+)
+
+
 def _new_sliced_totals() -> Dict[str, int]:
     return {"scatter_events": 0, "rows": 0, "max_slices": 0}
 
@@ -226,6 +279,14 @@ class MetricRecorder:
         self._sliced = _new_sliced_totals()
         self._sliced_slice_counts: Dict[str, int] = {}
         self._sketch = _new_sketch_totals()
+        self._export_errors = 0
+        #: tid -> thread name, registered as events from new threads arrive —
+        #: export_perfetto emits these as thread_name metadata so the async
+        #: worker's spans land on their own labeled track
+        self._thread_names: Dict[int, str] = {}
+        #: attached TimeSeriesRegistry (None = the windowed layer is off and
+        #: costs one attribute check per hook) — see attach_timeseries()
+        self.timeseries: Optional[Any] = None
         # per-thread compute-group attribution: a shared field would let
         # concurrent MetricCollection.update calls cross-attribute events
         self._group_local = threading.local()
@@ -252,6 +313,35 @@ class MetricRecorder:
         self.enabled = False
         return self
 
+    def attach_timeseries(self, registry: Optional[Any] = None, **kwargs: Any) -> Any:
+        """Attach a :class:`~metrics_tpu.observability.timeseries.
+        TimeSeriesRegistry` (created from ``**kwargs`` when not given) and
+        start feeding the standard windowed series (``SERIES_*``) from the
+        recorder's hooks. Returns the registry. Idempotent-friendly: a
+        second call replaces the registry."""
+        if registry is None:
+            from metrics_tpu.observability.timeseries import TimeSeriesRegistry
+
+            registry = TimeSeriesRegistry(**kwargs)
+        self.timeseries = registry
+        return registry
+
+    def detach_timeseries(self) -> "MetricRecorder":
+        """Stop feeding windowed series (the registry is dropped)."""
+        self.timeseries = None
+        return self
+
+    def _observe(self, name: str, value: float) -> None:
+        """Feed one observation into the attached registry (no-op when
+        detached). Called OUTSIDE the recorder lock — the registry has its
+        own leaf lock and never calls back into the recorder."""
+        ts = self.timeseries
+        if ts is not None:
+            try:
+                ts.observe(name, value, kind="counter" if name in COUNTER_SERIES else "distribution")
+            except Exception:  # noqa: BLE001 — telemetry must never take down the hot path
+                pass
+
     def reset(self) -> "MetricRecorder":
         with self._lock:
             self._t0 = time.time()
@@ -275,7 +365,15 @@ class MetricRecorder:
             self._sliced = _new_sliced_totals()
             self._sliced_slice_counts = {}
             self._sketch = _new_sketch_totals()
+            self._export_errors = 0
+            self._thread_names = {}
             self._group_local = threading.local()
+        # the windowed layer stays ATTACHED across reset (long jobs reset the
+        # event buffer periodically; the ring is fixed-capacity and must keep
+        # observing) but its data clears with everything else
+        ts = self.timeseries
+        if ts is not None:
+            ts.reset()
         return self
 
     # ------------------------------------------------------------------
@@ -357,6 +455,18 @@ class MetricRecorder:
         with self._lock:
             return dict(self._sliced_slice_counts)
 
+    def export_errors(self) -> int:
+        """Exporter ticks that raised (see ``PeriodicExporter``) — a
+        nonzero count means telemetry artifacts may be stale."""
+        with self._lock:
+            return self._export_errors
+
+    def thread_names(self) -> Dict[int, str]:
+        """tid -> thread name for every thread that recorded a span or an
+        async-pipeline event (Perfetto track labeling)."""
+        with self._lock:
+            return dict(self._thread_names)
+
     def dropped_events(self) -> int:
         """Events discarded after the MAX_EVENTS buffer cap (aggregate
         counters still include them; the JSONL stream does not)."""
@@ -429,6 +539,9 @@ class MetricRecorder:
             if group is not None:
                 event["compute_group"] = list(group)
             self._append(event)
+        if phase in ("update", "compute", "forward"):
+            # windowed per-phase latency distributions (SERIES_UPDATE_MS ...)
+            self._observe(f"{phase}_ms", duration_s * 1e3)
         if sig and phase in ("update", "forward"):
             return self.track_signature(f"{label}.{phase}", signature=sig)
         return False
@@ -476,6 +589,10 @@ class MetricRecorder:
                 " are genuinely static-bounded.",
                 UserWarning,
             )
+        if is_new:
+            # every new signature is an XLA compilation trigger — the
+            # windowed rate of this counter is the recompile-storm signal
+            self._observe(SERIES_RECOMPILES, 1)
         return is_new
 
     def record_compile(
@@ -619,13 +736,15 @@ class MetricRecorder:
         n_fused: int,
         n_fallback: int,
         duration_s: float,
+        batch_rows: Optional[int] = None,
         **extra: Any,
     ) -> None:
         """Record ONE fused collection update (one XLA dispatch serving
         ``n_fused`` metric updates, plus ``n_fallback`` eager fallbacks in
         the same batch). Exactly one ``fused_update`` event per batch is
         the fused path's dispatch-count contract — the guard test in
-        tests/bases/test_fused.py pins it."""
+        tests/bases/test_fused.py pins it. ``batch_rows`` (the batch's
+        leading dimension) feeds the windowed ingest-rate series."""
         with self._lock:
             self._fused_updates += 1
             self._fused_metric_updates += int(n_fused)
@@ -638,8 +757,13 @@ class MetricRecorder:
                 "n_fallback": int(n_fallback),
                 "dur_ms": round(duration_s * 1e3, 4),
             }
+            if batch_rows is not None:
+                event["batch_rows"] = int(batch_rows)
             event.update(extra)
             self._append(event)
+        self._observe(SERIES_FUSED_DISPATCH_MS, duration_s * 1e3)
+        if batch_rows is not None:
+            self._observe(SERIES_INGEST_ROWS, int(batch_rows))
 
     def record_sketch_merge(self, n_merges: int = 1, **extra: Any) -> None:
         """Record ``n_merges`` pairwise sketch merges (cross-rank sync folds,
@@ -669,6 +793,7 @@ class MetricRecorder:
             }
             event.update(extra)
             self._append(event)
+        self._observe(SERIES_SKETCH_FILL, worst)
 
     def record_sliced_scatter(
         self,
@@ -677,6 +802,7 @@ class MetricRecorder:
         n_slices: int,
         n_leaves: int,
         in_jit: bool = False,
+        hot_rows: Optional[int] = None,
         **extra: Any,
     ) -> None:
         """Record one slice-axis segment-scatter (``SlicedMetric._update``).
@@ -687,6 +813,11 @@ class MetricRecorder:
         accounting uses. The counters are therefore dispatch-shaped on the
         eager path and compile-shaped on the fused one; ``bench.py sliced``
         reads the fused handle's ``n_compiles`` for the hard compile gate.
+
+        ``hot_rows`` (eager path only — needs concrete slice ids) is the
+        row count of the batch's single most-hit slice; its share of the
+        batch feeds the windowed hot-slice-skew series the health layer
+        alarms on.
         """
         with self._lock:
             self._sliced["scatter_events"] += 1
@@ -701,8 +832,16 @@ class MetricRecorder:
                 "in_jit": bool(in_jit),
                 "t": round(time.time() - self._t0, 6),
             }
+            if hot_rows is not None:
+                event["hot_rows"] = int(hot_rows)
             event.update(extra)
             self._append(event)
+        if not in_jit:
+            # trace-time hooks are compile-shaped, not traffic-shaped — only
+            # eager scatters feed the windowed ingest/skew series
+            self._observe(SERIES_SLICED_ROWS, int(n_rows))
+            if hot_rows is not None and n_rows:
+                self._observe(SERIES_HOT_SLICE_SHARE, int(hot_rows) / int(n_rows))
 
     def record_async_event(
         self,
@@ -726,8 +865,14 @@ class MetricRecorder:
         the ``async_in_flight`` label, so the memory pinned by queued
         batches and donated in-flight state shows up next to the per-metric
         state HWMs instead of being invisible exactly when pressure peaks.
+
+        Every async event is stamped with the recording thread's id (and
+        the tid -> name map updated), so the Perfetto export can land the
+        worker's rows on their own labeled track.
         """
+        tid = threading.get_ident()
         with self._lock:
+            self._thread_names.setdefault(tid, threading.current_thread().name)
             totals = self._async
             if kind == "enqueue":
                 totals["enqueued"] += 1
@@ -752,28 +897,68 @@ class MetricRecorder:
                 )
                 if int(in_flight_bytes) > self._footprint_hwm.get(ASYNC_IN_FLIGHT_LABEL, -1):
                     self._footprint_hwm[ASYNC_IN_FLIGHT_LABEL] = int(in_flight_bytes)
-            if kind in ("drop", "snapshot"):
-                return  # counter/gauge-only: no event in the stream
-            event: Dict[str, Any] = {"type": kind, "t": round(time.time() - self._t0, 6)}
-            if batch_index is not None:
-                event["batch_index"] = int(batch_index)
-            if queue_depth is not None:
-                event["queue_depth"] = int(queue_depth)
-            if staleness_steps is not None:
-                event["staleness_steps"] = int(staleness_steps)
-            if in_flight_bytes is not None:
-                event["in_flight_bytes"] = int(in_flight_bytes)
+            if kind not in ("drop", "snapshot"):  # counter/gauge-only kinds skip the stream
+                event: Dict[str, Any] = {
+                    "type": kind,
+                    "t": round(time.time() - self._t0, 6),
+                    "tid": tid,
+                }
+                if batch_index is not None:
+                    event["batch_index"] = int(batch_index)
+                if queue_depth is not None:
+                    event["queue_depth"] = int(queue_depth)
+                if staleness_steps is not None:
+                    event["staleness_steps"] = int(staleness_steps)
+                if in_flight_bytes is not None:
+                    event["in_flight_bytes"] = int(in_flight_bytes)
+                if dur_ms is not None:
+                    event["dur_ms"] = dur_ms
+                event.update(extra)
+                self._append(event)
+        # windowed feeds (outside the lock; no-ops when detached)
+        if kind == "enqueue":
+            self._observe(SERIES_ASYNC_ENQUEUED, 1)
+        elif kind == "drop":
+            self._observe(SERIES_ASYNC_DROPPED, 1)
+        elif kind == "dequeue":
             if dur_ms is not None:
-                event["dur_ms"] = dur_ms
-            event.update(extra)
-            self._append(event)
+                self._observe(SERIES_ASYNC_APPLY_MS, float(dur_ms))
+            age_ms = extra.get("age_ms")
+            if age_ms is not None:
+                self._observe(SERIES_ASYNC_AGE_MS, float(age_ms))
+        elif kind == "snapshot" and staleness_steps is not None:
+            self._observe(SERIES_ASYNC_STALENESS, int(staleness_steps))
+        if queue_depth is not None:
+            self._observe(SERIES_ASYNC_QUEUE_DEPTH, int(queue_depth))
 
     def record_event(self, etype: str, **fields: Any) -> None:
         """Record a free-form auxiliary event (e.g. ``tracker_increment``)."""
         with self._lock:
+            tid = fields.get("tid")
+            if isinstance(tid, int) and tid == threading.get_ident():
+                # span-exit events carry their own thread's id — register
+                # the name so Perfetto tracks are labeled
+                self._thread_names.setdefault(tid, threading.current_thread().name)
             event: Dict[str, Any] = {"type": etype, "t": round(time.time() - self._t0, 6)}
             event.update(fields)
             self._append(event)
+
+    def record_export_error(self, error: Optional[BaseException] = None) -> None:
+        """Count one failed exporter tick (``PeriodicExporter`` hardening):
+        the thread keeps ticking, but the failure must be visible — in the
+        summary, the Prometheus page, the health snapshot, and the windowed
+        export-error series."""
+        with self._lock:
+            self._export_errors += 1
+            event: Dict[str, Any] = {
+                "type": "export_error",
+                "t": round(time.time() - self._t0, 6),
+                "n_errors": self._export_errors,
+            }
+            if error is not None:
+                event["error"] = repr(error)
+            self._append(event)
+        self._observe(SERIES_EXPORT_ERRORS, 1)
 
     # ------------------------------------------------------------------
     # compute-group attribution (MetricCollection)

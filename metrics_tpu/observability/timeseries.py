@@ -1,0 +1,505 @@
+"""Sketch-backed telemetry time series: fixed-capacity ring-of-buckets
+windows over every hot-path signal the recorder emits.
+
+PR 1/2's recorder answers *what happened since reset* — monotone counters
+and one-shot exports with no notion of time. A live serving job needs
+*windowed* answers ("p99 update latency over the last minute", "drop rate
+right now", "is the async queue saturating"), which means per-interval
+state that expires. This module is that layer:
+
+* A :class:`TelemetrySeries` is a **ring of time buckets**. Each bucket
+  covers ``bucket_seconds`` of wall time, keyed by the absolute bucket
+  index ``int(t / bucket_seconds)`` — so buckets align across processes
+  and the ring self-expires (a slot whose index has fallen out of the
+  ring's span is reset on the next write or ignored on read). Memory is
+  fixed: ``n_buckets`` buckets, never more.
+* A ``"distribution"`` series backs each bucket with a ``qsketch`` state
+  (:mod:`metrics_tpu.sketches.quantile`) — the SAME fixed-capacity
+  mergeable quantile sketch the metric states use — so windowed
+  p50/p95/p99 queries are a fold of :func:`qsketch_merge_into` over the
+  window's buckets and one :func:`qsketch_quantile`, with the sketch's
+  advertised :func:`rank_error_bound` as the accuracy contract. A
+  ``"counter"`` series skips the sketch and tracks windowed sums/rates.
+* **Hot-path cost is host-only**: ``record()`` appends to a per-bucket
+  pending list and updates count/sum/min/max — no jax dispatch. Pending
+  values are folded into the bucket's sketch in fixed-shape batches
+  (padded to ``sketch_capacity`` with weight-0 rows, so every flush hits
+  the same cached ``_absorb`` compilation) only at query/export time or
+  when the pending list crosses its bound.
+* **Cross-host aggregation reuses the merge contract**: a series
+  serializes to a JSON-safe payload (occupied sketch rows only) that
+  ``aggregate_across_hosts`` ships over the existing padded-uint8
+  allgather; same-index buckets merge by summing counts and
+  ``qsketch_merge``-ing sketches, so a fleet-wide windowed p99 is a fold
+  — the seed of the ROADMAP's merge-tree collector.
+
+The registry is wired into the default recorder via
+``get_recorder().attach_timeseries()``; the recorder then feeds the
+standard series (named by the ``SERIES_*`` constants in ``recorder.py``)
+from its existing hooks at zero extra cost when detached. The health/SLO
+engine (:mod:`metrics_tpu.observability.health`) evaluates its alarm
+rules over these windows. See docs/observability.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "TelemetrySeries",
+    "TimeSeriesRegistry",
+    "merge_registry_payloads",
+    "registry_from_payload",
+    "series_from_payload",
+]
+
+#: accepted series kinds — "distribution" buckets carry a quantile sketch,
+#: "counter" buckets only the count/sum/min/max scalars
+KINDS = ("distribution", "counter")
+
+
+class _Bucket:
+    """One ring slot: scalar aggregates + (distribution series) a pending
+    host-value list and the qsketch leaf it folds into."""
+
+    __slots__ = ("index", "count", "total", "vmin", "vmax", "pending", "sketch")
+
+    def __init__(self, index: int) -> None:
+        self.index = index
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.pending: List[float] = []
+        self.sketch: Any = None
+
+
+class TelemetrySeries:
+    """Windowed telemetry over one signal.
+
+    ``record(value)`` is the host-only hot path; ``rate``/``mean``/
+    ``value_max``/``quantile`` answer windowed queries; ``to_payload`` /
+    :func:`merge_series_payloads` / :func:`series_from_payload` carry the
+    series across hosts. All methods are thread-safe (worker threads and
+    the serving loop record concurrently; exporters query concurrently).
+
+    ``clock`` defaults to wall time (``time.time``) so bucket indexes
+    align across processes; tests and simulations may inject their own.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        kind: str = "distribution",
+        bucket_seconds: float = 1.0,
+        n_buckets: int = 60,
+        sketch_capacity: int = 128,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"series kind must be one of {KINDS}, got {kind!r}")
+        if bucket_seconds <= 0:
+            raise ValueError(f"bucket_seconds must be positive, got {bucket_seconds}")
+        if n_buckets < 2:
+            raise ValueError(f"n_buckets must be >= 2, got {n_buckets}")
+        if sketch_capacity < 8:
+            raise ValueError(f"sketch_capacity must be >= 8, got {sketch_capacity}")
+        self.name = name
+        self.kind = kind
+        self.bucket_seconds = float(bucket_seconds)
+        self.n_buckets = int(n_buckets)
+        self.sketch_capacity = int(sketch_capacity)
+        self.clock = clock if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._ring: List[Optional[_Bucket]] = [None] * self.n_buckets
+        #: pending-list bound before an inline sketch flush — bounds worst-
+        #: case host memory per bucket without a per-record jax dispatch
+        self._flush_at = max(4 * self.sketch_capacity, 512)
+
+    # ------------------------------------------------------------------
+    # hot path
+    # ------------------------------------------------------------------
+    def record(self, value: float, t: Optional[float] = None) -> None:
+        """Add one observation (distribution) or increment (counter) at
+        time ``t`` (default: now). O(1) host work; the only jax dispatch
+        this can trigger is the bounded inline flush of an overfull
+        pending list."""
+        t = self.clock() if t is None else float(t)
+        idx = int(t // self.bucket_seconds)
+        value = float(value)
+        with self._lock:
+            b = self._slot(idx)
+            b.count += 1
+            b.total += value
+            if value < b.vmin:
+                b.vmin = value
+            if value > b.vmax:
+                b.vmax = value
+            if self.kind == "distribution":
+                b.pending.append(value)
+                if len(b.pending) >= self._flush_at:
+                    self._flush(b)
+
+    def _slot(self, idx: int) -> _Bucket:
+        """The live bucket for absolute index ``idx`` — resetting the slot
+        if its previous occupant has expired out of the ring's span.
+        Caller holds the lock."""
+        pos = idx % self.n_buckets
+        b = self._ring[pos]
+        if b is None or b.index != idx:
+            b = _Bucket(idx)
+            self._ring[pos] = b
+        return b
+
+    # ------------------------------------------------------------------
+    # sketch materialization
+    # ------------------------------------------------------------------
+    def _flush(self, b: _Bucket) -> None:
+        """Fold the bucket's pending values into its sketch. Pads each
+        chunk to the fixed ``sketch_capacity`` shape with weight-0 rows
+        (the ``n_valid`` mask contract), so every flush — whatever the
+        pending length — reuses ONE cached compilation of the absorb
+        kernel instead of compiling per ragged length. Caller holds the
+        lock."""
+        if not b.pending:
+            return
+        import jax.numpy as jnp
+        import numpy as np
+
+        from metrics_tpu.sketches.quantile import qsketch_init, qsketch_insert
+
+        vals = b.pending
+        b.pending = []
+        if b.sketch is None:
+            b.sketch = qsketch_init(self.sketch_capacity)
+        cap = self.sketch_capacity
+        buf = np.zeros((cap,), np.float32)
+        for lo in range(0, len(vals), cap):
+            chunk = vals[lo : lo + cap]
+            buf[: len(chunk)] = chunk
+            buf[len(chunk) :] = 0.0
+            b.sketch = qsketch_insert(
+                b.sketch, jnp.asarray(buf), n_valid=jnp.asarray(len(chunk), jnp.int32)
+            )
+
+    # ------------------------------------------------------------------
+    # windowed queries
+    # ------------------------------------------------------------------
+    def _window(self, window_s: Optional[float], now: Optional[float]) -> List[_Bucket]:
+        """Live buckets inside ``[now - window_s, now]`` (whole ring span
+        when ``window_s`` is None). Caller holds the lock."""
+        now = self.clock() if now is None else float(now)
+        hi = int(now // self.bucket_seconds)
+        if window_s is None:
+            lo = hi - self.n_buckets + 1
+        else:
+            lo = int((now - float(window_s)) // self.bucket_seconds) + 1
+            # a window narrower than one bucket still covers the CURRENT
+            # bucket (else sub-bucket windows read empty and a rule over
+            # them can never fire)
+            lo = min(lo, hi)
+            lo = max(lo, hi - self.n_buckets + 1)
+        out = []
+        for idx in range(lo, hi + 1):
+            b = self._ring[idx % self.n_buckets]
+            if b is not None and b.index == idx and b.count:
+                out.append(b)
+        return out
+
+    def count(self, window_s: Optional[float] = None, now: Optional[float] = None) -> int:
+        """Observations recorded inside the window."""
+        with self._lock:
+            return sum(b.count for b in self._window(window_s, now))
+
+    def total(self, window_s: Optional[float] = None, now: Optional[float] = None) -> float:
+        """Sum of recorded values inside the window (a counter's windowed
+        increment total)."""
+        with self._lock:
+            return float(sum(b.total for b in self._window(window_s, now)))
+
+    def rate(self, window_s: float, now: Optional[float] = None) -> float:
+        """Windowed rate: summed values per second over ``window_s``."""
+        return self.total(window_s, now) / float(window_s)
+
+    def mean(self, window_s: Optional[float] = None, now: Optional[float] = None) -> Optional[float]:
+        with self._lock:
+            buckets = self._window(window_s, now)
+            n = sum(b.count for b in buckets)
+            if not n:
+                return None
+            return float(sum(b.total for b in buckets)) / n
+
+    def value_min(self, window_s: Optional[float] = None, now: Optional[float] = None) -> Optional[float]:
+        with self._lock:
+            buckets = self._window(window_s, now)
+            if not buckets:
+                return None
+            return float(min(b.vmin for b in buckets))
+
+    def value_max(self, window_s: Optional[float] = None, now: Optional[float] = None) -> Optional[float]:
+        with self._lock:
+            buckets = self._window(window_s, now)
+            if not buckets:
+                return None
+            return float(max(b.vmax for b in buckets))
+
+    def quantile(
+        self,
+        q: float,
+        window_s: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Optional[float]:
+        """Windowed quantile from the merged per-bucket sketches
+        (``None`` when the window is empty; distribution series only).
+        Accuracy follows :func:`metrics_tpu.sketches.quantile.
+        rank_error_bound` for the window's observation count — exact
+        inside the lossless window, capacity-bounded rank error past it."""
+        out = self.quantiles((q,), window_s=window_s, now=now)
+        return out[0] if out is not None else None
+
+    def quantiles(
+        self,
+        qs: Sequence[float],
+        window_s: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Optional[List[float]]:
+        """Several windowed quantiles from ONE merged sketch (one merge
+        fold + one query, however many quantiles)."""
+        if self.kind != "distribution":
+            raise ValueError(
+                f"series `{self.name}` is a counter; quantiles need a distribution series"
+            )
+        from metrics_tpu.sketches.quantile import qsketch_merge_into, qsketch_quantile
+
+        # flush + collect sketch REFS under the lock, but run the merge
+        # fold and quantile query (jax dispatches, first call compiles)
+        # OUTSIDE it — holding the lock through device work would block
+        # every record() feeding this series for the whole export tick
+        with self._lock:
+            buckets = self._window(window_s, now)
+            for b in buckets:
+                self._flush(b)
+            sketches = [b.sketch for b in buckets if b.sketch is not None]
+        if not sketches:
+            return None
+        # sketch leaves are immutable jnp arrays: a concurrent record()
+        # swaps the bucket's ref, never mutates ours
+        merged = qsketch_merge_into(sketches[0], *sketches[1:])
+        import jax.numpy as jnp
+
+        vals = qsketch_quantile(merged, jnp.asarray(list(qs), jnp.float32))
+        return [float(v) for v in vals]
+
+    def _live_buckets(self) -> List[_Bucket]:
+        """Every non-empty slot in the ring, oldest first — by construction
+        within the ring's span of the newest write, with NO clock involved
+        (a snapshot must capture whatever was recorded, even when the data
+        carried explicit timestamps far from this host's wall clock).
+        Caller holds the lock."""
+        return sorted(
+            (b for b in self._ring if b is not None and b.count), key=lambda b: b.index
+        )
+
+    def window_count(self) -> int:
+        """Non-empty buckets currently in the ring."""
+        with self._lock:
+            return len(self._live_buckets())
+
+    # ------------------------------------------------------------------
+    # serialization / merge
+    # ------------------------------------------------------------------
+    def to_payload(self) -> Dict[str, Any]:
+        """JSON-safe snapshot of the live ring (the unit the cross-host
+        allgather ships). Sketches serialize occupied rows only, so a
+        mostly-empty window stays small on the wire."""
+        # flush + snapshot scalars/sketch refs under the lock; the host
+        # readback (np.asarray syncs the device) runs outside it so the
+        # record() hot path never waits on serialization
+        with self._lock:
+            snap = []
+            for b in self._live_buckets():
+                self._flush(b)
+                snap.append((b.index, b.count, b.total, b.vmin, b.vmax, b.sketch))
+        buckets = []
+        for index, count, total, vmin, vmax, sketch in snap:
+            row: Dict[str, Any] = {"i": index, "c": count, "s": total, "mn": vmin, "mx": vmax}
+            if sketch is not None:
+                import numpy as np
+
+                arr = np.asarray(sketch)
+                occ = arr[arr[:, 0] > 0]
+                row["sk"] = [[float(x) for x in r] for r in occ]
+            buckets.append(row)
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "bucket_seconds": self.bucket_seconds,
+            "n_buckets": self.n_buckets,
+            "sketch_capacity": self.sketch_capacity,
+            "buckets": buckets,
+        }
+
+    def load_payload(self, payload: Dict[str, Any]) -> "TelemetrySeries":
+        """Install a payload's buckets into this (expected empty) series —
+        the read side of :func:`series_from_payload`."""
+        import jax.numpy as jnp
+
+        from metrics_tpu.sketches.quantile import qsketch_init, qsketch_merge
+
+        with self._lock:
+            for row in payload.get("buckets", []):
+                idx = int(row["i"])
+                existing = self._ring[idx % self.n_buckets]
+                if existing is not None and existing.index > idx:
+                    # the slot holds FRESHER data (a straggler host shipped
+                    # buckets older than the ring span) — installing the
+                    # stale bucket via _slot would evict the newer one; the
+                    # stale bucket is outside every live window anyway
+                    continue
+                b = self._slot(idx)
+                b.count += int(row["c"])
+                b.total += float(row["s"])
+                b.vmin = min(b.vmin, float(row["mn"]))
+                b.vmax = max(b.vmax, float(row["mx"]))
+                rows = row.get("sk")
+                if rows:
+                    self._flush(b)
+                    # a payload from a larger-capacity peer may carry more
+                    # occupied rows than our capacity; merge chunks it down
+                    incoming = jnp.zeros((max(self.sketch_capacity, len(rows)), 2), jnp.float32)
+                    incoming = incoming.at[: len(rows)].set(jnp.asarray(rows, jnp.float32))
+                    if b.sketch is None:
+                        b.sketch = qsketch_init(self.sketch_capacity)
+                    b.sketch = qsketch_merge(b.sketch, incoming)
+        return self
+
+    def reset(self) -> "TelemetrySeries":
+        with self._lock:
+            self._ring = [None] * self.n_buckets
+        return self
+
+
+def series_from_payload(
+    payload: Dict[str, Any], clock: Optional[Callable[[], float]] = None
+) -> TelemetrySeries:
+    """Reconstruct a queryable series from one (possibly merged) payload."""
+    s = TelemetrySeries(
+        payload["name"],
+        kind=payload.get("kind", "distribution"),
+        bucket_seconds=payload.get("bucket_seconds", 1.0),
+        n_buckets=payload.get("n_buckets", 60),
+        sketch_capacity=payload.get("sketch_capacity", 128),
+        clock=clock,
+    )
+    return s.load_payload(payload)
+
+
+def merge_series_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge same-series payloads from several hosts into one.
+
+    Buckets align on their absolute index (wall-clock bucketing makes
+    same-index buckets the same time interval on every host): counts and
+    sums add, min/max fold, and sketches merge through
+    :func:`qsketch_merge_into` — so a quantile over the merged payload is
+    within the sketch's advertised rank-error bound of the same quantile
+    over the pooled raw observations (pinned by test). Payloads may
+    disagree on capacity/layout across a mixed-version fleet; the first
+    payload's geometry wins and the rest fold into it."""
+    if not payloads:
+        return {}
+    base = series_from_payload(payloads[0])
+    for p in payloads[1:]:
+        base.load_payload(p)
+    return base.to_payload()
+
+
+class TimeSeriesRegistry:
+    """Named-series registry with one shared geometry (bucket width, ring
+    length, sketch capacity) and one clock.
+
+    ``observe(name, value, kind=...)`` is the get-or-create hot path the
+    recorder's feed hooks call. ``payload()`` snapshots every series for
+    ``aggregate_across_hosts``; :func:`merge_registry_payloads` folds the
+    per-host snapshots."""
+
+    def __init__(
+        self,
+        bucket_seconds: float = 1.0,
+        n_buckets: int = 60,
+        sketch_capacity: int = 128,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.bucket_seconds = float(bucket_seconds)
+        self.n_buckets = int(n_buckets)
+        self.sketch_capacity = int(sketch_capacity)
+        self.clock = clock if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._series: Dict[str, TelemetrySeries] = {}
+
+    def series(self, name: str, kind: str = "distribution") -> TelemetrySeries:
+        """Get-or-create the named series (first caller's ``kind`` wins)."""
+        s = self._series.get(name)
+        if s is None:
+            with self._lock:
+                s = self._series.get(name)
+                if s is None:
+                    s = self._series[name] = TelemetrySeries(
+                        name,
+                        kind=kind,
+                        bucket_seconds=self.bucket_seconds,
+                        n_buckets=self.n_buckets,
+                        sketch_capacity=self.sketch_capacity,
+                        clock=self.clock,
+                    )
+        return s
+
+    def observe(
+        self, name: str, value: float, kind: str = "distribution", t: Optional[float] = None
+    ) -> None:
+        self.series(name, kind=kind).record(value, t=t)
+
+    def get(self, name: str) -> Optional[TelemetrySeries]:
+        return self._series.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def payload(self) -> Dict[str, Any]:
+        """``{series name: series payload}`` for every registered series."""
+        with self._lock:
+            series = list(self._series.values())
+        return {s.name: s.to_payload() for s in series}
+
+    def reset(self) -> "TimeSeriesRegistry":
+        """Clear every series' data (registrations and geometry stay)."""
+        with self._lock:
+            series = list(self._series.values())
+        for s in series:
+            s.reset()
+        return self
+
+
+def merge_registry_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-host registry payloads: series align by name, and a host
+    missing a series (mixed-version fleet, workload skew) simply
+    contributes nothing — absent keys are identity, never an error."""
+    names: Dict[str, List[Dict[str, Any]]] = {}
+    for p in payloads:
+        if not isinstance(p, dict):
+            continue
+        for name, sp in p.items():
+            names.setdefault(name, []).append(sp)
+    return {name: merge_series_payloads(sps) for name, sps in sorted(names.items())}
+
+
+def registry_from_payload(
+    payload: Dict[str, Any], clock: Optional[Callable[[], float]] = None
+) -> TimeSeriesRegistry:
+    """Reconstruct a queryable registry from a (possibly merged) registry
+    payload — how an aggregator queries fleet-wide windowed quantiles."""
+    reg = TimeSeriesRegistry(clock=clock)
+    for name, sp in payload.items():
+        reg._series[name] = series_from_payload(sp, clock=clock)
+    return reg

@@ -111,10 +111,15 @@ def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
     Every ``span`` event becomes one complete ("X") trace event with
     microsecond ``ts``/``dur``; nesting renders from ts/dur containment per
     (pid, tid) track, exactly how the contextvars stack nested them.
-    Duration-carrying lifecycle events (``update``/``compute``/``forward``)
-    and ``sync``/``compile`` rows are included too, so the Perfetto view
-    shows the same stream the JSONL export does. Rank-zero gated: returns
-    the path written, or ``None`` on non-zero ranks.
+    Duration-carrying lifecycle events (``update``/``compute``/``forward``),
+    ``sync``/``compile`` rows, and the async-pipeline transitions
+    (``enqueue``/``dequeue``/``flush`` — which carry the recording thread's
+    id) are included too, so the Perfetto view shows the same stream the
+    JSONL export does. The recorder's tid -> thread-name map is emitted as
+    ``thread_name``/``process_name`` metadata, so the async worker's rows
+    land on their own LABELED track (``metrics-tpu-async-update``) instead
+    of interleaving with the main thread. Rank-zero gated: returns the
+    path written, or ``None`` on non-zero ranks.
     """
     if _process_index() != 0:
         return None
@@ -127,7 +132,19 @@ def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
     span_tid = {
         ev["span_id"]: ev.get("tid", 0) for ev in all_events if ev.get("type") == "span"
     }
-    trace_events: List[Dict[str, Any]] = []
+    trace_events: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"metrics_tpu rank {pid} ({rec.name})"},
+        }
+    ]
+    for tid, tname in sorted(rec.thread_names().items()):
+        trace_events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": int(tid), "args": {"name": tname}}
+        )
     for ev in all_events:
         etype = ev.get("type")
         dur_ms = ev.get("dur_ms")
@@ -139,6 +156,12 @@ def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
             name = f"{etype}:{ev.get('source') or ev.get('metric') or ev.get('entry') or '?'}"
             if dur_ms is None:
                 dur_ms = ev.get("compile_ms", 0.0)
+        elif etype in ("enqueue", "dequeue", "flush"):
+            # async-pipeline transitions: stamped with the recording
+            # thread's id, so dequeues render on the worker's labeled track
+            name = f"async.{etype}"
+            if ev.get("batch_index") is not None:
+                name = f"{name}[{ev['batch_index']}]"
         else:
             continue
         dur_ms = float(dur_ms or 0.0)

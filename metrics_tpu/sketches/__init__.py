@@ -32,6 +32,7 @@ from .quantile import (
     qsketch_init,
     qsketch_insert,
     qsketch_merge,
+    qsketch_merge_into,
     qsketch_quantile,
     qsketch_rank,
     qsketch_total_weight,
@@ -67,6 +68,7 @@ __all__ = [
     "qsketch_init",
     "qsketch_insert",
     "qsketch_merge",
+    "qsketch_merge_into",
     "qsketch_quantile",
     "qsketch_rank",
     "qsketch_total_weight",

@@ -213,6 +213,17 @@ def qsketch_merge(a: Array, b: Array) -> Array:
     return out
 
 
+def qsketch_merge_into(dst: Array, *others: Array) -> Array:
+    """Fold any number of sketches into ``dst``'s capacity (left fold of
+    :func:`qsketch_merge`) and return the result. The convenience shape the
+    fan-in consumers use — telemetry time-series window queries merge a run
+    of per-bucket sketches, and cross-host aggregation merges one sketch
+    per rank — without each spelling the fold loop."""
+    for other in others:
+        dst = qsketch_merge(dst, other)
+    return dst
+
+
 class _QSketchReduce:
     """``dist_reduce_fx`` for quantile-sketch leaves: takes the stacked
     per-rank leaves ``[world, capacity, cols]`` (the contract both

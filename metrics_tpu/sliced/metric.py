@@ -269,12 +269,28 @@ class SlicedMetric(Metric):
             # under the fused kernel this records once per TRACE (shapes are
             # static), on the eager path once per update — mirroring the
             # sync-byte accounting convention in parallel/distributed.py
+            hot_rows = None
+            if _TELEMETRY.timeseries is not None and _is_concrete(slice_ids) and n_rows:
+                # hottest-slice row count of THIS batch (eager path only —
+                # needs concrete ids): its share of the batch feeds the
+                # windowed hot-slice-skew series the health layer alarms on.
+                # Gated on an attached registry — the bincount forces a
+                # device readback, and counters-only telemetry must not pay
+                # it for a series nothing consumes. Out-of-range ids are
+                # clipped to match the scatter's drop semantics closely
+                # enough for a skew signal.
+                binc = np.bincount(
+                    np.clip(np.asarray(slice_ids), 0, num - 1).astype(np.int64),
+                    minlength=1,
+                )
+                hot_rows = int(binc.max())
             _TELEMETRY.record_sliced_scatter(
                 self,
                 n_rows=n_rows,
                 n_slices=num,
                 n_leaves=len(m._reductions),
                 in_jit=isinstance(slice_ids, jax.core.Tracer),
+                hot_rows=hot_rows,
             )
 
     def _compute(self) -> Any:
@@ -326,6 +342,18 @@ class SlicedMetric(Metric):
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+    def hot_slices(self, k: int = 10) -> Tuple[Array, Array]:
+        """The ``k`` slices with the most ingested rows and each one's
+        share of ALL ingested rows — the cumulative skew view behind the
+        hot-slice alarm (the per-batch share feeds the windowed series;
+        this is the since-reset answer to "which tenants are hot")."""
+        if not isinstance(k, int) or k <= 0:
+            raise MetricsUserError(f"`k` must be a positive int, got {k!r}")
+        counts = self.slice_counts
+        total = jnp.clip(jnp.sum(counts), 1, None)
+        top_counts, ids = jax.lax.top_k(counts, min(k, self.num_slices))
+        return ids, top_counts.astype(jnp.float32) / total.astype(jnp.float32)
+
     def state_footprint(self, include_children: bool = True) -> Dict[str, int]:
         """Per-state bytes with every key under ``sliced/`` — the telemetry
         recorder splits on the prefix so sliced-state growth tracks under a

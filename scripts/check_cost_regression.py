@@ -80,6 +80,7 @@ AUX_FIELDS: Dict[str, str] = {
     "sketch_state_bytes_frac": "lower",
     "sketch_auroc_abs_err": "lower",
     "sketch_fused_compiles": "lower",
+    "fused_telemetry_on_ratio": "higher",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a

@@ -1,0 +1,385 @@
+"""Health/SLO engine tests (ISSUE 11 tentpole): threshold + burn-rate rule
+semantics, the six standard alarm classes tripping AND clearing (the
+fault-injection acceptance pin, driven synthetically here and end-to-end by
+the serving-loop smoke), snapshot status escalation, the JSONL alarm log,
+Prometheus/terminal rendering, and the PeriodicExporter hardening
+satellite (export_errors counted, thread keeps ticking)."""
+import json
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.aggregation import MeanMetric
+from metrics_tpu.observability import (
+    BurnRateRule,
+    HealthMonitor,
+    PeriodicExporter,
+    ThresholdRule,
+    default_rules,
+    export_perfetto,
+    get_recorder,
+    render_health,
+    render_prometheus,
+    summary,
+)
+from metrics_tpu.observability.recorder import (
+    SERIES_ASYNC_DROPPED,
+    SERIES_ASYNC_ENQUEUED,
+    SERIES_ASYNC_QUEUE_DEPTH,
+    SERIES_ASYNC_STALENESS,
+    SERIES_HOT_SLICE_SHARE,
+    SERIES_RECOMPILES,
+    SERIES_SKETCH_FILL,
+)
+from metrics_tpu.observability.timeseries import TimeSeriesRegistry
+
+T0 = 50_000.0
+
+#: the six standard alarm classes default_rules covers (the critical queue
+#: escalation rides the same class)
+ALARM_CLASSES = (
+    "queue_saturation",
+    "staleness",
+    "drop_rate",
+    "recompile_storm",
+    "sketch_fill",
+    "hot_slice_skew",
+)
+
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.detach_timeseries()
+        rec.reset()
+
+
+def _registry(**kwargs):
+    kwargs.setdefault("bucket_seconds", 1.0)
+    kwargs.setdefault("n_buckets", 60)
+    kwargs.setdefault("sketch_capacity", 64)
+    return TimeSeriesRegistry(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# rule semantics
+# ---------------------------------------------------------------------------
+
+def test_threshold_rule_fires_and_clears_with_the_window():
+    reg = _registry()
+    rule = ThresholdRule("hot", "lat", stat="max", threshold=100.0, window_s=5.0)
+    for i in range(5):
+        reg.observe("lat", 500.0, t=T0 + i)
+    firing, value, detail = rule.evaluate(reg, now=T0 + 5)
+    assert firing and value == 500.0 and "max(lat" in detail
+    # the same data, twenty seconds later: outside the window -> clear
+    firing, value, _ = rule.evaluate(reg, now=T0 + 25)
+    assert not firing and value is None
+
+
+def test_threshold_rule_stats_paths():
+    reg = _registry()
+    for i, v in enumerate([1.0, 2.0, 3.0, 4.0]):
+        reg.observe("s", v, t=T0 + i * 0.5)
+    now = T0 + 2
+    checks = {
+        "max": 4.0,
+        "min": 1.0,
+        "mean": 2.5,
+        "count": 4.0,
+        "total": 10.0,
+        "rate": 1.0,  # 10 over a 10s window
+    }
+    for stat, expect in checks.items():
+        window = 10.0
+        rule = ThresholdRule("r", "s", stat=stat, threshold=-1.0, window_s=window)
+        firing, value, _ = rule.evaluate(reg, now=now)
+        assert firing and value == pytest.approx(expect), stat
+    p95 = ThresholdRule("r", "s", stat="p95", threshold=3.5, window_s=10.0)
+    firing, value, _ = p95.evaluate(reg, now=now)
+    assert firing and value == pytest.approx(4.0, abs=0.5)
+
+
+def test_threshold_rule_min_count_and_absent_series():
+    reg = _registry()
+    rule = ThresholdRule("r", "missing", stat="max", threshold=0.0)
+    firing, value, detail = rule.evaluate(reg, now=T0)
+    assert not firing and "absent" in detail
+    rule = ThresholdRule("r", "s", stat="p95", threshold=0.0, min_count=5)
+    reg.observe("s", 10.0, t=T0)
+    firing, _, detail = rule.evaluate(reg, now=T0)
+    assert not firing and "observation" in detail
+
+
+def test_threshold_rule_validation():
+    with pytest.raises(ValueError, match="stat"):
+        ThresholdRule("r", "s", stat="p101x", threshold=1)
+    with pytest.raises(ValueError, match="op"):
+        ThresholdRule("r", "s", stat="max", threshold=1, op="!=")
+    with pytest.raises(ValueError, match="severity"):
+        ThresholdRule("r", "s", stat="max", threshold=1, severity="page")
+
+
+def test_burn_rate_rule_multiwindow():
+    reg = _registry()
+    # long window: 100 offered/s with zero drops, then a drop spike in the
+    # last 3 seconds (30% drop ratio)
+    for i in range(12):
+        reg.observe("ok", 100.0, kind="counter", t=T0 + i)
+    for i in range(9, 12):
+        reg.observe("bad", 43.0, kind="counter", t=T0 + i)
+    rule = BurnRateRule(
+        "drops", numerator="bad", denominator=("ok", "bad"), budget=0.01,
+        short_window_s=3.0, long_window_s=12.0, burn_threshold=2.0,
+    )
+    now = T0 + 12
+    firing, short_burn, detail = rule.evaluate(reg, now=now)
+    assert firing  # short burn ~30x budget, long burn ~9.7x
+    assert short_burn == pytest.approx((129.0 / 429.0) / 0.01, rel=1e-3)
+    # spike alone in the SHORT window but long window healthy -> no page
+    calm = BurnRateRule(
+        "drops2", numerator="bad", denominator=("ok", "bad"), budget=0.01,
+        short_window_s=3.0, long_window_s=12.0, burn_threshold=15.0,
+    )
+    firing, _, _ = calm.evaluate(reg, now=now)
+    assert not firing
+
+
+def test_burn_rate_rule_zero_traffic_never_fires():
+    reg = _registry()
+    rule = BurnRateRule("drops", numerator="bad", denominator="ok", budget=0.1,
+                        short_window_s=2.0, long_window_s=10.0)
+    firing, value, detail = rule.evaluate(reg, now=T0)
+    assert not firing and value is None and "no traffic" in detail
+
+
+def test_burn_rate_validation():
+    with pytest.raises(ValueError, match="budget"):
+        BurnRateRule("r", "a", "b", budget=1.5)
+    with pytest.raises(ValueError, match="short_window"):
+        BurnRateRule("r", "a", "b", budget=0.1, short_window_s=10, long_window_s=5)
+
+
+# ---------------------------------------------------------------------------
+# monitor: the six alarm classes trip AND clear (synthetic acceptance pin)
+# ---------------------------------------------------------------------------
+
+def _inject_fault_signals(reg, t):
+    """One synthetic burst of every standard fault signal at time ``t``."""
+    for i in range(6):
+        reg.observe(SERIES_ASYNC_QUEUE_DEPTH, 9.0, t=t + i * 0.1)
+        reg.observe(SERIES_ASYNC_STALENESS, 8.0, t=t + i * 0.1)
+        reg.observe(SERIES_ASYNC_ENQUEUED, 1.0, kind="counter", t=t + i * 0.1)
+        reg.observe(SERIES_ASYNC_DROPPED, 5.0, kind="counter", t=t + i * 0.1)
+        reg.observe(SERIES_RECOMPILES, 3.0, kind="counter", t=t + i * 0.1)
+        reg.observe(SERIES_SKETCH_FILL, 0.97, t=t + i * 0.1)
+        reg.observe(SERIES_HOT_SLICE_SHARE, 0.9, t=t + i * 0.1)
+
+
+def _inject_healthy_signals(reg, t):
+    for i in range(6):
+        reg.observe(SERIES_ASYNC_QUEUE_DEPTH, 1.0, t=t + i * 0.1)
+        reg.observe(SERIES_ASYNC_STALENESS, 0.0, t=t + i * 0.1)
+        reg.observe(SERIES_ASYNC_ENQUEUED, 10.0, kind="counter", t=t + i * 0.1)
+        reg.observe(SERIES_SKETCH_FILL, 0.1, t=t + i * 0.1)
+        reg.observe(SERIES_HOT_SLICE_SHARE, 0.05, t=t + i * 0.1)
+
+
+def test_all_six_alarm_classes_trip_and_clear(tmp_path):
+    """The acceptance pin, driven synthetically (deterministic, no sleeps):
+    every standard alarm class fires under fault signals and clears once
+    the windows roll past them."""
+    reg = _registry()
+    log = tmp_path / "alarms.jsonl"
+    monitor = HealthMonitor(
+        default_rules(window_s=5.0), registry=reg, alarm_log_path=str(log)
+    )
+    snap0 = monitor.evaluate(now=T0)
+    assert snap0.status == "ok" and not snap0.firing
+
+    _inject_fault_signals(reg, T0 + 1)
+    snap1 = monitor.evaluate(now=T0 + 2)
+    firing = {a.name for a in snap1.firing}
+    for cls in ALARM_CLASSES:
+        assert cls in firing, cls
+    assert "queue_saturation_critical" in firing
+    assert snap1.status == "critical"
+
+    # recovery: healthy signals, evaluated after the fault fell out of
+    # every window (max window 5s)
+    _inject_healthy_signals(reg, T0 + 10)
+    snap2 = monitor.evaluate(now=T0 + 11)
+    assert snap2.status == "ok" and not snap2.firing
+
+    cleared = monitor.fired_and_cleared()
+    for cls in ALARM_CLASSES:
+        assert cls in cleared, cls
+
+    # the JSONL alarm log carries one fired and one cleared row per alarm
+    rows = [json.loads(line) for line in log.read_text().splitlines()]
+    by_event = {}
+    for r in rows:
+        by_event.setdefault(r["event"], set()).add(r["alarm"])
+        assert r["severity"] in ("warn", "critical") and "t" in r
+    for cls in ALARM_CLASSES:
+        assert cls in by_event["fired"] and cls in by_event["cleared"]
+    cleared_rows = [r for r in rows if r["event"] == "cleared"]
+    assert all(r["duration_s"] >= 0 for r in cleared_rows)
+
+
+def test_status_escalation_warn_vs_critical():
+    reg = _registry()
+    reg.observe("s", 10.0, t=T0)
+    warn_rule = ThresholdRule("w", "s", stat="max", threshold=5.0, window_s=5.0, severity="warn")
+    crit_rule = ThresholdRule("c", "s", stat="max", threshold=50.0, window_s=5.0, severity="critical")
+    monitor = HealthMonitor([warn_rule, crit_rule], registry=reg)
+    snap = monitor.evaluate(now=T0 + 1)
+    assert snap.status == "warn"  # only the warn rule fires
+    reg.observe("s", 100.0, t=T0 + 1)
+    snap = monitor.evaluate(now=T0 + 2)
+    assert snap.status == "critical"
+
+
+def test_monitor_rejects_duplicate_rule_names():
+    r1 = ThresholdRule("same", "s", stat="max", threshold=1.0)
+    r2 = ThresholdRule("same", "s", stat="min", threshold=1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        HealthMonitor([r1, r2])
+
+
+def test_broken_rule_does_not_kill_the_sweep():
+    class Broken(ThresholdRule):
+        def evaluate(self, registry, now=None):
+            raise RuntimeError("boom")
+
+    reg = _registry()
+    reg.observe("s", 10.0, t=T0)
+    ok_rule = ThresholdRule("ok", "s", stat="max", threshold=5.0, window_s=5.0)
+    monitor = HealthMonitor([Broken("bad", "s", stat="max", threshold=1.0), ok_rule], registry=reg)
+    snap = monitor.evaluate(now=T0 + 1)
+    states = {a.name: a for a in snap.alarms}
+    assert not states["bad"].firing and "failed" in states["bad"].detail
+    assert states["ok"].firing
+
+
+def test_render_health_and_snapshot_json():
+    reg = _registry()
+    reg.observe("s", 10.0, t=T0)
+    monitor = HealthMonitor(
+        [ThresholdRule("loud", "s", stat="max", threshold=5.0, window_s=5.0)], registry=reg
+    )
+    snap = monitor.evaluate(now=T0 + 1)
+    text = render_health(snap)
+    assert "health: WARN" in text and "FIRING" in text and "loud" in text
+    doc = snap.to_json()
+    assert doc["status"] == "warn" and doc["alarms"][0]["name"] == "loud"
+    assert json.loads(json.dumps(doc)) == doc  # JSON-safe
+
+
+def test_prometheus_lines_and_exporter_integration(tmp_path, recorder):
+    registry = recorder.attach_timeseries(bucket_seconds=1.0, n_buckets=30, sketch_capacity=64)
+    registry.observe("s", 10.0)
+    monitor = HealthMonitor(
+        [ThresholdRule("loud", "s", stat="max", threshold=5.0, window_s=60.0)],
+        registry=registry,
+    )
+    prom_path = tmp_path / "metrics.prom"
+    exporter = PeriodicExporter(interval_s=30.0, prometheus_path=str(prom_path), health=monitor)
+    exporter.export_once()
+    page = prom_path.read_text()
+    assert "metrics_tpu_health_status 1" in page
+    assert 'metrics_tpu_alarm_firing{alarm="loud",severity="warn"} 1' in page
+    assert 'metrics_tpu_alarm_value{alarm="loud"} 10' in page
+    # the windowed families ride the same page, labeled with the seconds
+    # ACTUALLY covered (60s requested, clamped to the 30-bucket ring span)
+    assert 'metrics_tpu_window_quantile{series="s",q="0.99",window_s="30"}' in page
+    assert 'metrics_tpu_window_count{series="s",window_s="30"} 1' in page
+
+
+# ---------------------------------------------------------------------------
+# PeriodicExporter hardening satellite
+# ---------------------------------------------------------------------------
+
+def test_exporter_tick_failure_counted_and_thread_survives(tmp_path, recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((2,)))
+    bad_path = tmp_path / "no_such_dir" / "metrics.prom"  # _atomic_write fails
+    exporter = PeriodicExporter(interval_s=0.05, prometheus_path=str(bad_path))
+    with pytest.warns(UserWarning, match="PeriodicExporter tick failed"):
+        exporter.start()
+        deadline = time.time() + 5.0
+        while exporter.export_errors < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    try:
+        # several ticks failed, every one was counted, the thread is alive
+        assert exporter.export_errors >= 2
+        assert recorder.export_errors() >= 2
+        assert exporter._thread is not None and exporter._thread.is_alive()
+    finally:
+        exporter.stop()
+    # the count surfaces in the summary, the Prometheus page, and health
+    assert "exporter tick(s) failed" in summary(recorder)
+    page = render_prometheus(recorder)
+    sample = next(
+        line for line in page.splitlines()
+        if line.startswith("metrics_tpu_export_errors_total")
+    )
+    assert int(sample.split()[-1]) >= 2
+    monitor = HealthMonitor(default_rules(), recorder=recorder)
+    assert monitor.evaluate().export_errors >= 2
+
+
+def test_exporter_recovers_after_failures(tmp_path, recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((2,)))
+    missing = tmp_path / "later"
+    exporter = PeriodicExporter(interval_s=30.0, prometheus_path=str(missing / "m.prom"))
+    with pytest.raises(FileNotFoundError):
+        exporter.export_once()  # manual tick: raises to the caller
+    missing.mkdir()
+    exporter.export_once()  # same exporter, next tick succeeds
+    assert (missing / "m.prom").exists()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto thread-track satellite
+# ---------------------------------------------------------------------------
+
+def test_perfetto_async_worker_labeled_track(tmp_path, recorder):
+    from metrics_tpu import MeanSquaredError, MetricCollection
+
+    col = MetricCollection({"mse": MeanSquaredError()})
+    handle = col.compile_update_async(queue_depth=2)
+    x = jnp.ones((8,))
+    try:
+        for _ in range(3):
+            col.update_async(x, x)
+        handle.flush()
+    finally:
+        handle.close()
+    path = tmp_path / "trace.json"
+    export_perfetto(str(path), recorder=recorder)
+    doc = json.loads(path.read_text())
+    meta = {
+        (e["name"], e["args"]["name"]): e
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M"
+    }
+    names = [k[1] for k in meta]
+    assert any("metrics-tpu-async-update" in n for n in names)
+    assert any(k[0] == "process_name" for k in meta)
+    worker_meta = next(
+        e for (kind, n), e in meta.items()
+        if kind == "thread_name" and "metrics-tpu-async-update" in n
+    )
+    dequeues = [e for e in doc["traceEvents"] if e.get("cat") == "dequeue"]
+    assert dequeues and all(e["tid"] == worker_meta["tid"] for e in dequeues)
+    enqueues = [e for e in doc["traceEvents"] if e.get("cat") == "enqueue"]
+    assert enqueues and all(e["tid"] != worker_meta["tid"] for e in enqueues)

@@ -194,7 +194,14 @@ def test_collection_metric_sync_span_tree_and_perfetto(recorder, tmp_path):
     assert export_perfetto(path, recorder) == path
     doc = json.loads(Path(path).read_text())
     assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    # "M" rows are track-labeling metadata (process/thread names — the async
+    # worker's labeled track); every non-metadata row is a complete event
+    meta = [te for te in doc["traceEvents"] if te.get("ph") == "M"]
+    assert any(te["name"] == "process_name" for te in meta)
     for te in doc["traceEvents"]:
+        if te.get("ph") == "M":
+            assert {"pid", "tid", "name", "args"} <= set(te)
+            continue
         assert {"ph", "ts", "dur", "pid", "tid", "name"} <= set(te)
         assert te["ph"] == "X"
         assert te["ts"] >= 0 and te["dur"] >= 0

@@ -1,0 +1,82 @@
+"""End-to-end serving-loop observatory test (ISSUE 11 acceptance): the
+fault-injection demo trips and clears EVERY alarm class — queue,
+staleness, drop-rate, recompile, fill, hot-slice — while publishing
+telemetry + health artifacts the whole run.
+
+Real wall clock (the loop paces itself and alarm clearing IS time
+passing), so this is the suite's one deliberately slow-ish test (~15s);
+every injected fault is deterministic (bounded drop-policy queue vs an
+unpaced producer, a held snapshot lock, ragged shapes, an 85%-hot tenant,
+a sketch smaller than the burst) so the assertions do not race the box.
+"""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from metrics_tpu.observability import get_recorder
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "examples"))
+
+ALARM_CLASSES = (
+    "queue_saturation",
+    "staleness",
+    "drop_rate",
+    "recompile_storm",
+    "sketch_fill",
+    "hot_slice_skew",
+)
+
+
+def test_fault_injection_trips_and_clears_every_alarm_class(tmp_path):
+    import serving_loop
+
+    report = serving_loop.run(
+        duration=8.0,
+        inject="all",
+        out_dir=str(tmp_path),
+        qps=60.0,
+        batch_size=64,
+        queue_depth=8,
+        sketch_capacity=8192,
+        tenants=64,
+        bucket_seconds=0.5,
+        window_s=3.0,
+        export_interval_s=0.5,
+        seed=0,
+        verbose=False,
+    )
+    for cls in ALARM_CLASSES:
+        assert cls in report["alarms_fired"], (cls, report["alarms_fired"])
+        assert cls in report["alarms_fired_and_cleared"], (
+            cls,
+            report["alarms_fired_and_cleared"],
+            report["transitions"],
+        )
+    assert report["final_status"] == "ok"
+    assert report["async"]["dropped"] > 0  # the burst really shed load
+    assert report["async"]["max_queue_depth"] >= 8
+    assert report["export_errors"] == 0
+    assert 0.0 <= report["final_values"]["auroc"] <= 1.0
+
+    # the observatory's artifacts all materialized
+    rows = [json.loads(l) for l in (tmp_path / "health_alarms.jsonl").read_text().splitlines()]
+    fired = {r["alarm"] for r in rows if r["event"] == "fired"}
+    cleared = {r["alarm"] for r in rows if r["event"] == "cleared"}
+    for cls in ALARM_CLASSES:
+        assert cls in fired and cls in cleared
+    page = (tmp_path / "metrics.prom").read_text()
+    assert "metrics_tpu_health_status" in page
+    assert "metrics_tpu_window_quantile" in page
+    assert "metrics_tpu_async_batches_total" in page
+    assert "health:" in (tmp_path / "health.txt").read_text()
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    assert any(e.get("ph") == "M" for e in trace["traceEvents"])
+    assert (tmp_path / "telemetry.jsonl").stat().st_size > 0
+    assert json.loads((tmp_path / "report.json").read_text())["inject"] == "all"
+
+    # the demo leaves the default recorder exactly as it found it
+    rec = get_recorder()
+    assert not rec.enabled and rec.timeseries is None and rec.events() == []

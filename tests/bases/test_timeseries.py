@@ -1,0 +1,443 @@
+"""Windowed telemetry time-series tests (ISSUE 11 tentpole): ring-of-buckets
+semantics, sketch-backed windowed quantiles vs the advertised rank-error
+bound, cross-host payload merge (the acceptance pin), and the recorder feed
+wiring for every standard series."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import MeanMetric
+from metrics_tpu.classification import AUROC
+from metrics_tpu.observability import (
+    aggregate_across_hosts,
+    counter_payload,
+    get_recorder,
+    merge_payloads,
+)
+from metrics_tpu.observability.recorder import (
+    SERIES_ASYNC_AGE_MS,
+    SERIES_ASYNC_APPLY_MS,
+    SERIES_ASYNC_DROPPED,
+    SERIES_ASYNC_ENQUEUED,
+    SERIES_ASYNC_QUEUE_DEPTH,
+    SERIES_FUSED_DISPATCH_MS,
+    SERIES_HOT_SLICE_SHARE,
+    SERIES_INGEST_ROWS,
+    SERIES_RECOMPILES,
+    SERIES_SKETCH_FILL,
+    SERIES_SLICED_ROWS,
+    SERIES_UPDATE_MS,
+)
+from metrics_tpu.observability.timeseries import (
+    TelemetrySeries,
+    TimeSeriesRegistry,
+    merge_registry_payloads,
+    registry_from_payload,
+    series_from_payload,
+)
+from metrics_tpu.sketches.quantile import rank_error_bound
+from metrics_tpu.sliced import SlicedMetric
+
+T0 = 10_000.0  # explicit timestamps: no test below depends on the wall clock
+
+
+@pytest.fixture
+def recorder():
+    """Default recorder enabled with a windowed registry attached; ALWAYS
+    disabled + detached + reset after (the session guard pins it)."""
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    rec.attach_timeseries(bucket_seconds=1.0, n_buckets=60, sketch_capacity=64)
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.detach_timeseries()
+        rec.reset()
+
+
+# ---------------------------------------------------------------------------
+# ring / window semantics
+# ---------------------------------------------------------------------------
+
+def test_windowed_scalar_stats():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=10)
+    for i, v in enumerate([10.0, 20.0, 30.0, 40.0]):
+        s.record(v, t=T0 + i)  # one value per bucket
+    now = T0 + 3.5
+    assert s.count(None, now=now) == 4
+    assert s.count(2.0, now=now) == 2  # only the last two buckets
+    assert s.total(2.0, now=now) == 70.0
+    assert s.mean(2.0, now=now) == 35.0
+    assert s.value_min(2.0, now=now) == 30.0
+    assert s.value_max(None, now=now) == 40.0
+    assert s.rate(2.0, now=now) == pytest.approx(35.0)
+
+
+def test_bucket_expiry_is_ring_capacity():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=5)
+    s.record(1.0, t=T0)
+    assert s.count(None, now=T0) == 1
+    # 5 buckets later the slot's index has left the ring span
+    assert s.count(None, now=T0 + 10) == 0
+    # and a write that wraps onto the slot resets it rather than mixing eras
+    s.record(2.0, t=T0 + 5)  # same ring position as T0 (5 % 5)
+    assert s.total(None, now=T0 + 5) == 2.0
+
+
+def test_sub_bucket_window_includes_current_bucket():
+    # a window narrower than one bucket must still see the current bucket:
+    # a health rule tuned tighter than the bucket width would otherwise
+    # read an empty window and silently never fire
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=10)
+    s.record(100.0, t=T0 + 0.55)
+    assert s.count(0.5, now=T0 + 0.6) == 1
+    assert s.value_max(0.25, now=T0 + 0.9) == 100.0
+    assert s.quantile(0.5, window_s=0.25, now=T0 + 0.9) == pytest.approx(100.0)
+
+
+def test_empty_window_returns_none():
+    s = TelemetrySeries("lat")
+    assert s.mean(10, now=T0) is None
+    assert s.value_max(10, now=T0) is None
+    assert s.quantile(0.5, window_s=10, now=T0) is None
+
+
+def test_counter_series_rejects_quantiles():
+    s = TelemetrySeries("ops", kind="counter")
+    s.record(5, t=T0)
+    s.record(3, t=T0 + 0.5)
+    assert s.total(10, now=T0 + 1) == 8.0
+    with pytest.raises(ValueError, match="counter"):
+        s.quantile(0.5, window_s=10, now=T0 + 1)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="kind"):
+        TelemetrySeries("x", kind="gauge")
+    with pytest.raises(ValueError, match="bucket_seconds"):
+        TelemetrySeries("x", bucket_seconds=0)
+    with pytest.raises(ValueError, match="n_buckets"):
+        TelemetrySeries("x", n_buckets=1)
+    with pytest.raises(ValueError, match="sketch_capacity"):
+        TelemetrySeries("x", sketch_capacity=4)
+
+
+# ---------------------------------------------------------------------------
+# windowed quantiles: accuracy contract
+# ---------------------------------------------------------------------------
+
+def _rank_err(values: np.ndarray, estimate: float, q: float) -> float:
+    return abs(np.sum(values <= estimate) / len(values) - q)
+
+
+def test_quantiles_lossless_window_exact():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=10, sketch_capacity=64)
+    vals = np.arange(40, dtype=np.float64)  # fits capacity: zero rank error
+    for v in vals:
+        s.record(float(v), t=T0 + (v % 4))
+    for q in (0.1, 0.5, 0.9):
+        est = s.quantile(q, window_s=10, now=T0 + 4)
+        assert est in vals  # the estimate is an actual sample
+        assert _rank_err(vals, est, q) <= 1.0 / len(vals) + 1e-9
+
+
+def test_quantiles_within_advertised_rank_error_past_capacity():
+    rng = np.random.default_rng(7)
+    cap = 64
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=20, sketch_capacity=cap)
+    vals = rng.uniform(0.0, 100.0, 3000)
+    for i, v in enumerate(vals):
+        s.record(float(v), t=T0 + (i % 10))
+    # per-bucket sketches each hold ~300 inserts -> merged error is bounded
+    # by the advertised envelope for the pooled count
+    bound = rank_error_bound(len(vals), cap) / len(vals)
+    now = T0 + 10
+    qs = (0.5, 0.95, 0.99)
+    ests = s.quantiles(qs, window_s=20, now=now)
+    for q, est in zip(qs, ests):
+        assert _rank_err(vals, est, q) <= bound, (q, est)
+
+
+def test_quantile_windowing_excludes_old_buckets():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=30, sketch_capacity=64)
+    for i in range(100):
+        s.record(1000.0, t=T0 + 0.5)  # old spike
+    for i in range(100):
+        s.record(float(i % 10), t=T0 + 8.0)
+    est = s.quantile(0.99, window_s=3.0, now=T0 + 9.0)
+    assert est < 100  # the spike is outside the window
+    est_all = s.quantile(0.99, window_s=None, now=T0 + 9.0)
+    assert est_all >= 900  # whole-ring query still sees it
+
+
+def test_inline_flush_bound_many_values_one_bucket():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=4, sketch_capacity=16)
+    vals = np.arange(5000, dtype=np.float64)
+    for v in vals:
+        s.record(float(v), t=T0)  # all in ONE bucket; pending flushes inline
+    assert s.count(None, now=T0) == 5000
+    est = s.quantile(0.5, window_s=None, now=T0)
+    assert _rank_err(vals, est, 0.5) <= rank_error_bound(5000, 16) / 5000
+
+
+# ---------------------------------------------------------------------------
+# payloads and cross-host merge (the aggregate_across_hosts acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_payload_roundtrip_preserves_queries():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=10, sketch_capacity=64)
+    rng = np.random.default_rng(3)
+    vals = rng.normal(50, 10, 500)
+    for i, v in enumerate(vals):
+        s.record(float(v), t=T0 + (i % 5))
+    clone = series_from_payload(s.to_payload())
+    now = T0 + 5
+    assert clone.count(10, now=now) == s.count(10, now=now)
+    assert clone.total(10, now=now) == pytest.approx(s.total(10, now=now))
+    assert clone.quantile(0.95, window_s=10, now=now) == pytest.approx(
+        s.quantile(0.95, window_s=10, now=now), rel=0.05
+    )
+
+
+def test_merged_hosts_quantiles_within_bound_of_pooled():
+    """THE acceptance pin: quantiles over the cross-host-merged series stay
+    within the sketch's advertised rank-error bound of the same quantiles
+    over the pooled raw observations."""
+    rng = np.random.default_rng(0)
+    cap = 64
+    hosts = []
+    pooled = []
+    for h in range(3):  # three "hosts" with skewed distributions
+        reg = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=20, sketch_capacity=cap)
+        vals = rng.uniform(h * 40.0, h * 40.0 + 100.0, 700)
+        for i, v in enumerate(vals):
+            reg.observe("lat_ms", float(v), t=T0 + (i % 8))
+        hosts.append(reg.payload())
+        pooled.append(vals)
+    pooled = np.concatenate(pooled)
+    merged = registry_from_payload(merge_registry_payloads(hosts))
+    s = merged.get("lat_ms")
+    now = T0 + 8
+    assert s.count(20, now=now) == len(pooled)
+    assert s.total(20, now=now) == pytest.approx(float(pooled.sum()), rel=1e-5)
+    bound = rank_error_bound(len(pooled), cap) / len(pooled)
+    for q in (0.5, 0.95, 0.99):
+        est = s.quantile(q, window_s=20, now=now)
+        assert _rank_err(pooled, est, q) <= bound, (q, est)
+
+
+def test_merge_registry_payloads_heterogeneous_series_sets():
+    """A host missing a series (mixed-version fleet) contributes identity,
+    never an error."""
+    a = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=8)
+    a.observe("only_a", 1.0, t=T0)
+    a.observe("shared", 2.0, t=T0)
+    b = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=8)
+    b.observe("shared", 3.0, t=T0)
+    merged = merge_registry_payloads([a.payload(), b.payload(), {}])
+    reg = registry_from_payload(merged)
+    assert reg.get("only_a").count(None, now=T0) == 1
+    assert reg.get("shared").count(None, now=T0) == 2
+    assert reg.get("shared").total(None, now=T0) == 5.0
+
+
+def test_merge_stale_host_payload_does_not_evict_fresh_buckets():
+    """A straggler host whose buckets fell out of the ring span must not
+    wipe another host's live buckets sharing the same ring position."""
+    fresh = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=10)
+    fresh.observe("s", 5.0, t=T0 + 100)
+    stale = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=10)
+    stale.observe("s", 7.0, t=T0 + 90)  # same ring position, 10 buckets older
+    for order in ([fresh, stale], [stale, fresh]):
+        merged = registry_from_payload(
+            merge_registry_payloads([r.payload() for r in order])
+        )
+        s = merged.get("s")
+        assert s.count(5, now=T0 + 100) == 1
+        assert s.total(5, now=T0 + 100) == 5.0
+
+
+def test_registry_get_or_create_and_reset():
+    reg = TimeSeriesRegistry(bucket_seconds=0.5, n_buckets=8)
+    s1 = reg.series("a")
+    assert reg.series("a") is s1  # get-or-create
+    assert s1.bucket_seconds == 0.5  # geometry inherited
+    reg.observe("a", 1.0, t=T0)
+    reg.observe("b", 1.0, kind="counter", t=T0)
+    assert reg.names() == ["a", "b"]
+    reg.reset()
+    assert reg.names() == ["a", "b"]  # registrations survive
+    assert reg.get("a").count(None, now=T0) == 0  # data does not
+
+
+# ---------------------------------------------------------------------------
+# recorder feed wiring
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_and_recompile_feeds(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    m.update(jnp.ones((6,)))  # second distinct signature
+    float(m.compute())
+    ts = recorder.timeseries
+    assert ts.get(SERIES_UPDATE_MS).count(None) == 2
+    assert ts.get("compute_ms").count(None) == 1
+    # both signatures were new -> two compilation triggers
+    assert ts.get(SERIES_RECOMPILES).total(None) == 2.0
+    assert ts.get(SERIES_RECOMPILES).kind == "counter"
+
+
+def test_disabled_recorder_feeds_nothing():
+    rec = get_recorder()
+    rec.reset()
+    registry = rec.attach_timeseries(bucket_seconds=1.0, n_buckets=8)
+    try:
+        assert not rec.enabled
+        m = MeanMetric()
+        m.update(jnp.ones((4,)))
+        float(m.compute())
+        assert registry.names() == []  # hooks never ran: one-bool-check off path
+    finally:
+        rec.detach_timeseries()
+        rec.reset()
+
+
+def test_detach_stops_feeding(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    recorder.detach_timeseries()
+    m.update(jnp.ones((4,)))  # recorded as events, not as series points
+    assert recorder.timeseries is None
+    assert len(recorder.events()) >= 2
+
+
+def test_reset_clears_series_data_but_keeps_attachment(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    registry = recorder.timeseries
+    assert registry.get(SERIES_UPDATE_MS).count(None) == 1
+    recorder.reset()
+    assert recorder.timeseries is registry
+    assert registry.get(SERIES_UPDATE_MS).count(None) == 0
+
+
+def test_fused_and_async_feeds(recorder):
+    col = MetricCollection({"mse": MeanSquaredError(), "mean": MeanMetric()})
+    handle = col.compile_update_async(queue_depth=2, policy="drop")
+    x = jnp.ones((16,))
+    try:
+        for _ in range(5):
+            col.update_async(x, x)
+        handle.flush()
+    finally:
+        handle.close()
+    ts = recorder.timeseries
+    assert ts.get(SERIES_ASYNC_ENQUEUED).total(None) >= 1
+    applied = recorder.async_totals()["applied"]
+    assert ts.get(SERIES_ASYNC_APPLY_MS).count(None) == applied
+    assert ts.get(SERIES_ASYNC_AGE_MS).count(None) == applied
+    assert ts.get(SERIES_ASYNC_QUEUE_DEPTH).count(None) >= applied
+    assert ts.get(SERIES_FUSED_DISPATCH_MS).count(None) == applied
+    # ingest_rows: 16 rows per applied fused dispatch
+    assert ts.get(SERIES_INGEST_ROWS).total(None) == 16.0 * applied
+    dropped = recorder.async_totals()["dropped"]
+    if dropped:
+        assert ts.get(SERIES_ASYNC_DROPPED).total(None) == float(dropped)
+
+
+def test_sliced_hot_share_feed(recorder):
+    m = SlicedMetric(MeanSquaredError(), num_slices=8)
+    ids = jnp.asarray([0, 0, 0, 1], jnp.int32)  # 75% of rows hit slice 0
+    x = jnp.ones((4,), jnp.float32)
+    m.update(ids, x, x)
+    ts = recorder.timeseries
+    assert ts.get(SERIES_SLICED_ROWS).total(None) == 4.0
+    share = ts.get(SERIES_HOT_SLICE_SHARE)
+    assert share.count(None) == 1
+    assert share.value_max(None) == pytest.approx(0.75)
+    # without a registry attached the skew bincount (a device readback) is
+    # skipped entirely — counters-only telemetry must not pay for it
+    recorder.detach_timeseries()
+    m.update(ids, x, x)
+    scatter_events = [e for e in recorder.events() if e["type"] == "sliced_scatter"]
+    assert "hot_rows" in scatter_events[0] and "hot_rows" not in scatter_events[1]
+
+
+def test_sliced_hot_slices_api():
+    m = SlicedMetric(MeanSquaredError(), num_slices=8)
+    ids = jnp.asarray([3, 3, 3, 1], jnp.int32)
+    x = jnp.ones((4,), jnp.float32)
+    m.update(ids, x, x)
+    top_ids, shares = m.hot_slices(2)
+    assert int(top_ids[0]) == 3
+    assert float(shares[0]) == pytest.approx(0.75)
+
+
+def test_sketch_fill_feed(recorder):
+    auroc = AUROC(pos_label=1, sketch_capacity=64)
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random(48, dtype=np.float32))
+    target = jnp.asarray((rng.random(48) > 0.5).astype(np.int32))
+    auroc.update(preds, target)
+    float(auroc.compute())  # fill recorded from the cold compute path
+    s = recorder.timeseries.get(SERIES_SKETCH_FILL)
+    assert s is not None and s.count(None) >= 1
+    assert 0.0 < s.value_max(None) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# aggregate_across_hosts integration (+ heterogeneous-payload satellite)
+# ---------------------------------------------------------------------------
+
+def test_aggregate_payload_carries_timeseries(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    agg = aggregate_across_hosts(recorder)
+    assert agg["world_size"] == 1
+    assert SERIES_UPDATE_MS in agg["timeseries"]
+    reg = registry_from_payload(agg["timeseries"])
+    assert reg.get(SERIES_UPDATE_MS).count(None) == 1
+
+
+def test_merge_payloads_sums_timeseries_across_hosts(recorder):
+    m = MeanMetric()
+    m.update(jnp.ones((4,)))
+    local = counter_payload(recorder)
+    merged = merge_payloads([local, local])  # two identical "hosts"
+    reg = registry_from_payload(merged["timeseries"])
+    assert reg.get(SERIES_UPDATE_MS).count(None) == 2
+
+
+def test_merge_payloads_heterogeneous_families_are_identity():
+    """ISSUE 11 satellite: a mixed-version fleet where a host is missing
+    whole counter families must merge as zero/identity, not raise."""
+    full = {
+        "process": 1,
+        "call_counts": {"A|update": 3},
+        "call_times": {"A|update": 0.5},
+        "signature_counts": {"A.update": 2},
+        "sync_totals": {"sync_events": 1, "gather_bytes": 10, "pad_waste_bytes": 0},
+        "footprint_hwm": {"A": 128},
+        "compile_counts": {"A.update": 1},
+        "compile_times": {"A.update": 0.2},
+        "export_errors": 2,
+        "dropped_events": 1,
+    }
+    bare = {"process": 0}  # an ancient build: no families at all
+    merged = merge_payloads([bare, full])
+    assert merged["call_counts"] == {("A", "update"): 3}
+    assert merged["sync_totals"]["gather_bytes"] == 10
+    assert merged["footprint_hwm"] == {"A": 128}
+    assert merged["signature_counts"] == {"A.update": 2}
+    assert merged["export_errors"] == 2
+    assert merged["dropped_events"] == 1
+    assert merged["async_totals"].get("enqueued", 0) == 0
+    assert merged["timeseries"] == {}
+    # and the renderers accept the heterogeneous per-process payloads
+    from metrics_tpu.observability.exporters import render_prometheus
+
+    page = render_prometheus(aggregate=merged)
+    assert 'metrics_tpu_calls_total{metric="A",phase="update"} 3' in page
