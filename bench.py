@@ -1242,6 +1242,124 @@ def bench_sketch() -> None:
     )
 
 
+def bench_windowed() -> None:
+    """Windowed metric state vs plain all-of-time state (ISSUE 12).
+
+    A fused collection of ``WindowedMetric``-wrapped Accuracy+MSE streams
+    bucketed ragged batches next to the identical unwrapped collection.
+    The tentpole claims being gated:
+
+    * **Fusion intact** — the windowed collection compiles EXACTLY once
+      across three ragged bucketed batch shapes (``windowed_compiles``,
+      anchor 1): the ring rotation is a fixed-shape ``.at[slot].set`` and
+      the wrapper's slot-aware pad correction keeps bucketing exact.
+    * **Affordable window** — ``windowed_vs_plain`` is the fused
+      throughput ratio of the windowed collection over the plain one
+      (the R-fold state plus the rotation costs something; the anchor
+      gates it from collapsing).
+    * **Ring-fold exactness** — ``windowed_ring_fold_exact``
+      (BOOL_FIELDS) pins that a ring-window ``compute()`` on
+      integer-exact data is BIT-identical to recomputing the same
+      window's batches from scratch — the sliding window is the real
+      metric, not an approximation of it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.windowed import WindowedMetric
+
+    rng = np.random.RandomState(12)
+    bs = 2048
+    shapes = (bs - 512, bs, bs - 100)
+
+    def make_batches(n_batches):
+        out = []
+        for i in range(n_batches):
+            n = shapes[i % len(shapes)]
+            preds = rng.randint(0, 2, n).astype(np.int32)
+            target = rng.randint(0, 2, n).astype(np.int32)
+            out.append((jnp.asarray(preds), jnp.asarray(target)))
+        return out
+
+    def make_collection(windowed):
+        # num_classes keeps Accuracy's canonicalizer traceable so both
+        # members genuinely ride the fused kernel on both sides of the ratio
+        if windowed:
+            return MetricCollection(
+                {
+                    "acc": WindowedMetric(Accuracy(num_classes=2), window=8, updates_per_bucket=4),
+                    "mse": WindowedMetric(MeanSquaredError(), window=8, updates_per_bucket=4),
+                }
+            )
+        return MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+
+    def block(col):
+        for m in col.values():
+            for name in m._defaults:
+                val = getattr(m, name)
+                if isinstance(val, jnp.ndarray):
+                    jax.block_until_ready(val)
+
+    n_measure = 120
+    batches = make_batches(n_measure)
+
+    def rows_per_sec(windowed):
+        col = make_collection(windowed)
+        handle = col.compile_update(buckets=(bs,))
+        for b in batches[:6]:  # warm every bucket entry + group discovery
+            col.update(*b)
+        block(col)
+        best = 0.0
+        for _ in range(3):  # min-of-3: this box's CPU steal is noisy
+            t0 = time.perf_counter()
+            rows = 0
+            for b in batches[6:]:
+                col.update(*b)
+                rows += int(b[0].shape[0])
+            block(col)
+            best = max(best, rows / (time.perf_counter() - t0))
+        return best, handle
+
+    windowed_ups, whandle = rows_per_sec(True)
+    plain_ups, _ = rows_per_sec(False)
+
+    # ring-fold exactness on integer data: compute() over the ring must be
+    # bit-identical to recomputing the in-window batches from scratch
+    wm = WindowedMetric(MeanSquaredError(), window=4, updates_per_bucket=2)
+    parity_batches = [
+        (
+            jnp.asarray(rng.randint(0, 7, 256).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 7, 256).astype(np.float32)),
+        )
+        for _ in range(11)
+    ]
+    for b in parity_batches:
+        wm.update(*b)
+    # 11 updates, 2/bucket -> buckets 0..5; ring of 4 holds buckets 2..5 =
+    # updates 4..10
+    fresh = MeanSquaredError()
+    for b in parity_batches[4:]:
+        fresh.update(*b)
+    ring_fold_exact = float(wm.compute()) == float(fresh.compute())
+
+    print(
+        json.dumps(
+            {
+                "metric": "windowed_update_throughput",
+                "value": round(windowed_ups, 1),
+                "unit": "rows/sec",
+                "plain_rows_per_sec": round(plain_ups, 1),
+                "windowed_vs_plain": round(windowed_ups / plain_ups, 4),
+                "windowed_compiles": whandle.n_compiles,
+                "windowed_fused": whandle.n_compiles == 1,
+                "bucketed_shapes": len(shapes),
+                "windowed_ring_fold_exact": bool(ring_fold_exact),
+            }
+        )
+    )
+
+
 def bench_telemetry() -> None:
     """Micro-bench for the telemetry zero-overhead-when-disabled contract:
     per-call wall cost of ``Metric.update`` with the recorder disabled vs
@@ -1353,6 +1471,7 @@ SUBCOMMANDS = {
     "async": bench_async,
     "sliced": bench_sliced,
     "sketch": bench_sketch,
+    "windowed": bench_windowed,
 }
 
 
@@ -1435,7 +1554,7 @@ def main() -> None:
     import subprocess
 
     records = []  # every emitted JSON object, for the --baseline check
-    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "telemetry"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],

@@ -11,15 +11,17 @@ telemetry, windowed quantiles, and health the whole time:
   (update/fused-dispatch wall time, enqueue->apply age, queue depth,
   drops, recompiles, sketch fill, hot-slice share) into ring-of-buckets
   windows backed by ``qsketch`` states;
-* a :class:`HealthMonitor` with the six standard alarm classes (queue
+* a :class:`HealthMonitor` with the seven standard alarm classes (queue
   saturation, staleness, drop-rate SLO burn, recompile storm, sketch-fill
-  ceiling, hot-slice skew) evaluates them continuously, logging every
-  fired/cleared transition to a JSONL alarm log;
+  ceiling, hot-slice skew, score drift) evaluates them continuously,
+  logging every fired/cleared transition to a JSONL alarm log;
 * ``--inject`` drives a fault phase that demonstrably trips the alarms —
   ``bursts`` (unpaced producer vs a bounded drop-policy queue), ``stall``
   (a reader holding the state snapshot lock, i.e. a slow consumer),
-  ``recompiles`` (ragged batch shapes), ``skew`` (one hot tenant), or
-  ``all`` — followed by a recovery phase in which every alarm clears.
+  ``recompiles`` (ragged batch shapes), ``skew`` (one hot tenant),
+  ``drift`` (a shifted score distribution vs the reference window frozen
+  during warmup), or ``all`` — followed by a recovery phase in which
+  every alarm clears.
 
 Artifacts land in ``--out-dir``: ``metrics.prom`` (Prometheus page incl.
 windowed quantiles + health families), ``telemetry.jsonl`` (event log),
@@ -52,6 +54,7 @@ import jax.numpy as jnp
 from metrics_tpu import AUROC, MeanSquaredError, MetricCollection
 from metrics_tpu.aggregation import SumMetric
 from metrics_tpu.observability import (
+    DriftRule,
     HealthMonitor,
     PeriodicExporter,
     aggregate_across_hosts,
@@ -64,7 +67,7 @@ from metrics_tpu.observability import (
 )
 from metrics_tpu.sliced import SlicedMetric
 
-INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "all")
+INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "drift", "all")
 
 #: phase boundaries as fractions of --duration: steady warmup, fault
 #: injection, recovery (the collection is reset at the recovery boundary —
@@ -72,11 +75,23 @@ INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "all")
 WARMUP_FRAC, FAULT_END_FRAC = 0.18, 0.45
 
 
-def _make_batch(rng: np.random.Generator, n: int, hot_tenant: bool, tenants: int):
+def _make_batch(
+    rng: "np.random.Generator", n: int, hot_tenant: bool, tenants: int, drifting: bool = False
+):
     """One simulated traffic batch: binary targets, noisy scores, and
-    row-aligned tenant ids (85% to tenant 0 under skew injection)."""
+    row-aligned tenant ids (85% to tenant 0 under skew injection). Under
+    drift injection the score distribution SHIFTS — a calibration
+    regression upstream of any label — which is exactly the signal the
+    score-drift alarm compares against its frozen reference window."""
     target = rng.integers(0, 2, n)
-    preds = np.clip(target * 0.7 + rng.normal(0.3, 0.25, n), 0.0, 1.0)
+    if drifting:
+        # shifted marginal: scores collapse into a tight high cluster
+        # regardless of label — far enough from the bimodal healthy
+        # marginal that even a half-drifted live window scores well past
+        # the alarm threshold
+        preds = np.clip(target * 0.08 + rng.normal(0.86, 0.07, n), 0.0, 1.0)
+    else:
+        preds = np.clip(target * 0.7 + rng.normal(0.3, 0.25, n), 0.0, 1.0)
     if hot_tenant:
         ids = np.where(rng.random(n) < 0.85, 0, rng.integers(0, tenants, n))
     else:
@@ -85,6 +100,7 @@ def _make_batch(rng: np.random.Generator, n: int, hot_tenant: bool, tenants: int
         jnp.asarray(preds, jnp.float32),
         jnp.asarray(target, jnp.int32),
         jnp.asarray(ids, jnp.int32),
+        preds,  # host copy: the sampled-score feed must not pay a device read
     )
 
 
@@ -130,6 +146,14 @@ def run(
             fill_ceiling=0.5,
             hot_share_limit=0.5,
             window_s=window_s,
+            # the reference is frozen EXPLICITLY at the warmup boundary
+            # below (count-gated auto-freeze trusts traffic-rate timing,
+            # and a cold-cache crawl once pushed it into the fault window —
+            # baselining on the drifted scores themselves); the threshold
+            # keeps headroom over small-reference binning noise while the
+            # injected shift measures 2-19 PSI
+            drift_threshold=0.5,
+            drift_freeze_after=6 * batch_size,
         ),
         recorder=rec,
         alarm_log_path=str(out / "health_alarms.jsonl"),
@@ -153,10 +177,24 @@ def run(
     per_tenant = SlicedMetric(MeanSquaredError(), num_slices=tenants)
     canary = SumMetric()
 
+    # pre-traffic warm-up: pay the first-batch XLA compiles (fused kernel,
+    # sliced scatter, canary) BEFORE the phase clock starts — a real
+    # serving job warms its caches before taking traffic, and the phase
+    # boundaries (warmup/fault/recovery fractions of --duration) assume
+    # full-rate steps from t=0 (the drift reference in particular must
+    # freeze from enough WARMUP-phase samples, not crawl through compiles
+    # into the fault window)
+    preds, target, ids, _ = _make_batch(rng, batch_size, False, tenants)
+    handle.update_async(preds, target)
+    handle.flush()
+    per_tenant.update(ids, preds, target.astype(jnp.float32))
+    canary.update(jnp.ones((8,), jnp.float32))
+
     t_start = time.time()
     fault_lo, fault_hi = WARMUP_FRAC * duration, FAULT_END_FRAC * duration
     step = 0
     did_reset = False
+    froze_ref = False
     last_probe = 0.0
     ragged_step = 0
 
@@ -182,6 +220,20 @@ def run(
                 break
             in_fault = fault_lo <= elapsed < fault_hi
             skewing = in_fault and inject in ("skew", "all")
+            drifting = in_fault and inject in ("drift", "all")
+
+            if not froze_ref and elapsed >= 0.9 * fault_lo:
+                # end of warmup: freeze the drift reference from the
+                # known-healthy scores recorded so far (see default_rules
+                # note above). Latch only on SUCCESS — an empty window here
+                # (very slow first steps) must retry next iteration, not
+                # silently fall back to the count gate this freeze exists
+                # to bypass
+                froze_ref = all(
+                    r.freeze_reference(rec.timeseries)
+                    for r in monitor.rules
+                    if isinstance(r, DriftRule)
+                )
 
             if not did_reset and elapsed >= fault_hi:
                 # recovery boundary = epoch boundary: publish values once
@@ -196,7 +248,12 @@ def run(
                 )
                 did_reset = True
 
-            preds, target, ids = _make_batch(rng, batch_size, skewing, tenants)
+            preds, target, ids, host_scores = _make_batch(rng, batch_size, skewing, tenants, drifting)
+            # score feed for the drift alarm (host values — no device
+            # readback on the serving hot path); the full batch feeds so
+            # the reference window accumulates fast enough to freeze
+            # well inside warmup
+            rec.record_scores(host_scores, max_samples=batch_size)
             if in_fault and inject in ("bursts", "all") and (inject != "all" or step % 2 == 0):
                 # unpaced producer: enqueue as fast as the host allows for
                 # one slice of the fault window — the bounded drop-policy
@@ -249,7 +306,8 @@ def run(
         # time to do it
         tail_end = time.time() + window_s + 2 * bucket_seconds
         while time.time() < tail_end:
-            preds, target, ids = _make_batch(rng, batch_size, False, tenants)
+            preds, target, ids, host_scores = _make_batch(rng, batch_size, False, tenants)
+            rec.record_scores(host_scores)
             handle.update_async(preds, target)
             per_tenant.update(ids, preds, target.astype(jnp.float32))
             canary.update(jnp.ones((8,), jnp.float32))
@@ -331,6 +389,15 @@ def main(argv=None) -> int:
         action="store_true",
         help="exit nonzero unless at least one alarm both fired and cleared (CI smoke)",
     )
+    parser.add_argument(
+        "--assert-alarm",
+        action="append",
+        default=[],
+        metavar="NAME",
+        help="exit nonzero unless the NAMED alarm both fired and cleared (repeatable;"
+        " the drift smoke leg pins score_drift specifically — a generic"
+        " any-alarm assert would pass with drift detection broken)",
+    )
     args = parser.parse_args(argv)
     report = run(
         duration=args.duration,
@@ -347,6 +414,14 @@ def main(argv=None) -> int:
     )
     if args.assert_fired_cleared and not report["alarms_fired_and_cleared"]:
         print("FAIL: no alarm both fired and cleared", file=sys.stderr)
+        return 2
+    missing = [a for a in args.assert_alarm if a not in report["alarms_fired_and_cleared"]]
+    if missing:
+        print(
+            f"FAIL: alarm(s) {missing} did not both fire and clear"
+            f" (fired_and_cleared={report['alarms_fired_and_cleared']})",
+            file=sys.stderr,
+        )
         return 2
     return 0
 

@@ -9,7 +9,7 @@ _logger = logging.getLogger("metrics_tpu")
 _logger.addHandler(logging.StreamHandler())
 _logger.setLevel(logging.INFO)
 
-__version__ = "0.11.0"
+__version__ = "0.12.0"
 
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402
@@ -104,6 +104,7 @@ from metrics_tpu.text import (  # noqa: E402
 )
 from metrics_tpu.observability import MetricRecorder, get_recorder  # noqa: E402
 from metrics_tpu.sliced import SlicedMetric  # noqa: E402
+from metrics_tpu.windowed import WindowedMetric  # noqa: E402
 from metrics_tpu import sketches  # noqa: E402  (fixed-capacity streaming sketch states)
 
 __all__ = [
@@ -174,6 +175,7 @@ __all__ = [
     "SignalDistortionRatio",
     "SignalNoiseRatio",
     "SlicedMetric",
+    "WindowedMetric",
     "SpearmanCorrCoef",
     "Specificity",
     "SQuAD",

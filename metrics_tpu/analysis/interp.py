@@ -1531,7 +1531,7 @@ def _infer_default(
     return _CONTAINER_UNKNOWN, None, None
 
 
-_STRING_REDUCERS = {"sum", "mean", "max", "min", "cat", "merge"}
+_STRING_REDUCERS = {"sum", "mean", "max", "min", "cat", "merge", "ring", "decay"}
 
 #: reducers with an exact slice-axis scatter (see StateEntry.sliceable)
 _SLICEABLE_REDUCERS = {"sum", "max", "min"}
@@ -1554,10 +1554,18 @@ def _reducer_of(call: ast.Call) -> Optional[str]:
         if isinstance(fx.value, str) and fx.value in _STRING_REDUCERS:
             return fx.value
     if isinstance(fx, ast.Call):
+        name = _last_name(fx.func)
+        # the windowed module's tagged reducers (`ring_sum_fx()`,
+        # `ring_merge_fx(...)`, `decay_sum_fx()`) serialize as their window
+        # semantics — checked BEFORE the merge_fx suffix so a ring-of-
+        # sketches leaf reads "ring", not "merge"
+        if name in ("ring_sum_fx", "ring_merge_fx"):
+            return "ring"
+        if name == "decay_sum_fx":
+            return "decay"
         # the sketch modules' tagged merge reducers (`sketch_merge_fx()`,
         # `reservoir_merge_fx()`, `ranksketch_merge_fx()`): a self-merging
         # leaf, distinct from an arbitrary custom callable
-        name = _last_name(fx.func)
         if name is not None and name.endswith("merge_fx"):
             return "merge"
     return "custom"

@@ -82,8 +82,8 @@ def _attr_chain(node: ast.AST) -> List[str]:
     return []
 
 
-#: string reducers ``add_state`` accepts (core/metric.py:244-255)
-KNOWN_REDUCERS = {"sum", "mean", "max", "min", "cat", "merge"}
+#: string reducers ``add_state`` accepts (core/metric.py:244-272)
+KNOWN_REDUCERS = {"sum", "mean", "max", "min", "cat", "merge", "ring", "decay"}
 
 #: methods whose bodies are trace-scoped (the jit/fusion surface)
 TRACED_METHODS = {"_update", "_compute", "update", "compute", "update_state", "compute_state"}

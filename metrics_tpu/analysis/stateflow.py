@@ -76,7 +76,9 @@ def _state_reducers(class_node: ast.ClassDef) -> Dict[str, str]:
             continue
         if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):
             reducer = _reducer_of(node)
-            if isinstance(reducer, str) and reducer in {"sum", "mean", "max", "min", "cat", "merge"}:
+            if isinstance(reducer, str) and reducer in {
+                "sum", "mean", "max", "min", "cat", "merge", "ring", "decay",
+            }:
                 out[node.args[0].value] = reducer
     return out
 
@@ -192,6 +194,48 @@ def _is_additive_rhs(rhs: ast.AST, attr: str) -> bool:
     ):
         return True
     return False
+
+
+def _is_bare_self_attr(node: ast.AST, attr: str) -> bool:
+    """``self.<attr>`` exactly — no scaling, no indexing, no wrapping."""
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == attr
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _direct_unscaled_additive(rhs: ast.AST, attr: str) -> bool:
+    """``self.x + e`` / ``e + self.x`` / ``self.x - e`` with the BARE
+    (unscaled) prior value as a top-level operand — the write shape that
+    never decays a decay leaf and ignores a ring leaf's rotation. A scaled
+    operand (``alpha * self.x + e``) deliberately does NOT match."""
+    if not (isinstance(rhs, ast.BinOp) and isinstance(rhs.op, _ADDITIVE_AUG_OPS)):
+        return False
+    return _is_bare_self_attr(rhs.left, attr) or _is_bare_self_attr(rhs.right, attr)
+
+
+def _has_scaled_prior(rhs: ast.AST, attr: str) -> bool:
+    """An ``alpha * self.x``-shaped multiplicative subexpression anywhere
+    in ``rhs`` — the decayed-accumulation signature."""
+    for sub in ast.walk(rhs):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, (ast.Mult, ast.Pow)):
+            if _mentions_self_attr(sub.left, attr) or _mentions_self_attr(sub.right, attr):
+                return True
+    return False
+
+
+def _is_ring_rotation(rhs: ast.AST, attr: str) -> bool:
+    """A ``.at[...]`` namespace write on the leaf itself (``self.x.at[
+    slot].set(row)`` / ``.add`` / ``.max`` / ``.min``) — the ring-rotation
+    idiom: one slot changes, the other buckets' rows are untouched."""
+    return (
+        isinstance(rhs, ast.Call)
+        and isinstance(rhs.func, ast.Attribute)
+        and rhs.func.attr in ("set", "add", "max", "min", "multiply", "mul")
+        and _mentions_self_attr(rhs.func.value, attr)
+    )
 
 
 def _locals_reading_attr(method: ast.FunctionDef, attrs: Iterable[str]) -> Dict[str, Set[str]]:
@@ -319,6 +363,69 @@ def _check_update_writes(
                     f"`{method.name}`; only the sketch's own insert/merge transforms keep "
                     "the packed layout mergeable across ranks",
                 )
+        elif reducer == "decay":
+            # exponentially-decayed sum leaves (metrics_tpu/windowed/):
+            # the one consistent accumulation is decay-then-add — the prior
+            # value must be SCALED before the delta lands. A plain additive
+            # write type-checks and sums, but the leaf silently stops
+            # forgetting: it degrades to an all-of-time sum while every
+            # consumer still reads it as "the recent window".
+            if kind in ("Add", "Sub"):
+                yield FlowFinding(
+                    stmt,
+                    f"`\"decay\"`-reduced state `{attr}` accumulated with a plain"
+                    f" `{kind}` in `{method.name}`; an unscaled addition never decays"
+                    " — write the decayed form"
+                    f" (`self.{attr} = alpha * self.{attr} + delta`)",
+                )
+            elif kind == "assign" and rhs is not None:
+                if _direct_unscaled_additive(rhs, attr) and not _has_scaled_prior(rhs, attr):
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"decay\"`-reduced state `{attr}` accumulated additively"
+                        f" without scaling the prior value in `{method.name}`; the"
+                        " leaf degrades to an all-of-time sum — write the decayed"
+                        f" form (`self.{attr} = alpha * self.{attr} + delta`)",
+                    )
+                elif not rhs_reads_prior(rhs):
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"decay\"`-reduced state `{attr}` overwritten in"
+                        f" `{method.name}` without reading its prior value; the"
+                        " overwrite discards the decayed history on this rank",
+                    )
+        elif reducer == "ring":
+            # ring-of-buckets leaves (metrics_tpu/windowed/): accumulation
+            # is a ROTATION — one slot is read, combined, and written back
+            # with `.at[slot].set(...)`; a whole-leaf additive write pours
+            # the batch into EVERY bucket's row, so expired buckets never
+            # evict and every window over-counts.
+            if kind in ("Add", "Sub"):
+                yield FlowFinding(
+                    stmt,
+                    f"`\"ring\"`-reduced state `{attr}` accumulated with a"
+                    f" whole-leaf `{kind}` in `{method.name}`; ring leaves rotate"
+                    " one slot per bucket — write through"
+                    f" `self.{attr} = self.{attr}.at[slot].set(row)`",
+                )
+            elif kind == "assign" and rhs is not None:
+                if _is_ring_rotation(rhs, attr):
+                    pass  # the ring-rotation idiom: reducer-consistent
+                elif _direct_unscaled_additive(rhs, attr):
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"ring\"`-reduced state `{attr}` accumulated with a"
+                        f" whole-leaf addition in `{method.name}`; the batch lands"
+                        " in every bucket's row and expired buckets never evict —"
+                        f" rotate one slot (`self.{attr}.at[slot].set(row)`)",
+                    )
+                elif not rhs_reads_prior(rhs):
+                    yield FlowFinding(
+                        stmt,
+                        f"`\"ring\"`-reduced state `{attr}` overwritten in"
+                        f" `{method.name}` without reading its prior value; the"
+                        " overwrite wipes every bucket's row, not one slot",
+                    )
         elif reducer in ("max", "min"):
             additive = (kind in ("Add", "Sub")) or (
                 kind == "assign" and rhs is not None and _is_additive_rhs(rhs, attr)

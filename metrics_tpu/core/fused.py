@@ -56,6 +56,15 @@ pad-and-mask bucket correction stays exact for it too: an edge-padded row
 replicates the last real row *including its slice id*, so the
 ``k * delta(last_row)`` subtraction lands in exactly the slice the pad rows
 polluted (and a replicated row cannot move a per-slice extremum).
+
+Windowed metrics (``metrics_tpu/windowed/``) fuse the same way: the ring
+rotation is a fixed-shape ``.at[slot].set`` driven by a state-carried
+clock. Their sum-shaped leaves carry TAGGED reducers (``windowed_kind``)
+rather than ``dim_zero_sum`` on purpose — the generic pad correction
+below probes the delta from the DEFAULT state, whose ring slot differs
+from the live one, so the wrapper performs its own slot-aware correction
+via the ``n_valid`` mask contract and the bucket-eligibility check
+accepts the tagged leaves on that basis.
 """
 from __future__ import annotations
 
@@ -347,6 +356,14 @@ class FusedUpdate:
                     # pad-mask kwarg: edge-pad rows insert with weight 0
                     # instead of needing an (impossible) subtraction — see
                     # _one_metric, which threads n_valid into the update
+                    continue
+                if getattr(red, "windowed_kind", None) is not None and mask_valid:
+                    # windowed ring/decay leaves (metrics_tpu/windowed/): the
+                    # wrapper receives n_valid and performs its own slot-aware
+                    # k * delta pad correction — the generic dim_zero_sum
+                    # correction below would probe from the DEFAULT state's
+                    # ring slot and double-correct, which is exactly why
+                    # these leaves carry a tagged reducer instead of sum
                     continue
                 if red not in (dim_zero_sum, dim_zero_max, dim_zero_min):
                     return False

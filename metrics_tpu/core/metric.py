@@ -259,10 +259,24 @@ class Metric(ABC):
             from metrics_tpu.sketches.quantile import sketch_merge_fx
 
             dist_reduce_fx = sketch_merge_fx()
+        elif dist_reduce_fx == "ring":
+            # windowed ring-of-sums leaf (metrics_tpu/windowed/): same-bucket
+            # rows add elementwise across ranks, but the leaf must stay
+            # distinct from dim_zero_sum so the fused pad correction defers
+            # to the wrapper's slot-aware one (see windowed/reducers.py)
+            from metrics_tpu.windowed.reducers import ring_sum_fx
+
+            dist_reduce_fx = ring_sum_fx()
+        elif dist_reduce_fx == "decay":
+            # exponentially-decayed sum leaf: lock-stepped decayed streams
+            # stay additive across ranks — sum fold, windowed-tagged
+            from metrics_tpu.windowed.reducers import decay_sum_fx
+
+            dist_reduce_fx = decay_sum_fx()
         elif dist_reduce_fx is not None and not callable(dist_reduce_fx):
             raise ValueError(
                 "`dist_reduce_fx` must be callable or one of"
-                " ['mean', 'sum', 'cat', 'min', 'max', 'merge', None]"
+                " ['mean', 'sum', 'cat', 'min', 'max', 'merge', 'ring', 'decay', None]"
             )
 
         if isinstance(default, list):
@@ -786,6 +800,10 @@ class Metric(ABC):
                 out[name] = red(jnp.stack([jnp.asarray(va), jnp.asarray(vb)]))
                 if _TELEMETRY.enabled:
                     _TELEMETRY.record_sketch_merge(1)
+            elif getattr(red, "inner_reduce", None) == "sum":
+                # windowed ring/decay sum leaves (metrics_tpu/windowed/):
+                # same-bucket rows and decayed sums add pairwise
+                out[name] = va + vb
             elif red is None:
                 raise MetricsUserError(
                     f"Cannot merge tensor state {name!r} with reduction None (gathered-not-reduced"
@@ -850,12 +868,18 @@ class Metric(ABC):
             val = getattr(self, name)
             if not isinstance(val, jnp.ndarray) or isinstance(val, jax.core.Tracer) or val.ndim < 2:
                 continue
+            # leading-ellipsis form covers both the flat [capacity, cols]
+            # sketch layout and the windowed ring-of-sketches [R, capacity,
+            # cols]. Per-SKETCH occupancy, worst slot reported: averaging
+            # over all ring slots would let one at-capacity live bucket
+            # (compactions imminent — exactly what the fill alarm watches)
+            # hide behind R-1 empty ones for the whole first ring lap.
             occupied = (
-                val[:, 0] > -jnp.inf
+                val[..., 0] > -jnp.inf
                 if getattr(red, "sketch_kind", "") == "reservoir"
-                else val[:, 0] > 0
+                else val[..., 0] > 0
             )
-            out[name] = float(jnp.sum(occupied)) / float(val.shape[0])
+            out[name] = float(jnp.max(jnp.mean(occupied.astype(jnp.float32), axis=-1)))
         return out
 
     # ------------------------------------------------------------------

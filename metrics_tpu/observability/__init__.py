@@ -31,9 +31,21 @@ from metrics_tpu.observability.exporters import (
     summary,
     write_prometheus,
 )
+from metrics_tpu.observability.drift import (
+    categorical_drift,
+    histogram_drift,
+    js_divergence_hist,
+    kl_divergence_hist,
+    psi_divergence,
+    reference_edges,
+    sketch_drift,
+    state_drift,
+    total_variation,
+)
 from metrics_tpu.observability.health import (
     AlarmState,
     BurnRateRule,
+    DriftRule,
     HealthMonitor,
     HealthSnapshot,
     Rule,
@@ -87,12 +99,22 @@ __all__ = [
     "series_from_payload",
     "AlarmState",
     "BurnRateRule",
+    "DriftRule",
     "HealthMonitor",
     "HealthSnapshot",
     "Rule",
     "ThresholdRule",
+    "categorical_drift",
     "default_rules",
+    "histogram_drift",
+    "js_divergence_hist",
+    "kl_divergence_hist",
+    "psi_divergence",
+    "reference_edges",
     "render_health",
+    "sketch_drift",
+    "state_drift",
+    "total_variation",
 ]
 
 _RECORDERS: Dict[str, MetricRecorder] = {"default": _DEFAULT_RECORDER}

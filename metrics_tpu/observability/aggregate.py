@@ -58,6 +58,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "sliced_totals": dict(rec.sliced_totals()),
         "sliced_slice_counts": dict(rec.footprint_slice_counts()),
         "sketch_totals": dict(rec.sketch_totals()),
+        "drift_scores": dict(rec.drift_scores()),
         "export_errors": rec.export_errors(),
         # windowed time series ride the same payload path: per-bucket
         # sketches serialize JSON-safe and merge by qsketch_merge, so a
@@ -119,6 +120,10 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # on every rank) — max is the safe reconciliation if they skew
         "sliced_slice_counts": _merge_max([p.get("sliced_slice_counts", {}) for p in payloads]),
         "sketch_totals": _merge_sketch([p.get("sketch_totals", {}) for p in payloads]),
+        # drift scores are last-seen gauges; the worst (max) rank's score is
+        # the fleet's headline — a rank without the drift layer contributes
+        # nothing, like every other family
+        "drift_scores": _merge_max([p.get("drift_scores", {}) for p in payloads]),
         "export_errors": sum(p.get("export_errors", 0) for p in payloads),
         "timeseries": _merge_timeseries([p.get("timeseries", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),

@@ -355,6 +355,14 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             f"metrics_tpu_sketch_fill_ratio{_labels(window='max', **proc_label(payload))}"
             f" {totals.get('max_fill_ratio', 0.0)}"
         )
+    lines.append("# HELP metrics_tpu_drift_score Last reference-vs-live drift score per watched source and statistic.")
+    lines.append("# TYPE metrics_tpu_drift_score gauge")
+    for payload in per_proc:
+        for key, v in sorted(payload.get("drift_scores", {}).items()):
+            source, _, stat = key.partition("|")
+            lines.append(
+                f"metrics_tpu_drift_score{_labels(metric=source, stat=stat, **proc_label(payload))} {v:g}"
+            )
     lines.append("# HELP metrics_tpu_export_errors_total Exporter ticks that raised (artifacts may be stale).")
     lines.append("# TYPE metrics_tpu_export_errors_total counter")
     for payload in per_proc:
@@ -447,6 +455,12 @@ def summary(recorder: Optional[Any] = None) -> str:
             f"sliced scatter: {sliced_totals['scatter_events']} events,"
             f" {sliced_totals['rows']} rows, max {sliced_totals['max_slices']} slices"
         )
+    drift = rec.drift_scores()
+    if drift:
+        lines.append("drift scores (reference vs live):")
+        for key, v in sorted(drift.items()):
+            source, _, stat = key.partition("|")
+            lines.append(f"  {source} [{stat}]: {v:.4g}")
     dropped = rec.dropped_events()
     if dropped:
         lines.append(

@@ -8,7 +8,7 @@ the registry and produces a typed :class:`HealthSnapshot` —
 Prometheus families, appended to a JSONL alarm log on every transition,
 and renderable as a terminal summary (:func:`render_health`).
 
-Two rule shapes cover the standard serving-loop failure modes:
+Three rule shapes cover the standard serving-loop failure modes:
 
 * :class:`ThresholdRule` — a windowed statistic (``p50``/``p95``/``p99``/
   ``mean``/``max``/``min``/``rate``/``count``) of one series compared
@@ -20,8 +20,14 @@ Two rule shapes cover the standard serving-loop failure modes:
   fires only when both burn rates exceed the threshold, the standard
   fast-burn page condition (short window catches the spike, long window
   filters blips). Backs the drop-rate alarm.
+* :class:`DriftRule` — a reference-vs-live distribution comparison: a
+  window of a distribution series is frozen as the reference, and later
+  windows histogram over the same static edges and score against it
+  (PSI / KL / JS / TV — :mod:`metrics_tpu.observability.drift`). Backs
+  the score-drift alarm, the "is the MODEL healthy" complement to the
+  pipeline alarms above.
 
-:func:`default_rules` wires the six standard alarm classes over the
+:func:`default_rules` wires the seven standard alarm classes over the
 standard series names the recorder feeds (``SERIES_*`` in
 ``recorder.py``); every threshold is a keyword so deployments tune rather
 than reimplement. ``examples/serving_loop.py`` drives the whole layer
@@ -43,12 +49,14 @@ from metrics_tpu.observability.recorder import (
     SERIES_ASYNC_STALENESS,
     SERIES_HOT_SLICE_SHARE,
     SERIES_RECOMPILES,
+    SERIES_SCORES,
     SERIES_SKETCH_FILL,
 )
 
 __all__ = [
     "AlarmState",
     "BurnRateRule",
+    "DriftRule",
     "HealthMonitor",
     "HealthSnapshot",
     "Rule",
@@ -228,6 +236,175 @@ class BurnRateRule(Rule):
         )
 
 
+class DriftRule(Rule):
+    """Fire when a distribution series drifts from its frozen reference
+    window (the seventh standard alarm class).
+
+    The rule watches a ``"distribution"`` series (by default the sampled
+    model scores serving loops feed via ``record_scores``). Evaluation has
+    two phases:
+
+    1. **Reference capture** — until the series has accumulated
+       ``freeze_after`` observations inside ``reference_window_s``, the
+       rule never fires (detail: "collecting reference"). At that point
+       the window's merged sketch is FROZEN as the reference: static
+       histogram edges are derived from it once
+       (:func:`~metrics_tpu.observability.drift.reference_edges`, unless
+       explicit ``edges`` were passed) and its binned histogram is kept.
+    2. **Live comparison** — every later evaluation histograms the
+       trailing ``window_s`` sketch over the SAME edges and scores it
+       against the reference with ``stat`` (``psi``/``kl``/``js``/``tv``
+       — see :mod:`metrics_tpu.observability.drift`), firing when the
+       score crosses ``threshold``. Scores also land on the default
+       recorder as ``metrics_tpu_drift_score{metric,stat}`` gauges.
+
+    The reference stays frozen until :meth:`reset_reference` (or a new
+    rule) — drift is measured against *then*, not against a sliding
+    yesterday that would normalize a slow regression away. An absent
+    series never fires, like every other rule.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        series: str = SERIES_SCORES,
+        stat: str = "psi",
+        threshold: float = 0.25,
+        window_s: float = 30.0,
+        reference_window_s: Optional[float] = None,
+        freeze_after: int = 200,
+        n_bins: int = 10,
+        min_count: int = 20,
+        edges: Optional[Any] = None,
+        severity: str = "warn",
+        description: str = "",
+        recorder: Optional[Any] = None,
+    ) -> None:
+        super().__init__(name, severity=severity, description=description)
+        #: recorder the drift-score gauges land on; None = inherit the
+        #: monitor's recorder (HealthMonitor injects its override at
+        #: construction, like every other health family), falling back to
+        #: the process default
+        self.recorder = recorder
+        from metrics_tpu.observability.drift import DRIFT_STATS
+
+        if stat not in DRIFT_STATS:
+            raise ValueError(f"stat must be one of {DRIFT_STATS}, got {stat!r}")
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        if not isinstance(freeze_after, int) or freeze_after < 1:
+            raise ValueError(f"freeze_after must be a positive int, got {freeze_after!r}")
+        if not isinstance(n_bins, int) or n_bins < 2:
+            raise ValueError(f"n_bins must be an int >= 2, got {n_bins!r}")
+        self.series = series
+        self.stat = stat
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.reference_window_s = float(
+            reference_window_s if reference_window_s is not None else window_s
+        )
+        self.freeze_after = int(freeze_after)
+        self.n_bins = int(n_bins)
+        self.min_count = int(min_count)
+        self._edges = edges
+        self._ref_hist: Optional[Any] = None
+        #: serializes reference mutation: the monitor lock covers evaluate(),
+        #: but freeze_reference() is a direct caller API (the serving loop's
+        #: phase boundary) racing the exporter tick's auto-freeze — without
+        #: this, two concurrent freezes can bin the reference over one
+        #: thread's edges and keep the OTHER thread's edges for live
+        #: comparisons, a permanently wrong score with no error
+        self._freeze_lock = threading.Lock()
+
+    def reset_reference(self) -> None:
+        """Drop the frozen reference; the next evaluations re-capture it
+        (an intentional re-baseline after a model push)."""
+        with self._freeze_lock:
+            self._ref_hist = None
+            # edges re-derive with the new reference unless explicit
+            if getattr(self, "_edges_derived", False):
+                self._edges = None
+
+    def freeze_reference(self, registry: Any, now: Optional[float] = None) -> bool:
+        """Freeze the reference from the CURRENT reference window,
+        bypassing the ``freeze_after`` count gate — for callers that know
+        their own phase boundaries (a serving loop freezing at the end of
+        a known-healthy warmup) instead of trusting traffic-rate timing:
+        the count-gated auto-freeze can land inside a fault window when
+        early traffic crawls through cold caches, silently baselining on
+        the very distribution the rule exists to catch. Returns True when
+        a reference was (already or newly) frozen; no-op on an absent
+        series or an empty window (the auto path remains)."""
+        if self._ref_hist is not None:
+            return True
+        s = registry.get(self.series) if registry is not None else None
+        if s is None:
+            return False
+        sketch = s.window_sketch(self.reference_window_s, now=now)
+        if sketch is None:
+            return False
+        self._freeze(sketch)
+        return True
+
+    def _freeze(self, sketch: Any) -> None:
+        import jax.numpy as jnp
+
+        from metrics_tpu.observability.drift import reference_edges
+        from metrics_tpu.sketches.quantile import qsketch_histogram
+
+        with self._freeze_lock:
+            if self._ref_hist is not None:
+                return  # another thread froze first: first freeze wins whole
+            if self._edges is None:
+                self._edges = reference_edges(sketch, n_bins=self.n_bins)
+                self._edges_derived = True
+            self._ref_hist = qsketch_histogram(
+                jnp.asarray(sketch), jnp.asarray(self._edges, jnp.float32)
+            )
+
+    def evaluate(self, registry: Any, now: Optional[float] = None) -> Tuple[bool, Optional[float], str]:
+        s = registry.get(self.series) if registry is not None else None
+        if s is None:
+            return False, None, f"series `{self.series}` absent"
+        with self._freeze_lock:
+            ref_hist, edges = self._ref_hist, self._edges
+        if ref_hist is None:
+            n_ref = s.count(self.reference_window_s, now=now)
+            if n_ref < self.freeze_after:
+                return False, None, f"collecting reference ({n_ref}/{self.freeze_after})"
+            sketch = s.window_sketch(self.reference_window_s, now=now)
+            if sketch is None:
+                return False, None, "reference window holds no mass yet"
+            self._freeze(sketch)
+            return False, 0.0, f"reference frozen over {self.reference_window_s:g}s"
+        n_live = s.count(self.window_s, now=now)
+        if n_live < self.min_count:
+            return False, None, f"only {n_live} live observation(s) in window"
+        live = s.window_sketch(self.window_s, now=now)
+        if live is None:
+            return False, None, "empty live window"
+        import jax.numpy as jnp
+
+        from metrics_tpu.observability.drift import histogram_drift
+        from metrics_tpu.sketches.quantile import qsketch_histogram
+
+        # score against the SNAPSHOT pair read under the lock above — a
+        # concurrent re-baseline cannot mix one reference's edges with
+        # another's histogram mid-evaluation
+        live_hist = qsketch_histogram(jnp.asarray(live), jnp.asarray(edges, jnp.float32))
+        score = histogram_drift(ref_hist, live_hist)[self.stat]
+        rec = self.recorder if self.recorder is not None else _DEFAULT_RECORDER
+        if rec.enabled:
+            rec.record_drift_score(self.series, self.stat, score)
+        firing = score >= self.threshold
+        return (
+            bool(firing),
+            float(score),
+            f"{self.stat}({self.series}: frozen ref vs live {self.window_s:g}s)"
+            f" = {score:.4g} >= {self.threshold:g}",
+        )
+
+
 @dataclass(frozen=True)
 class AlarmState:
     """One rule's state inside a snapshot."""
@@ -305,6 +482,13 @@ class HealthMonitor:
         self.rules = list(rules)
         self._registry = registry
         self._recorder = recorder
+        if recorder is not None:
+            # recorder-aware rules (DriftRule's score gauges) inherit the
+            # monitor's override unless they carry their own — the same
+            # routing every other health family gets via _resolve
+            for r in self.rules:
+                if getattr(r, "recorder", "__absent__") is None:
+                    r.recorder = recorder
         self.alarm_log_path = alarm_log_path
         self._lock = threading.Lock()
         #: serializes alarm-log appends — _atomic_append is a read-modify-
@@ -486,8 +670,11 @@ def default_rules(
     window_s: float = 30.0,
     short_window_s: Optional[float] = None,
     critical_queue_factor: float = 2.0,
+    drift_threshold: float = 0.25,
+    drift_freeze_after: int = 128,
+    drift_stat: str = "psi",
 ) -> List[Rule]:
-    """The six standard serving-loop alarm classes over the standard
+    """The seven standard serving-loop alarm classes over the standard
     recorder-fed series, every threshold tunable:
 
     * ``queue_saturation`` (warn) / ``queue_saturation_critical`` — p95 /
@@ -500,6 +687,10 @@ def default_rules(
       ceiling (past it, compactions are imminent/ongoing and accuracy is
       being spent).
     * ``hot_slice_skew`` — p95 of the per-batch hottest-slice row share.
+    * ``score_drift`` — PSI (by default) of the live score distribution
+      against its frozen reference window (``record_scores`` feeds the
+      series; absent when the loop never records scores — the rule then
+      never fires, like any absent series).
     """
     short = short_window_s if short_window_s is not None else max(window_s / 3.0, 1.0)
     return [
@@ -576,5 +767,17 @@ def default_rules(
             severity="warn",
             min_count=3,
             description="one slice is receiving an outsized share of batch rows",
+        ),
+        DriftRule(
+            "score_drift",
+            SERIES_SCORES,
+            stat=drift_stat,
+            threshold=drift_threshold,
+            window_s=window_s,
+            reference_window_s=window_s,
+            freeze_after=drift_freeze_after,
+            min_count=16,
+            severity="warn",
+            description="live score distribution drifted from the frozen reference window",
         ),
     ]

@@ -83,6 +83,15 @@ SKETCH_FOOTPRINT_PREFIX = "sketch/"
 #: HWM-label suffix for the sketch split of a metric's footprint
 SKETCH_LABEL_SUFFIX = "[sketch]"
 
+#: footprint keys under this prefix (WindowedMetric's [R]-leading ring /
+#: decayed states, metrics_tpu/windowed/) are the R-fold window budget —
+#: split to their own HWM label so window cost never masquerades as
+#: base-state growth
+WINDOWED_FOOTPRINT_PREFIX = "windowed/"
+
+#: HWM-label suffix for the windowed split of a metric's footprint
+WINDOWED_LABEL_SUFFIX = "[windowed]"
+
 
 # ---------------------------------------------------------------------------
 # standard time-series names (fed when a TimeSeriesRegistry is attached via
@@ -124,6 +133,10 @@ SERIES_SLICED_ROWS = "sliced_rows"
 SERIES_HOT_SLICE_SHARE = "hot_slice_share"
 #: exporter ticks that raised (PeriodicExporter hardening)
 SERIES_EXPORT_ERRORS = "export_errors"
+#: sampled model-score observations (fed by serving loops via
+#: ``record_scores``) — the live distribution the drift alarm compares
+#: against its frozen reference window
+SERIES_SCORES = "scores"
 
 #: the standard counter-kind series; every other standard series is a
 #: distribution (sketch-backed)
@@ -279,6 +292,9 @@ class MetricRecorder:
         self._sliced = _new_sliced_totals()
         self._sliced_slice_counts: Dict[str, int] = {}
         self._sketch = _new_sketch_totals()
+        #: "source|stat" -> last observed drift score (gauges; fed by the
+        #: health layer's DriftRule evaluations — see record_drift_score)
+        self._drift: Dict[str, float] = {}
         self._export_errors = 0
         #: tid -> thread name, registered as events from new threads arrive —
         #: export_perfetto emits these as thread_name metadata so the async
@@ -365,6 +381,7 @@ class MetricRecorder:
             self._sliced = _new_sliced_totals()
             self._sliced_slice_counts = {}
             self._sketch = _new_sketch_totals()
+            self._drift = {}
             self._export_errors = 0
             self._thread_names = {}
             self._group_local = threading.local()
@@ -454,6 +471,13 @@ class MetricRecorder:
         summary exporter divides by for the per-slice average."""
         with self._lock:
             return dict(self._sliced_slice_counts)
+
+    def drift_scores(self) -> Dict[str, float]:
+        """Last observed drift score per ``"source|stat"`` key (the
+        ``metrics_tpu_drift_score{metric,stat}`` Prometheus family's raw
+        data; gauges — merged max-wise across hosts)."""
+        with self._lock:
+            return dict(self._drift)
 
     def export_errors(self) -> int:
         """Exporter ticks that raised (see ``PeriodicExporter``) — a
@@ -674,15 +698,24 @@ class MetricRecorder:
         silently mixes with base-state growth under one mark."""
         label = type(metric).__name__
         total = int(sum(footprint.values()))
+        windowed_bytes = int(
+            sum(v for k, v in footprint.items() if k.startswith(WINDOWED_FOOTPRINT_PREFIX))
+        )
         sliced_bytes = int(
             sum(v for k, v in footprint.items() if k.startswith(SLICED_FOOTPRINT_PREFIX))
         )
         sketch_bytes = int(
             sum(v for k, v in footprint.items() if k.startswith(SKETCH_FOOTPRINT_PREFIX))
         )
-        base_bytes = total - sliced_bytes - sketch_bytes
+        base_bytes = total - sliced_bytes - sketch_bytes - windowed_bytes
         n_slices = getattr(metric, "num_slices", None) if sliced_bytes else None
         with self._lock:
+            if windowed_bytes:
+                # windowed ring/decay leaves are the R-fold window budget —
+                # bounded by construction, tracked under their own mark
+                windowed_label = label + WINDOWED_LABEL_SUFFIX
+                if windowed_bytes > self._footprint_hwm.get(windowed_label, -1):
+                    self._footprint_hwm[windowed_label] = windowed_bytes
             if sliced_bytes:
                 sliced_label = label + SLICED_LABEL_SUFFIX
                 if sliced_bytes > self._footprint_hwm.get(sliced_label, -1):
@@ -696,7 +729,9 @@ class MetricRecorder:
                 sketch_label = label + SKETCH_LABEL_SUFFIX
                 if sketch_bytes > self._footprint_hwm.get(sketch_label, -1):
                     self._footprint_hwm[sketch_label] = sketch_bytes
-            if (base_bytes or not (sliced_bytes or sketch_bytes)) and base_bytes > self._footprint_hwm.get(label, -1):
+            if (
+                base_bytes or not (sliced_bytes or sketch_bytes or windowed_bytes)
+            ) and base_bytes > self._footprint_hwm.get(label, -1):
                 self._footprint_hwm[label] = base_bytes
             event = {
                 "type": "footprint",
@@ -710,6 +745,8 @@ class MetricRecorder:
                     event["n_slices"] = n_slices
             if sketch_bytes:
                 event["sketch_bytes"] = sketch_bytes
+            if windowed_bytes:
+                event["windowed_bytes"] = windowed_bytes
             event.update(extra)
             self._append(event)
             warn = (
@@ -794,6 +831,55 @@ class MetricRecorder:
             event.update(extra)
             self._append(event)
         self._observe(SERIES_SKETCH_FILL, worst)
+
+    def record_scores(self, values: Any, series: str = SERIES_SCORES, max_samples: int = 32) -> None:
+        """Feed a bounded sample of model scores into the windowed
+        ``scores`` distribution series (no-op when no registry is
+        attached). The drift alarm (``DriftRule`` in observability/
+        health.py) freezes a reference window of this series and compares
+        the live window against it. Host-only: ``values`` is read back
+        once (callers on a hot path should pass host arrays); at most
+        ``max_samples`` evenly-strided values are recorded per call so
+        per-batch cost stays O(max_samples) whatever the batch size.
+        Gated on ``enabled`` like every other feed: a disabled recorder
+        pays one bool check and records nothing."""
+        ts = self.timeseries
+        if not self.enabled or ts is None:
+            return
+        try:
+            import numpy as np
+
+            arr = np.asarray(values, dtype=np.float64).reshape(-1)
+            if arr.size == 0:
+                return
+            # ceil stride: floor would over-generate and the truncation
+            # would then ALWAYS drop the batch tail — a biased sample when
+            # batches are ordered (sorted scores, grouped tenants)
+            stride = -(-arr.size // int(max_samples))
+            for v in arr[::stride]:
+                ts.observe(series, float(v), kind="distribution")
+        except Exception:  # noqa: BLE001 — telemetry must never take down the hot path
+            pass
+
+    def record_drift_score(self, source: str, stat: str, value: float, **extra: Any) -> None:
+        """Record one reference-vs-live drift score (``DriftRule``
+        evaluations): a last-seen gauge per (source, stat) — rendered as
+        the ``metrics_tpu_drift_score{metric,stat}`` Prometheus family and
+        carried through the cross-host aggregate payload (merged max-wise,
+        like every gauge family) — plus one ``drift`` event row so score
+        trajectories survive in the JSONL stream."""
+        key = f"{source}|{stat}"
+        with self._lock:
+            self._drift[key] = float(value)
+            event: Dict[str, Any] = {
+                "type": "drift",
+                "source": source,
+                "stat": stat,
+                "value": round(float(value), 6),
+                "t": round(time.time() - self._t0, 6),
+            }
+            event.update(extra)
+            self._append(event)
 
     def record_sliced_scatter(
         self,

@@ -258,6 +258,40 @@ class TelemetrySeries:
         out = self.quantiles((q,), window_s=window_s, now=now)
         return out[0] if out is not None else None
 
+    def window_sketch(self, window_s: Optional[float] = None, now: Optional[float] = None):
+        """The window's per-bucket sketches merged into ONE qsketch leaf
+        (``None`` when the window holds no mass) — what the quantile
+        queries fold and what the drift comparator
+        (:mod:`metrics_tpu.observability.drift`) histograms. Empty buckets
+        are skipped rather than folded: an all-zero sketch would poison
+        every downstream query with the empty-sketch ``NaN`` sentinel."""
+        if self.kind != "distribution":
+            raise ValueError(
+                f"series `{self.name}` is a counter; sketch queries need a distribution series"
+            )
+        from metrics_tpu.sketches.quantile import qsketch_merge_into, qsketch_total_weight
+
+        # flush + collect sketch REFS under the lock, but run the merge
+        # fold (jax dispatches, first call compiles) OUTSIDE it — holding
+        # the lock through device work would block every record() feeding
+        # this series for the whole export tick
+        with self._lock:
+            buckets = self._window(window_s, now)
+            for b in buckets:
+                self._flush(b)
+            # a bucket with observations always holds mass (unit-weight
+            # inserts), but payload-merged buckets can arrive sketchless or
+            # weightless — skip them instead of folding an empty leaf
+            sketches = [b.sketch for b in buckets if b.sketch is not None and b.count]
+        if not sketches:
+            return None
+        # sketch leaves are immutable jnp arrays: a concurrent record()
+        # swaps the bucket's ref, never mutates ours
+        merged = qsketch_merge_into(sketches[0], *sketches[1:])
+        if float(qsketch_total_weight(merged)) <= 0:
+            return None
+        return merged
+
     def quantiles(
         self,
         qs: Sequence[float],
@@ -265,28 +299,14 @@ class TelemetrySeries:
         now: Optional[float] = None,
     ) -> Optional[List[float]]:
         """Several windowed quantiles from ONE merged sketch (one merge
-        fold + one query, however many quantiles)."""
-        if self.kind != "distribution":
-            raise ValueError(
-                f"series `{self.name}` is a counter; quantiles need a distribution series"
-            )
-        from metrics_tpu.sketches.quantile import qsketch_merge_into, qsketch_quantile
-
-        # flush + collect sketch REFS under the lock, but run the merge
-        # fold and quantile query (jax dispatches, first call compiles)
-        # OUTSIDE it — holding the lock through device work would block
-        # every record() feeding this series for the whole export tick
-        with self._lock:
-            buckets = self._window(window_s, now)
-            for b in buckets:
-                self._flush(b)
-            sketches = [b.sketch for b in buckets if b.sketch is not None]
-        if not sketches:
+        fold + one query, however many quantiles). ``None`` — never the
+        empty-sketch ``NaN`` sentinel — when the window holds no mass."""
+        merged = self.window_sketch(window_s=window_s, now=now)
+        if merged is None:
             return None
-        # sketch leaves are immutable jnp arrays: a concurrent record()
-        # swaps the bucket's ref, never mutates ours
-        merged = qsketch_merge_into(sketches[0], *sketches[1:])
         import jax.numpy as jnp
+
+        from metrics_tpu.sketches.quantile import qsketch_quantile
 
         vals = qsketch_quantile(merged, jnp.asarray(list(qs), jnp.float32))
         return [float(v) for v in vals]

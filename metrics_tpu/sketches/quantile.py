@@ -285,22 +285,37 @@ def qsketch_rank(sketch: Array, xs: Array) -> Array:
 
 
 def qsketch_cdf(sketch: Array, xs: Array) -> Array:
-    """Estimated CDF at each query point (rank / total weight)."""
-    total = jnp.clip(qsketch_total_weight(sketch), 1e-12, None)
-    return qsketch_rank(sketch, xs) / total
+    """Estimated CDF at each query point (rank / total weight).
+
+    An EMPTY sketch (total weight 0) has no distribution to query: every
+    result is the explicit ``NaN`` sentinel rather than a confident-looking
+    0 from a guarded division — callers that can see an empty window
+    should skip the query instead (``TelemetrySeries`` does)."""
+    total = qsketch_total_weight(sketch)
+    cdf = qsketch_rank(sketch, xs) / jnp.clip(total, 1e-12, None)
+    return jnp.where(total > 0, cdf, jnp.nan)
 
 
 def qsketch_quantile(sketch: Array, q: Array) -> Array:
     """Estimated quantile(s): smallest key whose cumulative weight reaches
-    ``q`` of the total."""
+    ``q`` of the total.
+
+    Empty-sketch contract (total weight 0): returns ``NaN`` per query —
+    the un-guarded arithmetic would otherwise return key 0.0, a silently
+    wrong *value* where the windowed stats need a recognizable *absence*.
+    """
     w, key = sketch[:, 0], sketch[:, 1]
     order = jnp.argsort(jnp.where(w > 0, key, jnp.inf))
     sk, sw = key[order], w[order]
     cum = jnp.cumsum(sw)
-    total = jnp.clip(cum[-1], 1e-12, None)
+    total = cum[-1]
     q = jnp.asarray(q, jnp.float32).reshape(-1)
-    idx = jnp.clip(jnp.searchsorted(cum / total, q, side="left"), 0, sk.shape[0] - 1)
-    return sk[idx]
+    idx = jnp.clip(
+        jnp.searchsorted(cum / jnp.clip(total, 1e-12, None), q, side="left"),
+        0,
+        sk.shape[0] - 1,
+    )
+    return jnp.where(total > 0, sk[idx], jnp.nan)
 
 
 def qsketch_histogram(sketch: Array, edges: Array) -> Array:

@@ -1,0 +1,29 @@
+"""Windowed metric state: sliding-window and exponential-decay semantics
+for any fusible metric.
+
+:class:`WindowedMetric` turns an all-of-time metric into a live one — a
+ring of ``R`` state copies (one per bucket of updates, rotated in-place by
+``.at[slot].set`` inside the fused dispatch) or a per-leaf exponentially
+decayed sum — while composing unchanged with ``compile_update`` /
+``compile_update_async`` / ``sync_pytree_in_mesh`` and with
+``SlicedMetric`` (``WindowedMetric(SlicedMetric(...))`` is the per-tenant
+windowed surface). The reference-vs-live drift comparator in
+:mod:`metrics_tpu.observability.drift` reads its window folds.
+"""
+from metrics_tpu.windowed.metric import (
+    DECAY_WEIGHT,
+    RING_COUNT,
+    RING_ROWS,
+    WindowedMetric,
+)
+from metrics_tpu.windowed.reducers import decay_sum_fx, ring_merge_fx, ring_sum_fx
+
+__all__ = [
+    "DECAY_WEIGHT",
+    "RING_COUNT",
+    "RING_ROWS",
+    "WindowedMetric",
+    "decay_sum_fx",
+    "ring_merge_fx",
+    "ring_sum_fx",
+]

@@ -1,0 +1,477 @@
+"""``WindowedMetric`` — sliding-window / exponential-decay state for any
+fusible metric.
+
+All-of-time metric values answer "how good has this model been since
+reset"; a live serving job needs "how good is it NOW" — AUROC over the
+last five minutes, MSE over the last N thousand requests, a per-tenant
+error surface that forgets last week's traffic. This wrapper gives any
+fusible metric that time axis while staying inside the single fused
+dispatch, with two state layouts:
+
+* **Ring mode** (default) — every wrapped state leaf is broadcast to a
+  leading ``[R]`` ring axis (the same structural trick as
+  ``SlicedMetric``'s ``[S]`` slice axis), one row per *bucket* of
+  ``updates_per_bucket`` consecutive updates. Each update rotates into its
+  slot with one ``.at[slot].set`` (slot = bucket index mod ``R``, derived
+  from the ``_ring_count`` state — jit-clean, no host clock), resetting
+  the slot to defaults on the first update of a fresh bucket so expired
+  buckets self-evict. ``compute()`` folds the in-window rows oldest-first
+  through the wrapped metric's OWN reducers (``merge_states``: sum leaves
+  add, max/min fold, sketch leaves ``qsketch_merge`` in arrival order —
+  bit-identical to recomputing the window's batches inside each sketch's
+  lossless window), then runs the wrapped compute. ``compute(window=w)``
+  narrows to the last ``w`` buckets.
+* **Decay mode** (``mode="decay"``) — every (necessarily sum-reduced)
+  leaf becomes the exponentially-decayed sum ``alpha * state + delta``:
+  O(1) extra memory, an infinite soft window with half-life
+  ``ln(2)/ln(1/alpha)`` updates. Max/min and sketch leaves have no decay
+  (an extremum cannot forget; scaling sketch weights skews compaction) —
+  such metrics use ring mode, which is exactly why both live here.
+
+Both layouts are pure fixed-shape ``(state, batch) -> state`` transforms,
+so a ``WindowedMetric`` fuses, buckets, ingests asynchronously, and
+mesh-syncs unchanged: ``compile_update``/``compile_update_async`` compile
+it once across bucketed ragged shapes (the wrapper declares
+``__fused_mask_valid__`` and performs its own slot-aware ``k * delta``
+pad correction — the generic ``dim_zero_sum`` correction would probe the
+DEFAULT state's slot, see :mod:`.reducers`), and cross-rank sync folds
+ring rows bucket-by-bucket. Per-tenant windowed metrics are
+``WindowedMetric(SlicedMetric(...))`` by construction: the leaves become
+``[R, S, ...]`` and every mechanism above composes. See
+docs/windowed_metrics.md.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import _AUTO_COUNT, Metric
+from metrics_tpu.observability.recorder import WINDOWED_FOOTPRINT_PREFIX
+from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_max, dim_zero_min, dim_zero_sum
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.windowed.reducers import decay_sum_fx, ring_merge_fx, ring_sum_fx
+
+Array = jax.Array
+
+#: per-bucket update counter, ``[R]`` int32 — which ring rows are live and
+#: how much traffic each bucket absorbed ("ring"-reduced: same-bucket
+#: counts add across ranks)
+RING_ROWS = "_ring_rows"
+
+#: total updates since reset, int32 scalar — the jit-clean clock the ring
+#: slot derives from ("max"-reduced: the furthest clock wins a sync)
+RING_COUNT = "_ring_count"
+
+#: decayed effective sample weight ``sum_i alpha^i``, float32 scalar —
+#: what a decayed sum is "out of" (decay-reduced like the leaves it scales)
+DECAY_WEIGHT = "_decay_weight"
+
+_RESERVED = (RING_ROWS, RING_COUNT, DECAY_WEIGHT)
+
+_MODES = ("ring", "decay")
+
+
+def _reducer_name(red: Any) -> str:
+    names = {dim_zero_sum: "sum", dim_zero_max: "max", dim_zero_min: "min"}
+    if red is None:
+        return "None"
+    return names.get(red) or getattr(red, "__name__", repr(red))
+
+
+class WindowedMetric(Metric):
+    """Track ``metric`` over a sliding window (ring) or with exponential
+    decay.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> from metrics_tpu.windowed import WindowedMetric
+        >>> recent = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=1)
+        >>> for err in (9.0, 9.0, 0.0, 0.0, 0.0):  # old errors age out
+        ...     recent.update(jnp.array([err]), jnp.array([0.0]))
+        >>> float(recent.compute())  # only the last 3 buckets remain
+        0.0
+
+    Ring mode: ``window`` buckets of ``updates_per_bucket`` updates each;
+    ``compute()`` covers the whole ring, ``compute(window=w)`` the last
+    ``w`` buckets. Decay mode: ``WindowedMetric(m, mode="decay",
+    decay=0.99)`` keeps one exponentially-decayed copy of each sum leaf.
+    Reset / state_dict / merge_states / sync ride the stock
+    :class:`Metric` machinery — the states are ordinary array leaves.
+    """
+
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(
+        self,
+        metric: Metric,
+        *,
+        window: Optional[int] = None,
+        updates_per_bucket: Optional[int] = None,
+        mode: str = "ring",
+        decay: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise MetricsUserError(
+                f"WindowedMetric wraps a Metric instance, got {type(metric).__name__}"
+            )
+        if isinstance(metric, WindowedMetric):
+            raise MetricsUserError("WindowedMetric cannot wrap another WindowedMetric")
+        if mode not in _MODES:
+            raise MetricsUserError(f"`mode` must be one of {_MODES}, got {mode!r}")
+        if mode == "ring":
+            window = 8 if window is None else window
+            updates_per_bucket = 1 if updates_per_bucket is None else updates_per_bucket
+            if not isinstance(window, int) or window < 2:
+                raise MetricsUserError(f"`window` must be an int >= 2, got {window!r}")
+            if not isinstance(updates_per_bucket, int) or updates_per_bucket < 1:
+                raise MetricsUserError(
+                    f"`updates_per_bucket` must be a positive int, got {updates_per_bucket!r}"
+                )
+            if decay is not None:
+                raise MetricsUserError("`decay` only applies to mode='decay'")
+        else:
+            if window is not None or updates_per_bucket is not None:
+                # a silently-ignored ring knob would answer a different
+                # question than the caller configured (mirrors ring mode
+                # rejecting `decay`)
+                raise MetricsUserError(
+                    "`window`/`updates_per_bucket` only apply to mode='ring'"
+                )
+            window, updates_per_bucket = 0, 0  # unused in decay paths
+            if decay is None:
+                decay = 0.99
+            if not isinstance(decay, (int, float)) or not (0.0 < float(decay) < 1.0):
+                raise MetricsUserError(f"`decay` must be a float in (0, 1), got {decay!r}")
+        self.mode = mode
+        self.window = int(window)
+        self.updates_per_bucket = int(updates_per_bucket)
+        self._alpha = float(decay) if decay is not None else None
+        self._validate_windowable(metric, mode)
+        # template metric, stored via object.__setattr__ so it does NOT
+        # register as a child (a child registry would mark this class a
+        # wrapper and statically exclude it from the fused path) — the
+        # SlicedMetric precedent
+        object.__setattr__(self, "_template", metric.clone())
+        self._template.reset()
+        m = self._template
+        if mode == "ring":
+            for name, red in m._reductions.items():
+                default = jnp.asarray(m._defaults[name])
+                ringed = jnp.broadcast_to(default, (self.window,) + default.shape)
+                if red is dim_zero_sum:
+                    fx: Any = ring_sum_fx()
+                elif red is dim_zero_max:
+                    fx = "max"
+                elif red is dim_zero_min:
+                    fx = "min"
+                else:  # merge_like (validated)
+                    fx = ring_merge_fx(red)
+                self.add_state(name, default=jnp.array(ringed), dist_reduce_fx=fx)
+            # literal state names (== the RING_* module constants, pinned by
+            # test) so the tracelint interpreter serializes these leaves —
+            # and their ring reducers — into the fusibility manifest
+            self.add_state("_ring_rows", default=jnp.zeros(self.window, jnp.int32), dist_reduce_fx="ring")
+            self.add_state("_ring_count", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="max")
+        else:
+            for name in m._reductions:
+                default = jnp.asarray(m._defaults[name])
+                if jnp.issubdtype(default.dtype, jnp.integer) or default.dtype == jnp.bool_:
+                    # a decayed count is fractional by construction — an
+                    # integer leaf would truncate alpha to 0 and silently
+                    # reset instead of decaying
+                    default = default.astype(jnp.float32)
+                self.add_state(name, default=default, dist_reduce_fx="decay")
+            self.add_state("_decay_weight", default=jnp.asarray(0.0, jnp.float32), dist_reduce_fx="decay")
+        # pad-and-mask contract: the wrapper performs its own slot-aware
+        # pad correction (or threads n_valid into a masking template), so
+        # bucketed fused dispatches stay exact — see _update/_pad_correct
+        self.__fused_mask_valid__ = True
+
+    # ------------------------------------------------------------------
+    # construction-time validation
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _validate_windowable(metric: Metric, mode: str) -> None:
+        cls_name = type(metric).__name__
+        if getattr(metric, "__jit_unsafe__", False):
+            raise MetricsUserError(
+                f"`{cls_name}` declares `__jit_unsafe__` — its update cannot trace, so it"
+                " cannot run inside the windowed ring/decay kernel."
+            )
+        if metric._children:
+            raise MetricsUserError(
+                f"`{cls_name}` is a wrapper metric (child registry"
+                f" {sorted(dict(metric._iter_child_metrics()))}); window the inner"
+                " metric directly instead of the wrapper."
+            )
+        for name, red in metric._reductions.items():
+            default = metric._defaults[name]
+            if isinstance(default, list):
+                raise MetricsUserError(
+                    f"`{cls_name}` state `{name}` is a list ('cat') state; unbounded"
+                    " concatenation has no fixed-shape ring row. Use the metric's"
+                    " sketched mode (fixed-capacity merge leaves window exactly)."
+                )
+            if name in _RESERVED:
+                raise MetricsUserError(
+                    f"`{cls_name}` state `{name}` collides with a reserved windowed"
+                    " state name"
+                )
+            merge_like = bool(getattr(red, "merge_like", False))
+            if mode == "decay":
+                if red is not dim_zero_sum:
+                    hint = (
+                        " (extrema cannot forget and sketch weights must not be scaled"
+                        " — use mode='ring')"
+                        if red in (dim_zero_max, dim_zero_min) or merge_like
+                        else ""
+                    )
+                    raise MetricsUserError(
+                        f"`{cls_name}` state `{name}` has reducer"
+                        f" `{_reducer_name(red)}`; exponential decay is only exact for"
+                        f" sum-reduced leaves{hint}. A mean-style metric should"
+                        " accumulate sum-reduced numerator/denominator leaves."
+                    )
+            elif red not in (dim_zero_sum, dim_zero_max, dim_zero_min) and not merge_like:
+                hint = (
+                    " (the auto mean-merge counter has no per-bucket fold)"
+                    if name == _AUTO_COUNT
+                    else ""
+                )
+                raise MetricsUserError(
+                    f"`{cls_name}` state `{name}` has reducer"
+                    f" `{_reducer_name(red)}`; only sum/max/min/merge-reduced array"
+                    f" states have an exact per-bucket ring fold{hint}. A mean-style"
+                    " metric should accumulate sum-reduced numerator/denominator"
+                    " leaves (see MeanMetric)."
+                )
+
+    # ------------------------------------------------------------------
+    # update
+    # ------------------------------------------------------------------
+    @property
+    def wrapped(self) -> Metric:
+        """The wrapped template metric (its states are placeholders)."""
+        return self._template
+
+    @property
+    def bucket_counts(self) -> Array:
+        """Updates absorbed per ring bucket, ``[R]`` int32 (ring mode)."""
+        if self.mode != "ring":
+            raise MetricsUserError("`bucket_counts` is a ring-mode query")
+        return jnp.asarray(getattr(self, RING_ROWS))
+
+    @property
+    def decay_weight(self) -> Array:
+        """Effective decayed sample weight ``sum_i alpha^i`` (decay mode)."""
+        if self.mode != "decay":
+            raise MetricsUserError("`decay_weight` is a decay-mode query")
+        return jnp.asarray(getattr(self, DECAY_WEIGHT))
+
+    def _pad_correct(
+        self,
+        new: Dict[str, Array],
+        args: Any,
+        fkw: Dict[str, Any],
+        n_valid: Optional[Array],
+        m: Metric,
+    ) -> Dict[str, Array]:
+        """Remove the edge-pad rows' contribution from the template's
+        sum-reduced leaves: pads replicate the last real row (the fused
+        bucketing contract), so their contribution is ``k_pad *
+        delta(last_row)`` — subtracted HERE, where the live ring slot is
+        known, instead of by the fused kernel's generic correction (which
+        probes from the default state and would land at slot 0)."""
+        if n_valid is None:
+            return new
+        leaves, treedef = jax.tree_util.tree_flatten((args, fkw))
+        b = None
+        for x in leaves:
+            if isinstance(x, (jnp.ndarray, np.ndarray)) and getattr(x, "ndim", 0) >= 1:
+                b = int(x.shape[0])  # static leading dim (shape read)
+                break
+        if b is None:
+            return new
+        k_pad = jnp.asarray(b, jnp.int32) - jnp.asarray(n_valid, jnp.int32)
+        pad_leaves = []
+        for x in leaves:
+            if isinstance(x, (jnp.ndarray, np.ndarray)) and getattr(x, "ndim", 0) >= 1:
+                pad_leaves.append(x[-1:])
+            else:
+                pad_leaves.append(x)
+        pa, pkw = jax.tree_util.tree_unflatten(treedef, pad_leaves)
+        init = {k: jnp.asarray(v) for k, v in m._defaults.items()}
+        d = m.update_state(dict(init), *pa, **pkw)
+        out = dict(new)
+        for name, red in m._reductions.items():
+            if red is dim_zero_sum:
+                delta = d[name] - init[name]
+                out[name] = out[name] - delta * k_pad.astype(jnp.result_type(delta))
+        return out
+
+    def _update(self, *args: Any, **kwargs: Any) -> None:
+        m = self._template
+        n_valid = kwargs.pop("n_valid", None)
+        template_masks = bool(getattr(m, "__fused_mask_valid__", False))
+        fkw = m._filter_kwargs(**kwargs)
+        call_kw = fkw
+        if template_masks and n_valid is not None:
+            # the template owns its merge-leaf pad masking (weight-0 sketch
+            # inserts) — but its SUM companions (e.g. a sketched curve's
+            # n_seen) still count the full padded batch, so the k * delta
+            # correction below applies to them either way; the pad probe
+            # runs on `fkw` (no n_valid) so the single-row delta is the
+            # full unmasked contribution being removed
+            call_kw = dict(fkw)
+            call_kw["n_valid"] = n_valid
+
+        if self.mode == "decay":
+            base = {
+                name: jnp.asarray(self._alpha, jnp.asarray(getattr(self, name)).dtype)
+                * jnp.asarray(getattr(self, name))
+                for name in m._defaults
+            }
+            new = m.update_state(base, *args, **call_kw)
+            new = self._pad_correct(new, args, fkw, n_valid, m)
+            for name in m._defaults:
+                # keep the registered (float-promoted) dtype: the template's
+                # update may hand back its own integer arithmetic
+                dtype = jnp.asarray(self._defaults[name]).dtype
+                object.__setattr__(self, name, jnp.asarray(new[name]).astype(dtype))
+            w = jnp.asarray(getattr(self, DECAY_WEIGHT))
+            object.__setattr__(self, DECAY_WEIGHT, jnp.asarray(self._alpha, w.dtype) * w + 1.0)
+            return
+
+        count = jnp.asarray(getattr(self, RING_COUNT))
+        k, r = self.updates_per_bucket, self.window
+        slot = (count // k) % r
+        fresh = (count % k) == 0
+        defaults = {name: jnp.asarray(v) for name, v in m._defaults.items()}
+        base = {}
+        for name in m._defaults:
+            leaf = jnp.asarray(getattr(self, name))
+            # first update of a bucket restores the slot to defaults, so a
+            # wrapped (expired) bucket self-evicts before accumulating
+            base[name] = jnp.where(fresh, defaults[name], leaf[slot])
+        new = m.update_state(base, *args, **call_kw)
+        new = self._pad_correct(new, args, fkw, n_valid, m)
+        for name in m._defaults:
+            leaf = jnp.asarray(getattr(self, name))
+            object.__setattr__(self, name, leaf.at[slot].set(new[name].astype(leaf.dtype)))
+        rows = jnp.asarray(getattr(self, RING_ROWS))
+        object.__setattr__(
+            self, RING_ROWS, rows.at[slot].set(jnp.where(fresh, 0, rows[slot]) + 1)
+        )
+        object.__setattr__(self, RING_COUNT, count + 1)
+
+    # ------------------------------------------------------------------
+    # window folds / compute
+    # ------------------------------------------------------------------
+    def _window_rows(self, window: int, before: int = 0) -> List[Dict[str, Array]]:
+        """The last ``window`` buckets' row states ending ``before`` buckets
+        back, oldest first. Host-side (compute is an eager, host-driven
+        cycle like every other metric's) — requires a concrete clock."""
+        m = self._template
+        count = int(getattr(self, RING_COUNT))
+        if count == 0:
+            return []
+        k, r = self.updates_per_bucket, self.window
+        cur = (count - 1) // k - before
+        if cur < 0:
+            return []
+        lo = max(cur - window + 1, 0)
+        if (count - 1) // k - lo >= r:
+            raise MetricsUserError(
+                f"window of {window} bucket(s) ending {before} back reaches past the"
+                f" ring span ({r} buckets); those buckets were already evicted"
+            )
+        rows: List[Dict[str, Array]] = []
+        counts = np.asarray(getattr(self, RING_ROWS))
+        for b in range(lo, cur + 1):
+            if counts[b % r] <= 0:
+                continue  # a bucket `before` skipped past (never filled)
+            rows.append({name: jnp.asarray(getattr(self, name))[b % r] for name in m._defaults})
+        return rows
+
+    def window_state(self, window: Optional[int] = None, *, before: int = 0) -> Dict[str, Array]:
+        """The wrapped metric's state folded over the last ``window``
+        buckets (default: the whole ring) ending ``before`` buckets back —
+        the unit :mod:`metrics_tpu.observability.drift` compares. Rows fold
+        oldest-first through the wrapped reducers (``merge_states``), so
+        sum leaves are exact and sketch leaves keep arrival order."""
+        if self.mode != "ring":
+            raise MetricsUserError(
+                "window_state() is a ring-mode query; decay mode keeps one decayed state"
+            )
+        w = self.window if window is None else window
+        if not isinstance(w, int) or w < 1:
+            raise MetricsUserError(f"`window` must be a positive int, got {w!r}")
+        if w > self.window:
+            # the same strict-eviction contract `before` over-reach gets: a
+            # silently clamped answer would report an R-bucket value labeled
+            # as a wider window
+            raise MetricsUserError(
+                f"`window` of {w} bucket(s) exceeds the ring span ({self.window});"
+                " construct the metric with a larger `window` to query it"
+            )
+        if not isinstance(before, int) or before < 0:
+            raise MetricsUserError(f"`before` must be a non-negative int, got {before!r}")
+        m = self._template
+        rows = self._window_rows(w, before)
+        if not rows:
+            return {name: jnp.array(v) for name, v in m._defaults.items()}
+        state = rows[0]
+        for row in rows[1:]:
+            state = m.merge_states(state, row)
+        return state
+
+    def _compute(self) -> Any:
+        m = self._template
+        if self.mode == "decay":
+            return m.compute_state({name: getattr(self, name) for name in m._defaults})
+        return m.compute_state(self.window_state())
+
+    def compute(self, *, window: Optional[int] = None, before: Optional[int] = None) -> Any:
+        """The wrapped metric over the window.
+
+        With no arguments: the whole ring (or the decayed state) through
+        the ordinary :meth:`Metric.compute` cycle (caching, distributed
+        sync). ``window=w`` evaluates the last ``w`` buckets only —
+        local states, no sync, no cache; ``before=b`` shifts the window
+        end ``b`` buckets back (how drift comparators read a reference
+        window). Ring mode only."""
+        if window is None and before is None:
+            return super().compute()
+        if self.mode != "ring":
+            raise MetricsUserError("compute(window=...) is a ring-mode query")
+        m = self._template
+        return _squeeze_if_scalar(
+            m.compute_state(self.window_state(window, before=before or 0))
+        )
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def state_footprint(self, include_children: bool = True) -> Dict[str, int]:
+        """Per-state bytes with every key under ``windowed/`` — the
+        telemetry recorder splits on the prefix so the ``R``-fold window
+        cost tracks under a distinct ``<Metric>[windowed]`` high-water-mark
+        label instead of masquerading as base-state growth."""
+        base = super().state_footprint(include_children=include_children)
+        return {f"{WINDOWED_FOOTPRINT_PREFIX}{k}": v for k, v in base.items()}
+
+    def __repr__(self) -> str:
+        inner = type(self._template).__name__
+        if self.mode == "decay":
+            return f"{type(self).__name__}({inner}(), mode='decay', decay={self._alpha})"
+        return (
+            f"{type(self).__name__}({inner}(), window={self.window},"
+            f" updates_per_bucket={self.updates_per_bucket})"
+        )

@@ -1,0 +1,108 @@
+"""Tagged reducers for windowed metric state (``metrics_tpu.windowed``).
+
+A :class:`~metrics_tpu.windowed.WindowedMetric` leaf is still *sum-shaped*
+across ranks — same-bucket ring rows (and decayed sums of lock-stepped
+streams) add elementwise — but it must NOT be ``dim_zero_sum`` itself: the
+fused kernel's pad-and-mask correction subtracts ``k * delta(last_row)``
+from every ``dim_zero_sum`` leaf, and a windowed metric already performs
+its own slot-aware correction inside ``_update`` (the probe's delta would
+land at the DEFAULT state's ring slot, not the live one — a silent
+double-correction). These module-level classes are that distinction made
+typed: callables with the stacked-leaves fold contract of
+``Metric._sync_dist`` / ``sync_in_mesh``, tagged so every consumer
+(``merge_states``, the fused bucket-eligibility check, tracelint, the
+manifest) can recognize windowed leaves without importing jax-heavy
+modules at decision time:
+
+* ``windowed_kind`` — ``"ring"`` or ``"decay"`` (which window semantics
+  the leaf carries);
+* ``inner_reduce`` — the wrapped metric's own reducer the window rows
+  fold through (``"sum"`` here; ring max/min leaves keep the plain
+  ``dim_zero_max``/``dim_zero_min`` reducers — an elementwise extremum is
+  already both pad-immune and rank-correct);
+* ``merge_like`` (ring-of-sketches only) — rides the fused merge-gather
+  round of ``sync_pytree_in_mesh`` and the stacked-pair ``merge_states``
+  contract, folding per-slot instead of flattening the ring axis.
+
+All classes are module-level (pickle/deepcopy-safe) like the sketch
+reducers in :mod:`metrics_tpu.sketches.quantile`.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decay_sum_fx", "ring_merge_fx", "ring_sum_fx"]
+
+
+class _WindowedSumReduce:
+    """Cross-rank fold for a windowed sum leaf: elementwise sum of the
+    stacked per-rank leaves (ring rows align on bucket index across
+    lock-stepped ranks; decayed sums of synchronized streams are additive).
+    Distinct from ``dim_zero_sum`` ON PURPOSE — see the module docstring."""
+
+    inner_reduce = "sum"
+
+    def __init__(self, kind: str) -> None:
+        self.windowed_kind = kind
+        self.__name__ = f"{kind}_sum"
+
+    def __call__(self, stacked: Any) -> Any:
+        return jnp.sum(jnp.asarray(stacked), axis=0)
+
+    def __reduce__(self):  # pickle via the public constructors
+        return (ring_sum_fx if self.windowed_kind == "ring" else decay_sum_fx, ())
+
+
+_RING_SUM = _WindowedSumReduce("ring")
+_DECAY_SUM = _WindowedSumReduce("decay")
+
+
+def ring_sum_fx() -> _WindowedSumReduce:
+    """The shared ring-of-sums ``dist_reduce_fx`` (``add_state`` maps the
+    string ``"ring"`` here)."""
+    return _RING_SUM
+
+
+def decay_sum_fx() -> _WindowedSumReduce:
+    """The shared decayed-sum ``dist_reduce_fx`` (``add_state`` maps the
+    string ``"decay"`` here)."""
+    return _DECAY_SUM
+
+
+class _RingMergeReduce:
+    """Cross-rank fold for a ring-of-sketches leaf ``[R, capacity, cols]``:
+    the stacked per-rank rings ``[world, R, capacity, cols]`` fold pairwise
+    with the wrapped metric's own merge reducer vmapped over the ring axis,
+    so slot ``i`` of every rank merges with slot ``i`` of every other —
+    never across buckets. Inside each sketch's lossless window the fold is
+    rank-order concatenation per slot, bit-identical to a cat-gather."""
+
+    merge_like = True
+    windowed_kind = "ring"
+    __name__ = "ring_merge"
+
+    def __init__(self, inner: Any) -> None:
+        self._inner = inner
+        self.sketch_kind = getattr(inner, "sketch_kind", "quantile")
+
+    def __call__(self, stacked: Any) -> Any:
+        stacked = jnp.asarray(stacked)
+        if stacked.ndim == 3:  # single-rank passthrough: [R, capacity, cols]
+            return stacked
+        inner = self._inner
+        out = stacked[0]
+        for i in range(1, stacked.shape[0]):
+            out = jax.vmap(lambda a, b: inner(jnp.stack([a, b])))(out, stacked[i])
+        return out
+
+    def __reduce__(self):
+        return (ring_merge_fx, (self._inner,))
+
+
+def ring_merge_fx(inner: Any) -> _RingMergeReduce:
+    """Ring-axis wrapper for a tagged ``merge_like`` reducer (the wrapped
+    metric's own sketch merge) — see :class:`_RingMergeReduce`."""
+    return _RingMergeReduce(inner)

@@ -81,13 +81,23 @@ AUX_FIELDS: Dict[str, str] = {
     "sketch_auroc_abs_err": "lower",
     "sketch_fused_compiles": "lower",
     "fused_telemetry_on_ratio": "higher",
+    "windowed_vs_plain": "higher",
+    "windowed_compiles": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
 #: bench that reports a false parity bit (async final states diverged from
 #: the blocking path) is broken no matter how fast it ran, and the
 #: ratio/wall checks above would pass it silently
-BOOL_FIELDS: Tuple[str, ...] = ("states_bit_identical", "sketch_window_bit_exact")
+BOOL_FIELDS: Tuple[str, ...] = (
+    "states_bit_identical",
+    "sketch_window_bit_exact",
+    "windowed_ring_fold_exact",
+    # exactly-one-compile as a BOOL: the "lower"-direction AUX gate on
+    # windowed_compiles would pass n_compiles == 0 — a total eager
+    # demotion, the very regression the anchor exists to catch
+    "windowed_fused",
+)
 
 
 def _lower_is_better(record: Dict[str, Any]) -> bool:

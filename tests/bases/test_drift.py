@@ -1,0 +1,405 @@
+"""Drift observatory (ISSUE 12): PSI/KL/JS/TV scores, the DriftRule's
+freeze-then-compare lifecycle as the seventh standard alarm class, the
+``metrics_tpu_drift_score`` Prometheus family, and aggregate-payload
+carry-through (incl. the mixed-version-fleet identity contract).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.observability.drift import (
+    DRIFT_STATS,
+    categorical_drift,
+    histogram_drift,
+    js_divergence_hist,
+    kl_divergence_hist,
+    normalize_histogram,
+    psi_divergence,
+    reference_edges,
+    sketch_drift,
+    state_drift,
+    total_variation,
+)
+from metrics_tpu.observability.health import DriftRule, HealthMonitor, default_rules
+from metrics_tpu.observability.recorder import SERIES_SCORES
+from metrics_tpu.observability.timeseries import TimeSeriesRegistry
+from metrics_tpu.sketches.quantile import qsketch_init, qsketch_insert
+
+T0 = 50_000.0
+
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.detach_timeseries()
+        rec.reset()
+
+
+def _registry(**kwargs):
+    kwargs.setdefault("bucket_seconds", 1.0)
+    kwargs.setdefault("n_buckets", 60)
+    kwargs.setdefault("sketch_capacity", 128)
+    return TimeSeriesRegistry(**kwargs)
+
+
+def _sketch_of(values, capacity=256):
+    sk = qsketch_init(capacity)
+    return qsketch_insert(sk, jnp.asarray(np.asarray(values, np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# score math
+# ---------------------------------------------------------------------------
+
+class TestScores:
+    def test_identical_histograms_score_zero(self):
+        h = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+        assert psi_divergence(h, h) == pytest.approx(0.0, abs=1e-6)
+        assert kl_divergence_hist(h, h) == pytest.approx(0.0, abs=1e-6)
+        assert js_divergence_hist(h, h) == pytest.approx(0.0, abs=1e-6)
+        assert total_variation(h, h) == pytest.approx(0.0, abs=1e-6)
+
+    def test_known_values_and_bounds(self):
+        p = [80.0, 20.0]
+        q = [20.0, 80.0]
+        # PSI closed form: (0.8-0.2)ln(4) + (0.2-0.8)ln(1/4) = 1.2*ln 4
+        assert psi_divergence(p, q) == pytest.approx(1.2 * np.log(4.0), rel=1e-3)
+        assert total_variation(p, q) == pytest.approx(0.6, rel=1e-3)
+        assert 0.0 < js_divergence_hist(p, q) <= np.log(2.0) + 1e-6
+        # KL is asymmetric; JS/TV/PSI symmetric
+        assert psi_divergence(p, q) == pytest.approx(psi_divergence(q, p), rel=1e-6)
+        assert total_variation(p, q) == pytest.approx(total_variation(q, p), rel=1e-6)
+
+    def test_empty_sides_are_finite(self):
+        """Relative smoothing: one-sided-empty bins contribute large-but-
+        finite terms; two empty histograms compare as identical uniform."""
+        assert np.isfinite(psi_divergence([0.0, 10.0], [10.0, 0.0]))
+        assert psi_divergence([0.0, 0.0], [0.0, 0.0]) == pytest.approx(0.0, abs=1e-6)
+
+    def test_normalize_histogram_floors_bins(self):
+        p = np.asarray(normalize_histogram([0.0, 100.0]))
+        assert p.sum() == pytest.approx(1.0, rel=1e-6)
+        assert p[0] > 0  # floored, never exactly zero
+
+    def test_histogram_drift_reports_all_stats(self):
+        out = histogram_drift([5.0, 5.0], [9.0, 1.0])
+        assert set(out) == set(DRIFT_STATS)
+        assert all(np.isfinite(v) for v in out.values())
+
+    def test_categorical_drift_confusion_matrices(self):
+        ref = jnp.asarray([[50.0, 5.0], [5.0, 40.0]])
+        live_same = ref * 3.0  # scale-invariant
+        assert categorical_drift(ref, live_same)["tv"] == pytest.approx(0.0, abs=1e-4)
+        live_flipped = jnp.asarray([[5.0, 50.0], [40.0, 5.0]])
+        assert categorical_drift(ref, live_flipped)["tv"] > 0.5
+        with pytest.raises(ValueError, match="same-shaped"):
+            categorical_drift(jnp.zeros((2, 2)), jnp.zeros((3, 3)))
+
+
+# ---------------------------------------------------------------------------
+# sketch comparisons
+# ---------------------------------------------------------------------------
+
+class TestSketchDrift:
+    def test_same_distribution_scores_low_shifted_scores_high(self):
+        rng = np.random.RandomState(0)
+        ref = _sketch_of(rng.normal(0.3, 0.1, 2000).clip(0, 1))
+        same = _sketch_of(rng.normal(0.3, 0.1, 2000).clip(0, 1))
+        shifted = _sketch_of(rng.normal(0.8, 0.1, 2000).clip(0, 1))
+        edges = reference_edges(ref, n_bins=10)
+        low = sketch_drift(ref, same, edges)
+        high = sketch_drift(ref, shifted, edges)
+        assert low["psi"] < 0.1 < high["psi"]
+        assert low["tv"] < 0.1 < high["tv"]
+
+    def test_reference_edges_validation(self):
+        with pytest.raises(ValueError, match="empty sketch"):
+            reference_edges(qsketch_init(16))
+        with pytest.raises(ValueError, match="n_bins"):
+            reference_edges(_sketch_of([1.0, 2.0]), n_bins=1)
+
+    def test_state_drift_over_windowed_folds(self):
+        """The windowed-metric integration: reference vs live window folds
+        of a ring-of-sketches AUROC diverge when the score stream shifts."""
+        from metrics_tpu import AUROC, WindowedMetric
+
+        rng = np.random.RandomState(1)
+        wm = WindowedMetric(AUROC(pos_label=1, sketch_capacity=256), window=6, updates_per_bucket=1)
+        for _ in range(3):
+            wm.update(
+                jnp.asarray(rng.normal(0.3, 0.1, 64).clip(0, 1).astype(np.float32)),
+                jnp.asarray((rng.rand(64) < 0.4).astype(np.int32)),
+            )
+        for _ in range(3):
+            wm.update(
+                jnp.asarray(rng.normal(0.8, 0.1, 64).clip(0, 1).astype(np.float32)),
+                jnp.asarray((rng.rand(64) < 0.4).astype(np.int32)),
+            )
+        scores = state_drift(wm.wrapped, wm.window_state(3, before=3), wm.window_state(3))
+        assert "csketch" in scores
+        assert scores["csketch"]["psi"] > 0.5
+        assert 0.0 < scores["csketch"]["tv"] <= 1.0
+
+    def test_state_drift_accepts_the_wrapper_itself(self):
+        """Passing the WindowedMetric (not .wrapped) must not silently
+        skip its categorical sum leaves — the tagged ring reducers are
+        sum-shaped and the window folds are template-shaped."""
+        from metrics_tpu import ConfusionMatrix, WindowedMetric
+
+        rng = np.random.RandomState(8)
+        wm = WindowedMetric(ConfusionMatrix(num_classes=2), window=6, updates_per_bucket=1)
+        for _ in range(3):
+            t = jnp.asarray(rng.randint(0, 2, 64).astype(np.int32))
+            wm.update(t, t)  # diagonal mass
+        for _ in range(3):
+            t = jnp.asarray(rng.randint(0, 2, 64).astype(np.int32))
+            wm.update(1 - t, t)  # flipped: off-diagonal mass
+        scores = state_drift(wm, wm.window_state(3, before=3), wm.window_state(3))
+        assert "confmat" in scores and scores["confmat"]["tv"] > 0.5
+
+    def test_window_past_ring_span_raises_not_clamps(self):
+        from metrics_tpu import MeanSquaredError, WindowedMetric
+        from metrics_tpu.utils.exceptions import MetricsUserError
+
+        wm = WindowedMetric(MeanSquaredError(), window=4)
+        wm.update(jnp.asarray([1.0]), jnp.asarray([0.0]))
+        with pytest.raises(MetricsUserError, match="exceeds the ring span"):
+            wm.compute(window=100)
+
+    def test_state_drift_skips_reservoir_leaves(self):
+        """Reservoir leaves pack [Gumbel priority, payload] rows — reading
+        the priority column as a weight scores identical distributions as
+        drifted, so non-quantile sketch kinds are skipped."""
+        from metrics_tpu import SpearmanCorrCoef
+
+        rng = np.random.RandomState(9)
+        a = SpearmanCorrCoef()
+        b = SpearmanCorrCoef()
+        for m in (a, b):
+            x = rng.rand(128).astype(np.float32)
+            m.update(jnp.asarray(x), jnp.asarray((x + rng.rand(128) * 0.1).astype(np.float32)))
+        scores = state_drift(
+            a,
+            {k: getattr(a, k) for k in a._defaults},
+            {k: getattr(b, k) for k in b._defaults},
+        )
+        assert "rsketch" not in scores
+
+    def test_state_drift_categorical_sum_leaves(self):
+        from metrics_tpu import ConfusionMatrix
+
+        ref_m = ConfusionMatrix(num_classes=2)
+        ref_m.update(jnp.asarray([0, 0, 1, 1]), jnp.asarray([0, 0, 1, 1]))
+        live_m = ConfusionMatrix(num_classes=2)
+        live_m.update(jnp.asarray([1, 1, 0, 0]), jnp.asarray([0, 0, 1, 1]))
+        scores = state_drift(
+            ref_m,
+            {"confmat": getattr(ref_m, "confmat")},
+            {"confmat": getattr(live_m, "confmat")},
+        )
+        assert scores["confmat"]["tv"] == pytest.approx(1.0, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# DriftRule lifecycle
+# ---------------------------------------------------------------------------
+
+def _feed(reg, dist, t0, seconds, rate=20, per=32, rng=None):
+    rng = rng or np.random.RandomState(0)
+    t = t0
+    for _ in range(int(seconds * rate)):
+        for v in dist(rng, per):
+            reg.observe(SERIES_SCORES, float(v), t=t)
+        t += 1.0 / rate
+    return t
+
+
+def _healthy(rng, n):
+    return np.clip(rng.normal(0.3, 0.1, n), 0, 1)
+
+
+def _shifted(rng, n):
+    return np.clip(rng.normal(0.8, 0.08, n), 0, 1)
+
+
+class TestDriftRule:
+    def test_fires_on_shift_and_clears_on_recovery(self, recorder):
+        reg = _registry()
+        rule = DriftRule("score_drift", SERIES_SCORES, stat="psi", threshold=0.25,
+                         window_s=5.0, freeze_after=100, min_count=16)
+        mon = HealthMonitor([rule], registry=reg)
+        rng = np.random.RandomState(2)
+        t = _feed(reg, _healthy, T0, 2.0, rng=rng)
+        snap = mon.evaluate(now=t)
+        assert not snap.firing and "frozen" in snap.alarms[0].detail
+        snap = mon.evaluate(now=t)  # healthy live vs healthy reference
+        assert not snap.firing and snap.alarms[0].value < 0.25
+
+        t2 = _feed(reg, _shifted, t + 10, 6.0, rng=rng)
+        snap = mon.evaluate(now=t2)
+        assert snap.firing and snap.alarms[0].value > 0.25
+        assert snap.status == "warn"
+
+        t3 = _feed(reg, _healthy, t2 + 10, 6.0, rng=rng)
+        snap = mon.evaluate(now=t3)
+        assert not snap.firing
+        assert mon.fired_and_cleared() == ["score_drift"]
+        # scores landed on the recorder as gauges
+        assert any(k.startswith(f"{SERIES_SCORES}|psi") for k in recorder.drift_scores())
+
+    def test_scores_land_on_the_monitor_recorder_override(self):
+        """A monitor constructed with recorder= routes DriftRule's score
+        gauges there, like every other health family — not to the process
+        default."""
+        from metrics_tpu.observability import MetricRecorder
+
+        mine = MetricRecorder("mine")
+        mine.enable()
+        reg = _registry()
+        rule = DriftRule("d", SERIES_SCORES, threshold=0.25, window_s=5.0,
+                         freeze_after=50, min_count=16)
+        mon = HealthMonitor([rule], registry=reg, recorder=mine)
+        rng = np.random.RandomState(6)
+        t = _feed(reg, _healthy, T0, 2.0, rng=rng)
+        mon.evaluate(now=t)  # freeze
+        mon.evaluate(now=t)  # score
+        assert any(k.endswith("|psi") for k in mine.drift_scores())
+        assert not get_recorder().drift_scores()  # default untouched (disabled)
+
+    def test_record_scores_sampling_covers_the_batch_tail(self, recorder):
+        """Ceil-stride sampling: the last region of an ordered batch must
+        be represented (floor stride + truncation always dropped it)."""
+        reg = recorder.attach_timeseries(bucket_seconds=1.0, n_buckets=16, sketch_capacity=64)
+        recorder.record_scores(np.arange(100, dtype=np.float64), max_samples=32)
+        s = reg.get(SERIES_SCORES)
+        assert s.count(None) <= 32
+        assert s.value_max(None) >= 96  # the tail region was sampled
+
+    def test_collecting_reference_never_fires(self):
+        reg = _registry()
+        rule = DriftRule("d", SERIES_SCORES, freeze_after=10_000)
+        firing, value, detail = rule.evaluate(reg, now=T0)
+        assert not firing and "absent" in detail
+        rng = np.random.RandomState(3)
+        t = _feed(reg, _shifted, T0, 1.0, rng=rng)
+        firing, value, detail = rule.evaluate(reg, now=t)
+        assert not firing and "collecting reference" in detail
+
+    def test_explicit_freeze_reference(self):
+        """The serving loop's phase-boundary freeze: bypasses the count
+        gate so a cold-cache crawl cannot push the baseline into a fault
+        window."""
+        reg = _registry()
+        rule = DriftRule("d", SERIES_SCORES, threshold=0.25, window_s=5.0,
+                         freeze_after=10_000, min_count=16)
+        assert not rule.freeze_reference(reg)  # absent series: no-op
+        rng = np.random.RandomState(4)
+        t = _feed(reg, _healthy, T0, 1.0, rng=rng)
+        assert rule.freeze_reference(reg, now=t)
+        t2 = _feed(reg, _shifted, t + 10, 6.0, rng=rng)
+        firing, value, _ = rule.evaluate(reg, now=t2)
+        assert firing and value > 0.25
+
+    def test_reset_reference_rebaselines(self):
+        reg = _registry()
+        rule = DriftRule("d", SERIES_SCORES, threshold=0.25, window_s=5.0,
+                         freeze_after=50, min_count=16)
+        rng = np.random.RandomState(5)
+        t = _feed(reg, _healthy, T0, 2.0, rng=rng)
+        rule.evaluate(reg, now=t)  # freeze on healthy
+        t2 = _feed(reg, _shifted, t + 10, 6.0, rng=rng)
+        assert rule.evaluate(reg, now=t2)[0]
+        rule.reset_reference()
+        # re-freezes on the (shifted) present: drift is relative to "then"
+        rule.evaluate(reg, now=t2)
+        firing, value, _ = rule.evaluate(reg, now=t2)
+        assert not firing and value < 0.25
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="stat"):
+            DriftRule("d", SERIES_SCORES, stat="chi2")
+        with pytest.raises(ValueError, match="window_s"):
+            DriftRule("d", SERIES_SCORES, window_s=0)
+        with pytest.raises(ValueError, match="freeze_after"):
+            DriftRule("d", SERIES_SCORES, freeze_after=0)
+        with pytest.raises(ValueError, match="n_bins"):
+            DriftRule("d", SERIES_SCORES, n_bins=1)
+
+    def test_default_rules_seventh_class(self):
+        rules = default_rules()
+        names = [r.name for r in rules]
+        assert "score_drift" in names
+        drift = next(r for r in rules if r.name == "score_drift")
+        assert isinstance(drift, DriftRule)
+        # absent series: the monitor evaluates clean (no scores recorded)
+        mon = HealthMonitor(rules, registry=_registry())
+        snap = mon.evaluate(now=T0)
+        assert snap.status == "ok" and not snap.firing
+
+
+# ---------------------------------------------------------------------------
+# exporters + aggregate carry-through
+# ---------------------------------------------------------------------------
+
+class TestExportAndAggregate:
+    def test_prometheus_family_and_summary(self, recorder):
+        from metrics_tpu.observability.exporters import render_prometheus, summary
+
+        recorder.record_drift_score(SERIES_SCORES, "psi", 0.37)
+        page = render_prometheus(recorder)
+        assert 'metrics_tpu_drift_score{metric="scores",stat="psi"} 0.37' in page
+        text = summary(recorder)
+        assert "drift scores" in text and "scores [psi]: 0.37" in text
+        # the JSONL stream carries the score trajectory
+        assert any(e.get("type") == "drift" for e in recorder.events())
+
+    def test_aggregate_carry_through_and_max_merge(self, recorder):
+        from metrics_tpu.observability.aggregate import counter_payload, merge_payloads
+        from metrics_tpu.observability.exporters import render_prometheus
+
+        recorder.record_drift_score(SERIES_SCORES, "psi", 0.2)
+        local = counter_payload(recorder)
+        other = dict(local)
+        other = {**local, "process": 1, "drift_scores": {f"{SERIES_SCORES}|psi": 0.9}}
+        merged = merge_payloads([local, other])
+        assert merged["drift_scores"][f"{SERIES_SCORES}|psi"] == 0.9  # max wins
+        page = render_prometheus(aggregate=merged)
+        assert 'metrics_tpu_drift_score{metric="scores",stat="psi",process="0"} 0.2' in page
+        assert 'metrics_tpu_drift_score{metric="scores",stat="psi",process="1"} 0.9' in page
+
+    def test_mixed_version_fleet_missing_drift_family_is_identity(self, recorder):
+        """ISSUE 12 satellite: a rank on an older build (no drift/windowed
+        families at all) merges as identity and still renders."""
+        from metrics_tpu.observability.aggregate import counter_payload, merge_payloads
+        from metrics_tpu.observability.exporters import render_prometheus
+
+        recorder.record_drift_score(SERIES_SCORES, "js", 0.11)
+        bare = {"process": 7}  # ancient build: no families at all
+        merged = merge_payloads([bare, counter_payload(recorder)])
+        assert merged["drift_scores"] == {f"{SERIES_SCORES}|js": 0.11}
+        page = render_prometheus(aggregate=merged)
+        assert 'metrics_tpu_drift_score{metric="scores",stat="js",process="0"} 0.11' in page
+
+
+# ---------------------------------------------------------------------------
+# record_scores feed
+# ---------------------------------------------------------------------------
+
+class TestRecordScores:
+    def test_feeds_bounded_sample_into_series(self, recorder):
+        reg = recorder.attach_timeseries(bucket_seconds=1.0, n_buckets=16, sketch_capacity=64)
+        recorder.record_scores(np.linspace(0, 1, 1000), max_samples=16)
+        s = reg.get(SERIES_SCORES)
+        assert s is not None and s.count(None) == 16
+
+    def test_noop_when_detached(self, recorder):
+        recorder.detach_timeseries()
+        recorder.record_scores([0.5, 0.5])  # must not raise

@@ -1,7 +1,7 @@
-"""End-to-end serving-loop observatory test (ISSUE 11 acceptance): the
+"""End-to-end serving-loop observatory test (ISSUE 11/12 acceptance): the
 fault-injection demo trips and clears EVERY alarm class — queue,
-staleness, drop-rate, recompile, fill, hot-slice — while publishing
-telemetry + health artifacts the whole run.
+staleness, drop-rate, recompile, fill, hot-slice, score-drift — while
+publishing telemetry + health artifacts the whole run.
 
 Real wall clock (the loop paces itself and alarm clearing IS time
 passing), so this is the suite's one deliberately slow-ish test (~15s);
@@ -27,6 +27,7 @@ ALARM_CLASSES = (
     "recompile_storm",
     "sketch_fill",
     "hot_slice_skew",
+    "score_drift",
 )
 
 
@@ -71,6 +72,7 @@ def test_fault_injection_trips_and_clears_every_alarm_class(tmp_path):
     assert "metrics_tpu_health_status" in page
     assert "metrics_tpu_window_quantile" in page
     assert "metrics_tpu_async_batches_total" in page
+    assert 'metrics_tpu_drift_score{metric="scores",stat="psi"' in page
     assert "health:" in (tmp_path / "health.txt").read_text()
     trace = json.loads((tmp_path / "trace.json").read_text())
     assert any(e.get("ph") == "M" for e in trace["traceEvents"])

@@ -441,3 +441,40 @@ def test_merge_payloads_heterogeneous_families_are_identity():
 
     page = render_prometheus(aggregate=merged)
     assert 'metrics_tpu_calls_total{metric="A",phase="update"} 3' in page
+
+
+# ---------------------------------------------------------------------------
+# window_sketch + empty-bucket skip (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_window_sketch_and_empty_window_returns_none():
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=8, clock=lambda: 0.0)
+    assert s.window_sketch(4.0, now=100.0) is None  # empty window: None, never NaN
+    for i in range(10):
+        s.record(float(i), t=100.0 + i * 0.1)
+    sk = s.window_sketch(4.0, now=101.0)
+    assert sk is not None
+    from metrics_tpu.sketches.quantile import qsketch_total_weight
+
+    assert float(qsketch_total_weight(sk)) == 10.0
+    with pytest.raises(ValueError, match="counter"):
+        TelemetrySeries("c", kind="counter").window_sketch(4.0)
+
+
+def test_quantile_skips_zero_mass_buckets_instead_of_folding_nan():
+    """A payload-merged bucket can carry counts with zero-weight sketch
+    rows (a masked peer); the quantile query must skip the empty mass and
+    answer None — never fold the empty-sketch NaN sentinel into a number."""
+    s = TelemetrySeries("lat", bucket_seconds=1.0, n_buckets=8, clock=lambda: 0.0)
+    s.load_payload(
+        {
+            "buckets": [
+                {"i": 100, "c": 3, "s": 0.0, "mn": 0.0, "mx": 0.0, "sk": [[0.0, 1.0]]}
+            ]
+        }
+    )
+    assert s.quantile(0.5, window_s=4.0, now=100.5) is None
+    assert s.window_sketch(4.0, now=100.5) is None
+    # a real observation restores real answers
+    s.record(2.5, t=100.2)
+    assert s.quantile(0.5, window_s=4.0, now=100.5) == 2.5

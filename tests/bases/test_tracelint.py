@@ -1239,6 +1239,138 @@ class M(Metric):
         )
         assert "TL-FLOW" not in _rules_of(kept)
 
+    # -- windowed reducers (ISSUE 12): decayed-sum and ring-rotation writes
+
+    def test_decayed_write_into_decay_state_passes(self):
+        """The decay idiom: prior value SCALED before the delta lands."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="decay")
+    def _update(self, preds):
+        self.total = 0.99 * self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_plain_additive_write_into_decay_state_flags(self):
+        """An unscaled addition never decays — the leaf silently degrades
+        to an all-of-time sum while consumers read it as a window."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="decay")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_augassign_into_decay_state_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="decay")
+    def _update(self, preds):
+        self.total += jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_decay_state_overwrite_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="decay")
+    def _update(self, preds):
+        self.total = jnp.sum(preds)
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_ring_rotation_set_into_ring_state_passes(self):
+        """The ring idiom: one slot read, combined, written back with
+        `.at[slot].set` — reducer-consistent rotation."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("rows", default=jnp.zeros((8, 4)), dist_reduce_fx="ring")
+        self.add_state("clock", default=jnp.asarray(0), dist_reduce_fx="max")
+    def _update(self, preds):
+        slot = self.clock % 8
+        self.rows = self.rows.at[slot].set(self.rows[slot] + preds)
+        self.clock = jnp.maximum(self.clock, self.clock + 1)
+    def _compute(self):
+        return jnp.sum(self.rows, axis=0)
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_whole_leaf_additive_into_ring_state_flags(self):
+        """Pouring the batch into every bucket's row ignores rotation:
+        expired buckets never evict and every window over-counts."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("rows", default=jnp.zeros((8, 4)), dist_reduce_fx="ring")
+    def _update(self, preds):
+        self.rows = self.rows + preds
+    def _compute(self):
+        return jnp.sum(self.rows, axis=0)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_augassign_into_ring_state_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("rows", default=jnp.zeros((8, 4)), dist_reduce_fx="ring")
+    def _update(self, preds):
+        self.rows += preds
+    def _compute(self):
+        return jnp.sum(self.rows, axis=0)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
+    def test_ring_state_overwrite_flags(self):
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("rows", default=jnp.zeros((8, 4)), dist_reduce_fx="ring")
+    def _update(self, preds):
+        self.rows = jnp.broadcast_to(preds, (8, 4))
+    def _compute(self):
+        return jnp.sum(self.rows, axis=0)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+
     def test_where_guarded_sum_write_passes(self):
         """RHS mentioning the leaf (jnp.where blend) is accumulation the
         rule cannot refute — no finding."""

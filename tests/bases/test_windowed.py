@@ -1,0 +1,565 @@
+"""WindowedMetric: sliding-window (ring) and exponential-decay state for
+any fusible metric (ISSUE 12 tentpole).
+
+The acceptance pins: a ring-window ``compute()`` is BIT-identical to
+recomputing the same window's batches from scratch on integer-exact data
+(the sliding window IS the metric); decay mode matches its closed form;
+``WindowedMetric(Accuracy())`` and ``WindowedMetric(SlicedMetric(MSE))``
+run through ``compile_update_async`` with ONE compile across bucketed
+ragged shapes; ring-of-sketches leaves (sketched AUROC) window exactly
+inside the lossless window; and the windowed state pytree rides
+``sync_pytree_in_mesh`` unchanged.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    MeanSquaredError,
+    MetricCollection,
+    WindowedMetric,
+)
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+from metrics_tpu.sliced import SlicedMetric
+from metrics_tpu.utils.compat import shard_map
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.windowed import DECAY_WEIGHT, RING_COUNT, RING_ROWS
+from metrics_tpu.wrappers import MinMaxMetric
+
+
+def _int_batches(rng, n_batches, n=64, hi=7):
+    return [
+        (
+            jnp.asarray(rng.randint(0, hi, n).astype(np.float32)),
+            jnp.asarray(rng.randint(0, hi, n).astype(np.float32)),
+        )
+        for _ in range(n_batches)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_ring_fold_bit_identical_to_fresh_recompute(self):
+        """The acceptance pin: integer-exact data, ring compute == fresh
+        metric over exactly the in-window batches, bit for bit."""
+        rng = np.random.RandomState(0)
+        batches = _int_batches(rng, 11)
+        wm = WindowedMetric(MeanSquaredError(), window=4, updates_per_bucket=2)
+        for b in batches:
+            wm.update(*b)
+        # 11 updates, 2/bucket -> current bucket 5; ring holds buckets
+        # 2..5 = updates 4..10
+        fresh = MeanSquaredError()
+        for b in batches[4:]:
+            fresh.update(*b)
+        assert float(wm.compute()) == float(fresh.compute())
+
+    def test_narrow_window_and_before(self):
+        rng = np.random.RandomState(1)
+        batches = _int_batches(rng, 12)
+        wm = WindowedMetric(MeanSquaredError(), window=5, updates_per_bucket=2)
+        for b in batches:
+            wm.update(*b)
+        # current bucket 5; window=2 -> buckets 4..5 = updates 8..11
+        fresh = MeanSquaredError()
+        for b in batches[8:]:
+            fresh.update(*b)
+        assert float(wm.compute(window=2)) == float(fresh.compute())
+        # before=2 -> window of 2 ending at bucket 3 = updates 4..7
+        ref = MeanSquaredError()
+        for b in batches[4:8]:
+            ref.update(*b)
+        assert float(wm.compute(window=2, before=2)) == float(ref.compute())
+
+    def test_bucket_self_eviction_on_wrap(self):
+        """A wrapped slot is reset to defaults before accumulating — old
+        buckets never leak into the new bucket's row."""
+        wm = WindowedMetric(MeanSquaredError(), window=2, updates_per_bucket=1)
+        wm.update(jnp.asarray([9.0]), jnp.asarray([0.0]))  # bucket 0
+        wm.update(jnp.asarray([0.0]), jnp.asarray([0.0]))  # bucket 1
+        wm.update(jnp.asarray([0.0]), jnp.asarray([0.0]))  # bucket 2 evicts 0
+        assert float(wm.compute()) == 0.0
+
+    def test_bucket_counts_and_clock(self):
+        wm = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=2)
+        for _ in range(5):
+            wm.update(jnp.asarray([1.0]), jnp.asarray([0.0]))
+        assert int(getattr(wm, RING_COUNT)) == 5
+        counts = np.asarray(wm.bucket_counts)
+        assert counts.tolist() == [2, 2, 1]
+
+    def test_partial_ring_early_stream(self):
+        """Fewer updates than buckets: compute covers what exists."""
+        rng = np.random.RandomState(2)
+        batches = _int_batches(rng, 2)
+        wm = WindowedMetric(MeanSquaredError(), window=8, updates_per_bucket=1)
+        fresh = MeanSquaredError()
+        for b in batches:
+            wm.update(*b)
+            fresh.update(*b)
+        assert float(wm.compute()) == float(fresh.compute())
+
+    def test_window_past_ring_span_raises(self):
+        wm = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=1)
+        for _ in range(6):
+            wm.update(jnp.asarray([1.0]), jnp.asarray([0.0]))
+        with pytest.raises(MetricsUserError, match="evicted"):
+            wm.compute(window=3, before=2)
+
+    def test_reserved_constants_match_literals(self):
+        """The registered literal state names are the exported constants
+        (the literals exist so the manifest serializes the leaves)."""
+        wm = WindowedMetric(MeanSquaredError(), window=3)
+        assert RING_ROWS in wm._defaults and RING_COUNT in wm._defaults
+        dm = WindowedMetric(MeanSquaredError(), mode="decay", decay=0.9)
+        assert DECAY_WEIGHT in dm._defaults
+
+
+# ---------------------------------------------------------------------------
+# decay semantics
+# ---------------------------------------------------------------------------
+
+class TestDecay:
+    def test_closed_form(self):
+        """Constant per-update delta d: state_n = d * (1-a^n)/(1-a)."""
+        a = 0.5
+        dm = WindowedMetric(MeanSquaredError(), mode="decay", decay=a)
+        for _ in range(5):
+            dm.update(jnp.asarray([2.0]), jnp.asarray([0.0]))
+        geo = (1 - a**5) / (1 - a)
+        assert float(getattr(dm, "sum_squared_error")) == pytest.approx(4.0 * geo, rel=1e-6)
+        assert float(dm.decay_weight) == pytest.approx(geo, rel=1e-6)
+        # the RATIO metric is decay-invariant under a constant stream
+        assert float(dm.compute()) == pytest.approx(4.0, rel=1e-6)
+
+    def test_decay_forgets(self):
+        dm = WindowedMetric(MeanSquaredError(), mode="decay", decay=0.2)
+        dm.update(jnp.asarray([10.0]), jnp.asarray([0.0]))
+        for _ in range(20):
+            dm.update(jnp.asarray([0.0]), jnp.asarray([0.0]))
+        assert float(dm.compute()) < 1e-6
+
+    def test_integer_leaves_promoted(self):
+        """Integer sum leaves would truncate alpha to 0 (a silent reset
+        instead of a decay) — they promote to float32 at registration."""
+        dm = WindowedMetric(Accuracy(), mode="decay", decay=0.5)
+        for name in dm.wrapped._defaults:
+            assert jnp.asarray(getattr(dm, name)).dtype == jnp.float32
+        dm.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        dm.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        # tp decayed: 1*(1 + 0.5) = 1.5, not reset-and-recount
+        assert float(getattr(dm, "tp")) == pytest.approx(1.5)
+
+    def test_decay_rejects_window_queries(self):
+        dm = WindowedMetric(MeanSquaredError(), mode="decay", decay=0.9)
+        dm.update(jnp.asarray([1.0]), jnp.asarray([0.0]))
+        with pytest.raises(MetricsUserError, match="ring-mode"):
+            dm.compute(window=1)
+        with pytest.raises(MetricsUserError, match="ring-mode"):
+            dm.window_state()
+        with pytest.raises(MetricsUserError, match="ring-mode"):
+            _ = dm.bucket_counts
+
+
+# ---------------------------------------------------------------------------
+# construction validation
+# ---------------------------------------------------------------------------
+
+class TestValidation:
+    def test_rejects_jit_unsafe_metric(self):
+        with pytest.raises(MetricsUserError, match="jit_unsafe"):
+            WindowedMetric(MinMaxMetric(MeanSquaredError()), window=4)
+
+    def test_rejects_wrapper_metric(self):
+        from metrics_tpu.core.metric import Metric
+
+        class _Holder(Metric):
+            def __init__(self):
+                super().__init__()
+                self.child = MeanSquaredError()  # registers in _children
+                self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def _update(self, preds, target):
+                self.total = self.total + jnp.sum(preds)
+
+            def _compute(self):
+                return self.total
+
+        with pytest.raises(MetricsUserError, match="wrapper"):
+            WindowedMetric(_Holder(), window=4)
+
+    def test_rejects_nested_windowed(self):
+        with pytest.raises(MetricsUserError, match="another WindowedMetric"):
+            WindowedMetric(WindowedMetric(MeanSquaredError()), window=4)
+
+    def test_rejects_mean_reduced_leaves(self):
+        from metrics_tpu.core.metric import Metric
+
+        class _MeanState(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+            def _update(self, preds):
+                self.avg = jnp.mean(preds)
+
+            def _compute(self):
+                return self.avg
+
+        with pytest.raises(MetricsUserError, match="sum-reduced numerator"):
+            WindowedMetric(_MeanState(), window=4)
+
+    def test_decay_rejects_extremum_leaves(self):
+        from metrics_tpu.aggregation import MaxMetric
+
+        with pytest.raises(MetricsUserError, match="mode='ring'"):
+            WindowedMetric(MaxMetric(), mode="decay", decay=0.9)
+
+    def test_decay_rejects_sketch_leaves(self):
+        with pytest.raises(MetricsUserError, match="mode='ring'"):
+            WindowedMetric(AUROC(pos_label=1), mode="decay", decay=0.9)
+
+    def test_param_validation(self):
+        with pytest.raises(MetricsUserError, match="window"):
+            WindowedMetric(MeanSquaredError(), window=1)
+        with pytest.raises(MetricsUserError, match="updates_per_bucket"):
+            WindowedMetric(MeanSquaredError(), updates_per_bucket=0)
+        with pytest.raises(MetricsUserError, match="decay"):
+            WindowedMetric(MeanSquaredError(), mode="decay", decay=1.5)
+        with pytest.raises(MetricsUserError, match="mode"):
+            WindowedMetric(MeanSquaredError(), mode="sliding")
+        with pytest.raises(MetricsUserError, match="only applies"):
+            WindowedMetric(MeanSquaredError(), decay=0.9)
+        with pytest.raises(MetricsUserError, match="only apply to mode='ring'"):
+            WindowedMetric(MeanSquaredError(), mode="decay", decay=0.9, window=500)
+        with pytest.raises(MetricsUserError, match="only apply to mode='ring'"):
+            WindowedMetric(MeanSquaredError(), mode="decay", decay=0.9, updates_per_bucket=4)
+
+
+# ---------------------------------------------------------------------------
+# fused / async / sliced composition (the acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def _ragged_int_batches(rng, shapes, hi=2):
+    out = []
+    for n in shapes:
+        p = jnp.asarray(rng.randint(0, hi, n).astype(np.int32))
+        t = jnp.asarray(rng.randint(0, hi, n).astype(np.int32))
+        out.append((p, t))
+    return out
+
+
+class TestFusedAsync:
+    def test_single_compile_across_ragged_shapes_and_bit_parity(self):
+        rng = np.random.RandomState(3)
+        batches = _ragged_int_batches(rng, (48, 64, 57, 64, 31, 60))
+
+        def make():
+            # num_classes makes Accuracy's canonicalizer jit-traceable, so
+            # BOTH members genuinely ride the fused kernel (bare label
+            # inputs would silently fall back to the eager path)
+            return MetricCollection(
+                {
+                    "acc": WindowedMetric(Accuracy(num_classes=2), window=4, updates_per_bucket=2),
+                    "mse": WindowedMetric(MeanSquaredError(), window=4, updates_per_bucket=2),
+                }
+            )
+
+        fused_col = make()
+        handle = fused_col.compile_update(buckets=(64,))
+        eager_col = make()
+        for b in batches:
+            fused_col.update(*b)
+            eager_col.update(*b)
+        assert handle.n_compiles == 1
+        fv, ev = fused_col.compute(), eager_col.compute()
+        for k in fv:
+            assert float(fv[k]) == float(ev[k]), k
+        # state-level bit parity, leaf by leaf
+        for name, m in fused_col.items():
+            e = eager_col[name]
+            for leaf in m._defaults:
+                assert np.array_equal(np.asarray(getattr(m, leaf)), np.asarray(getattr(e, leaf))), (
+                    name,
+                    leaf,
+                )
+
+    def test_windowed_accuracy_through_async(self):
+        """Acceptance: WindowedMetric(Accuracy()) through
+        compile_update_async, 1 compile across bucketed ragged shapes."""
+        rng = np.random.RandomState(4)
+        batches = _ragged_int_batches(rng, (48, 64, 57, 60, 64, 33))
+        col = MetricCollection(
+            {"acc": WindowedMetric(Accuracy(num_classes=2), window=4, updates_per_bucket=2)}
+        )
+        handle = col.compile_update_async(buckets=(64,), queue_depth=4)
+        ref = WindowedMetric(Accuracy(num_classes=2), window=4, updates_per_bucket=2)
+        try:
+            for b in batches:
+                handle.update_async(*b)
+                ref.update(*b)
+            handle.flush()
+            assert col.fused_update.n_compiles == 1
+            assert float(col.compute()["acc"]) == float(ref.compute())
+        finally:
+            handle.close()
+
+    def test_windowed_sliced_mse_through_async(self):
+        """Acceptance: WindowedMetric(SlicedMetric(MSE)) through
+        compile_update_async, 1 compile across bucketed ragged shapes,
+        bit-identical to the eager windowed-sliced metric."""
+        rng = np.random.RandomState(5)
+        S = 8
+        shapes = (48, 64, 57, 60, 64, 33)
+        batches = []
+        for n in shapes:
+            ids = jnp.asarray(rng.randint(0, S, n).astype(np.int32))
+            p = jnp.asarray(rng.randint(0, 5, n).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 5, n).astype(np.float32))
+            batches.append((ids, p, t))
+
+        def make():
+            return WindowedMetric(
+                SlicedMetric(MeanSquaredError(), num_slices=S), window=3, updates_per_bucket=2
+            )
+
+        col = MetricCollection({"wsliced": make()})
+        handle = col.compile_update_async(buckets=(64,), queue_depth=4)
+        ref = make()
+        try:
+            for b in batches:
+                handle.update_async(*b)
+                ref.update(*b)
+            handle.flush()
+            assert col.fused_update.n_compiles == 1
+            fused_vals = np.asarray(col.compute()["wsliced"])
+            ref_vals = np.asarray(ref.compute())
+            assert np.array_equal(fused_vals, ref_vals)
+        finally:
+            handle.close()
+
+    def test_windowed_sliced_parity_vs_per_window_fanout(self):
+        """The composed semantics are right: per-slice windowed values
+        equal fresh per-slice metrics over the in-window rows."""
+        rng = np.random.RandomState(6)
+        S = 4
+        wm = WindowedMetric(SlicedMetric(MeanSquaredError(), num_slices=S), window=2, updates_per_bucket=1)
+        batches = []
+        for _ in range(4):
+            ids = rng.randint(0, S, 32).astype(np.int32)
+            p = rng.randint(0, 5, 32).astype(np.float32)
+            t = rng.randint(0, 5, 32).astype(np.float32)
+            batches.append((ids, p, t))
+            wm.update(jnp.asarray(ids), jnp.asarray(p), jnp.asarray(t))
+        # window of 2 = last two batches
+        ref = SlicedMetric(MeanSquaredError(), num_slices=S)
+        for ids, p, t in batches[2:]:
+            ref.update(jnp.asarray(ids), jnp.asarray(p), jnp.asarray(t))
+        assert np.array_equal(np.asarray(wm.compute()), np.asarray(ref.compute()))
+
+
+# ---------------------------------------------------------------------------
+# ring-of-sketches (merge leaves)
+# ---------------------------------------------------------------------------
+
+class TestRingSketches:
+    def test_windowed_sketched_auroc_bit_identical_in_lossless_window(self):
+        rng = np.random.RandomState(7)
+        scores = rng.rand(5, 32).astype(np.float32)
+        ys = (rng.rand(5, 32) < 0.4).astype(np.int32)
+        wm = WindowedMetric(AUROC(pos_label=1, sketch_capacity=512), window=3, updates_per_bucket=1)
+        for i in range(5):
+            wm.update(jnp.asarray(scores[i]), jnp.asarray(ys[i]))
+        ref = AUROC(pos_label=1, sketch_capacity=512)
+        for i in range(2, 5):
+            ref.update(jnp.asarray(scores[i]), jnp.asarray(ys[i]))
+        assert float(wm.compute()) == float(ref.compute())
+
+    def test_bucketed_windowed_auroc_corrects_sum_companions(self):
+        """A masking template's merge leaves pad-mask themselves, but its
+        SUM companions (n_seen) count the full padded batch — the wrapper's
+        slot-aware correction must remove the pad rows from them too, so
+        the bucketed fused path stays bit-identical to eager."""
+        rng = np.random.RandomState(12)
+
+        def make():
+            return MetricCollection(
+                {"auroc": WindowedMetric(AUROC(pos_label=1, sketch_capacity=512), window=3)}
+            )
+
+        fused_col = make()
+        handle = fused_col.compile_update(buckets=(64,))
+        eager = WindowedMetric(AUROC(pos_label=1, sketch_capacity=512), window=3)
+        for n in (48, 64, 57):
+            p = jnp.asarray(rng.rand(n).astype(np.float32))
+            t = jnp.asarray((rng.rand(n) < 0.4).astype(np.int32))
+            fused_col.update(p, t)
+            eager.update(p, t)
+        assert handle.n_compiles == 1
+        fm = fused_col["auroc"]
+        assert np.asarray(getattr(fm, "n_seen")).tolist() == np.asarray(
+            getattr(eager, "n_seen")
+        ).tolist()
+        assert float(fused_col.compute()["auroc"]) == float(eager.compute())
+
+    def test_sketch_fill_ratio_handles_ring_axis(self):
+        wm = WindowedMetric(AUROC(pos_label=1, sketch_capacity=64), window=4, updates_per_bucket=1)
+        rng = np.random.RandomState(8)
+        wm.update(jnp.asarray(rng.rand(16).astype(np.float32)), jnp.asarray((rng.rand(16) < 0.5).astype(np.int32)))
+        ratios = wm.sketch_fill_ratios()
+        assert ratios and 0.0 < ratios["csketch"] <= 1.0
+        # 16 rows in the live slot of capacity 64 — the WORST slot is the
+        # fill signal (a ring average would hide an at-capacity live
+        # bucket behind the empty slots for the whole first lap)
+        assert ratios["csketch"] == pytest.approx(16 / 64)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: reset / state_dict / clone / merge_states
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_reset_restores_ring(self):
+        wm = WindowedMetric(MeanSquaredError(), window=3)
+        wm.update(jnp.asarray([2.0]), jnp.asarray([0.0]))
+        wm.reset()
+        assert int(getattr(wm, RING_COUNT)) == 0
+        assert float(jnp.sum(jnp.asarray(getattr(wm, "sum_squared_error")))) == 0.0
+
+    def test_state_dict_roundtrip(self):
+        rng = np.random.RandomState(9)
+        wm = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=2)
+        for b in _int_batches(rng, 5):
+            wm.update(*b)
+        sd = wm.state_dict()
+        other = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=2)
+        other.load_state_dict(sd)
+        assert float(other.compute()) == float(wm.compute())
+
+    def test_clone_independent(self):
+        wm = WindowedMetric(MeanSquaredError(), window=3)
+        wm.update(jnp.asarray([2.0]), jnp.asarray([0.0]))
+        c = wm.clone()
+        c.update(jnp.asarray([4.0]), jnp.asarray([0.0]))
+        assert float(wm.compute()) != float(c.compute())
+
+    def test_merge_states_pairwise(self):
+        """Two lock-stepped ranks' ring states merge: same-bucket rows add,
+        and the merged compute equals the pooled stream's window."""
+        rng = np.random.RandomState(10)
+        a_batches = _int_batches(rng, 4, n=16)
+        b_batches = _int_batches(rng, 4, n=16)
+        wa = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=1)
+        wb = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=1)
+        for b in a_batches:
+            wa.update(*b)
+        for b in b_batches:
+            wb.update(*b)
+        merged = wa.merge_states(
+            {k: getattr(wa, k) for k in wa._defaults},
+            {k: getattr(wb, k) for k in wb._defaults},
+        )
+        # pooled in-window stream: last 3 batches of each rank
+        fresh = MeanSquaredError()
+        for b in a_batches[1:] + b_batches[1:]:
+            fresh.update(*b)
+        # fold the merged ring through the window machinery: bind and compute
+        bound = wa._bind(merged)
+        try:
+            val = float(wa._compute())
+        finally:
+            for k, v in bound.items():
+                object.__setattr__(wa, k, v)
+        assert val == float(fresh.compute())
+
+    def test_forward_returns_batch_value(self):
+        wm = WindowedMetric(MeanSquaredError(), window=3)
+        out = wm(jnp.asarray([3.0]), jnp.asarray([0.0]))
+        assert float(out) == 9.0
+        assert float(wm.compute()) == 9.0
+
+
+# ---------------------------------------------------------------------------
+# mesh sync
+# ---------------------------------------------------------------------------
+
+class TestMeshSync:
+    def test_windowed_state_syncs_in_mesh(self):
+        """Replicated windowed state over the 8-device mesh: sum-shaped
+        ring leaves fold 8x elementwise per bucket, the clock rides max,
+        and ring sketch leaves merge per slot (weight x8)."""
+        wm = WindowedMetric(MeanSquaredError(), window=3)
+        wm.update(jnp.asarray([1.0, 2.0]), jnp.asarray([0.0, 0.0]))
+        state = {k: jnp.asarray(getattr(wm, k)) for k in wm._defaults}
+        reds = wm.state_reductions()
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("d",))
+        from jax.sharding import PartitionSpec as P
+
+        specs = jax.tree_util.tree_map(lambda x: P(), state)
+        out = shard_map(
+            lambda st: sync_pytree_in_mesh(st, reds, "d"),
+            mesh=mesh,
+            in_specs=(specs,),
+            out_specs=specs,
+        )(state)
+        assert np.asarray(out["sum_squared_error"])[0] == pytest.approx(8 * 5.0)
+        assert int(np.asarray(out[RING_COUNT])) == 1  # max, not 8
+
+    def test_ring_sketch_merges_per_slot_in_mesh(self):
+        wm = WindowedMetric(AUROC(pos_label=1, sketch_capacity=64), window=3)
+        rng = np.random.RandomState(11)
+        wm.update(
+            jnp.asarray(rng.rand(16).astype(np.float32)),
+            jnp.asarray((rng.rand(16) < 0.5).astype(np.int32)),
+        )
+        state = {k: jnp.asarray(getattr(wm, k)) for k in wm._defaults}
+        reds = wm.state_reductions()
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("d",))
+        from jax.sharding import PartitionSpec as P
+
+        specs = jax.tree_util.tree_map(lambda x: P(), state)
+        out = shard_map(
+            lambda st: sync_pytree_in_mesh(st, reds, "d"),
+            mesh=mesh,
+            in_specs=(specs,),
+            out_specs=specs,
+        )(state)
+        sk_in, sk_out = np.asarray(state["csketch"]), np.asarray(out["csketch"])
+        # total mass multiplies by world size; only the occupied slot moved
+        assert sk_out[..., 0].sum() == pytest.approx(8 * sk_in[..., 0].sum())
+        assert (sk_out[1, :, 0] > 0).sum() == 0  # untouched ring slots stay empty
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_footprint_prefixed_and_hwm_label_split(self):
+        rec = get_recorder()
+        rec.reset()
+        rec.enable(footprint_warn_bytes=1 << 40)
+        try:
+            wm = WindowedMetric(MeanSquaredError(), window=4)
+            wm.update(jnp.asarray([1.0]), jnp.asarray([0.0]))
+            fp = wm.state_footprint()
+            assert all(k.startswith("windowed/") for k in fp)
+            hwm = rec.footprint_high_water_marks()
+            assert "WindowedMetric[windowed]" in hwm
+            assert "WindowedMetric" not in hwm  # no base-state mark: all windowed
+        finally:
+            rec.disable()
+            rec.reset()
+
+    def test_repr(self):
+        assert "window=4" in repr(WindowedMetric(MeanSquaredError(), window=4))
+        assert "decay=0.9" in repr(WindowedMetric(MeanSquaredError(), mode="decay", decay=0.9))

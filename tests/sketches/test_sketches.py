@@ -250,3 +250,26 @@ def test_histogram_bin_convention_matches_calibration_kernel():
     np.testing.assert_allclose(np.where(count == 0, 0.0, np.asarray(h[1]) / safe), np.asarray(conf_bin), atol=1e-6)
     np.testing.assert_allclose(np.where(count == 0, 0.0, np.asarray(h[2]) / safe), np.asarray(acc_bin), atol=1e-6)
     np.testing.assert_allclose(count / count.sum(), np.asarray(prop_bin), atol=1e-6)
+
+
+def test_empty_sketch_quantile_and_cdf_return_nan_sentinel():
+    """ISSUE 12 satellite: a zero-weight sketch has no distribution — the
+    queries return the documented NaN sentinel instead of a confidently
+    wrong 0.0 (the un-guarded arithmetic's answer), and the guard is
+    explicit rather than an accident of clipping."""
+    from metrics_tpu.sketches.quantile import qsketch_cdf, qsketch_init, qsketch_quantile
+
+    empty = qsketch_init(16)
+    q = qsketch_quantile(empty, jnp.asarray([0.1, 0.5, 0.9]))
+    assert bool(jnp.all(jnp.isnan(q)))
+    c = qsketch_cdf(empty, jnp.asarray([0.0, 0.5]))
+    assert bool(jnp.all(jnp.isnan(c)))
+    # a sketch whose rows were masked to weight 0 is empty too
+    masked = qsketch_insert(
+        qsketch_init(16), jnp.asarray([1.0, 2.0]), n_valid=jnp.asarray(0, jnp.int32)
+    )
+    assert bool(jnp.isnan(qsketch_quantile(masked, 0.5)).all())
+    # and a NON-empty sketch still answers real values
+    live = qsketch_insert(qsketch_init(16), jnp.asarray([1.0, 2.0, 3.0]))
+    assert float(qsketch_quantile(live, 0.5)[0]) == 2.0
+    assert not bool(jnp.isnan(qsketch_cdf(live, jnp.asarray([2.0]))).any())
