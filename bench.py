@@ -1360,6 +1360,105 @@ def bench_windowed() -> None:
     )
 
 
+def bench_collector() -> None:
+    """Fleet-observatory collector bench (ISSUE 13).
+
+    Pre-encodes a fleet's worth of cumulative snapshots (8 publishers x
+    ~150 sequence numbers, each carrying the metric-state pytree of an
+    Accuracy+MSE collection plus a telemetry counter payload) and measures
+    the two tentpole numbers:
+
+    * **fold throughput** — ``collector_fold_per_sec``: snapshots ingested
+      (decode + validate + dedup + absorb) per second through
+      ``FleetCollector.ingest`` plus the final global fold; the
+      "thousands of snapshots per second" claim, AUX-gated.
+    * **wire cost** — ``wire_bytes_per_snapshot``: mean encoded snapshot
+      size for this template; growth means the wire format regressed
+      (e.g. lost the raw-buffer encoding), AUX-gated lower-is-better.
+    * **determinism** — ``collector_fold_deterministic`` (BOOL_FIELDS):
+      the same snapshot multiset ingested in two different arrival orders
+      (including a duplicate) must produce bit-identical folded state
+      leaves and a byte-identical fold-side Prometheus page; a false bit
+      fails the gate regardless of throughput.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import MeanSquaredError, MetricCollection
+    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.observability import counter_payload, encode_snapshot, snapshot_states
+    from metrics_tpu.observability.collector import FleetCollector
+
+    def make_collection():
+        return MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+
+    rng = np.random.RandomState(13)
+    n_pubs, n_seqs, bs = 8, 150, 64
+    payload = counter_payload()
+    blobs = []
+    t_base = 1_000_000.0
+    for p in range(n_pubs):
+        col = make_collection()
+        for seq in range(n_seqs):
+            preds = jnp.asarray(rng.randint(0, 2, bs), jnp.int32)
+            target = jnp.asarray(rng.randint(0, 2, bs), jnp.int32)
+            col.update(preds, target)
+            blobs.append(
+                encode_snapshot(
+                    publisher=f"pub{p}",
+                    seq=seq,
+                    t=t_base + seq,
+                    host=f"host{p % 4}",
+                    process=p,
+                    states=snapshot_states(col),
+                    states_template=col,
+                    telemetry=payload,
+                )
+            )
+    wire_bytes = sum(len(b) for b in blobs) / len(blobs)
+
+    def fold_all(order):
+        coll = FleetCollector(template=make_collection(), late_window_s=1e9, stale_after_s=60.0)
+        t0 = time.perf_counter()
+        for i in order:
+            coll.ingest(blobs[i], now=t_base + n_seqs)
+        states = coll.fold_states()
+        dur = time.perf_counter() - t0
+        return coll, states, len(order) / dur
+
+    base_order = list(range(len(blobs)))
+    coll, states_a, per_sec = fold_all(base_order)
+
+    # determinism probe: reversed arrival plus one duplicate — identical
+    # folded leaves, identical fold-side exposition bytes
+    perm = list(reversed(base_order)) + [0]
+    coll_b, states_b, _ = fold_all(perm)
+    det = coll_b.totals()["duplicates"] == 1
+    for name in states_a:
+        for leaf in states_a[name]:
+            det = det and bool(
+                np.array_equal(np.asarray(states_a[name][leaf]), np.asarray(states_b[name][leaf]))
+            )
+    det = det and (
+        coll.render_prometheus(include_collector_families=False, include_fold_values=True)
+        == coll_b.render_prometheus(include_collector_families=False, include_fold_values=True)
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "collector_fold_throughput",
+                "value": None,
+                "unit": "snapshots/sec",
+                "collector_fold_per_sec": round(per_sec, 1),
+                "wire_bytes_per_snapshot": round(wire_bytes, 1),
+                "n_snapshots": len(blobs),
+                "n_publishers": n_pubs,
+                "collector_fold_deterministic": bool(det),
+            }
+        )
+    )
+
+
 def bench_telemetry() -> None:
     """Micro-bench for the telemetry zero-overhead-when-disabled contract:
     per-call wall cost of ``Metric.update`` with the recorder disabled vs
@@ -1472,6 +1571,7 @@ SUBCOMMANDS = {
     "sliced": bench_sliced,
     "sketch": bench_sketch,
     "windowed": bench_windowed,
+    "collector": bench_collector,
 }
 
 

@@ -1,0 +1,423 @@
+"""Fleet observatory demo: N publisher processes, one merge-tree collector,
+fault injection that trips (and clears) every fleet alarm class.
+
+The demo ROADMAP item 3 exists for: three REAL publisher subprocesses each
+run their own metric collection (integer-exact ``Accuracy`` + a running
+``MeanSquaredError``) over simulated traffic and publish cumulative fleet
+snapshots — metric-state pytrees plus their telemetry counter payload,
+schema-versioned and provenance-stamped — into a directory-queue sink
+(:class:`~metrics_tpu.observability.SnapshotSink`). The orchestrator runs
+a :class:`~metrics_tpu.observability.FleetCollector` that folds the
+snapshots through the same ``merge_states``/``merge_payloads`` reducers a
+single job would use, tracks per-publisher liveness/lag, and feeds the
+windowed ``publisher_lag_s`` / ``collector_backlog`` /
+``collector_fold_errors`` series a :class:`HealthMonitor` alarms on.
+
+Fault injection (``--inject all``, the default) drives all three fleet
+alarm classes through a fire-AND-clear cycle plus the two wire-level
+hazards the collector must absorb silently:
+
+* **duplicates** — publisher 0 re-ships every 4th snapshot byte-for-byte
+  (same publisher + sequence number): the collector's exactly-once dedup
+  counts and drops them, and the fold is unaffected.
+* **late snapshot** — publisher 1 ships one snapshot stamped far behind
+  the event-time watermark: counted and dropped, never folded.
+* **stalled publisher** — publisher 2 goes silent for a slice of the run:
+  its lag grows past the bound (``publisher_stale`` fires) and recovers
+  when it resumes (the alarm clears as the window rolls).
+* **collector pause** — the orchestrator stops polling for a slice while
+  publishers keep shipping: the queue piles up (``snapshot_backlog``
+  fires on the post-pause poll) and drains (clears).
+* **corrupt snapshot** — the orchestrator drops a garbage ``.snap`` file
+  into the queue: ``fold_error`` (critical) fires and clears once the
+  window rolls past it.
+
+Artifacts land in ``--out-dir``: ``fleet.prom`` (the federated Prometheus
+page: per-host-labelled families, the global fold, the collector's fleet
+families, and the fleet-wide metric values), ``telemetry.jsonl``,
+``health_alarms.jsonl``, ``health.txt``, and ``report.json``. Exit status
+is 0 unless an ``--assert-*`` contract fails (the CI smoke leg).
+
+Run::
+
+    python examples/fleet_collector.py --duration 12 --inject all
+"""
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo root
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+INJECT_MODES = ("none", "faults", "all")
+
+#: fault window as fractions of --duration (collector clock): the pause /
+#: stall / corrupt-file injections all land inside it, the tail after it
+#: gives every alarm the wall time to clear
+FAULT_LO_FRAC, FAULT_HI_FRAC = 0.30, 0.55
+
+
+def make_collection():
+    """The shared publisher/collector template: integer-exact Accuracy
+    (sum-reduced count states — the collector fold is bit-identical to a
+    single job) plus a running MSE."""
+    from metrics_tpu import MeanSquaredError, MetricCollection
+    from metrics_tpu.classification import Accuracy
+
+    return MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+
+
+# ---------------------------------------------------------------------------
+# publisher role (subprocess)
+# ---------------------------------------------------------------------------
+
+def run_publisher(args) -> int:
+    """One publisher process: update the collection with deterministic
+    traffic, publish a cumulative snapshot every interval, and play the
+    faults this publisher was assigned."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.observability import SnapshotSink, counter_payload, get_recorder, snapshot_states
+
+    rng = np.random.default_rng(args.seed)
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    col = make_collection()
+    sink = SnapshotSink(
+        args.queue_dir,
+        publisher=args.publisher_id,
+        host=f"host-{args.publisher_id}",
+        process=args.process,
+    )
+    t_start = time.time()
+    stall_lo = args.stall_lo_frac * args.duration
+    stall_hi = args.stall_hi_frac * args.duration
+    published = 0
+    sent_late = False
+    while True:
+        elapsed = time.time() - t_start
+        if elapsed >= args.duration:
+            break
+        if stall_lo <= elapsed < stall_hi:
+            # stalled publisher: no traffic, no snapshots — the collector
+            # watches this publisher's lag grow past the staleness bound
+            time.sleep(0.05)
+            continue
+        preds = jnp.asarray(rng.integers(0, 2, args.batch_size), jnp.int32)
+        target = jnp.asarray(rng.integers(0, 2, args.batch_size), jnp.int32)
+        col.update(preds, target)
+        sink.publish(
+            states=snapshot_states(col),
+            states_template=col,
+            telemetry=counter_payload(rec),
+        )
+        published += 1
+        if args.dup_every and published % args.dup_every == 0:
+            # byte-for-byte re-ship of the previous snapshot (same
+            # publisher + seq): the dedup contract's live fixture
+            sink.republish_last()
+        if args.late_at_frac and not sent_late and elapsed >= args.late_at_frac * args.duration:
+            # one snapshot stamped far behind the watermark — counted and
+            # dropped; the fresh-seq/old-t combination is exactly what a
+            # partitioned-then-healed publisher replays
+            sent_late = True
+            sink.publish(
+                states=snapshot_states(col),
+                states_template=col,
+                telemetry=counter_payload(rec),
+                t=time.time() - args.late_by_s,
+            )
+        time.sleep(args.interval)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator role (collector + subprocess publishers)
+# ---------------------------------------------------------------------------
+
+def run(
+    duration: float = 12.0,
+    inject: str = "all",
+    out_dir: str = "fleet_artifacts",
+    n_publishers: int = 3,
+    interval: float = 0.2,
+    poll_interval: float = 0.25,
+    late_window_s: float = 3.0,
+    window_s: float = 4.0,
+    batch_size: int = 32,
+    seed: int = 0,
+    verbose: bool = True,
+):
+    """Drive the fleet and return the run report (also written to
+    ``<out_dir>/report.json``)."""
+    if inject not in INJECT_MODES:
+        raise ValueError(f"inject must be one of {INJECT_MODES}, got {inject!r}")
+    from metrics_tpu.observability import (
+        FleetCollector,
+        HealthMonitor,
+        PeriodicExporter,
+        default_rules,
+        get_recorder,
+        render_health,
+        summary,
+    )
+
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    queue_dir = out / "queue"
+    queue_dir.mkdir(exist_ok=True)
+    for stale in queue_dir.glob("*.snap"):
+        stale.unlink()
+
+    faults = inject in ("faults", "all")
+    rec = get_recorder()
+    was_enabled = rec.enabled
+    rec.reset()
+    rec.enable()
+    rec.attach_timeseries(
+        bucket_seconds=0.5,
+        n_buckets=max(int(3 * window_s / 0.5), 16),
+        sketch_capacity=128,
+    )
+    stale_after_s = max(6 * interval, 1.5)
+    monitor = HealthMonitor(
+        default_rules(
+            window_s=window_s,
+            publisher_lag_limit_s=stale_after_s,
+            # steady state leaves ~n_publishers * poll/publish ratio files
+            # per poll; the pause piles up an order of magnitude more
+            backlog_limit=max(4 * n_publishers, 8),
+            fold_errors_per_window=1,
+        ),
+        recorder=rec,
+        alarm_log_path=str(out / "health_alarms.jsonl"),
+    )
+    template = make_collection()
+    collector = FleetCollector(
+        str(queue_dir),
+        template=template,
+        late_window_s=late_window_s,
+        stale_after_s=stale_after_s,
+        recorder=rec,
+    )
+    exporter = PeriodicExporter(
+        interval_s=1.0,
+        prometheus_path=str(out / "fleet.prom"),
+        jsonl_path=str(out / "telemetry.jsonl"),
+        recorder=rec,
+        health=monitor,
+    )
+    exporter.start()
+
+    # spawn the publishers: per-publisher fault assignments (dup / late /
+    # stall) only under injection
+    procs = []
+    for i in range(n_publishers):
+        cmd = [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--role", "publisher",
+            "--queue-dir", str(queue_dir),
+            "--publisher-id", f"pub{i}",
+            "--process", str(i),
+            "--duration", str(duration),
+            "--interval", str(interval),
+            "--batch-size", str(batch_size),
+            "--seed", str(seed + i),
+            "--late-by-s", str(late_window_s + 30.0),
+        ]
+        if faults and i == 0:
+            cmd += ["--dup-every", "4"]
+        if faults and i == 1:
+            cmd += ["--late-at-frac", str((FAULT_LO_FRAC + FAULT_HI_FRAC) / 2)]
+        if faults and i == 2:
+            cmd += ["--stall-lo-frac", str(FAULT_LO_FRAC), "--stall-hi-frac", str(FAULT_HI_FRAC)]
+        procs.append(subprocess.Popen(cmd, env=dict(os.environ, JAX_PLATFORMS="cpu")))
+
+    fault_lo, fault_hi = FAULT_LO_FRAC * duration, FAULT_HI_FRAC * duration
+    pause_lo, pause_hi = fault_lo, fault_lo + 0.6 * (fault_hi - fault_lo)
+    t_start = time.time()
+    corrupted = False
+    polls = 0
+    try:
+        # collect until every publisher has exited AND the window has had
+        # time to roll every fired alarm clear
+        tail_end = None
+        while True:
+            now = time.time()
+            elapsed = now - t_start
+            for i, p in enumerate(procs):
+                if p.poll() is not None:
+                    # clean shutdown deregisters the publisher from
+                    # liveness: an exited-on-purpose publisher must not
+                    # read as a stalled one through the tail
+                    collector.retire_publisher(f"pub{i}")
+            if tail_end is None and all(p.poll() is not None for p in procs):
+                tail_end = time.time() + window_s + 2.0
+            if tail_end is not None and time.time() >= tail_end:
+                break
+            in_pause = faults and pause_lo <= elapsed < pause_hi
+            if faults and not corrupted and elapsed >= (pause_lo + pause_hi) / 2:
+                # fold_error fixture: garbage bytes in the queue — the
+                # collector must count it and keep folding
+                corrupted = True
+                (queue_dir / "zz-corrupt-000000000000.snap").write_bytes(b"not a snapshot")
+            if not in_pause:
+                collector.poll()
+                polls += 1
+                monitor.evaluate()
+            time.sleep(poll_interval)
+        collector.flush_pending()
+        collector.poll()
+        final = monitor.evaluate()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            p.wait(timeout=30)
+        exporter.stop()
+
+    # final artifacts: the federated page (global fold + per-host families
+    # + collector families + fleet-wide metric values) and the report
+    prom = collector.render_prometheus(include_fold_values=True)
+    prom += "\n".join(monitor.prometheus_lines(final)) + "\n"
+    (out / "fleet.prom").write_text(prom)
+    health_text = render_health(final)
+    (out / "health.txt").write_text(health_text + "\n")
+
+    totals = collector.totals()
+    values = {k: float(v) for k, v in collector.fold_values().items()}
+    report = {
+        "inject": inject,
+        "duration_s": duration,
+        "polls": polls,
+        "publisher_exit_codes": [p.returncode for p in procs],
+        "totals": totals,
+        "fleet_values": values,
+        "publishers": [
+            {
+                "publisher": s.publisher,
+                "host": s.host,
+                "last_seq": s.last_seq,
+                "stale": s.stale,
+                "absorbed": s.absorbed,
+                "duplicates": s.duplicates,
+                "late_dropped": s.late_dropped,
+            }
+            for s in collector.publishers()
+        ],
+        "final_status": final.status,
+        "alarms_fired": monitor.fired_ever(),
+        "alarms_fired_and_cleared": monitor.fired_and_cleared(),
+        "fold_error_details": collector.fold_error_details,
+    }
+    (out / "report.json").write_text(json.dumps(report, indent=2) + "\n")
+    if verbose:
+        print(summary(rec))
+        print(health_text)
+        print(
+            f"fleet_collector: {totals['absorbed']} snapshots folded from"
+            f" {totals['publishers']} publishers ({totals['duplicates']} dup,"
+            f" {totals['late_dropped']} late, {totals['fold_errors']} fold errors);"
+            f" fleet values={values}; alarms fired={report['alarms_fired']}"
+            f" fired_and_cleared={report['alarms_fired_and_cleared']};"
+            f" artifacts in {out}/"
+        )
+
+    rec.disable()
+    rec.detach_timeseries()
+    rec.reset()
+    if was_enabled:
+        rec.enable()
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--role", choices=("orchestrator", "publisher"), default="orchestrator")
+    parser.add_argument("--duration", type=float, default=12.0)
+    parser.add_argument("--inject", choices=INJECT_MODES, default="all")
+    parser.add_argument("--out-dir", default="fleet_artifacts")
+    parser.add_argument("--publishers", type=int, default=3)
+    parser.add_argument("--interval", type=float, default=0.2, help="publish interval (s)")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--late-window-seconds", type=float, default=3.0)
+    parser.add_argument("--window-seconds", type=float, default=4.0)
+    parser.add_argument("--seed", type=int, default=0)
+    # publisher-role plumbing (set by the orchestrator)
+    parser.add_argument("--queue-dir", default="")
+    parser.add_argument("--publisher-id", default="pub")
+    parser.add_argument("--process", type=int, default=0)
+    parser.add_argument("--dup-every", type=int, default=0)
+    parser.add_argument("--late-at-frac", type=float, default=0.0)
+    parser.add_argument("--late-by-s", type=float, default=60.0)
+    parser.add_argument("--stall-lo-frac", type=float, default=0.0)
+    parser.add_argument("--stall-hi-frac", type=float, default=0.0)
+    parser.add_argument(
+        "--assert-fired-cleared",
+        action="store_true",
+        help="exit nonzero unless at least one alarm both fired and cleared (CI smoke)",
+    )
+    parser.add_argument(
+        "--assert-alarm",
+        action="append",
+        default=[],
+        metavar="NAME",
+        help="exit nonzero unless the NAMED alarm both fired and cleared (repeatable;"
+        " the fleet smoke pins publisher_stale, snapshot_backlog, and fold_error"
+        " specifically)",
+    )
+    parser.add_argument(
+        "--assert-faults-observed",
+        action="store_true",
+        help="exit nonzero unless the collector counted at least one duplicate AND"
+        " one late-dropped snapshot (the wire-hazard half of the smoke contract)",
+    )
+    args = parser.parse_args(argv)
+    if args.role == "publisher":
+        return run_publisher(args)
+    report = run(
+        duration=args.duration,
+        inject=args.inject,
+        out_dir=args.out_dir,
+        n_publishers=args.publishers,
+        interval=args.interval,
+        late_window_s=args.late_window_seconds,
+        window_s=args.window_seconds,
+        batch_size=args.batch_size,
+        seed=args.seed,
+    )
+    if args.assert_fired_cleared and not report["alarms_fired_and_cleared"]:
+        print("FAIL: no alarm both fired and cleared", file=sys.stderr)
+        return 2
+    missing = [a for a in args.assert_alarm if a not in report["alarms_fired_and_cleared"]]
+    if missing:
+        print(
+            f"FAIL: alarm(s) {missing} did not both fire and clear"
+            f" (fired_and_cleared={report['alarms_fired_and_cleared']})",
+            file=sys.stderr,
+        )
+        return 2
+    if args.assert_faults_observed:
+        totals = report["totals"]
+        if not (totals["duplicates"] and totals["late_dropped"]):
+            print(
+                f"FAIL: expected duplicate AND late-dropped snapshots, saw"
+                f" duplicates={totals['duplicates']} late_dropped={totals['late_dropped']}",
+                file=sys.stderr,
+            )
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,12 @@ import os
 from typing import Dict
 
 from metrics_tpu.observability.aggregate import aggregate_across_hosts, counter_payload, merge_payloads
+from metrics_tpu.observability.collector import (
+    FleetCollector,
+    PublisherStatus,
+    SnapshotQueue,
+    SnapshotSink,
+)
 from metrics_tpu.observability.exporters import (
     PeriodicExporter,
     export_jsonl,
@@ -69,6 +75,16 @@ from metrics_tpu.observability.timeseries import (
     series_from_payload,
 )
 from metrics_tpu.observability.trace import export_perfetto, span
+from metrics_tpu.observability.wire import (
+    Snapshot,
+    WireError,
+    decode_snapshot,
+    encode_snapshot,
+    manifest_fingerprint,
+    members_of,
+    snapshot_states,
+    states_key,
+)
 
 __all__ = [
     "MetricRecorder",
@@ -92,6 +108,18 @@ __all__ = [
     "aggregate_across_hosts",
     "counter_payload",
     "merge_payloads",
+    "FleetCollector",
+    "PublisherStatus",
+    "SnapshotQueue",
+    "SnapshotSink",
+    "Snapshot",
+    "WireError",
+    "decode_snapshot",
+    "encode_snapshot",
+    "manifest_fingerprint",
+    "members_of",
+    "snapshot_states",
+    "states_key",
     "TelemetrySeries",
     "TimeSeriesRegistry",
     "merge_registry_payloads",

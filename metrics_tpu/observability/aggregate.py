@@ -39,13 +39,28 @@ _KEY_SEP = "|"
 
 def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
     """One process's aggregate counters as a flat JSON-safe dict (the unit
-    the cross-host allgather serializes)."""
+    the cross-host allgather serializes — and the fleet wire format ships).
+
+    Every payload is stamped with snapshot provenance beyond the bare
+    process index: the ``host`` name, the wall-clock ``t`` it was taken,
+    and a monotonic per-process ``seq`` (survives recorder resets) — what
+    a fleet collector's per-host labelling, lag tracking, and duplicate
+    detection key on. All three merge as identity defaults: a payload
+    from an older build simply lacks them (``merge_payloads`` reads every
+    family with ``.get``), so mixed-fleet merges keep working."""
     rec = recorder if recorder is not None else _DEFAULT_RECORDER
+    import socket
+    import time as _time
+
     from metrics_tpu.parallel.distributed import process_index
 
     registry = getattr(rec, "timeseries", None)
+    next_seq = getattr(rec, "next_snapshot_seq", None)
     return {
         "process": process_index(),
+        "host": socket.gethostname(),
+        "t": _time.time(),
+        "seq": next_seq() if callable(next_seq) else 0,
         "call_counts": {_KEY_SEP.join(k): v for k, v in rec.call_counts().items()},
         "call_times": {_KEY_SEP.join(k): v for k, v in rec.call_times().items()},
         "signature_counts": dict(rec.signature_counts()),
@@ -59,6 +74,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "sliced_slice_counts": dict(rec.footprint_slice_counts()),
         "sketch_totals": dict(rec.sketch_totals()),
         "drift_scores": dict(rec.drift_scores()),
+        "fleet_totals": dict(rec.fleet_totals()),
         "export_errors": rec.export_errors(),
         # windowed time series ride the same payload path: per-bucket
         # sketches serialize JSON-safe and merge by qsketch_merge, so a
@@ -124,6 +140,7 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # the fleet's headline — a rank without the drift layer contributes
         # nothing, like every other family
         "drift_scores": _merge_max([p.get("drift_scores", {}) for p in payloads]),
+        "fleet_totals": _merge_fleet([p.get("fleet_totals", {}) for p in payloads]),
         "export_errors": sum(p.get("export_errors", 0) for p in payloads),
         "timeseries": _merge_timeseries([p.get("timeseries", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
@@ -167,6 +184,20 @@ _SLICED_SUM_KEYS = ("scatter_events", "rows")
 def _merge_sliced(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
     sums = _merge_sum([{k: v for k, v in m.items() if k in _SLICED_SUM_KEYS} for m in maps])
     maxes = _merge_max([{k: v for k, v in m.items() if k not in _SLICED_SUM_KEYS} for m in maps])
+    return {**maxes, **sums}
+
+
+#: fleet-collector counter keys that are extensive (summed); backlog and
+#: publisher-lag gauges/high-water marks (and the publisher count) max
+_FLEET_SUM_KEYS = ("absorbed", "duplicates", "late_dropped", "fold_errors")
+
+
+def _merge_fleet(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fleet-collector totals: snapshot outcome counts sum; the backlog /
+    worst-lag gauges and the publisher count max — a rank that runs no
+    collector contributes nothing, like every other family."""
+    sums = _merge_sum([{k: v for k, v in m.items() if k in _FLEET_SUM_KEYS} for m in maps])
+    maxes = _merge_max([{k: v for k, v in m.items() if k not in _FLEET_SUM_KEYS} for m in maps])
     return {**maxes, **sums}
 
 

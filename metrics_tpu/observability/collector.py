@@ -1,0 +1,815 @@
+"""Fleet observatory: a merge-tree snapshot collector over the wire format.
+
+The fold half of ROADMAP item 3 (:mod:`metrics_tpu.observability.wire` is
+the serialization half): N serving processes publish snapshots into a
+transport-agnostic sink, and a collector process folds them — at
+thousands of snapshots per second — into the same answer a single job
+would have computed, using the reducers already in-tree
+(``Metric.merge_states`` for metric-state pytrees,
+:func:`~metrics_tpu.observability.merge_payloads` for telemetry).
+
+* :class:`SnapshotSink` — the publisher side of the in-tree transport: a
+  **directory queue** of atomic snapshot files (tmp + ``os.replace``; a
+  reader can never observe a truncation). No RPC dependency; any
+  shared/synced filesystem, object-store mount, or sidecar shipping the
+  files works. The sink owns the monotonic per-publisher sequence number.
+* :class:`SnapshotQueue` — the collector side: consume-on-read polling of
+  the directory, oldest first, with an optional per-poll cap so one burst
+  cannot head-of-line-block liveness accounting.
+* :class:`FleetCollector` — decode, validate, dedup, and fold:
+
+  - **exactly-once**: snapshots are identified by ``(publisher, seq)``;
+    a duplicate (retried ship, double-mounted queue) is counted and
+    dropped, never folded twice.
+  - **bounded late window with a watermark**: the event-time watermark
+    trails the newest snapshot wall-clock by ``late_window_s``. Late
+    snapshots still above the watermark fold normally (``"delta"`` mode
+    holds pending snapshots until the watermark passes them so they fold
+    in sequence order — the fold is arrival-order independent);
+    post-watermark stragglers are counted and dropped.
+  - **per-publisher liveness/lag**: last sequence, last snapshot time,
+    and the current lag per publisher; ``stale_after_s`` marks silent
+    publishers, and every poll feeds the windowed ``publisher_lag_s`` /
+    ``collector_backlog`` / ``collector_fold_errors`` telemetry series
+    the ``publisher_stale`` / ``snapshot_backlog`` / ``fold_error``
+    health alarms watch.
+  - **hierarchical fan-in**: :meth:`FleetCollector.publish_fold`
+    re-publishes the collector's own fold as a snapshot, so host-level
+    collectors feed rack collectors feed a global one — a merge tree;
+    every tier runs the same code and the same reducers.
+
+Folding disciplines per snapshot ``mode`` (set by the publisher):
+
+* ``"state"`` — cumulative snapshots: per publisher the newest sequence
+  wins, and the global fold merges one state per publisher (sorted by
+  publisher id) — exactly ``aggregate_across_hosts``'s semantics with
+  files instead of a collective.
+* ``"delta"`` — publishers reset after publishing; every snapshot is a
+  disjoint increment, folded in sequence order per publisher below the
+  watermark.
+
+See docs/fleet_collector.md and ``examples/fleet_collector.py``.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from metrics_tpu.observability.wire import (
+    Snapshot,
+    WireError,
+    decode_snapshot,
+    encode_snapshot,
+    members_of,
+    snapshot_states,
+    states_key,
+)
+
+__all__ = [
+    "FleetCollector",
+    "PublisherStatus",
+    "SnapshotQueue",
+    "SnapshotSink",
+]
+
+#: snapshot file suffix in a directory queue
+SNAPSHOT_SUFFIX = ".snap"
+
+_SAFE_ID = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def _safe_name(publisher: str) -> str:
+    """Publisher id -> filesystem-safe file stem."""
+    return _SAFE_ID.sub("_", publisher) or "publisher"
+
+
+class SnapshotSink:
+    """Publisher-side directory queue: atomic snapshot files, one per
+    ``publish()``.
+
+    Owns the monotonic per-publisher sequence number (``seq_start`` lets
+    a restarted publisher resume above its previous range — sequence
+    numbers identify snapshots, so a restart that reuses them would be
+    deduplicated away as duplicates). Thread-safe."""
+
+    def __init__(
+        self,
+        directory: str,
+        publisher: str,
+        host: str = "",
+        process: int = 0,
+        tier: str = "leaf",
+        seq_start: int = 0,
+    ) -> None:
+        if not publisher:
+            raise ValueError("publisher id must be non-empty")
+        self.directory = str(directory)
+        self.publisher = publisher
+        self.host = host
+        self.process = int(process)
+        self.tier = tier
+        os.makedirs(self.directory, exist_ok=True)
+        self._seq = int(seq_start)
+        self._dups = 0
+        self._lock = threading.Lock()
+        self.last_path: Optional[str] = None
+        self._last_blob: Optional[bytes] = None
+
+    def publish(
+        self,
+        *,
+        states: Optional[Dict[str, Dict[str, Any]]] = None,
+        states_template: Optional[Any] = None,
+        telemetry: Optional[Any] = None,
+        mode: str = "state",
+        t: Optional[float] = None,
+    ) -> str:
+        """Encode and atomically land one snapshot file; returns its path.
+        ``states``/``telemetry`` as in :func:`~metrics_tpu.observability.
+        wire.encode_snapshot`; the sink supplies the provenance header."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            blob = encode_snapshot(
+                publisher=self.publisher,
+                seq=seq,
+                t=t,
+                host=self.host,
+                process=self.process,
+                mode=mode,
+                tier=self.tier,
+                states=states,
+                states_template=states_template,
+                telemetry=telemetry,
+            )
+            path = self._write(blob, seq)
+            self.last_path = path
+            self._last_blob = blob
+            return path
+
+    def republish_last(self) -> Optional[str]:
+        """Write the previous snapshot AGAIN under a fresh file name (same
+        publisher + sequence number inside) — fault injection for the
+        collector's exactly-once dedup contract. Returns the new path, or
+        ``None`` before the first publish."""
+        with self._lock:
+            if self._last_blob is None:
+                return None
+            self._dups += 1
+            return self._write(self._last_blob, self._seq - 1, dup=self._dups)
+
+    def _write(self, blob: bytes, seq: int, dup: int = 0) -> str:
+        stem = f"{_safe_name(self.publisher)}-{seq:012d}{f'-dup{dup}' if dup else ''}"
+        path = os.path.join(self.directory, stem + SNAPSHOT_SUFFIX)
+        tmp = os.path.join(self.directory, f".{stem}.tmp.{os.getpid()}")
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+class SnapshotQueue:
+    """Collector-side directory queue: consume-on-read polling.
+
+    ``poll()`` returns up to ``max_files`` ``(path, bytes)`` pairs oldest
+    first and unlinks each file after reading it — a snapshot is consumed
+    exactly once even across collector restarts. Unreadable files are
+    returned with ``b""`` bytes so the collector can count the loss
+    instead of silently skipping it."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def backlog(self) -> int:
+        """Snapshot files currently waiting in the directory."""
+        try:
+            return sum(1 for n in os.listdir(self.directory) if n.endswith(SNAPSHOT_SUFFIX))
+        except OSError:
+            return 0
+
+    def poll(self, max_files: Optional[int] = None) -> List[Tuple[str, bytes]]:
+        try:
+            names = sorted(n for n in os.listdir(self.directory) if n.endswith(SNAPSHOT_SUFFIX))
+        except OSError:
+            return []
+        if max_files is not None:
+            names = names[: int(max_files)]
+        out: List[Tuple[str, bytes]] = []
+        for name in names:
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path, "rb") as fh:
+                    blob = fh.read()
+            except OSError:
+                blob = b""
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            out.append((path, blob))
+        return out
+
+
+@dataclass(frozen=True)
+class PublisherStatus:
+    """One publisher's liveness/lag view at a point in time."""
+
+    publisher: str
+    host: str
+    process: int
+    tier: str
+    last_seq: int
+    last_t: float
+    last_arrival: float
+    lag_s: float
+    stale: bool
+    absorbed: int
+    duplicates: int
+    late_dropped: int
+    pending: int
+    retired: bool = False
+
+
+class _Pub:
+    """Per-publisher collector state (internal)."""
+
+    __slots__ = (
+        "publisher", "host", "process", "tier", "seen", "pending",
+        "newest", "delta_states", "delta_frontier", "telemetry",
+        "telemetry_seq", "last_seq", "last_t", "last_arrival",
+        "absorbed", "duplicates", "late_dropped", "retired",
+    )
+
+    def __init__(self, publisher: str) -> None:
+        self.publisher = publisher
+        self.host = ""
+        self.process = 0
+        self.tier = "leaf"
+        self.seen: Dict[int, float] = {}  # seq -> snapshot t (pruned at watermark)
+        self.pending: Dict[int, Snapshot] = {}  # delta mode, awaiting watermark
+        self.newest: Optional[Snapshot] = None  # state mode, max-seq snapshot
+        self.delta_states: Optional[Dict[str, Dict[str, Any]]] = None
+        self.delta_frontier = -1
+        self.telemetry: List[Dict[str, Any]] = []
+        self.telemetry_seq = -1
+        self.last_seq = -1
+        self.last_t = float("-inf")
+        self.last_arrival = float("-inf")
+        self.absorbed = 0
+        self.duplicates = 0
+        self.late_dropped = 0
+        self.retired = False
+
+
+class FleetCollector:
+    """Folds published snapshots into one fleet view (see module docs).
+
+    ``template`` — a metric or :class:`~metrics_tpu.collections.
+    MetricCollection` structurally identical to what publishers snapshot;
+    its per-leaf reducers (``merge_states``) ARE the fold. ``None`` for a
+    telemetry-only collector. ``recorder`` (default: the process default)
+    receives the windowed liveness/backlog/fold-error series each poll
+    when enabled."""
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        template: Optional[Any] = None,
+        late_window_s: float = 30.0,
+        stale_after_s: float = 10.0,
+        recorder: Optional[Any] = None,
+        clock: Optional[Callable[[], float]] = None,
+        name: str = "collector",
+    ) -> None:
+        if late_window_s < 0:
+            raise ValueError(f"late_window_s must be >= 0, got {late_window_s}")
+        if stale_after_s <= 0:
+            raise ValueError(f"stale_after_s must be positive, got {stale_after_s}")
+        self.queue = SnapshotQueue(directory) if directory is not None else None
+        self.template = template
+        self._template_key = states_key(template) if template is not None else None
+        self._template_members = members_of(template) if template is not None else {}
+        self.late_window_s = float(late_window_s)
+        self.stale_after_s = float(stale_after_s)
+        self.name = name
+        self.clock = clock if clock is not None else time.time
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._pubs: Dict[str, _Pub] = {}
+        self._max_t = float("-inf")
+        self.fold_errors = 0
+        self.fold_error_details: List[str] = []  # bounded ring, newest last
+        self._reported = {"absorbed": 0, "duplicates": 0, "late_dropped": 0, "fold_errors": 0}
+
+    # ------------------------------------------------------------------
+    # ingest
+    # ------------------------------------------------------------------
+    @property
+    def watermark(self) -> float:
+        """Event-time watermark: newest snapshot time seen minus the late
+        window. Snapshots at or below it are final — a straggler behind
+        the watermark is counted and dropped, never folded."""
+        return self._max_t - self.late_window_s
+
+    def poll(self, max_files: Optional[int] = None, now: Optional[float] = None) -> int:
+        """Consume queued snapshot files (up to ``max_files``), ingest
+        each, advance the watermark fold, and feed the telemetry series.
+        Returns the number of files consumed. Safe to call on a timer from
+        one thread while another queries the fold."""
+        if self.queue is None:
+            raise ValueError("this collector was constructed without a queue directory")
+        # the backlog gauge is measured BEFORE consuming: "how much work
+        # was waiting when the collector woke up" is the falling-behind
+        # signal — post-consume it would always read near zero and the
+        # snapshot_backlog alarm could never fire
+        backlog_pre = self.backlog()
+        entries = self.queue.poll(max_files=max_files)
+        for path, blob in entries:
+            if not blob:
+                self._count_fold_error(f"unreadable snapshot file {os.path.basename(path)}")
+                continue
+            self.ingest(blob, now=now)
+        self._advance()
+        self._feed_recorder(now=now, backlog=backlog_pre)
+        return len(entries)
+
+    def ingest(self, blob: bytes, now: Optional[float] = None) -> bool:
+        """Ingest one raw snapshot (the transport-agnostic entry point —
+        ``poll`` calls this per file; tests and benches call it directly).
+        Returns True when the snapshot was absorbed, False when it was
+        deduplicated, late-dropped, or counted as a fold error."""
+        try:
+            snap = decode_snapshot(blob)
+        except WireError as err:
+            self._count_fold_error(str(err))
+            return False
+        return self._ingest_snapshot(snap, now=now)
+
+    def _ingest_snapshot(self, snap: Snapshot, now: Optional[float] = None) -> bool:
+        arrival = self.clock() if now is None else float(now)
+        with self._lock:
+            pub = self._pubs.get(snap.publisher)
+            if pub is None:
+                pub = self._pubs[snap.publisher] = _Pub(snap.publisher)
+            if snap.host:
+                pub.host = snap.host
+            pub.process = snap.process
+            pub.tier = snap.tier
+            # liveness first: even a duplicate/late snapshot proves the
+            # publisher process is alive and shipping
+            pub.last_arrival = arrival
+            pub.retired = False
+            if snap.seq in pub.seen or snap.seq in pub.pending or (
+                snap.mode == "delta" and snap.seq <= pub.delta_frontier
+            ):
+                pub.duplicates += 1
+                return False
+            if snap.t <= self.watermark:
+                pub.late_dropped += 1
+                return False
+            if snap.states is not None and not self._states_compatible(snap):
+                return False
+            pub.seen[snap.seq] = snap.t
+            pub.last_seq = max(pub.last_seq, snap.seq)
+            pub.last_t = max(pub.last_t, snap.t)
+            self._max_t = max(self._max_t, snap.t)
+            if snap.telemetry and snap.seq > pub.telemetry_seq:
+                # telemetry payloads are cumulative counters: newest wins
+                # per publisher, whatever the states mode. Each payload is
+                # annotated with its publisher id — several publishers on
+                # one host share a process index, and the federated
+                # Prometheus view needs a disambiguating label per rank
+                pub.telemetry = [
+                    p if p.get("publisher") else {**p, "publisher": snap.publisher}
+                    for p in snap.telemetry
+                ]
+                pub.telemetry_seq = snap.seq
+            if snap.mode == "delta" and snap.states is not None:
+                # deltas hold until the watermark passes them so the fold
+                # runs in sequence order whatever the arrival order
+                pub.pending[snap.seq] = snap
+            elif snap.states is not None:
+                if pub.newest is None or snap.seq > pub.newest.seq:
+                    pub.newest = snap
+            pub.absorbed += 1
+            return True
+
+    def _states_compatible(self, snap: Snapshot) -> bool:
+        """Validate a states-carrying snapshot against the collector
+        template BEFORE any leaf is folded; a mismatch is a fold error.
+        Caller holds the lock."""
+        if self.template is None:
+            self._count_fold_error_locked(
+                f"publisher {snap.publisher!r} shipped metric states but this"
+                " collector has no template to fold them with"
+            )
+            return False
+        if snap.states_key is not None and snap.states_key != self._template_key:
+            self._count_fold_error_locked(
+                f"publisher {snap.publisher!r} states layout disagrees with the"
+                f" collector template (seq {snap.seq})"
+            )
+            return False
+        from metrics_tpu.observability.wire import manifest_fingerprint
+
+        ours = manifest_fingerprint()
+        if snap.manifest_hash and ours and snap.manifest_hash != ours:
+            self._count_fold_error_locked(
+                f"publisher {snap.publisher!r} manifest fingerprint"
+                f" {snap.manifest_hash} != collector {ours} (version skew)"
+            )
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    # watermark fold
+    # ------------------------------------------------------------------
+    def _advance(self) -> None:
+        """Fold delta snapshots the watermark has passed (in sequence
+        order) and prune resolved sequence numbers."""
+        with self._lock:
+            wm = self.watermark
+            for pub in self._pubs.values():
+                ready = sorted(s for s, snap in pub.pending.items() if snap.t <= wm)
+                for seq in ready:
+                    snap = pub.pending.pop(seq)
+                    self._fold_delta_locked(pub, snap)
+                # sequence numbers at or below the watermark can never fold
+                # again (any re-arrival is late-dropped first), so the dedup
+                # set stays bounded by the late window
+                pub.seen = {s: t for s, t in pub.seen.items() if t > wm}
+
+    def _fold_delta_locked(self, pub: _Pub, snap: Snapshot) -> None:
+        try:
+            if pub.delta_states is None:
+                pub.delta_states = snap.states
+            else:
+                pub.delta_states = self._merge_states_trees(pub.delta_states, snap.states)
+            pub.delta_frontier = max(pub.delta_frontier, snap.seq)
+        except Exception as err:  # noqa: BLE001 — one bad snapshot must not kill the tree
+            self._count_fold_error_locked(
+                f"delta fold failed for {pub.publisher!r} seq {snap.seq}: {err!r}"
+            )
+
+    def _merge_states_trees(
+        self, a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, metric in self._template_members.items():
+            out[name] = metric.merge_states(a[name], b[name])
+        return out
+
+    def flush_pending(self) -> None:
+        """Force-fold every pending delta snapshot regardless of the
+        watermark (sequence order per publisher) — the shutdown/inspection
+        path when no further snapshots are expected."""
+        with self._lock:
+            for pub in self._pubs.values():
+                for seq in sorted(pub.pending):
+                    self._fold_delta_locked(pub, pub.pending.pop(seq))
+
+    # ------------------------------------------------------------------
+    # error accounting
+    # ------------------------------------------------------------------
+    MAX_ERROR_DETAILS = 64
+
+    def _count_fold_error(self, detail: str) -> None:
+        with self._lock:
+            self._count_fold_error_locked(detail)
+
+    def _count_fold_error_locked(self, detail: str) -> None:
+        self.fold_errors += 1
+        self.fold_error_details.append(detail)
+        if len(self.fold_error_details) > self.MAX_ERROR_DETAILS:
+            self.fold_error_details = self.fold_error_details[-self.MAX_ERROR_DETAILS :]
+
+    # ------------------------------------------------------------------
+    # views
+    # ------------------------------------------------------------------
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "absorbed": sum(p.absorbed for p in self._pubs.values()),
+                "duplicates": sum(p.duplicates for p in self._pubs.values()),
+                "late_dropped": sum(p.late_dropped for p in self._pubs.values()),
+                "fold_errors": self.fold_errors,
+                "publishers": len(self._pubs),
+            }
+
+    def backlog(self) -> int:
+        """Unfolded work: queued snapshot files plus pending (in-window)
+        delta snapshots."""
+        with self._lock:
+            pending = sum(len(p.pending) for p in self._pubs.values())
+        return pending + (self.queue.backlog() if self.queue is not None else 0)
+
+    def retire_publisher(self, publisher: str) -> bool:
+        """Deregister a cleanly-shut-down publisher from liveness tracking:
+        its folded contribution STAYS in the fleet view, but its lag no
+        longer feeds the ``publisher_stale`` signal — a publisher that
+        *said goodbye* is not a stalled one. A later snapshot from the
+        same id un-retires it. Returns False for an unknown publisher."""
+        with self._lock:
+            p = self._pubs.get(publisher)
+            if p is None:
+                return False
+            p.retired = True
+            return True
+
+    def publishers(self, now: Optional[float] = None) -> List[PublisherStatus]:
+        """Liveness/lag per publisher, sorted by publisher id. ``lag_s``
+        is collector-clock now minus the publisher's newest snapshot
+        time; a non-retired publisher silent longer than ``stale_after_s``
+        is ``stale`` — the ``publisher_stale`` alarm's raw data."""
+        now = self.clock() if now is None else float(now)
+        with self._lock:
+            out = []
+            for name in sorted(self._pubs):
+                p = self._pubs[name]
+                lag = max(0.0, now - p.last_t) if p.last_t > float("-inf") else float("inf")
+                out.append(
+                    PublisherStatus(
+                        publisher=p.publisher,
+                        host=p.host,
+                        process=p.process,
+                        tier=p.tier,
+                        last_seq=p.last_seq,
+                        last_t=p.last_t,
+                        last_arrival=p.last_arrival,
+                        lag_s=lag,
+                        stale=(not p.retired) and lag > self.stale_after_s,
+                        absorbed=p.absorbed,
+                        duplicates=p.duplicates,
+                        late_dropped=p.late_dropped,
+                        pending=len(p.pending),
+                        retired=p.retired,
+                    )
+                )
+            return out
+
+    # ------------------------------------------------------------------
+    # the fold
+    # ------------------------------------------------------------------
+    def fold_states(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        """The global metric-state fold: one state tree per publisher
+        (newest cumulative snapshot in ``"state"`` mode, the
+        watermark-folded increments in ``"delta"`` mode), merged across
+        publishers in sorted publisher order through the template's
+        ``merge_states`` — deterministic whatever the arrival order, and
+        bit-identical to a single job that saw every event (integer-exact
+        reducers; float sums associate to rounding). ``None`` when no
+        publisher has shipped states yet."""
+        with self._lock:
+            contributions: List[Tuple[str, str, Dict[str, Dict[str, Any]]]] = []
+            for name in sorted(self._pubs):
+                p = self._pubs[name]
+                if p.newest is not None and p.newest.states is not None:
+                    contributions.append((name, "newest", p.newest.states))
+                if p.delta_states is not None:
+                    contributions.append((name, "delta", p.delta_states))
+        folded: Optional[Dict[str, Dict[str, Any]]] = None
+        for pub_name, kind, tree in contributions:
+            # ONE poisonous contribution (a skewed publisher absorbed
+            # before the structural key existed, or a key-less snapshot)
+            # must not take the whole fleet view dark forever: validate
+            # the contribution's leaf structure against the template —
+            # which attributes the skew to the RIGHT publisher, where a
+            # failed pairwise merge could not — then count + EVICT it and
+            # keep folding everyone else. The try/except is the final net
+            # for same-structure merges that still raise.
+            problem = self._structural_mismatch(tree)
+            if problem is None:
+                try:
+                    folded = tree if folded is None else self._merge_states_trees(folded, tree)
+                    continue
+                except Exception as err:  # noqa: BLE001
+                    problem = repr(err)
+            self._count_fold_error(
+                f"fold contribution from {pub_name!r} evicted: {problem}"
+            )
+            with self._lock:
+                p = self._pubs.get(pub_name)
+                if p is not None:
+                    if kind == "newest":
+                        p.newest = None
+                    else:
+                        p.delta_states = None
+        return folded
+
+    def _structural_mismatch(self, tree: Dict[str, Dict[str, Any]]) -> Optional[str]:
+        """Compare a contribution's leaf structure (names + dtype/shape
+        signatures) against the collector template; returns a description
+        of the first mismatch, or ``None`` when the fold is safe."""
+        if self._template_key is None:
+            return "no collector template"
+        from metrics_tpu.observability.wire import _leaf_key
+
+        if set(tree) != set(self._template_key):
+            return f"metric set {sorted(tree)} != template {sorted(self._template_key)}"
+        for metric, leaves in tree.items():
+            want = self._template_key[metric]["states"]
+            if set(leaves) != set(want):
+                return f"{metric!r} states {sorted(leaves)} != template {sorted(want)}"
+            for name, leaf in leaves.items():
+                got = _leaf_key(leaf)
+                if got != want[name]:
+                    return f"{metric}.{name} layout {got} != template {want[name]}"
+        return None
+
+    def fold_values(self) -> Dict[str, Any]:
+        """``compute`` over the global fold: the fleet-wide metric VALUES
+        (the number a dashboard wants), via each template member's pure
+        ``compute_state``. Empty when there is nothing to fold."""
+        folded = self.fold_states()
+        if folded is None:
+            return {}
+        out: Dict[str, Any] = {}
+        for name, metric in self._template_members.items():
+            try:
+                out[name] = metric.compute_state(folded[name])
+            except Exception as err:  # noqa: BLE001
+                self._count_fold_error(f"compute over fold failed for {name!r}: {err!r}")
+        return out
+
+    def fold_telemetry(self) -> List[Dict[str, Any]]:
+        """Every publisher's newest telemetry payload list, concatenated
+        in sorted publisher order — the input
+        :func:`~metrics_tpu.observability.merge_payloads` merges into the
+        job-wide aggregate."""
+        with self._lock:
+            out: List[Dict[str, Any]] = []
+            for name in sorted(self._pubs):
+                out.extend(self._pubs[name].telemetry)
+            return out
+
+    def merged_telemetry(self) -> Optional[Dict[str, Any]]:
+        """The fleet-wide telemetry aggregate (``merge_payloads`` over
+        :meth:`fold_telemetry`), or ``None`` when no publisher shipped
+        telemetry."""
+        payloads = self.fold_telemetry()
+        if not payloads:
+            return None
+        from metrics_tpu.observability.aggregate import merge_payloads
+
+        return merge_payloads(payloads)
+
+    # ------------------------------------------------------------------
+    # hierarchy
+    # ------------------------------------------------------------------
+    def publish_fold(self, sink: SnapshotSink, t: Optional[float] = None) -> Optional[str]:
+        """Re-publish this collector's global fold as ONE snapshot into a
+        parent tier's sink — the merge-tree edge (host collector -> rack
+        sink -> global collector), every tier running the same fold.
+        Cumulative (``"state"`` mode) by construction. Returns the path
+        written, or ``None`` when there is nothing to publish yet."""
+        folded = self.fold_states()
+        payloads = self.fold_telemetry()
+        if folded is None and not payloads:
+            return None
+        return sink.publish(
+            states=folded,
+            states_template=self.template if folded is not None else None,
+            telemetry=payloads or None,
+            mode="state",
+            t=t,
+        )
+
+    # ------------------------------------------------------------------
+    # telemetry feed + Prometheus
+    # ------------------------------------------------------------------
+    def _feed_recorder(self, now: Optional[float] = None, backlog: Optional[int] = None) -> None:
+        rec = self._recorder
+        if rec is None:
+            from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as rec  # noqa: N813
+        if not rec.enabled:
+            return
+        totals = self.totals()
+        deltas = {k: totals[k] - self._reported[k] for k in self._reported}
+        self._reported = {k: totals[k] for k in self._reported}
+        statuses = self.publishers(now=now)
+        lags = [s.lag_s for s in statuses if not s.retired and s.lag_s != float("inf")]
+        try:
+            rec.record_fleet_poll(
+                absorbed=deltas["absorbed"],
+                duplicates=deltas["duplicates"],
+                late_dropped=deltas["late_dropped"],
+                fold_errors=deltas["fold_errors"],
+                backlog=self.backlog() if backlog is None else backlog,
+                max_lag_s=max(lags) if lags else 0.0,
+                publishers=totals["publishers"],
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never break the fold
+            pass
+
+    def prometheus_lines(self, now: Optional[float] = None) -> List[str]:
+        """The collector's own families: per-publisher liveness/lag/seq
+        plus the snapshot outcome counters, backlog, and watermark age."""
+        from metrics_tpu.observability.exporters import _labels
+
+        now_f = self.clock() if now is None else float(now)
+        statuses = self.publishers(now=now_f)
+        totals = self.totals()
+        lines = [
+            "# HELP metrics_tpu_fleet_publisher_up Publisher liveness (1 = shipped a snapshot within stale_after_s).",
+            "# TYPE metrics_tpu_fleet_publisher_up gauge",
+        ]
+        for s in statuses:
+            lines.append(
+                f"metrics_tpu_fleet_publisher_up{_labels(publisher=s.publisher, host=s.host)}"
+                f" {0 if s.stale else 1}"
+            )
+        lines.append("# HELP metrics_tpu_fleet_publisher_lag_seconds Now minus the publisher's newest snapshot time.")
+        lines.append("# TYPE metrics_tpu_fleet_publisher_lag_seconds gauge")
+        for s in statuses:
+            if s.lag_s != float("inf"):
+                lines.append(
+                    f"metrics_tpu_fleet_publisher_lag_seconds"
+                    f"{_labels(publisher=s.publisher, host=s.host)} {s.lag_s:g}"
+                )
+        lines.append("# HELP metrics_tpu_fleet_publisher_last_seq Newest sequence number absorbed per publisher.")
+        lines.append("# TYPE metrics_tpu_fleet_publisher_last_seq gauge")
+        for s in statuses:
+            lines.append(
+                f"metrics_tpu_fleet_publisher_last_seq"
+                f"{_labels(publisher=s.publisher, host=s.host)} {s.last_seq}"
+            )
+        lines.append("# HELP metrics_tpu_fleet_snapshots_total Snapshots by ingest outcome (absorbed|duplicate|late_dropped|fold_error; disjoint).")
+        lines.append("# TYPE metrics_tpu_fleet_snapshots_total counter")
+        for outcome, key in (
+            ("absorbed", "absorbed"),
+            ("duplicate", "duplicates"),
+            ("late_dropped", "late_dropped"),
+            ("fold_error", "fold_errors"),
+        ):
+            lines.append(
+                f"metrics_tpu_fleet_snapshots_total{_labels(outcome=outcome)} {totals[key]}"
+            )
+        lines.append("# HELP metrics_tpu_fleet_backlog Unfolded snapshots (queued files + in-window pending deltas).")
+        lines.append("# TYPE metrics_tpu_fleet_backlog gauge")
+        lines.append(f"metrics_tpu_fleet_backlog {self.backlog()}")
+        lines.append("# HELP metrics_tpu_fleet_publishers Distinct publishers ever seen.")
+        lines.append("# TYPE metrics_tpu_fleet_publishers gauge")
+        lines.append(f"metrics_tpu_fleet_publishers {totals['publishers']}")
+        if self._max_t > float("-inf"):
+            lines.append("# HELP metrics_tpu_fleet_watermark_age_seconds Now minus the event-time watermark.")
+            lines.append("# TYPE metrics_tpu_fleet_watermark_age_seconds gauge")
+            lines.append(f"metrics_tpu_fleet_watermark_age_seconds {max(0.0, now_f - self.watermark):g}")
+        return lines
+
+    def fold_value_lines(self) -> List[str]:
+        """Scalar fleet-wide metric values as a Prometheus family (vector
+        results are skipped — exposition samples are scalars)."""
+        from metrics_tpu.observability.exporters import _labels
+
+        values = self.fold_values()
+        lines: List[str] = []
+        scalars = []
+        for name, value in sorted(values.items()):
+            try:
+                scalars.append((name, float(value)))
+            except (TypeError, ValueError):
+                continue
+        if scalars:
+            lines.append("# HELP metrics_tpu_fleet_metric_value Fleet-wide metric value computed over the global fold.")
+            lines.append("# TYPE metrics_tpu_fleet_metric_value gauge")
+            for name, v in scalars:
+                lines.append(f"metrics_tpu_fleet_metric_value{_labels(metric=name)} {v:g}")
+        return lines
+
+    def render_prometheus(
+        self,
+        now: Optional[float] = None,
+        include_collector_families: bool = True,
+        include_fold_values: bool = False,
+    ) -> str:
+        """The federated Prometheus page: the merged telemetry rendered
+        through :func:`~metrics_tpu.observability.render_prometheus`
+        (every per-rank family carries ``process`` AND ``host`` labels;
+        the totals are the global fold), plus the collector's own fleet
+        families and — optionally — the fleet-wide metric values.
+
+        The fold-derived portion is deterministic for a given absorbed
+        multiset whatever the arrival order (the fold-determinism
+        contract); the collector families count arrival bookkeeping, so
+        ``include_collector_families=False`` gives the strictly
+        deterministic page."""
+        from metrics_tpu.observability.exporters import render_prometheus
+
+        merged = self.merged_telemetry()
+        parts: List[str] = []
+        if merged is not None:
+            parts.append(render_prometheus(aggregate=merged))
+        if include_fold_values:
+            lines = self.fold_value_lines()
+            if lines:
+                parts.append("\n".join(lines) + "\n")
+        if include_collector_families:
+            parts.append("\n".join(self.prometheus_lines(now=now)) + "\n")
+        return "".join(parts)

@@ -46,18 +46,46 @@ def _atomic_write(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
-def _atomic_append(path: str, text: str) -> None:
-    """Atomic logical append: read the existing artifact (if any), write
-    existing+new through the tmp+replace path. O(file) per call — fine for
-    the once-per-process-exit appends the entry points perform; sustained
-    high-rate appenders should export full snapshots instead."""
-    existing = ""
+#: rotation cap for appended line logs (alarm JSONL, env-var telemetry
+#: appends): past it the current file moves to ``<path>.1`` (previous
+#: ``.1`` overwritten) and appends continue on a fresh file — long-running
+#: jobs keep bounded log disk, with the newest full generation retained
+APPEND_ROTATE_BYTES = 64 * 1024 * 1024
+
+
+def _atomic_append(path: str, text: str, max_bytes: Optional[int] = APPEND_ROTATE_BYTES) -> None:
+    """Line-log append: ONE ``O_APPEND`` ``write`` of the new bytes.
+
+    O(len(text)) per call whatever the file size — the previous
+    read-whole-file-and-rewrite implementation made every append O(file),
+    so a long-running alarm/telemetry log degraded quadratically (pinned
+    by the multi-thousand-append test). ``O_APPEND`` + a single ``write``
+    is atomic w.r.t. the file offset, so concurrent appenders (and
+    multi-process env-var telemetry) interleave at line granularity, and
+    a crash mid-call loses at most the tail of this one write — every
+    previously appended line survives intact.
+
+    ``max_bytes`` caps the file: when this append would push past it, the
+    current file rotates to ``<path>.1`` first (previous ``.1``
+    overwritten — one old generation retained) and the append lands on a
+    fresh file. ``None`` disables rotation."""
+    data = text.encode("utf-8")
+    flags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+    fd = os.open(path, flags, 0o644)
     try:
-        with open(path) as fh:
-            existing = fh.read()
-    except FileNotFoundError:
-        pass
-    _atomic_write(path, existing + text)
+        if (
+            max_bytes is not None
+            and os.fstat(fd).st_size > 0
+            and os.fstat(fd).st_size + len(data) > max_bytes
+        ):
+            os.close(fd)
+            fd = -1
+            os.replace(path, path + ".1")
+            fd = os.open(path, flags, 0o644)
+        os.write(fd, data)
+    finally:
+        if fd >= 0:
+            os.close(fd)
 
 
 def export_jsonl(path: str, recorder: Optional[Any] = None, append: bool = False) -> Optional[str]:
@@ -65,9 +93,10 @@ def export_jsonl(path: str, recorder: Optional[Any] = None, append: bool = False
 
     Returns the path written, or ``None`` on non-zero ranks (rank-zero
     gated). Events are plain dicts of JSON scalars/lists, so the artifact
-    round-trips through ``json.loads`` line by line. Writes are atomic
-    (tmp + ``os.replace``), including ``append=True`` — a reader or a
-    crash can never observe half an event line.
+    round-trips through ``json.loads`` line by line. Full writes are
+    atomic (tmp + ``os.replace``); ``append=True`` is a single
+    ``O_APPEND`` write (crash-safe up to the current write, size-cap
+    rotated — see :func:`_atomic_append`).
     """
     if _process_index() != 0:
         return None
@@ -193,7 +222,20 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
         dropped = rec.dropped_events()
 
     def proc_label(payload: Dict[str, Any]) -> Dict[str, Any]:
-        return {"process": payload["process"]} if aggregate is not None else {}
+        if aggregate is None:
+            return {}
+        # per-host labelling for the federated (fleet-collector) view:
+        # payloads carrying snapshot provenance get host (and, through a
+        # collector, publisher) labels next to the process index — several
+        # publishers on one host share a process index, so the publisher
+        # id is what keeps the per-rank series distinct. Older payloads
+        # without provenance stay process-only.
+        labels: Dict[str, Any] = {"process": payload.get("process", 0)}
+        if payload.get("host"):
+            labels["host"] = payload["host"]
+        if payload.get("publisher"):
+            labels["publisher"] = payload["publisher"]
+        return labels
 
     lines: List[str] = []
     lines.append("# HELP metrics_tpu_calls_total Metric lifecycle calls by metric and phase.")
@@ -362,6 +404,41 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             source, _, stat = key.partition("|")
             lines.append(
                 f"metrics_tpu_drift_score{_labels(metric=source, stat=stat, **proc_label(payload))} {v:g}"
+            )
+    lines.append("# HELP metrics_tpu_fleet_ingest_total Fleet-collector snapshot ingests by outcome (absorbed|duplicate|late_dropped|fold_error; disjoint).")
+    lines.append("# TYPE metrics_tpu_fleet_ingest_total counter")
+    for payload in per_proc:
+        totals = payload.get("fleet_totals", {})
+        for outcome, key in (
+            ("absorbed", "absorbed"),
+            ("duplicate", "duplicates"),
+            ("late_dropped", "late_dropped"),
+            ("fold_error", "fold_errors"),
+        ):
+            lines.append(
+                f"metrics_tpu_fleet_ingest_total"
+                f"{_labels(outcome=outcome, **proc_label(payload))} {totals.get(key, 0)}"
+            )
+    # the fleet gauges follow the async-gauge contiguity pattern: each
+    # family's HELP/TYPE directly above its own samples
+    for family, key, help_text in (
+        ("metrics_tpu_fleet_backlog_snapshots", "backlog",
+         "Unfolded snapshots at the collector (queued files + in-window"
+         " pending deltas; last seen / high-water)."),
+        ("metrics_tpu_fleet_worst_publisher_lag_seconds", "publisher_lag_s",
+         "Worst per-publisher snapshot lag observed at a collector poll"
+         " (last seen / high-water)."),
+    ):
+        lines.append(f"# HELP {family} {help_text}")
+        lines.append(f"# TYPE {family} gauge")
+        for payload in per_proc:
+            totals = payload.get("fleet_totals", {})
+            lines.append(
+                f"{family}{_labels(window='last', **proc_label(payload))} {totals.get(key, 0)}"
+            )
+            lines.append(
+                f"{family}{_labels(window='max', **proc_label(payload))}"
+                f" {totals.get('max_' + key, 0)}"
             )
     lines.append("# HELP metrics_tpu_export_errors_total Exporter ticks that raised (artifacts may be stale).")
     lines.append("# TYPE metrics_tpu_export_errors_total counter")
@@ -553,6 +630,22 @@ class PeriodicExporter:
     ``health`` and every tick evaluates it (firing/clearing alarms on
     schedule even when no new events arrive — clearing is time passing)
     and appends its Prometheus families to the Prometheus artifact.
+
+    **Fleet publishing**: pass a
+    :class:`~metrics_tpu.observability.collector.SnapshotSink` as
+    ``snapshot_sink`` and every tick also publishes one fleet snapshot —
+    the recorder's counter payload (telemetry), plus the metric states
+    returned by ``states_fn`` when given (a zero-arg callable returning
+    the :func:`~metrics_tpu.observability.wire.snapshot_states` dict, or
+    the metric/collection itself to snapshot — the latter also embeds
+    the structural layout key the collector validates against; when
+    ``states_fn`` returns a bare dict, pass the metric/collection as
+    ``states_template`` so dict-publishing ticks do not bypass that
+    validation). Published on EVERY tick, even idle ones: the snapshot
+    is the publisher's heartbeat — the collector's ``publisher_stale``
+    alarm watches for its absence. ``snapshot_mode`` is ``"state"``
+    (cumulative, the default) or ``"delta"`` (the caller resets after
+    each tick).
     """
 
     def __init__(
@@ -562,15 +655,25 @@ class PeriodicExporter:
         jsonl_path: Optional[str] = None,
         recorder: Optional[Any] = None,
         health: Optional[Any] = None,
+        snapshot_sink: Optional[Any] = None,
+        states_fn: Optional[Any] = None,
+        states_template: Optional[Any] = None,
+        snapshot_mode: str = "state",
     ) -> None:
-        if prometheus_path is None and jsonl_path is None:
-            raise ValueError("PeriodicExporter needs a prometheus_path and/or a jsonl_path")
+        if prometheus_path is None and jsonl_path is None and snapshot_sink is None:
+            raise ValueError(
+                "PeriodicExporter needs a prometheus_path, a jsonl_path, and/or a snapshot_sink"
+            )
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
         self.interval_s = float(interval_s)
         self.prometheus_path = prometheus_path
         self.jsonl_path = jsonl_path
         self.health = health
+        self.snapshot_sink = snapshot_sink
+        self.states_fn = states_fn
+        self.states_template = states_template
+        self.snapshot_mode = snapshot_mode
         self.export_errors = 0
         self._recorder = recorder
         self._thread: Optional[threading.Thread] = None
@@ -644,6 +747,10 @@ class PeriodicExporter:
             # sketch math) and unconditionally: alarms must clear on
             # schedule even when the job records nothing new
             snapshot = self.health.evaluate()
+        if self.snapshot_sink is not None:
+            # every tick, even idle ones: the snapshot doubles as the
+            # publisher heartbeat the collector's liveness tracking needs
+            self._publish_snapshot(rec)
         with self._lock:
             state = (len(events), rec.dropped_events())
             live_window = self.health is not None or rec.timeseries is not None
@@ -659,6 +766,36 @@ class PeriodicExporter:
                     self.jsonl_path, "".join(json.dumps(e) + "\n" for e in events)
                 )
             self._exported_state = state
+
+    def _publish_snapshot(self, rec: Any) -> None:
+        """One fleet snapshot into the configured sink: the recorder's
+        counter payload plus (when ``states_fn`` is set) the metric
+        states. ``states_fn`` may return the canonical states dict or the
+        metric/collection itself."""
+        from metrics_tpu.observability.aggregate import counter_payload
+
+        states = None
+        template = self.states_template
+        if self.states_fn is not None:
+            obj = self.states_fn()
+            if obj is not None:
+                if isinstance(obj, dict):
+                    # a bare dict carries no structure of its own — the
+                    # explicit states_template (when given) supplies the
+                    # layout key so these snapshots do not bypass the
+                    # collector's validation
+                    states = obj
+                else:
+                    from metrics_tpu.observability.wire import snapshot_states
+
+                    states = snapshot_states(obj)
+                    template = obj
+        self.snapshot_sink.publish(
+            states=states,
+            states_template=template,
+            telemetry=counter_payload(rec),
+            mode=self.snapshot_mode,
+        )
 
     def stop(self) -> None:
         """Stop the thread and perform one final export. Idempotent."""

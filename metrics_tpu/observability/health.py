@@ -27,11 +27,14 @@ Three rule shapes cover the standard serving-loop failure modes:
   the score-drift alarm, the "is the MODEL healthy" complement to the
   pipeline alarms above.
 
-:func:`default_rules` wires the seven standard alarm classes over the
+:func:`default_rules` wires the ten standard alarm classes — seven
+serving-loop classes plus the three fleet-collector classes
+(``publisher_stale``/``snapshot_backlog``/``fold_error``) — over the
 standard series names the recorder feeds (``SERIES_*`` in
 ``recorder.py``); every threshold is a keyword so deployments tune rather
-than reimplement. ``examples/serving_loop.py`` drives the whole layer
-under fault injection. See docs/observability.md for the rule reference.
+than reimplement. ``examples/serving_loop.py`` drives the serving layer
+and ``examples/fleet_collector.py`` the fleet layer under fault
+injection. See docs/observability.md for the rule reference.
 """
 from __future__ import annotations
 
@@ -47,7 +50,10 @@ from metrics_tpu.observability.recorder import (
     SERIES_ASYNC_ENQUEUED,
     SERIES_ASYNC_QUEUE_DEPTH,
     SERIES_ASYNC_STALENESS,
+    SERIES_COLLECTOR_BACKLOG,
+    SERIES_FOLD_ERRORS,
     SERIES_HOT_SLICE_SHARE,
+    SERIES_PUBLISHER_LAG,
     SERIES_RECOMPILES,
     SERIES_SCORES,
     SERIES_SKETCH_FILL,
@@ -491,9 +497,10 @@ class HealthMonitor:
                     r.recorder = recorder
         self.alarm_log_path = alarm_log_path
         self._lock = threading.Lock()
-        #: serializes alarm-log appends — _atomic_append is a read-modify-
-        #: replace, so concurrent evaluates (exporter tick thread + the
-        #: serving loop's probe) would lose rows without it
+        #: serializes alarm-log appends — O_APPEND writes interleave at
+        #: line granularity, but the rows of ONE evaluation must land as a
+        #: contiguous block so concurrent evaluates (exporter tick thread +
+        #: the serving loop's probe) read as coherent transitions
         self._log_lock = threading.Lock()
         self._fired_at: Dict[str, float] = {}
         self._transitions: List[Dict[str, Any]] = []
@@ -673,9 +680,13 @@ def default_rules(
     drift_threshold: float = 0.25,
     drift_freeze_after: int = 128,
     drift_stat: str = "psi",
+    publisher_lag_limit_s: float = 30.0,
+    backlog_limit: float = 64,
+    fold_errors_per_window: float = 1,
 ) -> List[Rule]:
-    """The seven standard serving-loop alarm classes over the standard
-    recorder-fed series, every threshold tunable:
+    """The ten standard alarm classes — seven serving-loop classes plus
+    the three fleet-collector classes — over the standard recorder-fed
+    series, every threshold tunable:
 
     * ``queue_saturation`` (warn) / ``queue_saturation_critical`` — p95 /
       max of the async queue depth against the configured limit.
@@ -691,6 +702,18 @@ def default_rules(
       against its frozen reference window (``record_scores`` feeds the
       series; absent when the loop never records scores — the rule then
       never fires, like any absent series).
+    * ``publisher_stale`` — worst per-publisher snapshot lag seen at a
+      fleet-collector poll against the staleness bound (a silent
+      publisher's lag grows every poll; the collector feeds the series).
+    * ``snapshot_backlog`` — unfolded snapshots at the collector (queued
+      files + in-window pending deltas) against the backlog limit.
+    * ``fold_error`` (critical) — ANY fold error in the window: a
+      snapshot the collector could not decode, validate, or merge is
+      fleet data loss.
+
+    The three fleet classes watch series only a
+    :class:`~metrics_tpu.observability.collector.FleetCollector` feeds —
+    in a job without a collector they never fire, like any absent series.
     """
     short = short_window_s if short_window_s is not None else max(window_s / 3.0, 1.0)
     return [
@@ -779,5 +802,35 @@ def default_rules(
             min_count=16,
             severity="warn",
             description="live score distribution drifted from the frozen reference window",
+        ),
+        ThresholdRule(
+            "publisher_stale",
+            SERIES_PUBLISHER_LAG,
+            stat="max",
+            threshold=publisher_lag_limit_s,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            description="a fleet publisher has not shipped a snapshot within the staleness bound",
+        ),
+        ThresholdRule(
+            "snapshot_backlog",
+            SERIES_COLLECTOR_BACKLOG,
+            stat="max",
+            threshold=backlog_limit,
+            window_s=window_s,
+            op=">=",
+            severity="warn",
+            description="the fleet collector is falling behind the publishers' snapshot rate",
+        ),
+        ThresholdRule(
+            "fold_error",
+            SERIES_FOLD_ERRORS,
+            stat="total",
+            threshold=fold_errors_per_window,
+            window_s=window_s,
+            op=">=",
+            severity="critical",
+            description="snapshots failed to decode/validate/fold — fleet data loss",
         ),
     ]

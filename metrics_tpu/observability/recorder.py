@@ -137,6 +137,15 @@ SERIES_EXPORT_ERRORS = "export_errors"
 #: ``record_scores``) — the live distribution the drift alarm compares
 #: against its frozen reference window
 SERIES_SCORES = "scores"
+#: fleet collector: worst per-publisher snapshot lag observed at a poll
+#: (seconds behind the collector clock) — the ``publisher_stale`` signal
+SERIES_PUBLISHER_LAG = "publisher_lag_s"
+#: fleet collector: unfolded snapshots (queued files + in-window pending
+#: deltas) observed at a poll — the ``snapshot_backlog`` signal
+SERIES_COLLECTOR_BACKLOG = "collector_backlog"
+#: fleet collector: fold errors (undecodable/foreign/mismatched/failed
+#: snapshots) per poll — the ``fold_error`` signal
+SERIES_FOLD_ERRORS = "collector_fold_errors"
 
 #: the standard counter-kind series; every other standard series is a
 #: distribution (sketch-backed)
@@ -147,6 +156,7 @@ COUNTER_SERIES = (
     SERIES_RECOMPILES,
     SERIES_SLICED_ROWS,
     SERIES_EXPORT_ERRORS,
+    SERIES_FOLD_ERRORS,
 )
 
 
@@ -159,6 +169,23 @@ def _new_sketch_totals() -> Dict[str, float]:
     (extensive — summed across hosts) plus last-seen and high-water
     capacity-fill ratio gauges (maxed across hosts)."""
     return {"merges": 0, "fill_ratio": 0.0, "max_fill_ratio": 0.0}
+
+
+def _new_fleet_totals() -> Dict[str, float]:
+    """Zeroed fleet-collector counters: snapshot ingest outcomes and fold
+    errors (extensive — summed across hosts) plus last-seen and high-water
+    gauges for the backlog and the worst publisher lag."""
+    return {
+        "absorbed": 0,
+        "duplicates": 0,
+        "late_dropped": 0,
+        "fold_errors": 0,
+        "backlog": 0,
+        "max_backlog": 0,
+        "publisher_lag_s": 0.0,
+        "max_publisher_lag_s": 0.0,
+        "publishers": 0,
+    }
 
 
 def _new_async_totals() -> Dict[str, int]:
@@ -295,7 +322,11 @@ class MetricRecorder:
         #: "source|stat" -> last observed drift score (gauges; fed by the
         #: health layer's DriftRule evaluations — see record_drift_score)
         self._drift: Dict[str, float] = {}
+        self._fleet = _new_fleet_totals()
         self._export_errors = 0
+        #: monotonic provenance sequence for exported counter payloads —
+        #: see ``next_snapshot_seq`` / ``aggregate.counter_payload``
+        self._snapshot_seq = 0
         #: tid -> thread name, registered as events from new threads arrive —
         #: export_perfetto emits these as thread_name metadata so the async
         #: worker's spans land on their own labeled track
@@ -382,7 +413,11 @@ class MetricRecorder:
             self._sliced_slice_counts = {}
             self._sketch = _new_sketch_totals()
             self._drift = {}
+            self._fleet = _new_fleet_totals()
             self._export_errors = 0
+            # the snapshot sequence survives reset ON PURPOSE: provenance
+            # must stay monotonic for the publisher's whole lifetime, or a
+            # collector's dedup would see post-reset payloads as replays
             self._thread_names = {}
             self._group_local = threading.local()
         # the windowed layer stays ATTACHED across reset (long jobs reset the
@@ -478,6 +513,25 @@ class MetricRecorder:
         data; gauges — merged max-wise across hosts)."""
         with self._lock:
             return dict(self._drift)
+
+    def fleet_totals(self) -> Dict[str, float]:
+        """Fleet-collector counters: snapshot ingest outcomes (absorbed/
+        duplicates/late_dropped — extensive), fold errors, plus last-seen
+        and high-water gauges for the unfolded backlog and the worst
+        publisher lag. Fed by ``FleetCollector`` polls via
+        ``record_fleet_poll``."""
+        with self._lock:
+            return dict(self._fleet)
+
+    def next_snapshot_seq(self) -> int:
+        """The next monotonic provenance sequence number for an exported
+        counter payload / fleet snapshot from this process. Monotonic for
+        the recorder's lifetime (``reset()`` does NOT rewind it — a
+        collector's duplicate detection keys on it)."""
+        with self._lock:
+            seq = self._snapshot_seq
+            self._snapshot_seq += 1
+            return seq
 
     def export_errors(self) -> int:
         """Exporter ticks that raised (see ``PeriodicExporter``) — a
@@ -1028,6 +1082,56 @@ class MetricRecorder:
             event: Dict[str, Any] = {"type": etype, "t": round(time.time() - self._t0, 6)}
             event.update(fields)
             self._append(event)
+
+    def record_fleet_poll(
+        self,
+        absorbed: int = 0,
+        duplicates: int = 0,
+        late_dropped: int = 0,
+        fold_errors: int = 0,
+        backlog: int = 0,
+        max_lag_s: float = 0.0,
+        publishers: int = 0,
+        **extra: Any,
+    ) -> None:
+        """Record one fleet-collector poll (``FleetCollector._feed_recorder``).
+
+        The count arguments are DELTAS since the previous poll (summed
+        into the extensive totals); ``backlog``/``max_lag_s`` are gauges
+        (last seen + high-water). Feeds the windowed ``publisher_lag_s``
+        / ``collector_backlog`` / ``collector_fold_errors`` series the
+        three fleet alarm classes watch. An event row is appended only
+        when a poll actually moved a counter — idle polls update gauges
+        and series without flooding the stream."""
+        with self._lock:
+            f = self._fleet
+            f["absorbed"] += int(absorbed)
+            f["duplicates"] += int(duplicates)
+            f["late_dropped"] += int(late_dropped)
+            f["fold_errors"] += int(fold_errors)
+            f["backlog"] = int(backlog)
+            f["max_backlog"] = max(f["max_backlog"], int(backlog))
+            f["publisher_lag_s"] = float(max_lag_s)
+            f["max_publisher_lag_s"] = max(f["max_publisher_lag_s"], float(max_lag_s))
+            f["publishers"] = max(f["publishers"], int(publishers))
+            if absorbed or duplicates or late_dropped or fold_errors:
+                event: Dict[str, Any] = {
+                    "type": "fleet_poll",
+                    "t": round(time.time() - self._t0, 6),
+                    "absorbed": int(absorbed),
+                    "duplicates": int(duplicates),
+                    "late_dropped": int(late_dropped),
+                    "fold_errors": int(fold_errors),
+                    "backlog": int(backlog),
+                    "max_lag_s": round(float(max_lag_s), 4),
+                }
+                event.update(extra)
+                self._append(event)
+        # windowed feeds (outside the lock; no-ops when detached)
+        self._observe(SERIES_COLLECTOR_BACKLOG, int(backlog))
+        self._observe(SERIES_PUBLISHER_LAG, float(max_lag_s))
+        if fold_errors:
+            self._observe(SERIES_FOLD_ERRORS, int(fold_errors))
 
     def record_export_error(self, error: Optional[BaseException] = None) -> None:
         """Count one failed exporter tick (``PeriodicExporter`` hardening):

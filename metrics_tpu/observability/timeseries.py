@@ -363,9 +363,7 @@ class TelemetrySeries:
     def load_payload(self, payload: Dict[str, Any]) -> "TelemetrySeries":
         """Install a payload's buckets into this (expected empty) series —
         the read side of :func:`series_from_payload`."""
-        import jax.numpy as jnp
-
-        from metrics_tpu.sketches.quantile import qsketch_init, qsketch_merge
+        from metrics_tpu.sketches.quantile import qsketch_absorb_rows, qsketch_init
 
         with self._lock:
             for row in payload.get("buckets", []):
@@ -385,13 +383,11 @@ class TelemetrySeries:
                 rows = row.get("sk")
                 if rows:
                     self._flush(b)
-                    # a payload from a larger-capacity peer may carry more
-                    # occupied rows than our capacity; merge chunks it down
-                    incoming = jnp.zeros((max(self.sketch_capacity, len(rows)), 2), jnp.float32)
-                    incoming = incoming.at[: len(rows)].set(jnp.asarray(rows, jnp.float32))
                     if b.sketch is None:
                         b.sketch = qsketch_init(self.sketch_capacity)
-                    b.sketch = qsketch_merge(b.sketch, incoming)
+                    # the shared payload-fan-in fold (larger-capacity peers
+                    # chunk down inside the merge)
+                    b.sketch = qsketch_absorb_rows(b.sketch, rows)
         return self
 
     def reset(self) -> "TelemetrySeries":

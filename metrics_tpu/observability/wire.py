@@ -1,0 +1,381 @@
+"""Versioned wire format for fleet snapshots: metric-state pytrees and
+telemetry payloads as self-describing, dtype-stable byte blobs.
+
+ROADMAP item 3's transport layer. Every metric state in this repo is a
+CRDT-style mergeable value (sum/max/min reducers, the sketch
+init/insert/merge contract, ``merge_payloads`` identity semantics), which
+means N serving processes can each serialize their state, ship the bytes
+anywhere, and a collector can fold them back into the single-job answer.
+This module is the serialization half of that story; the fold half lives
+in :mod:`metrics_tpu.observability.collector`.
+
+Design constraints, in order:
+
+* **Dtype-stable**: array leaves round-trip bit-for-bit. Each leaf carries
+  its numpy dtype string (normalized little-endian) plus the raw buffer
+  base64-encoded — JSON numbers would silently promote int64 counters to
+  doubles and round float32 state, so raw bytes are the only encoding that
+  keeps the collector fold *bit-identical* to the single-job accumulation.
+* **Schema-versioned**: every snapshot leads with a magic string and a
+  schema version; a collector refuses (counts, never crashes on) bytes
+  from a future schema instead of misreading them.
+* **Manifest-keyed**: the header carries a fingerprint of the committed
+  fusibility manifest (the repo's machine description of every metric's
+  state layout and reducers) plus a structural key of the published
+  states (class path + per-leaf name/dtype/shape signatures). Publisher/
+  collector version AND layout skew is detected *before* a fold can
+  silently mis-merge.
+* **Provenance-stamped**: host id, process index, publisher id, a
+  monotonic per-publisher sequence number, and the wall clock — the
+  fields the collector's dedup (exactly-once per ``(publisher, seq)``),
+  late-window watermark, and per-publisher liveness tracking key on.
+* **Transport-agnostic**: a snapshot is ``bytes``. The in-tree transport
+  is a directory queue of atomic files (:class:`~metrics_tpu.
+  observability.collector.SnapshotSink`), but nothing here assumes it.
+
+Two snapshot **modes** cover the two publishing disciplines:
+
+* ``"state"`` (default) — the publisher ships its *cumulative* state
+  every tick; per publisher the collector keeps the newest sequence
+  number and the cross-publisher fold merges one state per publisher
+  (exactly :func:`~metrics_tpu.observability.aggregate_across_hosts`'s
+  semantics, with files instead of a collective).
+* ``"delta"`` — the publisher resets after each publish, so every
+  snapshot is a disjoint increment and the collector folds *all* of them
+  (in sequence order per publisher) — the shape a publisher uses when its
+  own memory must stay bounded across an unbounded run.
+
+See docs/fleet_collector.md for the byte-level schema reference.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "WIRE_MAGIC",
+    "WIRE_SCHEMA_VERSION",
+    "Snapshot",
+    "WireError",
+    "decode_snapshot",
+    "encode_snapshot",
+    "manifest_fingerprint",
+    "members_of",
+    "snapshot_states",
+    "states_key",
+]
+
+#: leading magic every snapshot blob starts with (inside the JSON header)
+WIRE_MAGIC = "metrics-tpu-snapshot"
+
+#: current wire schema. Decoders accept any version <= this and refuse
+#: newer ones — an old collector must never misread a future layout.
+WIRE_SCHEMA_VERSION = 1
+
+#: accepted snapshot modes (see module docstring)
+MODES = ("state", "delta")
+
+
+class WireError(ValueError):
+    """Raised on undecodable/foreign/future-schema snapshot bytes. The
+    collector catches it per snapshot and counts a ``fold_error`` instead
+    of dying — one corrupt file must not take down the fleet view."""
+
+
+# ---------------------------------------------------------------------------
+# leaf codec (dtype-stable)
+# ---------------------------------------------------------------------------
+
+def _encode_leaf(value: Any) -> Any:
+    """One state leaf -> JSON-safe form. Arrays keep dtype + raw bytes
+    (bit-exact); Python scalars (the eager auto-count fast path leaves an
+    int behind) pass through as JSON numbers; list states (cat
+    accumulators) encode element-wise."""
+    if isinstance(value, (int, float)):
+        return value
+    if isinstance(value, list):
+        return {"__list__": [_encode_leaf(v) for v in value]}
+    import numpy as np
+
+    arr = np.asarray(value)
+    # normalize to little-endian so the wire bytes mean the same thing on
+    # every host ('|' = byte-order-free dtypes like uint8 stay as-is)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    return {
+        "__arr__": {
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+            "data": base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode("ascii"),
+        }
+    }
+
+
+def _decode_leaf(value: Any) -> Any:
+    if isinstance(value, dict) and "__list__" in value:
+        return [_decode_leaf(v) for v in value["__list__"]]
+    if isinstance(value, dict) and "__arr__" in value:
+        import numpy as np
+
+        spec = value["__arr__"]
+        try:
+            raw = base64.b64decode(spec["data"].encode("ascii"), validate=True)
+            arr = np.frombuffer(raw, dtype=np.dtype(spec["dtype"]))
+            return arr.reshape([int(d) for d in spec["shape"]]).copy()
+        except (KeyError, ValueError, TypeError) as err:
+            raise WireError(f"corrupt array leaf: {err!r}") from err
+    return value
+
+
+# ---------------------------------------------------------------------------
+# states helpers
+# ---------------------------------------------------------------------------
+
+def members_of(obj: Any) -> Dict[str, Any]:
+    """The canonical ``{metric name: metric}`` member map of a template —
+    a :class:`~metrics_tpu.collections.MetricCollection` keys members by
+    their collection names, a bare metric keys its one entry by its class
+    name. THE single source of the member enumeration: the snapshot shape
+    (:func:`snapshot_states`), the layout key (:func:`states_key`), and
+    the collector's fold all derive from this one helper, so they cannot
+    drift apart."""
+    if hasattr(obj, "items") and hasattr(obj, "compile_update"):  # MetricCollection
+        return dict(obj.items(keep_base=True))
+    return {type(obj).__name__: obj}
+
+
+def snapshot_states(obj: Any) -> Dict[str, Dict[str, Any]]:
+    """Snapshot a metric's (or collection's) current states in the wire's
+    canonical ``{metric name: {state name: leaf}}`` shape (member keying
+    per :func:`members_of`). Leaves are the live state values (arrays /
+    eager-int counters / cat lists) — callers publishing ``"delta"``-mode
+    snapshots reset the metric right after snapshotting."""
+    return {name: _metric_states(m) for name, m in members_of(obj).items()}
+
+
+def _metric_states(metric: Any) -> Dict[str, Any]:
+    return {name: getattr(metric, name) for name in metric._defaults}
+
+
+def _leaf_key(value: Any) -> str:
+    """One leaf's structural signature for :func:`states_key`.
+
+    Cat-list states key as ``"list"`` (their shape is data, not layout)
+    and SCALAR leaves as bare ``"int"``/``"float"`` — the eager counter
+    fast path leaves a Python int where another publisher holds an int32
+    array, and that flip-flop must not read as layout skew. Arrays with
+    real axes key dtype + shape: config-determined layouts (bin counts,
+    class axes, sketch capacities) are exactly the skew that would
+    otherwise poison a fold with a broadcast error."""
+    if isinstance(value, list):
+        return "list"
+    if isinstance(value, bool) or isinstance(value, int):
+        return "int"
+    if isinstance(value, float):
+        return "float"
+    import numpy as np
+
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        return "int" if arr.dtype.kind in "biu" else "float"
+    return f"{arr.dtype.str}{list(arr.shape)}"
+
+
+def states_key(obj: Any) -> Dict[str, Any]:
+    """Structural key of a template's states: class path plus each leaf's
+    name and structural signature (dtype + shape for non-scalar arrays —
+    see :func:`_leaf_key`). Rides the snapshot header so a collector can
+    refuse (count a ``fold_error`` for) a publisher whose metric layout
+    disagrees with the collector template *before* any leaf is folded —
+    including same-class config skew that changes a state's shape (bin
+    counts, class axes, sketch capacities). Same-shape config skew (e.g.
+    two scalar-state metrics constructed differently) is structurally
+    invisible; the manifest fingerprint plus deployment discipline own
+    that case."""
+    def one(metric: Any) -> Dict[str, Any]:
+        return {
+            "class": f"{type(metric).__module__}.{type(metric).__name__}",
+            "states": {
+                name: _leaf_key(getattr(metric, name)) for name in sorted(metric._defaults)
+            },
+        }
+
+    return {name: one(m) for name, m in members_of(obj).items()}
+
+
+_MANIFEST_FP_CACHE: Optional[str] = None
+
+
+def manifest_fingerprint() -> str:
+    """Short sha256 fingerprint of the committed fusibility manifest —
+    the repo's machine description of every metric's state layout and
+    reducers, so two builds with the same fingerprint serialize the same
+    state schemas. ``""`` when no manifest is present (installed package
+    without the scripts/ tree); collectors treat empty as "unknown, fold
+    anyway" and a *mismatching* non-empty pair as skew. Cached for the
+    process lifetime: the collector consults it per ingested snapshot,
+    and re-hashing the manifest file at thousands of snapshots/s would
+    dominate the fold."""
+    global _MANIFEST_FP_CACHE
+    if _MANIFEST_FP_CACHE is not None:
+        return _MANIFEST_FP_CACHE
+    try:
+        from metrics_tpu.analysis.manifest import default_manifest_path
+
+        data = default_manifest_path().read_bytes()
+        _MANIFEST_FP_CACHE = hashlib.sha256(data).hexdigest()[:16]
+    except Exception:  # noqa: BLE001 — absent manifest is a legal deployment
+        _MANIFEST_FP_CACHE = ""
+    return _MANIFEST_FP_CACHE
+
+
+# ---------------------------------------------------------------------------
+# snapshot codec
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Snapshot:
+    """One decoded fleet snapshot: provenance header + payloads.
+
+    ``telemetry`` is a LIST of per-process counter payloads (the
+    :func:`~metrics_tpu.observability.counter_payload` shape) — a leaf
+    publisher ships a one-element list, a mid-tier collector re-publishes
+    the concatenation for its whole subtree, and the top-level fold is
+    :func:`~metrics_tpu.observability.merge_payloads` over every payload
+    in the tree — identical semantics to ``aggregate_across_hosts``."""
+
+    publisher: str
+    seq: int
+    t: float
+    host: str = ""
+    process: int = 0
+    mode: str = "state"
+    tier: str = "leaf"
+    schema: int = WIRE_SCHEMA_VERSION
+    manifest_hash: str = ""
+    states: Optional[Dict[str, Dict[str, Any]]] = None
+    states_key: Optional[Dict[str, Any]] = None
+    telemetry: List[Dict[str, Any]] = field(default_factory=list)
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        """The dedup identity: ``(publisher, seq)``."""
+        return (self.publisher, self.seq)
+
+
+def encode_snapshot(
+    *,
+    publisher: str,
+    seq: int,
+    t: Optional[float] = None,
+    host: str = "",
+    process: int = 0,
+    mode: str = "state",
+    tier: str = "leaf",
+    states: Optional[Dict[str, Dict[str, Any]]] = None,
+    states_template: Optional[Any] = None,
+    telemetry: Optional[Any] = None,
+    manifest_hash: Optional[str] = None,
+) -> bytes:
+    """Serialize one snapshot to wire bytes (UTF-8 JSON, array leaves as
+    base64 raw buffers).
+
+    ``states`` is the canonical ``{metric: {state: leaf}}`` dict (use
+    :func:`snapshot_states`); ``states_template`` (the metric/collection
+    the states came from) additionally embeds the structural
+    :func:`states_key` so the collector can verify layout agreement.
+    ``telemetry`` is one counter payload or a list of them. ``t`` defaults
+    to the wall clock; ``manifest_hash`` to the live
+    :func:`manifest_fingerprint`."""
+    if mode not in MODES:
+        raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    if not publisher:
+        raise ValueError("publisher id must be non-empty")
+    if seq < 0:
+        raise ValueError(f"seq must be non-negative, got {seq}")
+    if telemetry is None:
+        payloads: List[Dict[str, Any]] = []
+    elif isinstance(telemetry, dict):
+        payloads = [telemetry]
+    else:
+        payloads = list(telemetry)
+    doc: Dict[str, Any] = {
+        "magic": WIRE_MAGIC,
+        "schema": WIRE_SCHEMA_VERSION,
+        "publisher": publisher,
+        "seq": int(seq),
+        "t": float(time.time() if t is None else t),
+        "host": host,
+        "process": int(process),
+        "mode": mode,
+        "tier": tier,
+        "manifest_hash": manifest_fingerprint() if manifest_hash is None else manifest_hash,
+    }
+    if states is not None:
+        doc["states"] = {
+            metric: {name: _encode_leaf(leaf) for name, leaf in tree.items()}
+            for metric, tree in states.items()
+        }
+        if states_template is not None:
+            doc["states_key"] = states_key(states_template)
+    if payloads:
+        doc["telemetry"] = payloads
+    return json.dumps(doc, sort_keys=True).encode("utf-8")
+
+
+def decode_snapshot(data: bytes) -> Snapshot:
+    """Parse wire bytes back into a :class:`Snapshot`. Raises
+    :class:`WireError` on anything that is not a complete snapshot this
+    build can read (truncated JSON, foreign magic, a FUTURE schema
+    version, corrupt array leaves) — the collector's per-snapshot
+    ``fold_error`` boundary."""
+    try:
+        doc = json.loads(data.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as err:
+        raise WireError(f"undecodable snapshot bytes: {err!r}") from err
+    if not isinstance(doc, dict) or doc.get("magic") != WIRE_MAGIC:
+        raise WireError("not a metrics-tpu snapshot (bad magic)")
+    schema = doc.get("schema")
+    if not isinstance(schema, int) or schema < 1:
+        raise WireError(f"bad schema version {schema!r}")
+    if schema > WIRE_SCHEMA_VERSION:
+        raise WireError(
+            f"snapshot schema v{schema} is newer than this build's"
+            f" v{WIRE_SCHEMA_VERSION}; upgrade the collector"
+        )
+    try:
+        publisher = doc["publisher"]
+        seq = int(doc["seq"])
+        t = float(doc["t"])
+    except (KeyError, TypeError, ValueError) as err:
+        raise WireError(f"snapshot header incomplete: {err!r}") from err
+    states = doc.get("states")
+    if states is not None:
+        states = {
+            metric: {name: _decode_leaf(leaf) for name, leaf in tree.items()}
+            for metric, tree in states.items()
+        }
+    telemetry = doc.get("telemetry", [])
+    if not isinstance(telemetry, list):
+        raise WireError("telemetry payload must be a list of counter payloads")
+    mode = doc.get("mode", "state")
+    if mode not in MODES:
+        raise WireError(f"unknown snapshot mode {mode!r}")
+    return Snapshot(
+        publisher=publisher,
+        seq=seq,
+        t=t,
+        host=doc.get("host", ""),
+        process=int(doc.get("process", 0)),
+        mode=mode,
+        tier=doc.get("tier", "leaf"),
+        schema=schema,
+        manifest_hash=doc.get("manifest_hash", ""),
+        states=states,
+        states_key=doc.get("states_key"),
+        telemetry=telemetry,
+    )

@@ -26,6 +26,7 @@ window, capacity tuning, and the mergeability story.
 from .histogram import hist_bin_index, hist_init, hist_insert, hist_merge
 from .quantile import (
     QSKETCH_RANK_EPS,
+    qsketch_absorb_rows,
     qsketch_cdf,
     qsketch_fill,
     qsketch_histogram,
@@ -62,6 +63,7 @@ __all__ = [
     "hist_init",
     "hist_insert",
     "hist_merge",
+    "qsketch_absorb_rows",
     "qsketch_cdf",
     "qsketch_fill",
     "qsketch_histogram",

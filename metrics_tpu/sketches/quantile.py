@@ -46,7 +46,7 @@ shapes — so metrics whose update is one ``qsketch_insert`` fuse, bucket
 (via ``n_valid`` pad masking), vmap, and mesh-sync like any sum-state
 metric.
 """
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -222,6 +222,24 @@ def qsketch_merge_into(dst: Array, *others: Array) -> Array:
     for other in others:
         dst = qsketch_merge(dst, other)
     return dst
+
+
+def qsketch_absorb_rows(sketch: Array, rows: Any) -> Array:
+    """Fold serialized occupied rows (a ``[n, cols]`` host array/list —
+    the shape telemetry payloads and fleet snapshots ship sketches as)
+    into ``sketch``. ``n`` may exceed the sketch's capacity (a payload
+    from a larger-capacity peer); the merge chunks it down. The one
+    payload-fan-in fold shared by the time-series registry merge and the
+    fleet collector, so wire-level sketch semantics cannot drift from the
+    in-memory merge contract."""
+    rows = jnp.asarray(rows, sketch.dtype)
+    if rows.ndim != 2 or rows.shape[1] != sketch.shape[1]:
+        raise ValueError(
+            f"serialized rows layout {rows.shape} does not match sketch layout {sketch.shape}"
+        )
+    incoming = jnp.zeros((max(sketch.shape[0], rows.shape[0]), sketch.shape[1]), sketch.dtype)
+    incoming = incoming.at[: rows.shape[0]].set(rows)
+    return qsketch_merge(sketch, incoming)
 
 
 class _QSketchReduce:

@@ -83,6 +83,8 @@ AUX_FIELDS: Dict[str, str] = {
     "fused_telemetry_on_ratio": "higher",
     "windowed_vs_plain": "higher",
     "windowed_compiles": "lower",
+    "collector_fold_per_sec": "higher",
+    "wire_bytes_per_snapshot": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -97,6 +99,10 @@ BOOL_FIELDS: Tuple[str, ...] = (
     # windowed_compiles would pass n_compiles == 0 — a total eager
     # demotion, the very regression the anchor exists to catch
     "windowed_fused",
+    # arrival-order independence of the fleet collector fold (bit-identical
+    # leaves + byte-identical exposition) — broken determinism is data
+    # corruption however fast the fold runs
+    "collector_fold_deterministic",
 )
 
 

@@ -1,0 +1,706 @@
+"""Fleet collector tests (ISSUE 13 tentpole + fold-determinism satellite):
+the directory-queue transport, exactly-once dedup, the bounded late window
+with watermark, per-publisher liveness/retirement, delta-mode sequence-
+order folding, hierarchical (merge-tree) fan-in, the fold_error boundary,
+the federated Prometheus view, the recorder/health wiring for the three
+fleet alarm classes, and the arrival-order-independence contract: the
+same snapshot multiset folded in any order (including a duplicate and a
+late arrival) yields bit-identical collector state, a byte-identical
+Prometheus exposition, and matches single-job ``aggregate_across_hosts``
+/ sequential accumulation on the same events."""
+import itertools
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.observability import (
+    FleetCollector,
+    HealthMonitor,
+    PeriodicExporter,
+    SnapshotSink,
+    counter_payload,
+    default_rules,
+    encode_snapshot,
+    get_recorder,
+    merge_payloads,
+    render_prometheus,
+    snapshot_states,
+)
+from metrics_tpu.observability.collector import SnapshotQueue
+from metrics_tpu.observability.recorder import (
+    SERIES_COLLECTOR_BACKLOG,
+    SERIES_FOLD_ERRORS,
+    SERIES_PUBLISHER_LAG,
+)
+from metrics_tpu.observability.timeseries import TimeSeriesRegistry
+
+T0 = 1_000_000.0
+
+
+def make_collection():
+    return MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+
+
+def int_batches(seed, n_batches, bs=16):
+    """Integer-exact traffic: sum/count reducers fold bit-identically."""
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.randint(0, 2, bs), jnp.int32),
+            jnp.asarray(rng.randint(0, 2, bs), jnp.int32),
+        )
+        for _ in range(n_batches)
+    ]
+
+
+def publisher_snapshots(pub_index, n_snaps, mode="state", bs=16, telemetry=None):
+    """Encoded snapshots of one publisher's evolving collection. In state
+    mode each snapshot is cumulative; in delta mode the collection resets
+    after each publish."""
+    col = make_collection()
+    blobs = []
+    for seq, (preds, target) in enumerate(int_batches(100 + pub_index, n_snaps, bs)):
+        col.update(preds, target)
+        blobs.append(
+            encode_snapshot(
+                publisher=f"pub{pub_index}",
+                seq=seq,
+                t=T0 + seq,
+                host=f"h{pub_index}",
+                process=pub_index,
+                mode=mode,
+                states=snapshot_states(col),
+                states_template=col,
+                telemetry=telemetry,
+            )
+        )
+        if mode == "delta":
+            col.reset()
+    return blobs
+
+
+def assert_states_equal(a, b):
+    assert set(a) == set(b)
+    for m in a:
+        assert set(a[m]) == set(b[m])
+        for leaf in a[m]:
+            assert np.array_equal(np.asarray(a[m][leaf]), np.asarray(b[m][leaf])), (m, leaf)
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+class TestTransport:
+    def test_sink_writes_atomic_files_queue_consumes_once(self, tmp_path):
+        sink = SnapshotSink(str(tmp_path), publisher="p0", host="h", process=0)
+        sink.publish(telemetry={"process": 0})
+        sink.publish(telemetry={"process": 0})
+        queue = SnapshotQueue(str(tmp_path))
+        assert queue.backlog() == 2
+        entries = queue.poll()
+        assert len(entries) == 2
+        assert queue.backlog() == 0 and queue.poll() == []
+        # no tmp litter
+        assert all(not n.startswith(".") for n in os.listdir(tmp_path))
+
+    def test_poll_cap_drains_oldest_first(self, tmp_path):
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        for _ in range(5):
+            sink.publish(telemetry={"process": 0})
+        queue = SnapshotQueue(str(tmp_path))
+        first = queue.poll(max_files=2)
+        assert len(first) == 2 and queue.backlog() == 3
+        # oldest sequence numbers come out first
+        seqs = [json.loads(blob)["seq"] for _, blob in first]
+        assert seqs == [0, 1]
+
+    def test_sink_seq_monotonic_and_restart_offset(self, tmp_path):
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        sink.publish(telemetry={"process": 0})
+        restarted = SnapshotSink(str(tmp_path), publisher="p0", seq_start=100)
+        restarted.publish(telemetry={"process": 0})
+        seqs = sorted(json.loads(b)["seq"] for _, b in SnapshotQueue(str(tmp_path)).poll())
+        assert seqs == [0, 100]
+
+    def test_republish_last_is_byte_identical_dup(self, tmp_path):
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        assert sink.republish_last() is None
+        sink.publish(telemetry={"process": 0})
+        dup = sink.republish_last()
+        assert dup is not None and dup != sink.last_path
+        blobs = [b for _, b in SnapshotQueue(str(tmp_path)).poll()]
+        assert len(blobs) == 2 and blobs[0] == blobs[1]
+
+
+# ---------------------------------------------------------------------------
+# state-mode folding + single-job parity
+# ---------------------------------------------------------------------------
+
+class TestStateModeFold:
+    def test_fold_matches_single_job_bit_identical(self, tmp_path):
+        collector = FleetCollector(str(tmp_path), template=make_collection())
+        single = make_collection()
+        for p in range(3):
+            col = make_collection()
+            sink = SnapshotSink(str(tmp_path), publisher=f"pub{p}", host=f"h{p}", process=p)
+            for preds, target in int_batches(p, 4):
+                col.update(preds, target)
+                single.update(preds, target)
+            sink.publish(states=snapshot_states(col), states_template=col, t=T0)
+        collector.poll(now=T0)
+        folded = collector.fold_states()
+        # collector fold == merge_states fold of the three publisher
+        # states == the states a single job accumulating all events holds
+        # (integer-exact sum/count reducers)
+        expected = snapshot_states(single)
+        assert_states_equal(folded, expected)
+        vals = collector.fold_values()
+        singles = single.compute()
+        for k in singles:
+            assert float(vals[k]) == pytest.approx(float(singles[k]))
+
+    def test_newest_sequence_wins_per_publisher(self, tmp_path):
+        collector = FleetCollector(str(tmp_path), template=make_collection())
+        blobs = publisher_snapshots(0, 5)
+        for blob in blobs:
+            collector.ingest(blob, now=T0)
+        # cumulative: folding all five == decoding only the newest
+        fresh = FleetCollector(template=make_collection())
+        fresh.ingest(blobs[-1], now=T0)
+        assert_states_equal(collector.fold_states(), fresh.fold_states())
+
+    def test_telemetry_fold_matches_merge_payloads(self):
+        rec = get_recorder()
+        rec.reset()
+        rec.enable()
+        try:
+            m = SumMetric()
+            m.update(jnp.asarray([1.0]))
+            payloads = []
+            collector = FleetCollector(template=None)
+            for p in range(3):
+                payload = counter_payload(rec)
+                payload["process"] = p
+                payloads.append(payload)
+                collector.ingest(
+                    encode_snapshot(
+                        publisher=f"pub{p}", seq=0, t=T0, process=p, telemetry=payload
+                    ),
+                    now=T0,
+                )
+            merged = collector.merged_telemetry()
+            # the collector annotates payloads with their publisher id (the
+            # federated page's disambiguating label); strip it to compare
+            # against the single-job merge of the SAME payloads
+            expected = merge_payloads(payloads)
+            for fam in ("call_counts", "sync_totals", "footprint_hwm", "call_times"):
+                assert merged[fam] == expected[fam]
+            assert merged["world_size"] == expected["world_size"]
+        finally:
+            rec.disable()
+            rec.reset()
+
+
+# ---------------------------------------------------------------------------
+# dedup + late window
+# ---------------------------------------------------------------------------
+
+class TestDedupAndLateness:
+    def test_duplicates_folded_exactly_once(self, tmp_path):
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        col = make_collection()
+        col.update(*int_batches(0, 1)[0])
+        sink.publish(states=snapshot_states(col), states_template=col, t=T0)
+        sink.republish_last()
+        sink.republish_last()
+        collector = FleetCollector(str(tmp_path), template=make_collection())
+        collector.poll(now=T0)
+        totals = collector.totals()
+        assert totals["absorbed"] == 1 and totals["duplicates"] == 2
+        assert_states_equal(collector.fold_states(), snapshot_states(col))
+
+    def test_post_watermark_straggler_counted_and_dropped(self):
+        collector = FleetCollector(template=make_collection(), late_window_s=5.0)
+        fresh = publisher_snapshots(0, 1)[0]
+        # a fresh snapshot advances the watermark to T0 - 5
+        collector.ingest(fresh, now=T0)
+        col = make_collection()
+        col.update(*int_batches(1, 1)[0])
+        straggler = encode_snapshot(
+            publisher="pub9", seq=0, t=T0 - 30.0, states=snapshot_states(col), states_template=col
+        )
+        assert not collector.ingest(straggler, now=T0)
+        assert collector.totals()["late_dropped"] == 1
+        # the straggler contributed nothing to the fold
+        ref = FleetCollector(template=make_collection())
+        ref.ingest(fresh, now=T0)
+        assert_states_equal(collector.fold_states(), ref.fold_states())
+
+    def test_in_window_late_arrival_folds(self):
+        collector = FleetCollector(template=make_collection(), late_window_s=60.0)
+        blobs = publisher_snapshots(0, 3)
+        collector.ingest(blobs[2], now=T0)  # newest first
+        collector.ingest(blobs[0], now=T0)  # older, but inside the window
+        assert collector.totals()["absorbed"] == 2
+        assert collector.totals()["late_dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# delta mode
+# ---------------------------------------------------------------------------
+
+class TestDeltaMode:
+    def test_delta_fold_in_seq_order_any_arrival(self):
+        blobs = publisher_snapshots(0, 4, mode="delta")
+        single = make_collection()
+        for preds, target in int_batches(100, 4):
+            single.update(preds, target)
+        results = []
+        # the late window must cover the snapshots' timestamp spread (3s
+        # here): arrival-order independence is only promised for snapshots
+        # the watermark has not passed — a window narrower than the spread
+        # legitimately drops stragglers when newer timestamps arrive first
+        for order in ([0, 1, 2, 3], [3, 1, 0, 2]):
+            collector = FleetCollector(template=make_collection(), late_window_s=10.0)
+            for i in order:
+                collector.ingest(blobs[i], now=T0)
+            # watermark passes every delta once a fresh marker arrives
+            collector.ingest(
+                encode_snapshot(publisher="pub0", seq=99, t=T0 + 100.0), now=T0 + 100.0
+            )
+            collector._advance()
+            results.append(collector.fold_states())
+        assert_states_equal(results[0], results[1])
+        assert_states_equal(results[0], snapshot_states(single))
+
+    def test_flush_pending_folds_in_window_deltas(self):
+        blobs = publisher_snapshots(0, 3, mode="delta")
+        collector = FleetCollector(template=make_collection(), late_window_s=1e9)
+        for blob in blobs:
+            collector.ingest(blob, now=T0)
+        assert collector.fold_states() is None  # all pending, watermark far behind
+        collector.flush_pending()
+        single = make_collection()
+        for preds, target in int_batches(100, 3):
+            single.update(preds, target)
+        assert_states_equal(collector.fold_states(), snapshot_states(single))
+
+    def test_delta_duplicate_of_folded_seq_dropped(self):
+        blobs = publisher_snapshots(0, 2, mode="delta")
+        collector = FleetCollector(template=make_collection(), late_window_s=0.0)
+        for blob in blobs:
+            collector.ingest(blob, now=T0)
+        collector._advance()  # watermark == newest t, folds everything
+        before = collector.fold_states()
+        assert not collector.ingest(blobs[0], now=T0)
+        collector.flush_pending()
+        assert_states_equal(collector.fold_states(), before)
+        # dropped as duplicate OR late — either way folded exactly once
+        totals = collector.totals()
+        assert totals["duplicates"] + totals["late_dropped"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# fold determinism (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestFoldDeterminism:
+    def test_any_arrival_order_bit_identical_state_and_exposition(self):
+        """The acceptance pin: the same multiset — three publishers' worth
+        of snapshots plus one DUPLICATE and one in-window LATE arrival —
+        folded under every arrival permutation yields bit-identical folded
+        leaves and a byte-identical fold-side Prometheus page."""
+        rec = get_recorder()
+        rec.reset()
+        rec.enable()
+        try:
+            m = SumMetric()
+            m.update(jnp.asarray([1.0]))
+            base_payload = counter_payload(rec)
+        finally:
+            rec.disable()
+            rec.reset()
+        blobs = []
+        for p in range(3):
+            payload = dict(base_payload, process=p)
+            blobs.extend(
+                publisher_snapshots(p, 2, telemetry=payload)
+            )
+        # the "late arrival": pub0's seq-0 snapshot re-shipped — identical
+        # (publisher, seq), so wherever it lands in the order it is the
+        # duplicate; the older-t snapshots themselves are the in-window
+        # late arrivals when a permutation delivers newer t first
+        dup = blobs[0]
+        items = blobs + [dup]
+        pages = set()
+        folds = []
+        for order in itertools.islice(itertools.permutations(range(len(items))), 0, 24, 5):
+            collector = FleetCollector(template=make_collection(), late_window_s=1e6)
+            for i in order:
+                collector.ingest(items[i], now=T0 + 10.0)
+            assert collector.totals()["duplicates"] == 1
+            folds.append(collector.fold_states())
+            pages.add(
+                collector.render_prometheus(
+                    include_collector_families=False, include_fold_values=True
+                )
+            )
+        for other in folds[1:]:
+            assert_states_equal(folds[0], other)
+        assert len(pages) == 1  # byte-identical exposition
+
+    def test_fold_matches_aggregate_across_hosts_semantics(self):
+        """Collector telemetry fold == merge_payloads of the same payload
+        list — the single-job ``aggregate_across_hosts`` merge — family by
+        family, rendered byte-identically through render_prometheus."""
+        rec = get_recorder()
+        rec.reset()
+        rec.enable()
+        try:
+            m = SumMetric()
+            m.update(jnp.asarray([2.0]))
+            payloads = []
+            for p in range(3):
+                payload = counter_payload(rec)
+                payload["process"] = p
+                payload["publisher"] = f"pub{p}"  # pre-annotated: identical inputs
+                payloads.append(payload)
+            collector = FleetCollector(template=None)
+            for p, payload in enumerate(payloads):
+                collector.ingest(
+                    encode_snapshot(publisher=f"pub{p}", seq=0, t=T0, process=p, telemetry=payload),
+                    now=T0,
+                )
+            merged = collector.merged_telemetry()
+            expected = merge_payloads(payloads)
+            assert render_prometheus(aggregate=merged) == render_prometheus(aggregate=expected)
+        finally:
+            rec.disable()
+            rec.reset()
+
+
+# ---------------------------------------------------------------------------
+# hierarchy (merge tree)
+# ---------------------------------------------------------------------------
+
+class TestHierarchy:
+    def test_two_tier_fold_equals_flat_fold(self, tmp_path):
+        single = make_collection()
+        child_dirs = [tmp_path / "rack0", tmp_path / "rack1"]
+        parent_dir = tmp_path / "global"
+        children = []
+        for rack, d in enumerate(child_dirs):
+            child = FleetCollector(str(d), template=make_collection())
+            for p in range(2):
+                idx = rack * 2 + p
+                col = make_collection()
+                sink = SnapshotSink(str(d), publisher=f"pub{idx}", process=idx)
+                for preds, target in int_batches(idx, 3):
+                    col.update(preds, target)
+                    single.update(preds, target)
+                sink.publish(states=snapshot_states(col), states_template=col, t=T0)
+            child.poll(now=T0)
+            children.append(child)
+        parent = FleetCollector(str(parent_dir), template=make_collection())
+        for rack, child in enumerate(children):
+            sink = SnapshotSink(str(parent_dir), publisher=f"rack{rack}", tier="rack")
+            assert child.publish_fold(sink, t=T0) is not None
+        parent.poll(now=T0)
+        assert_states_equal(parent.fold_states(), snapshot_states(single))
+        statuses = parent.publishers(now=T0)
+        assert [s.tier for s in statuses] == ["rack", "rack"]
+
+    def test_publish_fold_empty_collector_is_noop(self, tmp_path):
+        collector = FleetCollector(str(tmp_path / "q"), template=make_collection())
+        sink = SnapshotSink(str(tmp_path / "parent"), publisher="rack0")
+        assert collector.publish_fold(sink) is None
+
+
+# ---------------------------------------------------------------------------
+# fold_error boundary
+# ---------------------------------------------------------------------------
+
+class TestFoldErrors:
+    def test_corrupt_file_counted_and_survived(self, tmp_path):
+        (tmp_path / "bad-000000000000.snap").write_bytes(b"garbage")
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        col = make_collection()
+        col.update(*int_batches(0, 1)[0])
+        sink.publish(states=snapshot_states(col), states_template=col, t=T0)
+        collector = FleetCollector(str(tmp_path), template=make_collection())
+        collector.poll(now=T0)
+        assert collector.totals()["fold_errors"] == 1
+        assert collector.totals()["absorbed"] == 1
+        assert collector.fold_error_details
+
+    def test_states_without_template_is_fold_error(self):
+        collector = FleetCollector(template=None)
+        blob = publisher_snapshots(0, 1)[0]
+        assert not collector.ingest(blob, now=T0)
+        assert collector.totals()["fold_errors"] == 1
+
+    def test_layout_skew_is_fold_error(self):
+        collector = FleetCollector(
+            template=MetricCollection({"acc": Accuracy(num_classes=2)})
+        )
+        blob = publisher_snapshots(0, 1)[0]  # acc+mse layout
+        assert not collector.ingest(blob, now=T0)
+        assert collector.totals()["fold_errors"] == 1
+        assert "layout" in collector.fold_error_details[-1]
+
+    def test_future_schema_is_fold_error(self):
+        collector = FleetCollector(template=make_collection())
+        doc = json.loads(publisher_snapshots(0, 1)[0].decode())
+        doc["schema"] = 99
+        assert not collector.ingest(json.dumps(doc).encode(), now=T0)
+        assert collector.totals()["fold_errors"] == 1
+
+    def test_shape_skew_refused_at_ingest(self):
+        """A same-class publisher whose config changes a state's SHAPE
+        (the fold-poisoning hazard) is refused by the structural key
+        before any leaf folds."""
+        from metrics_tpu.classification import ConfusionMatrix
+
+        collector = FleetCollector(
+            template=MetricCollection({"cm": ConfusionMatrix(num_classes=3)})
+        )
+        skew = MetricCollection({"cm": ConfusionMatrix(num_classes=5)})
+        skew.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        blob = encode_snapshot(
+            publisher="pub0", seq=0, t=T0, states=snapshot_states(skew), states_template=skew
+        )
+        assert not collector.ingest(blob, now=T0)
+        assert collector.totals()["fold_errors"] == 1
+        assert collector.fold_states() is None
+
+    def test_poisonous_keyless_contribution_evicted_not_fatal(self):
+        """An absorbed skewed contribution (shipped WITHOUT a states_key,
+        so ingest could not refuse it) must not take the fleet view dark
+        forever: the fold validates each contribution structurally,
+        evicts the mismatching publisher (counted, attributed), and keeps
+        folding everyone else — and the error does not re-count on every
+        subsequent read."""
+        from metrics_tpu.classification import ConfusionMatrix
+
+        collector = FleetCollector(template=MetricCollection({"cm": ConfusionMatrix(num_classes=3)}))
+        good = MetricCollection({"cm": ConfusionMatrix(num_classes=3)})
+        good.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        collector.ingest(
+            encode_snapshot(
+                publisher="good", seq=0, t=T0, states=snapshot_states(good), states_template=good
+            ),
+            now=T0,
+        )
+        skew = MetricCollection({"cm": ConfusionMatrix(num_classes=5)})
+        skew.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        # no states_template => no states_key on the wire => absorbed
+        poisoned = encode_snapshot(
+            publisher="skewed", seq=0, t=T0, states=snapshot_states(skew)
+        )
+        assert collector.ingest(poisoned, now=T0)
+        folded = collector.fold_states()
+        assert folded is not None  # the view stays up
+        assert_states_equal(folded, snapshot_states(good))
+        assert collector.totals()["fold_errors"] == 1
+        assert "skewed" in collector.fold_error_details[-1]
+        # eviction is permanent: a second read neither fails nor re-counts
+        assert collector.fold_states() is not None
+        assert collector.totals()["fold_errors"] == 1
+
+    def test_error_details_ring_is_bounded(self):
+        collector = FleetCollector(template=None)
+        for _ in range(collector.MAX_ERROR_DETAILS + 10):
+            collector.ingest(b"junk", now=T0)
+        assert len(collector.fold_error_details) == collector.MAX_ERROR_DETAILS
+
+
+# ---------------------------------------------------------------------------
+# liveness
+# ---------------------------------------------------------------------------
+
+class TestLiveness:
+    def test_lag_and_staleness_with_injected_clock(self):
+        now = [T0]
+        collector = FleetCollector(
+            template=make_collection(), stale_after_s=5.0, clock=lambda: now[0]
+        )
+        collector.ingest(publisher_snapshots(0, 1)[0], now=T0)
+        status = collector.publishers()[0]
+        # snapshot t is T0 (publisher_snapshots stamps T0+seq)
+        assert not status.stale and status.lag_s == pytest.approx(0.0)
+        now[0] = T0 + 10.0
+        status = collector.publishers()[0]
+        assert status.stale and status.lag_s == pytest.approx(10.0)
+
+    def test_retire_publisher_clears_staleness_until_next_snapshot(self):
+        now = [T0 + 10.0]
+        collector = FleetCollector(
+            template=make_collection(), stale_after_s=5.0, clock=lambda: now[0]
+        )
+        blobs = publisher_snapshots(0, 2)
+        collector.ingest(blobs[0], now=T0)
+        assert collector.publishers()[0].stale
+        assert collector.retire_publisher("pub0")
+        assert not collector.retire_publisher("unknown")
+        status = collector.publishers()[0]
+        assert status.retired and not status.stale
+        # a later snapshot un-retires
+        collector.ingest(blobs[1], now=now[0])
+        assert not collector.publishers()[0].retired
+
+
+# ---------------------------------------------------------------------------
+# recorder / health / Prometheus wiring
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.detach_timeseries()
+        rec.reset()
+
+
+class TestObservabilityWiring:
+    def test_poll_feeds_fleet_series_and_totals(self, tmp_path, recorder):
+        recorder.attach_timeseries(bucket_seconds=1.0, n_buckets=16, sketch_capacity=32)
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        sink.publish(telemetry={"process": 0}, t=T0)
+        sink.republish_last()
+        (tmp_path / "bad-000000000099.snap").write_bytes(b"junk")
+        collector = FleetCollector(str(tmp_path), template=None, recorder=recorder)
+        collector.poll(now=T0)
+        totals = recorder.fleet_totals()
+        assert totals["absorbed"] == 1
+        assert totals["duplicates"] == 1
+        assert totals["fold_errors"] == 1
+        ts = recorder.timeseries
+        assert ts.get(SERIES_COLLECTOR_BACKLOG).count(None) == 1
+        assert ts.get(SERIES_PUBLISHER_LAG).count(None) == 1
+        assert ts.get(SERIES_FOLD_ERRORS).total(None) == 1.0
+
+    def test_fleet_totals_ride_counter_payload_and_prometheus(self, recorder):
+        recorder.record_fleet_poll(
+            absorbed=5, duplicates=1, late_dropped=2, fold_errors=1, backlog=7,
+            max_lag_s=3.5, publishers=3,
+        )
+        payload = counter_payload(recorder)
+        assert payload["fleet_totals"]["absorbed"] == 5
+        assert payload["fleet_totals"]["max_backlog"] == 7
+        merged = merge_payloads([payload, payload])
+        assert merged["fleet_totals"]["absorbed"] == 10  # extensive: summed
+        assert merged["fleet_totals"]["max_backlog"] == 7  # gauge: maxed
+        page = render_prometheus(recorder)
+        assert 'metrics_tpu_fleet_ingest_total{outcome="absorbed"} 5' in page
+        assert 'metrics_tpu_fleet_backlog_snapshots{window="max"} 7' in page
+        # mixed-fleet identity: an old payload without the family merges clean
+        old = {"process": 1}
+        merged = merge_payloads([old, payload])
+        assert merged["fleet_totals"]["absorbed"] == 5
+
+    def test_three_fleet_alarm_classes_fire_and_clear(self):
+        """publisher_stale / snapshot_backlog / fold_error each trip on a
+        synthetic fault window and clear once the window rolls past —
+        driven end-to-end (real subprocesses) by examples/fleet_collector.py
+        in the CI smoke leg."""
+        reg = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=64, sketch_capacity=32)
+        monitor = HealthMonitor(
+            default_rules(
+                window_s=5.0,
+                publisher_lag_limit_s=4.0,
+                backlog_limit=10,
+                fold_errors_per_window=1,
+            ),
+            registry=reg,
+        )
+        fleet_alarms = {"publisher_stale", "snapshot_backlog", "fold_error"}
+        # healthy phase
+        for i in range(3):
+            reg.observe(SERIES_PUBLISHER_LAG, 0.5, t=T0 + i)
+            reg.observe(SERIES_COLLECTOR_BACKLOG, 2, t=T0 + i)
+        snap = monitor.evaluate(now=T0 + 3)
+        assert not {a.name for a in snap.firing} & fleet_alarms
+        # fault phase: a stalled publisher, a pile-up, a corrupt snapshot
+        reg.observe(SERIES_PUBLISHER_LAG, 9.0, t=T0 + 4)
+        reg.observe(SERIES_COLLECTOR_BACKLOG, 40, t=T0 + 4)
+        reg.observe(SERIES_FOLD_ERRORS, 1, t=T0 + 4, kind="counter")
+        snap = monitor.evaluate(now=T0 + 5)
+        assert fleet_alarms <= {a.name for a in snap.firing}
+        assert snap.status == "critical"  # fold_error is critical
+        # recovery: the window rolls past the fault
+        for i in range(6, 12):
+            reg.observe(SERIES_PUBLISHER_LAG, 0.5, t=T0 + i)
+            reg.observe(SERIES_COLLECTOR_BACKLOG, 2, t=T0 + i)
+        snap = monitor.evaluate(now=T0 + 12)
+        assert not {a.name for a in snap.firing} & fleet_alarms
+        assert set(monitor.fired_and_cleared()) >= fleet_alarms
+
+    def test_collector_prometheus_page_families(self, tmp_path, recorder):
+        sink = SnapshotSink(str(tmp_path), publisher="p0", host="hostA")
+        col = make_collection()
+        col.update(*int_batches(0, 1)[0])
+        sink.publish(
+            states=snapshot_states(col), states_template=col,
+            telemetry=counter_payload(recorder), t=T0,
+        )
+        collector = FleetCollector(str(tmp_path), template=make_collection())
+        collector.poll(now=T0)
+        page = collector.render_prometheus(now=T0, include_fold_values=True)
+        assert 'metrics_tpu_fleet_publisher_up{publisher="p0",host="hostA"} 1' in page
+        assert 'metrics_tpu_fleet_snapshots_total{outcome="absorbed"} 1' in page
+        assert 'metrics_tpu_fleet_metric_value{metric="acc"}' in page
+        # the merged-telemetry portion carries host AND publisher labels
+        assert 'publisher="p0"' in page
+
+    def test_periodic_exporter_publishes_heartbeat_snapshots(self, tmp_path, recorder):
+        col = make_collection()
+        col.update(*int_batches(0, 1)[0])
+        sink = SnapshotSink(str(tmp_path / "q"), publisher="svc0")
+        exporter = PeriodicExporter(
+            interval_s=30.0,
+            snapshot_sink=sink,
+            states_fn=lambda: col,
+            recorder=recorder,
+        )
+        exporter.export_once()
+        exporter.export_once()  # idle tick still heartbeats
+        collector = FleetCollector(str(tmp_path / "q"), template=make_collection())
+        collector.poll()
+        totals = collector.totals()
+        assert totals["absorbed"] == 2
+        assert_states_equal(collector.fold_states(), snapshot_states(col))
+        assert collector.fold_telemetry()  # counter payload rode along
+
+    def test_periodic_exporter_dict_states_fn_carries_template_key(self, tmp_path, recorder):
+        """A states_fn returning a bare dict must not bypass collector
+        layout validation: the explicit states_template supplies the
+        structural key on the wire."""
+        from metrics_tpu.observability import decode_snapshot, states_key
+        from metrics_tpu.observability.collector import SnapshotQueue
+
+        col = make_collection()
+        col.update(*int_batches(0, 1)[0])
+        sink = SnapshotSink(str(tmp_path / "q"), publisher="svc0")
+        exporter = PeriodicExporter(
+            interval_s=30.0,
+            snapshot_sink=sink,
+            states_fn=lambda: snapshot_states(col),
+            states_template=col,
+            recorder=recorder,
+        )
+        exporter.export_once()
+        (_, blob), = SnapshotQueue(str(tmp_path / "q")).poll()
+        assert decode_snapshot(blob).states_key == states_key(col)

@@ -372,8 +372,11 @@ class TestExportAndAggregate:
         merged = merge_payloads([local, other])
         assert merged["drift_scores"][f"{SERIES_SCORES}|psi"] == 0.9  # max wins
         page = render_prometheus(aggregate=merged)
-        assert 'metrics_tpu_drift_score{metric="scores",stat="psi",process="0"} 0.2' in page
-        assert 'metrics_tpu_drift_score{metric="scores",stat="psi",process="1"} 0.9' in page
+        # payloads carry snapshot provenance (ISSUE 13), so per-rank
+        # samples label host alongside process
+        host = f',host="{local["host"]}"' if local.get("host") else ""
+        assert f'metrics_tpu_drift_score{{metric="scores",stat="psi",process="0"{host}}} 0.2' in page
+        assert f'metrics_tpu_drift_score{{metric="scores",stat="psi",process="1"{host}}} 0.9' in page
 
     def test_mixed_version_fleet_missing_drift_family_is_identity(self, recorder):
         """ISSUE 12 satellite: a rank on an older build (no drift/windowed
@@ -383,10 +386,12 @@ class TestExportAndAggregate:
 
         recorder.record_drift_score(SERIES_SCORES, "js", 0.11)
         bare = {"process": 7}  # ancient build: no families at all
-        merged = merge_payloads([bare, counter_payload(recorder)])
+        local = counter_payload(recorder)
+        merged = merge_payloads([bare, local])
         assert merged["drift_scores"] == {f"{SERIES_SCORES}|js": 0.11}
         page = render_prometheus(aggregate=merged)
-        assert 'metrics_tpu_drift_score{metric="scores",stat="js",process="0"} 0.11' in page
+        host = f',host="{local["host"]}"' if local.get("host") else ""
+        assert f'metrics_tpu_drift_score{{metric="scores",stat="js",process="0"{host}}} 0.11' in page
 
 
 # ---------------------------------------------------------------------------

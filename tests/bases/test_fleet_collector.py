@@ -1,0 +1,62 @@
+"""End-to-end fleet observatory test (ISSUE 13 acceptance): three REAL
+publisher subprocesses + the merge-tree collector, with fault injection
+that must trip AND clear all three fleet alarm classes while the wire
+hazards (a byte-identical duplicate, a post-watermark straggler) are
+counted and absorbed without corrupting the fold.
+
+Real wall clock (publishers pace themselves and alarm clearing IS time
+passing) plus three jax subprocess startups, so this is deliberately the
+suite's slow-ish fleet test (~25s); every injected fault is deterministic
+(a scheduled stall window, a scheduled polling pause, one corrupt file,
+counted dup/late ships) so the assertions do not race the box."""
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "examples"))
+
+FLEET_ALARM_CLASSES = ("publisher_stale", "snapshot_backlog", "fold_error")
+
+
+def test_fleet_faults_trip_and_clear_every_fleet_alarm_class(tmp_path):
+    import fleet_collector
+
+    report = fleet_collector.run(
+        duration=8.0,
+        inject="all",
+        out_dir=str(tmp_path),
+        n_publishers=3,
+        interval=0.2,
+        poll_interval=0.25,
+        late_window_s=3.0,
+        window_s=4.0,
+        batch_size=32,
+        seed=0,
+        verbose=False,
+    )
+    for cls in FLEET_ALARM_CLASSES:
+        assert cls in report["alarms_fired"], (cls, report["alarms_fired"])
+        assert cls in report["alarms_fired_and_cleared"], (
+            cls,
+            report["alarms_fired_and_cleared"],
+        )
+    totals = report["totals"]
+    # the wire hazards were really exercised — and absorbed exactly once
+    assert totals["duplicates"] > 0
+    assert totals["late_dropped"] > 0
+    assert totals["fold_errors"] == 1  # the one corrupt file, nothing else
+    assert totals["publishers"] == 3
+    assert totals["absorbed"] > 0
+    # every publisher shipped and exited cleanly
+    assert report["publisher_exit_codes"] == [0, 0, 0]
+    assert all(not p["stale"] for p in report["publishers"])
+    # the global fold computed real fleet-wide values
+    assert 0.0 <= report["fleet_values"]["acc"] <= 1.0
+    assert report["final_status"] == "ok"
+    # artifacts materialized
+    assert (tmp_path / "fleet.prom").exists()
+    assert (tmp_path / "report.json").exists()
+    assert (tmp_path / "health_alarms.jsonl").exists()
+    page = (tmp_path / "fleet.prom").read_text()
+    assert "metrics_tpu_fleet_snapshots_total" in page
+    assert 'metrics_tpu_fleet_metric_value{metric="acc"}' in page

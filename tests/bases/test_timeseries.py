@@ -441,6 +441,44 @@ def test_merge_payloads_heterogeneous_families_are_identity():
 
     page = render_prometheus(aggregate=merged)
     assert 'metrics_tpu_calls_total{metric="A",phase="update"} 3' in page
+    # ISSUE 13 satellite: provenance (host/t/seq) merges as identity too —
+    # the `full` payload above predates it entirely, and a provenance-less
+    # rank renders without host/publisher labels rather than raising
+    assert merged.get("fleet_totals", {}).get("absorbed", 0) == 0
+    assert 'host="' not in page
+
+
+def test_counter_payload_carries_snapshot_provenance():
+    """ISSUE 13 satellite: every payload is stamped with hostname, wall
+    clock, and a monotonic per-process sequence number (survives recorder
+    resets) — what fleet collectors key liveness and dedup on."""
+    import socket
+    import time
+
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        before = time.time()
+        p1 = counter_payload(rec)
+        p2 = counter_payload(rec)
+        assert p1["host"] == socket.gethostname()
+        assert before <= p1["t"] <= time.time()
+        assert p2["seq"] == p1["seq"] + 1  # monotonic
+        rec.reset()
+        p3 = counter_payload(rec)
+        assert p3["seq"] > p2["seq"]  # reset does NOT rewind provenance
+        # provenance-stamped payloads render with host (and publisher,
+        # when a collector annotated one) labels on the per-rank families
+        from metrics_tpu.observability.exporters import render_prometheus
+
+        merged = merge_payloads([p1, {**p2, "publisher": "svc0"}])
+        page = render_prometheus(aggregate=merged)
+        assert f'host="{p1["host"]}"' in page
+        assert 'publisher="svc0"' in page
+    finally:
+        rec.disable()
+        rec.reset()
 
 
 # ---------------------------------------------------------------------------

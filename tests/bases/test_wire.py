@@ -1,0 +1,211 @@
+"""Wire-format tests (ISSUE 13 tentpole): dtype-stable bit-exact leaf
+round-trips, the schema-versioned provenance header, the canonical
+states/states-key shapes, telemetry payload normalization, and the
+WireError boundary (bad magic / future schema / corrupt leaves) the
+collector's fold_error accounting relies on."""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.observability.wire import (
+    WIRE_MAGIC,
+    WIRE_SCHEMA_VERSION,
+    WireError,
+    decode_snapshot,
+    encode_snapshot,
+    manifest_fingerprint,
+    snapshot_states,
+    states_key,
+)
+
+
+def _round_trip(states):
+    blob = encode_snapshot(publisher="p", seq=0, t=100.0, states=states)
+    return decode_snapshot(blob).states
+
+
+class TestLeafCodec:
+    @pytest.mark.parametrize(
+        "dtype",
+        [np.int32, np.int64, np.float32, np.float64, np.uint8, np.bool_],
+    )
+    def test_array_round_trip_bit_exact(self, dtype):
+        rng = np.random.RandomState(0)
+        arr = (rng.rand(3, 5) * 100).astype(dtype)
+        out = _round_trip({"m": {"x": arr}})["m"]["x"]
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        assert np.array_equal(out, arr)
+
+    def test_int64_values_survive_json(self):
+        # JSON numbers would round 2**53+1; raw-buffer leaves must not
+        big = np.asarray([2**53 + 1, -(2**62)], np.int64)
+        out = _round_trip({"m": {"x": big}})["m"]["x"]
+        assert np.array_equal(out, big)
+
+    def test_float32_bits_survive(self):
+        vals = np.asarray([0.1, 1e-38, 3.4e38, np.inf, -np.inf], np.float32)
+        out = _round_trip({"m": {"x": vals}})["m"]["x"]
+        assert out.tobytes() == vals.tobytes()
+
+    def test_jax_array_leaves_decode_as_numpy(self):
+        out = _round_trip({"m": {"x": jnp.asarray([1, 2, 3], jnp.int32)}})["m"]["x"]
+        assert isinstance(out, np.ndarray)
+        assert np.array_equal(out, np.asarray([1, 2, 3], np.int32))
+
+    def test_python_scalars_and_list_states(self):
+        states = {"m": {"n": 7, "f": 0.5, "cat": [np.ones((2,), np.float32), np.zeros((3,), np.float32)]}}
+        out = _round_trip(states)["m"]
+        assert out["n"] == 7 and out["f"] == 0.5
+        assert len(out["cat"]) == 2
+        assert np.array_equal(out["cat"][0], np.ones((2,), np.float32))
+        assert np.array_equal(out["cat"][1], np.zeros((3,), np.float32))
+
+    def test_zero_dim_array(self):
+        out = _round_trip({"m": {"x": np.asarray(3.5, np.float32)}})["m"]["x"]
+        assert out.shape == () and float(out) == 3.5
+
+
+class TestHeader:
+    def test_provenance_fields(self):
+        blob = encode_snapshot(
+            publisher="pub0", seq=17, t=123.5, host="h0", process=3, tier="rack"
+        )
+        snap = decode_snapshot(blob)
+        assert snap.publisher == "pub0"
+        assert snap.seq == 17
+        assert snap.t == 123.5
+        assert snap.host == "h0"
+        assert snap.process == 3
+        assert snap.tier == "rack"
+        assert snap.schema == WIRE_SCHEMA_VERSION
+        assert snap.key == ("pub0", 17)
+
+    def test_manifest_hash_rides_the_header(self):
+        fp = manifest_fingerprint()
+        snap = decode_snapshot(encode_snapshot(publisher="p", seq=0, t=1.0))
+        assert snap.manifest_hash == fp
+
+    def test_manifest_fingerprint_stable_and_short(self):
+        fp = manifest_fingerprint()
+        assert fp == manifest_fingerprint()
+        assert fp == "" or (len(fp) == 16 and int(fp, 16) >= 0)
+
+    def test_mode_validated(self):
+        with pytest.raises(ValueError, match="mode"):
+            encode_snapshot(publisher="p", seq=0, mode="increment")
+        with pytest.raises(ValueError, match="publisher"):
+            encode_snapshot(publisher="", seq=0)
+        with pytest.raises(ValueError, match="seq"):
+            encode_snapshot(publisher="p", seq=-1)
+
+    def test_telemetry_normalizes_to_list(self):
+        one = {"process": 0, "call_counts": {}}
+        snap = decode_snapshot(encode_snapshot(publisher="p", seq=0, telemetry=one))
+        assert snap.telemetry == [one]
+        snap = decode_snapshot(encode_snapshot(publisher="p", seq=0, telemetry=[one, one]))
+        assert len(snap.telemetry) == 2
+
+
+class TestWireErrorBoundary:
+    def test_garbage_bytes(self):
+        with pytest.raises(WireError):
+            decode_snapshot(b"not json at all")
+
+    def test_truncated_json(self):
+        blob = encode_snapshot(publisher="p", seq=0)
+        with pytest.raises(WireError):
+            decode_snapshot(blob[: len(blob) // 2])
+
+    def test_foreign_magic(self):
+        with pytest.raises(WireError, match="magic"):
+            decode_snapshot(json.dumps({"magic": "something-else", "schema": 1}).encode())
+
+    def test_future_schema_refused(self):
+        doc = json.loads(encode_snapshot(publisher="p", seq=0).decode())
+        doc["schema"] = WIRE_SCHEMA_VERSION + 1
+        with pytest.raises(WireError, match="newer"):
+            decode_snapshot(json.dumps(doc).encode())
+
+    def test_corrupt_array_leaf(self):
+        doc = json.loads(
+            encode_snapshot(
+                publisher="p", seq=0, states={"m": {"x": np.ones((2,), np.float32)}}
+            ).decode()
+        )
+        doc["states"]["m"]["x"]["__arr__"]["data"] = "!!!not-base64!!!"
+        with pytest.raises(WireError):
+            decode_snapshot(json.dumps(doc).encode())
+
+    def test_incomplete_header(self):
+        with pytest.raises(WireError, match="incomplete"):
+            decode_snapshot(
+                json.dumps({"magic": WIRE_MAGIC, "schema": 1, "publisher": "p"}).encode()
+            )
+
+
+class TestStatesHelpers:
+    def test_snapshot_states_metric(self):
+        m = SumMetric()
+        m.update(jnp.asarray([2.0, 3.0]))
+        states = snapshot_states(m)
+        assert list(states) == ["SumMetric"]
+        assert float(np.asarray(states["SumMetric"]["value"])) == 5.0
+
+    def test_snapshot_states_collection(self):
+        col = MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+        col.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        states = snapshot_states(col)
+        assert set(states) == {"acc", "mse"}
+        key = states_key(col)
+        assert key["acc"]["class"].endswith("Accuracy")
+        assert sorted(key["acc"]["states"]) == sorted(states["acc"])
+
+    def test_states_key_detects_layout_skew(self):
+        # scalar-state config skew is structurally invisible (documented:
+        # the manifest fingerprint + deployment discipline own it) ...
+        a = states_key(MetricCollection({"acc": Accuracy(num_classes=2)}))
+        b = states_key(MetricCollection({"acc": Accuracy(num_classes=3)}))
+        assert a == b
+        # ... but a different metric class, or a config that changes a
+        # state's SHAPE, changes the key — the skew that would otherwise
+        # poison a fold with a broadcast error is refused at ingest
+        c = states_key(MetricCollection({"acc": SumMetric()}))
+        assert a != c
+        from metrics_tpu.classification import ConfusionMatrix
+
+        d2 = states_key(MetricCollection({"cm": ConfusionMatrix(num_classes=2)}))
+        d3 = states_key(MetricCollection({"cm": ConfusionMatrix(num_classes=3)}))
+        assert d2 != d3
+
+    def test_leaf_key_scalar_normalization(self):
+        # the eager counter fast path leaves a Python int where another
+        # publisher holds an int32 array — same key, never layout skew
+        from metrics_tpu.observability.wire import _leaf_key
+
+        assert _leaf_key(7) == _leaf_key(np.asarray(7, np.int32)) == "int"
+        assert _leaf_key(0.5) == _leaf_key(np.asarray(0.5, np.float32)) == "float"
+        assert _leaf_key([]) == "list"
+        assert _leaf_key(np.zeros((3, 2), np.float32)) == "<f4[3, 2]"
+
+    def test_collection_states_round_trip_bit_exact(self):
+        col = MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+        col.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 1, 0]))
+        states = snapshot_states(col)
+        blob = encode_snapshot(
+            publisher="p", seq=0, states=states, states_template=col, telemetry=None
+        )
+        snap = decode_snapshot(blob)
+        for mname, tree in states.items():
+            for sname, leaf in tree.items():
+                got = snap.states[mname][sname]
+                want = np.asarray(leaf)
+                assert np.array_equal(np.asarray(got), want), (mname, sname)
+                assert np.asarray(got).dtype == want.dtype
+        assert snap.states_key == states_key(col)
