@@ -1459,6 +1459,139 @@ def bench_collector() -> None:
     )
 
 
+def bench_ops() -> None:
+    """Ops kernel suite: dispatched-vs-direct throughput per op + parity bits.
+
+    For each registered hot op (bincount, segment_sum, qsketch_compact) at
+    2-3 sizes, times the registry-dispatched path against a direct call of
+    the jnp implementation. On this CPU box both resolve to the same jnp
+    kernel, so the ratio isolates the DISPATCH LAYER's overhead (registry
+    lookup + routing predicate + counter check) — the ``ops_dispatch_overhead``
+    AUX gate pins it near 1.0 so the shared layer can never quietly tax
+    every confusion-matrix update. On TPU the same bench doubles as the
+    kernel-vs-jnp A/B (the dispatched side routes to Pallas above the
+    density floors).
+
+    The parity BOOLs run the REAL Pallas kernel bodies in interpret mode
+    on integer-exact data, where the f32 MXU accumulation is exact: a
+    false bit means a kernel diverged from its fallback — data corruption
+    regardless of speed — and fails CI via BOOL_FIELDS even without a
+    baseline anchor.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import ops
+    from metrics_tpu.ops.qsketch_pallas import _qsketch_compact_pallas
+    from metrics_tpu.sketches.quantile import _compact_rows_jnp
+
+    rng = np.random.RandomState(14)
+
+    def best_of(fn, *args, reps=5, inner=4):
+        fn(*args)  # warm caches / jit
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / inner)
+        return best
+
+    per_op = {}
+
+    # --- bincount: the confusion-matrix inner loop shape -------------------
+    bincount_elems_per_sec = 0.0
+    for n, c in ((1 << 16, 10_000), (1 << 20, 1_000_000)):
+        x = jnp.asarray(rng.randint(0, c, n), jnp.int32)
+        t_disp = best_of(lambda a: ops.bincount_dispatch(a, c), x)
+        t_jnp = best_of(lambda a: jnp.bincount(a, length=c), x)
+        per_op[f"bincount_{n}x{c}"] = {
+            "dispatched_elems_per_sec": round(n / t_disp, 1),
+            "jnp_elems_per_sec": round(n / t_jnp, 1),
+            "overhead_ratio": round(t_disp / t_jnp, 4),
+        }
+        bincount_elems_per_sec = n / t_disp
+
+    # --- segment_sum: the sliced-scatter shape -----------------------------
+    for b, d, s in ((1 << 16, 8, 1_000), (1 << 18, 8, 100_000)):
+        vals = jnp.asarray(rng.randint(0, 7, (b, d)).astype(np.float32))
+        ids = jnp.asarray(rng.randint(0, s, b), jnp.int32)
+        t_disp = best_of(lambda v, i: ops.segment_sum_dispatch(v, i, s), vals, ids)
+        t_jnp = best_of(lambda v, i: jax.ops.segment_sum(v, i, num_segments=s), vals, ids)
+        per_op[f"segment_sum_{b}x{d}_s{s}"] = {
+            "dispatched_rows_per_sec": round(b / t_disp, 1),
+            "jnp_rows_per_sec": round(b / t_jnp, 1),
+            "overhead_ratio": round(t_disp / t_jnp, 4),
+        }
+
+    # --- qsketch_compact: the sketched-metric overflow pass ----------------
+    for cap in (1024, 8192):
+        n = cap * 2
+        rows = np.zeros((n, 3), np.float32)
+        rows[:, 0] = 1.0
+        rows[:, 1] = rng.randint(0, 100_000, n)
+        rows[:, 2] = rng.randint(0, 2, n)
+        rows = jnp.asarray(rows)
+        t_disp = best_of(lambda r: ops.qsketch_compact_dispatch(r, cap), rows, reps=3, inner=2)
+        t_jnp = best_of(lambda r: _compact_rows_jnp(r, cap), rows, reps=3, inner=2)
+        per_op[f"qsketch_compact_{n}_cap{cap}"] = {
+            "dispatched_rows_per_sec": round(n / t_disp, 1),
+            "jnp_rows_per_sec": round(n / t_jnp, 1),
+            "overhead_ratio": round(t_disp / t_jnp, 4),
+        }
+
+    # the gated overhead headline: the WORST dispatched/direct ratio across
+    # ops and sizes (lower is better; ~1.0 when routing resolves to jnp)
+    overhead = max(v["overhead_ratio"] for v in per_op.values())
+
+    # --- parity bits: real kernel bodies, interpret mode, integer data ----
+    xp = jnp.asarray(rng.randint(0, 500, 4096), jnp.int32)
+    with ops.forced_backend("interpret"):
+        bc_parity = bool(jnp.array_equal(ops.bincount_dispatch(xp, 500), jnp.bincount(xp, length=500)))
+    sv = jnp.asarray(rng.randint(-9, 9, (2048, 4)).astype(np.float32))
+    si = jnp.asarray(rng.randint(0, 300, 2048), jnp.int32)
+    with ops.forced_backend("interpret"):
+        ss_parity = bool(
+            jnp.array_equal(
+                ops.segment_sum_dispatch(sv, si, 300),
+                jax.ops.segment_sum(sv, si, num_segments=300),
+            )
+        )
+    prows = np.zeros((512, 3), np.float32)
+    prows[:, 0] = rng.randint(1, 4, 512)
+    prows[:, 1] = rng.randint(-500, 500, 512)
+    prows[:, 2] = rng.randint(0, 3, 512)
+    prows = jnp.asarray(prows)
+    qc_parity = bool(
+        jnp.array_equal(_qsketch_compact_pallas(prows, 256, interpret=True), _compact_rows_jnp(prows, 256))
+    )
+
+    # compiled-cost bill for the headline dispatched op (--cost-analysis)
+    c = 1_000_000
+    xbill = jnp.asarray(rng.randint(0, c, 1 << 20), jnp.int32)
+    cost = _compiled_cost_payload(jax.jit(lambda a: ops.bincount_dispatch(a, c)), xbill)
+
+    print(
+        json.dumps(
+            _with_cost(
+                {
+                    "metric": "ops_kernel_dispatch_throughput",
+                    "value": round(bincount_elems_per_sec, 1),
+                    "unit": "elems/sec",
+                    "backend": jax.default_backend(),
+                    "ops_dispatch_overhead": round(overhead, 4),
+                    "ops_bincount_parity": bc_parity,
+                    "ops_segment_sum_parity": ss_parity,
+                    "ops_qsketch_compact_parity": qc_parity,
+                    "per_op": per_op,
+                },
+                cost,
+            )
+        )
+    )
+
+
 def bench_telemetry() -> None:
     """Micro-bench for the telemetry zero-overhead-when-disabled contract:
     per-call wall cost of ``Metric.update`` with the recorder disabled vs
@@ -1572,6 +1705,7 @@ SUBCOMMANDS = {
     "sketch": bench_sketch,
     "windowed": bench_windowed,
     "collector": bench_collector,
+    "ops": bench_ops,
 }
 
 
@@ -1654,7 +1788,7 @@ def main() -> None:
     import subprocess
 
     records = []  # every emitted JSON object, for the --baseline check
-    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry", "ops"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],

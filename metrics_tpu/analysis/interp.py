@@ -140,6 +140,24 @@ _AT_UPDATE_METHODS = {
     "set", "add", "subtract", "multiply", "divide", "power", "max", "min", "get", "apply",
 }
 
+#: registry-dispatched array ops (metrics_tpu/ops/): the routing decision
+#: is host-static — backend identity, the METRICS_TPU_NO_PALLAS env hatch,
+#: and shape/dtype route predicates, all resolved in host Python at trace
+#: time — and every backend lowers a pure fixed-shape array program, so a
+#: dispatched call is modeled exactly like a jnp op: traced result, no
+#: descent (the value validation inside the boundary is `_is_concrete`-
+#: guarded, the same exemption pattern that function gets). Descending
+#: instead would misread the host-side routing `if`s as trace-value
+#: concretization and flip every bincount/scatter consumer to unsafe.
+_DISPATCHED_OPS = {
+    "bincount_dispatch",
+    "segment_sum_dispatch",
+    "segment_max_dispatch",
+    "segment_min_dispatch",
+    "qsketch_compact_dispatch",
+    "box_iou_dispatch",
+}
+
 #: builtins whose results are host/static values (superset of the rule-side
 #: set: pure readers plus shape-free constructors)
 _SAFE_HOST_BUILTINS = {
@@ -971,6 +989,8 @@ class _Scanner:
             name = func.id
             if name == "_is_concrete":
                 return _Value(tainted=False, noneness=_NOT_NONE)
+            if name in _DISPATCHED_OPS:
+                return _Value(tainted=True, noneness=_NOT_NONE)
             if name in _CAST_BUILTINS:
                 if any_taint:
                     self._emit(
@@ -1050,6 +1070,8 @@ class _Scanner:
                         )
                     return _Value(tainted=False, noneness=_NOT_NONE)
             # self.<method>(...) — resolve within the class chain if bound
+            # (resolved BEFORE the dispatched-ops name check: a class's own
+            # method shadowing one of those names must still be descended)
             if (
                 isinstance(func.value, ast.Name)
                 and func.value.id == "self"
@@ -1068,6 +1090,14 @@ class _Scanner:
                         node,
                     )
                 return _Value(tainted=False, noneness=_MAYBE)
+            # module-attribute form of the dispatched ops (ops.bincount_dispatch);
+            # after self-method resolution so a class's own same-named method
+            # is still descended; the names are distinctive (`*_dispatch`)
+            if member in _DISPATCHED_OPS and not (
+                isinstance(func.value, ast.Name) and func.value.id == "self"
+            ):
+                self._eval(func.value, env, conditional)
+                return _Value(tainted=True, noneness=_NOT_NONE)
             # `x.at[idx].set/add/...` — jax's pure functional scatter-update
             # namespace: a traced array op whatever the receiver's taint
             if (

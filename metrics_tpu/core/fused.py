@@ -530,7 +530,15 @@ class FusedUpdate:
             (name, k, tuple(v.shape), str(v.dtype)) for name in names for k, v in states[name].items()
         )
         static_sig = tuple((i, repr(v)) for i, v in static)
-        key = (tuple(names), treedef, sig, static_sig, state_sig, bucket)
+        # the ops-dispatch routing state (backend, METRICS_TPU_NO_PALLAS,
+        # forced interpret/jnp test mode) is resolved at TRACE time by the
+        # kernels this update traces through (_bincount, the sliced scatter,
+        # sketch compaction); folding it into the cache key keeps the
+        # documented runtime kill switch honest — a flipped env var must
+        # recompile, not keep executing the suspect kernel from a stale trace
+        from metrics_tpu.ops.dispatch import dispatch_mode
+
+        key = (tuple(names), treedef, sig, static_sig, state_sig, bucket, dispatch_mode())
 
         entry = self._cache.get(key)
         cache_hit = entry is not None

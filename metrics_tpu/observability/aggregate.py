@@ -75,6 +75,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "sketch_totals": dict(rec.sketch_totals()),
         "drift_scores": dict(rec.drift_scores()),
         "fleet_totals": dict(rec.fleet_totals()),
+        "ops_dispatch_totals": dict(rec.ops_dispatch_totals()),
         "export_errors": rec.export_errors(),
         # windowed time series ride the same payload path: per-bucket
         # sketches serialize JSON-safe and merge by qsketch_merge, so a
@@ -141,6 +142,12 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         # nothing, like every other family
         "drift_scores": _merge_max([p.get("drift_scores", {}) for p in payloads]),
         "fleet_totals": _merge_fleet([p.get("fleet_totals", {}) for p in payloads]),
+        # dispatch counts are extensive; the per-backend split surviving the
+        # merge is the point — a fleet where one host's TPU traffic all
+        # lands on the jnp fallback is exactly what this view must show
+        "ops_dispatch_totals": _merge_sum(
+            [p.get("ops_dispatch_totals", {}) for p in payloads]
+        ),
         "export_errors": sum(p.get("export_errors", 0) for p in payloads),
         "timeseries": _merge_timeseries([p.get("timeseries", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),

@@ -397,6 +397,15 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             f"metrics_tpu_sketch_fill_ratio{_labels(window='max', **proc_label(payload))}"
             f" {totals.get('max_fill_ratio', 0.0)}"
         )
+    lines.append("# HELP metrics_tpu_ops_dispatch_total Kernel-registry dispatches by op and chosen backend (pallas|jnp|interpret; jitted traffic counts per compilation).")
+    lines.append("# TYPE metrics_tpu_ops_dispatch_total counter")
+    for payload in per_proc:
+        for key, n in sorted(payload.get("ops_dispatch_totals", {}).items()):
+            op, _, backend = key.partition("|")
+            lines.append(
+                f"metrics_tpu_ops_dispatch_total"
+                f"{_labels(op=op, backend=backend, **proc_label(payload))} {n}"
+            )
     lines.append("# HELP metrics_tpu_drift_score Last reference-vs-live drift score per watched source and statistic.")
     lines.append("# TYPE metrics_tpu_drift_score gauge")
     for payload in per_proc:

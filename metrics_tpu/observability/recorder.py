@@ -323,6 +323,10 @@ class MetricRecorder:
         #: health layer's DriftRule evaluations — see record_drift_score)
         self._drift: Dict[str, float] = {}
         self._fleet = _new_fleet_totals()
+        #: "op|backend" -> dispatches through the ops kernel registry
+        #: (ops/dispatch.py) — which backends actually ran kernels vs
+        #: fallbacks; see record_ops_dispatch
+        self._ops_dispatch: Dict[str, int] = {}
         self._export_errors = 0
         #: monotonic provenance sequence for exported counter payloads —
         #: see ``next_snapshot_seq`` / ``aggregate.counter_payload``
@@ -414,6 +418,7 @@ class MetricRecorder:
             self._sketch = _new_sketch_totals()
             self._drift = {}
             self._fleet = _new_fleet_totals()
+            self._ops_dispatch = {}
             self._export_errors = 0
             # the snapshot sequence survives reset ON PURPOSE: provenance
             # must stay monotonic for the publisher's whole lifetime, or a
@@ -522,6 +527,14 @@ class MetricRecorder:
         ``record_fleet_poll``."""
         with self._lock:
             return dict(self._fleet)
+
+    def ops_dispatch_totals(self) -> Dict[str, int]:
+        """Kernel-registry dispatches per ``"op|backend"`` key (backend in
+        ``pallas | jnp | interpret``) — the raw data behind the Prometheus
+        family ``metrics_tpu_ops_dispatch_total{op,backend}``. Extensive:
+        summed across hosts by ``aggregate_across_hosts``."""
+        with self._lock:
+            return dict(self._ops_dispatch)
 
     def next_snapshot_seq(self) -> int:
         """The next monotonic provenance sequence number for an exported
@@ -982,6 +995,21 @@ class MetricRecorder:
             self._observe(SERIES_SLICED_ROWS, int(n_rows))
             if hot_rows is not None and n_rows:
                 self._observe(SERIES_HOT_SLICE_SHARE, int(hot_rows) / int(n_rows))
+
+    def record_ops_dispatch(self, op: str, backend: str) -> None:
+        """Count one kernel-registry dispatch (``ops/dispatch.py``).
+
+        Counter-only — no event append: a dispatched op can run inside
+        every eager metric update (``_bincount`` under every
+        confusion-matrix metric), and the per-call interest is which
+        BACKEND served it, not each occurrence. Under jit the dispatch
+        decision happens at trace time, so jitted traffic counts once per
+        compilation — the same convention as the in-jit sliced-scatter
+        accounting.
+        """
+        key = f"{op}|{backend}"
+        with self._lock:
+            self._ops_dispatch[key] = self._ops_dispatch.get(key, 0) + 1
 
     def record_async_event(
         self,

@@ -1,6 +1,38 @@
 """Pallas TPU kernels for hot ops (SURVEY §2.9 native-equivalents plan).
 
-Kernels dispatch through shape/backend heuristics with jnp fallbacks, so
-every entry point works on CPU (interpret mode in tests) and TPU alike.
+Every op routes through the shared dispatch registry
+(:mod:`metrics_tpu.ops.dispatch`): a Pallas kernel where the route
+predicate predicts a TPU win, a jnp fallback everywhere else (CPU CI,
+exotic dtypes, the ``METRICS_TPU_NO_PALLAS`` kill switch), and interpret
+mode for CPU parity tests. Dispatches are counted per ``(op, backend)``
+on the telemetry recorder (``metrics_tpu_ops_dispatch_total``).
+
+Registered ops: ``box_iou`` (tiled pairwise/batched IoU), ``bincount`` /
+``segment_sum`` (the tiled one-hot MXU scatter serving confusion-matrix
+metrics and the ``SlicedMetric`` slice axis; ``segment_max`` /
+``segment_min`` are jnp-only slots), and ``qsketch_compact`` (the fused
+sort->bucket->segment-merge t-digest compaction). See docs/ops_kernels.md.
 """
+from metrics_tpu.ops.dispatch import (  # noqa: F401
+    NO_PALLAS_ENV,
+    KernelSpec,
+    dispatch,
+    dispatch_mode,
+    forced_backend,
+    get_kernel,
+    kernel_names,
+    pallas_disabled,
+    register_kernel,
+)
+from metrics_tpu.ops.scatter_pallas import (  # noqa: F401
+    bincount_dispatch,
+    segment_max_dispatch,
+    segment_min_dispatch,
+    segment_sum_dispatch,
+    segment_sum_tiled,
+)
+from metrics_tpu.ops.qsketch_pallas import (  # noqa: F401
+    qsketch_compact_dispatch,
+    qsketch_sort_bucket_tiled,
+)
 from metrics_tpu.ops.box_iou_pallas import box_iou_dispatch, box_iou_tiled  # noqa: F401

@@ -20,6 +20,8 @@ import numpy as np
 
 from jax.experimental import pallas as pl
 
+from metrics_tpu.ops.dispatch import dispatch, register_kernel
+
 try:  # TPU-specific memory spaces; absent on CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 
@@ -132,8 +134,8 @@ def box_iou_batched_tiled(boxes1: ArrayLike, boxes2: ArrayLike, interpret: bool 
     return iou[:, :d, :g]
 
 
-def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 << 20) -> Array:
-    """Pick the Pallas tile kernel on TPU for large problems, else jnp.
+def _box_iou_route(boxes1: Array, boxes2: Array, min_elems: int = 1 << 20) -> bool:
+    """Route predicate for the ``"box_iou"`` registry entry.
 
     Measured on-chip (see BASELINE.md "Pallas box-IoU A/B"): for the 2-D
     [N, 4] x [M, 4] case the tile kernel is bit-exact vs the jnp broadcast
@@ -141,46 +143,70 @@ def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 <<
     one kernel, so there are no HBM intermediates to save at these sizes).
     For the BATCHED [U, D, 4] x [U, G, 4] case — the detection matching
     kernel's shape — the unit-grid Pallas kernel avoids the [U, D, G, 4]
-    broadcast intermediates; the dispatch routes to it above ``min_elems``
-    output elements, where the measured win holds.
+    broadcast intermediates; the route accepts it above ``min_elems``
+    output elements, where the measured win holds. The Pallas kernels
+    compute in float32; under x64 a float64 result would silently lose
+    precision vs the jnp fallback, so f64 problems always take the
+    fallback — values AND dtype are dispatch-invariant.
     """
-    from metrics_tpu.functional.detection.box_ops import box_iou as _jnp_box_iou
+    out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype, jnp.float32)
+    if jnp.issubdtype(out_dtype, jnp.floating) and out_dtype == jnp.float64:
+        return False
+    if boxes1.ndim == 2 and boxes2.ndim == 2:
+        return boxes1.shape[0] * boxes2.shape[0] >= min_elems
+    if boxes1.ndim == 3 and boxes2.ndim == 3:
+        return (
+            boxes1.shape[0] == boxes2.shape[0]
+            and boxes1.shape[0] * boxes1.shape[1] * boxes2.shape[1] >= min_elems
+            # the unit tile pads G to 128 lanes and D to 8 sublanes; the
+            # measured on-chip win (BASELINE.md) holds when the lane padding
+            # waste is <= 4x (G >= 32): 1.13x at [4096, 128, 32], 1.54x at
+            # [1024, 128, 128], but 0.48x at [16384, 64, 16] where 8x lane
+            # waste dominates
+            and boxes2.shape[1] >= 32
+            and boxes1.shape[1] >= 8
+        )
+    return False
 
-    boxes1 = jnp.asarray(boxes1)
-    boxes2 = jnp.asarray(boxes2)
-    on_tpu = jax.default_backend() == "tpu"
+
+def _box_iou_pallas(
+    boxes1: Array, boxes2: Array, min_elems: int = 1 << 20, interpret: bool = False
+) -> Array:
     # IoU is a ratio: both paths produce floating point. Match the jnp
     # fallback's promotion (true division promotes ints to float) so the
-    # dispatch threshold never changes dtype or values.
+    # dispatch never changes dtype or values.
     out_dtype = jnp.result_type(boxes1.dtype, boxes2.dtype, jnp.float32)
     if not jnp.issubdtype(out_dtype, jnp.floating):
         out_dtype = jnp.float32
-    # the Pallas kernels compute in float32; under x64 a float64 result would
-    # silently lose precision vs the jnp fallback, so f64 problems (both the
-    # 2-D and batched shapes) always take the fallback — values AND dtype are
-    # dispatch-invariant
-    pallas_ok = out_dtype != jnp.float64
-    if (
-        on_tpu
-        and pallas_ok
-        and boxes1.ndim == 2
-        and boxes2.ndim == 2
-        and boxes1.shape[0] * boxes2.shape[0] >= min_elems
-    ):
-        return box_iou_tiled(boxes1, boxes2).astype(out_dtype)
-    if (
-        on_tpu
-        and pallas_ok
-        and boxes1.ndim == 3
-        and boxes2.ndim == 3
-        and boxes1.shape[0] == boxes2.shape[0]
-        and boxes1.shape[0] * boxes1.shape[1] * boxes2.shape[1] >= min_elems
-        # the unit tile pads G to 128 lanes and D to 8 sublanes; the measured
-        # on-chip win (BASELINE.md) holds when the lane padding waste is
-        # <= 4x (G >= 32): 1.13x at [4096, 128, 32], 1.54x at [1024, 128,
-        # 128], but 0.48x at [16384, 64, 16] where 8x lane waste dominates
-        and boxes2.shape[1] >= 32
-        and boxes1.shape[1] >= 8
-    ):
-        return box_iou_batched_tiled(boxes1, boxes2).astype(out_dtype)
+    # a forced-interpret dispatch bypasses the route predicate; shapes the
+    # kernels cannot take (mixed ndim, mismatched batch, f64 precision)
+    # still belong to the fallback
+    if out_dtype == jnp.float64:
+        return _box_iou_jnp(boxes1, boxes2, min_elems)
+    if boxes1.ndim == 2 and boxes2.ndim == 2:
+        return box_iou_tiled(boxes1, boxes2, interpret=interpret).astype(out_dtype)
+    if boxes1.ndim == 3 and boxes2.ndim == 3 and boxes1.shape[0] == boxes2.shape[0]:
+        return box_iou_batched_tiled(boxes1, boxes2, interpret=interpret).astype(out_dtype)
+    return _box_iou_jnp(boxes1, boxes2, min_elems)
+
+
+def _box_iou_jnp(boxes1: Array, boxes2: Array, min_elems: int = 1 << 20) -> Array:
+    from metrics_tpu.functional.detection.box_ops import box_iou as _jnp_box_iou
+
     return _jnp_box_iou(boxes1, boxes2)
+
+
+register_kernel(
+    "box_iou",
+    pallas_fn=_box_iou_pallas,
+    jnp_fn=_box_iou_jnp,
+    route=_box_iou_route,
+)
+
+
+def box_iou_dispatch(boxes1: ArrayLike, boxes2: ArrayLike, min_elems: int = 1 << 20) -> Array:
+    """Pairwise box IoU through the ops kernel registry: the Pallas tile
+    kernels on TPU where :func:`_box_iou_route` predicts a win, the jnp
+    broadcast everywhere else (and always under ``METRICS_TPU_NO_PALLAS``).
+    Values and dtype are dispatch-invariant."""
+    return dispatch("box_iou", jnp.asarray(boxes1), jnp.asarray(boxes2), min_elems)

@@ -1,0 +1,206 @@
+"""Shared kernel dispatch layer for the ``ops/`` Pallas suite.
+
+``box_iou_pallas.py`` proved the pattern — a host wrapper that routes
+between a Pallas TPU kernel and a jnp fallback on backend/shape/dtype
+heuristics — but kept it private. This module extracts the pattern into a
+registry every hot op shares, so the routing policy, the escape hatches,
+and the observability are written once:
+
+* **Registry** — :func:`register_kernel` binds an op name to a Pallas
+  implementation, a jnp fallback, and a ``route`` predicate (the
+  shape/dtype heuristic deciding whether the Pallas path wins). jnp-only
+  ops register with ``pallas_fn=None`` and always take the fallback —
+  they still exist in the registry so their dispatch traffic is counted
+  and a kernel can be slotted in later without touching callers.
+* **Routing** — :func:`dispatch` picks the backend per call: the Pallas
+  kernel runs only on a real TPU backend, when the op's ``route``
+  predicate accepts the arguments, and when the escape hatch is off.
+  Everything else takes the jnp fallback, so CPU-only CI and exotic
+  dtypes are always correct.
+* **Escape hatch** — setting the environment variable
+  ``METRICS_TPU_NO_PALLAS`` (to any non-empty value) forces every op to
+  its jnp fallback, beating both the route predicate and a forced mode.
+  This is the production kill switch for a suspect kernel: no redeploy,
+  values stay dispatch-invariant by the parity contract.
+* **Interpret parity mode** — :func:`forced_backend` is the test-side
+  lever: ``with forced_backend("interpret")`` routes every dispatch
+  through the REAL Pallas kernel bodies in interpreter mode on CPU, which
+  is how the ``tests/ops/`` parity suite pins kernel-vs-fallback
+  agreement without TPU hardware.
+* **Observability** — every dispatch bumps a ``(op, backend)`` counter on
+  the default telemetry recorder (one ``enabled`` bool check when
+  telemetry is off), exported as the Prometheus family
+  ``metrics_tpu_ops_dispatch_total{op,backend}`` and summed across hosts
+  by ``aggregate_across_hosts`` — the fleet view of which backends
+  actually ran kernels vs fallbacks.
+
+Dispatch decisions are made in host Python at trace time (backend, env,
+and shapes are all static under ``jit``), so a dispatched op inside a
+fused/jitted update costs nothing at execution time. Jitted callers that
+cache traces (e.g. the sketch ``_absorb`` kernel) must key their cache on
+:func:`dispatch_mode` so a forced interpret test or a flipped env var
+cannot be shadowed by a stale trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "NO_PALLAS_ENV",
+    "KernelSpec",
+    "register_kernel",
+    "get_kernel",
+    "kernel_names",
+    "pallas_disabled",
+    "forced_backend",
+    "dispatch_mode",
+    "dispatch",
+]
+
+#: environment escape hatch: any non-empty value forces every registered
+#: op to its jnp fallback (kill switch for a suspect kernel)
+NO_PALLAS_ENV = "METRICS_TPU_NO_PALLAS"
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered op: a Pallas kernel, its jnp fallback, and the
+    routing predicate that decides (from the call's arguments) whether
+    the Pallas path is expected to win on TPU.
+
+    ``pallas_fn`` receives the call's arguments plus an ``interpret``
+    keyword; ``jnp_fn`` receives the arguments verbatim. ``route`` must be
+    a cheap, host-side shape/dtype predicate — it runs on every dispatch.
+    """
+
+    name: str
+    pallas_fn: Optional[Callable[..., Any]]
+    jnp_fn: Callable[..., Any]
+    route: Callable[..., bool]
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+# test-side forced mode ("interpret" | "jnp" | None); thread-local so a
+# parity test forcing interpret cannot leak into a concurrent async worker
+_FORCED = threading.local()
+
+
+def register_kernel(
+    name: str,
+    *,
+    pallas_fn: Optional[Callable[..., Any]],
+    jnp_fn: Callable[..., Any],
+    route: Optional[Callable[..., bool]] = None,
+) -> KernelSpec:
+    """Register (or replace) an op in the dispatch registry."""
+    if not callable(jnp_fn):
+        raise TypeError(f"kernel {name!r}: jnp_fn must be callable (the always-correct fallback)")
+    if route is None:
+        route = (lambda *a, **k: True) if pallas_fn is not None else (lambda *a, **k: False)
+    spec = KernelSpec(name=name, pallas_fn=pallas_fn, jnp_fn=jnp_fn, route=route)
+    with _REGISTRY_LOCK:
+        _REGISTRY[name] = spec
+    return spec
+
+
+def get_kernel(name: str) -> KernelSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no kernel {name!r} in the ops dispatch registry; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def kernel_names() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def pallas_disabled() -> bool:
+    """True when the ``METRICS_TPU_NO_PALLAS`` kill switch is set."""
+    return bool(os.environ.get(NO_PALLAS_ENV))
+
+
+@contextlib.contextmanager
+def forced_backend(mode: Optional[str]) -> Iterator[None]:
+    """Force every dispatch in this thread to ``"interpret"`` (the real
+    Pallas kernel bodies under the interpreter — the CPU parity mode) or
+    ``"jnp"`` (the fallback) until the context exits. ``None`` restores
+    normal routing. The ``METRICS_TPU_NO_PALLAS`` hatch still wins over
+    a forced ``"interpret"`` — the kill switch must be absolute."""
+    if mode not in (None, "interpret", "jnp"):
+        raise ValueError(f"forced_backend mode must be 'interpret', 'jnp', or None, got {mode!r}")
+    prev = getattr(_FORCED, "mode", None)
+    _FORCED.mode = mode
+    try:
+        yield
+    finally:
+        _FORCED.mode = prev
+
+
+def dispatch_mode() -> Tuple[Optional[str], bool, str]:
+    """The (forced_mode, hatch_set, default_backend) triple a jitted
+    caller must fold into its trace-cache key: any component changing can
+    change which backend :func:`dispatch` picks inside the trace."""
+    return (getattr(_FORCED, "mode", None), pallas_disabled(), jax.default_backend())
+
+
+_RECORDER: Any = None
+
+
+def _recorder() -> Any:
+    """The default telemetry recorder, imported lazily: ``utils/data.py``
+    (imported by nearly everything) calls into this module, so a module-
+    level recorder import would cycle through ``observability``."""
+    global _RECORDER
+    if _RECORDER is None:
+        from metrics_tpu.observability.recorder import _DEFAULT_RECORDER
+
+        _RECORDER = _DEFAULT_RECORDER
+    return _RECORDER
+
+
+def _count(op: str, backend: str) -> None:
+    rec = _recorder()
+    if rec.enabled:
+        rec.record_ops_dispatch(op, backend)
+
+
+def choose_backend(spec: KernelSpec, *args: Any, **kwargs: Any) -> str:
+    """The routing decision alone (``"pallas" | "interpret" | "jnp"``),
+    without running anything — what :func:`dispatch` executes and what the
+    routing tests assert on."""
+    if pallas_disabled():
+        return "jnp"
+    forced = getattr(_FORCED, "mode", None)
+    if forced == "jnp":
+        return "jnp"
+    if forced == "interpret":
+        return "interpret" if spec.pallas_fn is not None else "jnp"
+    if (
+        spec.pallas_fn is not None
+        and jax.default_backend() == "tpu"
+        and spec.route(*args, **kwargs)
+    ):
+        return "pallas"
+    return "jnp"
+
+
+def dispatch(name: str, *args: Any, **kwargs: Any) -> Any:
+    """Run op ``name`` on the routed backend and count the dispatch."""
+    spec = get_kernel(name)
+    backend = choose_backend(spec, *args, **kwargs)
+    _count(name, backend)
+    if backend == "pallas":
+        return spec.pallas_fn(*args, **kwargs)
+    if backend == "interpret":
+        return spec.pallas_fn(*args, interpret=True, **kwargs)
+    return spec.jnp_fn(*args, **kwargs)

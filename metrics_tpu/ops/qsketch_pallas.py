@@ -1,0 +1,227 @@
+"""Pallas TPU kernel: fused quantile-sketch compaction (sort -> bucket).
+
+Every sketched metric (AUROC, CalibrationError, Spearman, ...) past its
+lossless window pays the merging-t-digest compaction in
+``sketches/quantile.py::_compact_rows`` on overflow: a stable lexsort by
+key, a weight prefix-sum to mid-quantile positions, the tail-adaptive
+``k1(q) = (capacity / 2pi) * asin(2q - 1)`` bucket map, and a segment-sum
+weighted-centroid merge. XLA lowers the sort generically (multi-pass HBM
+round-trips) and cannot fuse it with the bucket arithmetic; this kernel
+keeps the whole chain resident in VMEM:
+
+* **Sort** — a bitonic compare-exchange network over the padded
+  power-of-two row count, expressed as pure reshape + ``where`` stages
+  (no gathers). Each element carries its original index as a tiebreak, so
+  the network's output permutation is EXACTLY the fallback's stable
+  ``lexsort((arange, key))`` — bitonic networks are not stable, but with
+  the index tiebreak every composite key is distinct and the sorted order
+  is unique.
+* **Prefix sum** — the sorted weights' inclusive cumsum by log-step
+  shift-adds (Hillis-Steele), still on-chip.
+* **Bucket map** — mid-quantile positions through the k1 scale to integer
+  bucket ids, plus the weighted rows ``[w, w*key, w*payload]`` the
+  centroid merge consumes.
+
+The segment-sum centroid merge itself reuses the SAME tiled one-hot MXU
+kernel that serves bincount and the sliced scatter
+(:func:`metrics_tpu.ops.scatter_pallas.segment_sum_tiled`), and the cheap
+O(capacity) epilogue (weighted-mean divide, embed, stable pack) stays jnp.
+
+Data is staged TRANSPOSED — ``[cols, n_pad]`` with the row axis on the
+128-wide lane dimension — so the handful of sketch columns (2 + payload)
+do not each pad to a full lane tile; compare-exchange reshapes only ever
+split the lane axis.
+
+Parity contract (pinned in ``tests/ops/``): with integer-valued weights
+the prefix sum is order-independent-exact in f32, so sorted order, bucket
+ids, and merged centroids are BIT-identical to the jnp path; with
+arbitrary float weights the summation-order difference can flip a
+bucket boundary, so parity is pinned at the sketch level — quantile
+queries within the advertised ``rank_error_bound``.
+"""
+import functools
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops.dispatch import dispatch, register_kernel
+from metrics_tpu.ops.scatter_pallas import segment_sum_tiled
+
+try:  # TPU-specific memory spaces; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    _VMEM = None
+
+Array = jax.Array
+ArrayLike = Union[Array, np.ndarray]
+
+#: largest padded row count the fused sort kernel accepts: 2**15 rows keep
+#: the [cols, n_pad] stage plus the network's live temporaries well under
+#: the ~16 MB VMEM budget at sketch-typical column counts
+_MAX_SORT_ROWS = 1 << 15
+#: below this the sort is too small for the kernel to matter; jnp path
+_MIN_SORT_ROWS = 1 << 10
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _bitonic_by_key(key: Array, idx: Array, data: Array, n_pad: int) -> Tuple[Array, Array]:
+    """Ascending bitonic network on composite ``(key, idx)``; ``data``
+    rides the permutation. ``key``/``idx`` are ``[1, n_pad]``, ``data`` is
+    ``[cols, n_pad]``. Static Python loops — the network fully unrolls at
+    trace time."""
+    cols = data.shape[0]
+    k = 2
+    while k <= n_pad:
+        j = k // 2
+        while j >= 1:
+            m = n_pad // (2 * j)
+            kr = key.reshape(1, m, 2, j)
+            ir = idx.reshape(1, m, 2, j)
+            dr = data.reshape(cols, m, 2, j)
+            klo, khi = kr[:, :, 0, :], kr[:, :, 1, :]
+            ilo, ihi = ir[:, :, 0, :], ir[:, :, 1, :]
+            gt = (klo > khi) | ((klo == khi) & (ilo > ihi))
+            lt = (klo < khi) | ((klo == khi) & (ilo < ihi))
+            # direction per 2j-block: bit k of the element index i = b*2j + r
+            # (r < 2j <= k) depends only on the block index b
+            blk = jax.lax.broadcasted_iota(jnp.int32, (1, m, 1), 1)
+            asc = ((blk * (2 * j)) & k) == 0
+            swap = jnp.where(asc, gt, lt)  # [1, m, j]
+            key = jnp.stack(
+                [jnp.where(swap, khi, klo), jnp.where(swap, klo, khi)], axis=2
+            ).reshape(1, n_pad)
+            idx = jnp.stack(
+                [jnp.where(swap, ihi, ilo), jnp.where(swap, ilo, ihi)], axis=2
+            ).reshape(1, n_pad)
+            dlo, dhi = dr[:, :, 0, :], dr[:, :, 1, :]
+            data = jnp.stack(
+                [jnp.where(swap, dhi, dlo), jnp.where(swap, dlo, dhi)], axis=2
+            ).reshape(cols, n_pad)
+            j //= 2
+        k *= 2
+    return key, data
+
+
+def _make_sort_bucket_kernel(capacity: int, n_pad: int, n_seg: int):
+    def kernel(data_ref, wvals_ref, bucket_ref):
+        data = data_ref[:, :]  # [cols, n_pad]: row 0 = weight, row 1 = key
+        w0 = data[0:1, :]
+        occ = w0 > 0
+        key = jnp.where(occ, data[1:2, :], jnp.inf)
+        idx = jax.lax.broadcasted_iota(jnp.float32, (1, n_pad), 1)
+        _, srt = _bitonic_by_key(key, idx, data, n_pad)
+
+        sw = srt[0:1, :]
+        # inclusive prefix sum by log-step shift-adds
+        cum = sw
+        t = 1
+        while t < n_pad:
+            cum = cum + jnp.concatenate(
+                [jnp.zeros((1, t), jnp.float32), cum[:, : n_pad - t]], axis=1
+            )
+            t *= 2
+        total = jnp.clip(jnp.sum(sw), 1e-30, None)
+        q = jnp.clip((cum - sw / 2.0) / total, 0.0, 1.0)
+        scale = capacity / (2.0 * jnp.pi)
+        k1 = scale * jnp.arcsin(2.0 * q - 1.0)
+        bucket_ref[:, :] = jnp.clip(
+            jnp.floor(k1).astype(jnp.int32) + capacity // 4 + 1, 0, n_seg - 1
+        )
+        # weighted rows for the centroid merge: [w, w*key, w*payload]
+        wvals_ref[:, :] = jnp.concatenate([sw, sw * srt[1:, :]], axis=0)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "interpret"))
+def qsketch_sort_bucket_tiled(
+    rows: ArrayLike, capacity: int, interpret: bool = False
+) -> Tuple[Array, Array]:
+    """The fused sort->cumsum->bucket stage: ``[n, cols]`` sketch rows in,
+    ``(weighted_rows [n_pad, cols], bucket_ids [n_pad])`` out, with the
+    zero-weight pad rows bucketed harmlessly (they carry no weight)."""
+    rows = jnp.asarray(rows, jnp.float32)
+    n, cols = rows.shape
+    n_pad = _next_pow2(max(n, 2))
+    n_seg = capacity // 2 + 4
+    data = jnp.zeros((cols, n_pad), jnp.float32).at[:, :n].set(rows.T)
+
+    kwargs = {}
+    if not interpret and _VMEM is not None:
+        kwargs = {
+            "in_specs": [pl.BlockSpec(memory_space=_VMEM)],
+            "out_specs": (
+                pl.BlockSpec(memory_space=_VMEM),
+                pl.BlockSpec(memory_space=_VMEM),
+            ),
+        }
+    wvals, bucket = pl.pallas_call(
+        _make_sort_bucket_kernel(capacity, n_pad, n_seg),
+        out_shape=(
+            jax.ShapeDtypeStruct((cols, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        ),
+        interpret=interpret,
+        **kwargs,
+    )(data)
+    return wvals.T, bucket[0]
+
+
+def _qsketch_compact_pallas(rows: Array, capacity: int, interpret: bool = False) -> Array:
+    """The full fused compaction: sort/bucket kernel + the shared tiled
+    segment-sum kernel for the centroid merge + the jnp epilogue shared
+    with the fallback (weighted-mean divide, embed at bucket order,
+    stable pack)."""
+    n_seg = capacity // 2 + 4
+    wvals, bucket = qsketch_sort_bucket_tiled(rows, capacity, interpret=interpret)
+    seg = segment_sum_tiled(wvals, bucket, n_seg, interpret=interpret)  # [n_seg, cols]
+    from metrics_tpu.sketches.quantile import _finalize_compact
+
+    return _finalize_compact(seg[:, 0], seg[:, 1:], rows)
+
+
+def _qsketch_compact_jnp(rows: Array, capacity: int) -> Array:
+    from metrics_tpu.sketches.quantile import _compact_rows_jnp
+
+    return _compact_rows_jnp(rows, capacity)
+
+
+def _qsketch_route(rows: Array, capacity: int) -> bool:
+    n, cols = rows.shape
+    return (
+        rows.dtype == jnp.float32
+        and _MIN_SORT_ROWS <= n
+        and _next_pow2(n) <= _MAX_SORT_ROWS
+        and cols <= 16
+    )
+
+
+register_kernel(
+    "qsketch_compact",
+    pallas_fn=_qsketch_compact_pallas,
+    jnp_fn=_qsketch_compact_jnp,
+    route=_qsketch_route,
+)
+
+
+def qsketch_compact_dispatch(rows: ArrayLike, capacity: int) -> Array:
+    """Registry-routed merging-t-digest compaction pass (the overflow step
+    of ``qsketch_insert``/``qsketch_merge``). Semantics of
+    ``sketches/quantile.py::_compact_rows_jnp``; see the module docstring
+    for the per-backend parity contract. The rows' dtype is preserved —
+    non-f32 sketch leaves (bf16 precision sweeps) route to the jnp path,
+    and inside ``_absorb``'s ``lax.cond`` both branches must keep the
+    leaf's exact dtype."""
+    return dispatch("qsketch_compact", jnp.asarray(rows), capacity)

@@ -1,0 +1,300 @@
+"""Pallas TPU kernel: fused bincount / segment-scatter via tiled one-hot matmul.
+
+The index-mapped bincount ``(target * C + pred) -> counts`` is the core of
+every confusion-matrix classification metric (``utils/data.py::_bincount``
+via ``functional/classification/confusion_matrix.py``), and the same
+reduction shape — scatter-add ``[B]``-aligned rows into ``[S]`` segments —
+is the per-update cost of the ``SlicedMetric`` slice-axis scatter
+(``sliced/metric.py``). XLA lowers both to a generic serial scatter; this
+kernel re-expresses them as what the TPU is actually good at: a tiled
+one-hot matrix product on the MXU.
+
+One grid step owns a ``(TILE_S, D)`` output tile and streams ``TILE_B``
+index rows through VMEM: the tile's one-hot membership matrix
+``[TILE_B, TILE_S]`` is built on-chip from a broadcasted iota (never
+materialized in HBM) and contracted against the value rows on the MXU,
+accumulating into the resident output tile across the batch dimension of
+the grid. Out-of-range ids (negative included) match no one-hot column and
+are dropped — exactly ``jax.ops.segment_sum``'s documented semantics, which
+the jnp fallback shares.
+
+Accumulation is float32 on the MXU. Unit-weight COUNTS (bincount) are
+exact while the batch stays below ``2**24`` — the route's bound — and
+float payload scatters agree with the fallback within f32
+summation-order rounding (callers accumulate across batches OUTSIDE the
+kernel, ``old + delta``, so per-dispatch magnitudes are batch-bounded).
+Integer payload scatters always take the exact jnp fallback: their
+per-segment partial magnitudes are not statically bounded, and a partial
+past ``2**24`` would round silently where XLA's scatter is exact.
+
+Entry points: :func:`segment_sum_tiled` (the raw kernel wrapper),
+:func:`segment_sum_dispatch` / :func:`bincount_dispatch` (registry-routed,
+see :mod:`metrics_tpu.ops.dispatch`). ``segment_max`` / ``segment_min``
+register as jnp-only ops — extremum scatters have no measured Pallas win
+yet, but routing them through the registry counts their traffic and
+reserves the slot.
+"""
+import functools
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops.dispatch import dispatch, register_kernel
+
+try:  # TPU-specific memory spaces; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    _VMEM = None
+
+Array = jax.Array
+ArrayLike = Union[Array, np.ndarray]
+
+#: batch rows streamed per grid step (sublane-aligned multiple of 8)
+_TILE_B = 512
+#: segment columns owned per grid step (one MXU lane tile)
+_TILE_S = 128
+#: f32 integer-exactness window: unit-weight counts / integer partial sums
+#: below this are exact on the MXU accumulate path
+_F32_EXACT = 1 << 24
+
+
+def _segment_sum_kernel(ids_ref, vals_ref, out_ref):
+    """Accumulate one (TILE_S, D) segment tile over the batch grid axis."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[0, :]  # [TILE_B] int32
+    seg = i * _TILE_S + jax.lax.broadcasted_iota(jnp.int32, (_TILE_B, _TILE_S), 1)
+    onehot = (ids[:, None] == seg).astype(jnp.float32)  # [TILE_B, TILE_S], on-chip only
+    # contract the batch axis: [TILE_B, TILE_S] x [TILE_B, D] -> [TILE_S, D]
+    out_ref[:, :] += jax.lax.dot_general(
+        onehot,
+        vals_ref[:, :],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def segment_sum_tiled(
+    vals: ArrayLike, ids: ArrayLike, num_segments: int, interpret: bool = False
+) -> Array:
+    """Segment-sum ``[B, D] x [B] -> [num_segments, D]`` via the tiled
+    one-hot MXU kernel. ``vals`` may be ``[B]`` (returns ``[num_segments]``).
+
+    Pads B/D/S up to tile multiples (pad rows carry id ``-1``, matching no
+    segment) and slices back. Float32 compute; out-of-range ids drop.
+    """
+    vals = jnp.asarray(vals, jnp.float32)
+    squeeze = vals.ndim == 1
+    if squeeze:
+        vals = vals[:, None]
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    b, d = vals.shape
+    b_pad = -(-max(b, 1) // _TILE_B) * _TILE_B
+    d_pad = -(-max(d, 1) // 128) * 128
+    s_pad = -(-max(num_segments, 1) // _TILE_S) * _TILE_S
+
+    ids_p = jnp.full((1, b_pad), -1, jnp.int32).at[0, :b].set(ids)
+    vals_p = jnp.zeros((b_pad, d_pad), jnp.float32).at[:b, :d].set(vals)
+
+    ms = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
+    out = pl.pallas_call(
+        _segment_sum_kernel,
+        out_shape=jax.ShapeDtypeStruct((s_pad, d_pad), jnp.float32),
+        grid=(s_pad // _TILE_S, b_pad // _TILE_B),
+        in_specs=[
+            pl.BlockSpec((1, _TILE_B), lambda i, j: (0, j), **ms),
+            pl.BlockSpec((_TILE_B, d_pad), lambda i, j: (j, 0), **ms),
+        ],
+        out_specs=pl.BlockSpec((_TILE_S, d_pad), lambda i, j: (i, 0), **ms),
+        interpret=interpret,
+    )(ids_p, vals_p)
+    out = out[:num_segments, :d]
+    return out[:, 0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# registry-routed entry points
+# ---------------------------------------------------------------------------
+
+
+def _route_dtype_ok(dtype: jnp.dtype) -> bool:
+    """float32 ONLY. Every other dtype diverges from its fallback by more
+    than summation order: ``jax.ops.segment_sum`` accumulates bf16/f16
+    IN bf16/f16 (a 100k-row bf16 sum saturates around 256), so the
+    kernel's f32-accumulate-then-cast result differs by orders of
+    magnitude, not ulps; INTEGER leaves have statically unbounded
+    per-segment partials that would round past ``2**24`` where XLA's
+    scatter is exact; f64 would lose precision (the box-IoU guard's
+    logic). All of those take the exact fallback."""
+    return jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+
+
+def _segment_route(vals: Any, ids: Array, num_segments: int) -> bool:
+    b = ids.shape[0]
+    d = 1 if len(vals.shape) == 1 else vals.shape[1]
+    return (
+        _route_dtype_ok(vals.dtype)
+        and b >= 256  # tiny batches: pad waste dominates, scatter is fine
+        and num_segments >= 64
+        and b < _F32_EXACT  # unit-weight counts stay f32-exact (bincount)
+        # the kernel tiles B and S but holds the FULL feature dim per block:
+        # vals block (512, d_pad) + resident out tile (128, d_pad) must fit
+        # VMEM with pipelining double-buffers — d_pad <= 1024 keeps the
+        # working set ~5 MiB; wider leaves take the fallback instead of
+        # failing Mosaic compilation at runtime
+        and -(-max(d, 1) // 128) * 128 <= 1024
+        # dense one-hot work is B * S_pad MACs per 128 value lanes; cap the
+        # blow-up where an enormous (B, S) product would out-cost the
+        # scatter it replaces
+        and b * (-(-num_segments // _TILE_S) * _TILE_S) * max(d, 1) <= 1 << 36
+    )
+
+
+def _segment_sum_pallas(vals, ids, num_segments, interpret=False):
+    out = segment_sum_tiled(vals, ids, num_segments, interpret=interpret)
+    return out.astype(jnp.asarray(vals).dtype)
+
+
+def _segment_sum_jnp(vals, ids, num_segments):
+    return jax.ops.segment_sum(vals, ids, num_segments=num_segments)
+
+
+register_kernel(
+    "segment_sum",
+    pallas_fn=_segment_sum_pallas,
+    jnp_fn=_segment_sum_jnp,
+    route=_segment_route,
+)
+register_kernel(
+    "segment_max",
+    pallas_fn=None,
+    jnp_fn=lambda vals, ids, num_segments: jax.ops.segment_max(
+        vals, ids, num_segments=num_segments
+    ),
+)
+register_kernel(
+    "segment_min",
+    pallas_fn=None,
+    jnp_fn=lambda vals, ids, num_segments: jax.ops.segment_min(
+        vals, ids, num_segments=num_segments
+    ),
+)
+
+
+def segment_sum_dispatch(vals: ArrayLike, ids: ArrayLike, num_segments: int) -> Array:
+    """Registry-routed segment-sum over the LEADING axis: ``[B, ...]`` rows
+    scatter-add into ``[num_segments, ...]``. Trailing dims are flattened
+    through the kernel and restored; result dtype follows the input (the
+    jnp fallback's contract). Out-of-range ids (negative included) drop on
+    both paths."""
+    vals = jnp.asarray(vals)
+    ids = jnp.asarray(ids)
+    lead = vals.shape[0] if vals.ndim else 0
+    flat = vals.reshape(lead, -1) if vals.ndim > 2 else vals
+    out = dispatch("segment_sum", flat, ids, num_segments)
+    if vals.ndim > 2:
+        out = out.reshape((num_segments,) + vals.shape[1:])
+    return out
+
+
+def segment_max_dispatch(vals: ArrayLike, ids: ArrayLike, num_segments: int) -> Array:
+    """Registry-routed segment-max (jnp-only today; empty segments fill
+    with the dtype minimum — the extremum identity)."""
+    return dispatch("segment_max", jnp.asarray(vals), jnp.asarray(ids), num_segments)
+
+
+def segment_min_dispatch(vals: ArrayLike, ids: ArrayLike, num_segments: int) -> Array:
+    """Registry-routed segment-min (jnp-only today)."""
+    return dispatch("segment_min", jnp.asarray(vals), jnp.asarray(ids), num_segments)
+
+
+# ---------------------------------------------------------------------------
+# bincount: validation at the dispatch boundary + the same kernel
+# ---------------------------------------------------------------------------
+
+
+def _bincount_route(x: Array, minlength: int) -> bool:
+    # shape-only probe for the unit-weight values (counts are bounded by the
+    # route's B cap, hence f32-exact) — no device allocation on the hot path
+    probe = jax.ShapeDtypeStruct((x.shape[0] if x.ndim else 1,), jnp.float32)
+    return not jax.config.jax_enable_x64 and _segment_route(probe, x, minlength)
+
+
+def _bincount_pallas(x, minlength, interpret=False):
+    ones = jnp.ones(x.shape, jnp.float32)
+    return segment_sum_tiled(ones, x, minlength, interpret=interpret).astype(jnp.int32)
+
+
+def _bincount_jnp(x, minlength):
+    return jnp.bincount(x, length=minlength)
+
+
+register_kernel(
+    "bincount",
+    pallas_fn=_bincount_pallas,
+    jnp_fn=_bincount_jnp,
+    route=_bincount_route,
+)
+
+
+def bincount_dispatch(x: ArrayLike, minlength: int) -> Array:
+    """Registry-routed static-length bincount with hardened inputs.
+
+    ``jnp.bincount`` inherits XLA scatter's silent edge semantics: float
+    indices raise only deep in the scatter lowering, and NEGATIVE indices
+    are silently clipped into bin 0 — corrupting the count that every
+    confusion-matrix metric is built on. This boundary makes the contract
+    explicit:
+
+    * ``minlength`` must be a positive Python int (it is the static output
+      length under jit).
+    * ``x`` must be integer-typed — floats raise ``TypeError`` here, not
+      three layers down.
+    * negative indices raise ``ValueError`` when the values are already on
+      the host (numpy arrays, Python sequences) — a free check. Device or
+      traced values are NOT pulled back for validation (a per-call
+      device->host sync would serialize every eager classification
+      update); instead negatives are masked to ``minlength`` and DROPPED
+      on both backends — the deterministic fate of too-large ids, never a
+      silent bin-0 credit.
+    """
+    if not isinstance(minlength, int) or isinstance(minlength, bool) or minlength <= 0:
+        raise ValueError(f"`minlength` must be a positive int, got {minlength!r}")
+    host_vals = np.asarray(x) if isinstance(x, (np.ndarray, list, tuple)) else None
+    x = jnp.asarray(x).reshape(-1)
+    if not jnp.issubdtype(x.dtype, jnp.integer):
+        raise TypeError(
+            f"bincount indices must be integer-typed, got dtype {x.dtype};"
+            " cast labels with .astype(jnp.int32) at the call site"
+        )
+    if host_vals is not None and host_vals.size and host_vals.min() < 0:
+        raise ValueError(
+            f"bincount indices must be non-negative, got min {int(host_vals.min())};"
+            " XLA scatter would otherwise clip negatives into bin 0"
+        )
+    if x.dtype.itemsize < 4:
+        # the out-of-range sentinel below must be representable: in int8,
+        # `minlength=300` wraps to 44 — a VALID bin — silently re-crediting
+        # the masked negatives (and int16 overflows similarly). int64 stays:
+        # downcasting could wrap a huge OOB label INTO range.
+        x = x.astype(jnp.int32)
+    if host_vals is None:
+        # device/traced values: force negatives out of range so both
+        # backends DROP them (scatter would clip them into bin 0); fuses
+        # into the count — no host sync
+        x = jnp.where(x < 0, minlength, x)
+    return dispatch("bincount", x, minlength)
+
+

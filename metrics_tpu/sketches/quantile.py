@@ -46,6 +46,7 @@ shapes — so metrics whose update is one ``qsketch_insert`` fuse, bucket
 (via ``n_valid`` pad masking), vmap, and mesh-sync like any sum-state
 metric.
 """
+import functools
 from typing import Any, Optional
 
 import jax
@@ -93,8 +94,22 @@ def _pack_rows(rows: Array) -> Array:
     return rows[order]
 
 
-def _compact_rows(rows: Array, capacity: int) -> Array:
-    """One merging-t-digest compaction pass, fully vectorized.
+def _finalize_compact(seg_w: Array, seg_vals: Array, rows: Array) -> Array:
+    """Compaction epilogue shared by the jnp and Pallas paths: divide the
+    segment-summed weighted values back to centroids, embed them at their
+    (key-ordered) bucket positions in a ``rows``-shaped buffer, and pack
+    occupied rows first. ``seg_vals`` carries the WEIGHTED sums."""
+    n_seg = seg_w.shape[0]
+    seg_vals = seg_vals / jnp.clip(seg_w[:, None], 1e-30, None)
+    merged = jnp.concatenate([seg_w[:, None], seg_vals], axis=1)
+    out = jnp.zeros_like(rows)
+    out = out.at[:n_seg].set(merged.astype(rows.dtype))
+    return _pack_rows(out)
+
+
+def _compact_rows_jnp(rows: Array, capacity: int) -> Array:
+    """One merging-t-digest compaction pass, fully vectorized (the jnp
+    reference path; ``_compact_rows`` routes here off-TPU).
 
     Occupied rows (weighted centroids) are sorted by key; each row's
     mid-quantile position ``q`` maps through the tail-adaptive scale
@@ -124,21 +139,33 @@ def _compact_rows(rows: Array, capacity: int) -> Array:
     )
     seg_w = jax.ops.segment_sum(sw, bucket, num_segments=n_seg)
     seg_vals = jax.ops.segment_sum(sw[:, None] * srt[:, 1:], bucket, num_segments=n_seg)
-    seg_vals = seg_vals / jnp.clip(seg_w[:, None], 1e-30, None)
-    merged = jnp.concatenate([seg_w[:, None], seg_vals], axis=1)
-    out = jnp.zeros_like(rows)
-    out = out.at[:n_seg].set(merged.astype(rows.dtype))
-    return _pack_rows(out)
+    return _finalize_compact(seg_w, seg_vals, rows)
 
 
-@jax.jit
-def _absorb(sketch: Array, new_rows: Array) -> Array:
+def _compact_rows(rows: Array, capacity: int) -> Array:
+    """The compaction pass, routed through the ops kernel registry: the
+    fused Pallas sort→bucket→segment-merge chain on TPU
+    (:mod:`metrics_tpu.ops.qsketch_pallas`), :func:`_compact_rows_jnp`
+    everywhere else. Lazy import — ``ops`` imports this module's jnp body
+    as its fallback."""
+    from metrics_tpu.ops import qsketch_compact_dispatch
+
+    return qsketch_compact_dispatch(rows, capacity)
+
+
+@functools.partial(jax.jit, static_argnames=("_mode",))
+def _absorb_impl(sketch: Array, new_rows: Array, _mode: Any = None) -> Array:
     """Shared insert/merge core: concatenate, pack, compact iff the
     occupied rows overflow capacity (``lax.cond`` — the compaction branch
     only runs on overflow, so in-window streams never pay the sort).
     Jitted on its own so EAGER metric updates pay one cached dispatch per
     (capacity, batch) signature instead of tens of small op dispatches; the
-    raises below are host-static shape checks that fire at trace time."""
+    raises below are host-static shape checks that fire at trace time.
+    ``_mode`` is the ops-dispatch routing state (see
+    ``ops.dispatch.dispatch_mode``) folded into the jit cache key — the
+    compaction backend is chosen at trace time, so a flipped
+    ``METRICS_TPU_NO_PALLAS`` or a forced interpret test must not be
+    shadowed by a stale trace."""
     capacity = sketch.shape[0]
     if new_rows.shape[0] > capacity:
         raise ValueError(
@@ -156,6 +183,14 @@ def _absorb(sketch: Array, new_rows: Array) -> Array:
         lambda r: r,
         packed,
     )[:capacity]
+
+
+def _absorb(sketch: Array, new_rows: Array) -> Array:
+    """:func:`_absorb_impl` with the current ops-dispatch routing state as
+    the trace-cache discriminator."""
+    from metrics_tpu.ops.dispatch import dispatch_mode
+
+    return _absorb_impl(sketch, new_rows, _mode=dispatch_mode())
 
 
 def qsketch_insert(

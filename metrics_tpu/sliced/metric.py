@@ -245,25 +245,34 @@ class SlicedMetric(Metric):
         num = self.num_slices
         row_states = self._row_states(args, m._filter_kwargs(**kwargs), n_rows)
         defaults = {k: jnp.asarray(v) for k, v in m._defaults.items()}
+        # per-leaf scatters route through the ops kernel registry: the tiled
+        # one-hot MXU segment-sum kernel on TPU where the route predicts a
+        # win, jax.ops.segment_* elsewhere (CPU states stay bit-identical)
+        from metrics_tpu.ops import (
+            segment_max_dispatch,
+            segment_min_dispatch,
+            segment_sum_dispatch,
+        )
+
         for name, red in m._reductions.items():
             rows = row_states[name]
             old = getattr(self, name)
             if red is dim_zero_sum:
                 # per-row delta against the default, segment-summed into the
                 # slice axis: exact for additive (sum-reduced) accumulation
-                new = old + jax.ops.segment_sum(rows - defaults[name], slice_ids, num_segments=num)
+                new = old + segment_sum_dispatch(rows - defaults[name], slice_ids, num)
             elif red is dim_zero_max:
                 # empty segments fill with the dtype's -inf/min — the
                 # extremum identity — so untouched slices stay bit-identical
-                new = jnp.maximum(old, jax.ops.segment_max(rows, slice_ids, num_segments=num))
+                new = jnp.maximum(old, segment_max_dispatch(rows, slice_ids, num))
             else:  # dim_zero_min (validated at construction)
-                new = jnp.minimum(old, jax.ops.segment_min(rows, slice_ids, num_segments=num))
+                new = jnp.minimum(old, segment_min_dispatch(rows, slice_ids, num))
             object.__setattr__(self, name, new)
         counts = getattr(self, SLICE_ROWS)
         object.__setattr__(
             self,
             SLICE_ROWS,
-            counts + jax.ops.segment_sum(jnp.ones(n_rows, jnp.int32), slice_ids, num_segments=num),
+            counts + segment_sum_dispatch(jnp.ones(n_rows, jnp.int32), slice_ids, num),
         )
         if _TELEMETRY.enabled:
             # under the fused kernel this records once per TRACE (shapes are

@@ -145,8 +145,19 @@ def _safe_divide(num: Array, denom: Array) -> Array:
 
 
 def _bincount(x: Array, minlength: int) -> Array:
-    """Static-length bincount (jit-safe)."""
-    return jnp.bincount(jnp.asarray(x).reshape(-1), length=minlength)
+    """Static-length bincount (jit-safe), routed through the ops kernel
+    registry: the tiled one-hot MXU scatter kernel on TPU, ``jnp.bincount``
+    elsewhere. The dispatch boundary also hardens the inputs — float
+    indices raise, host-side negative indices raise, and device/traced
+    negatives deterministically DROP instead of riding XLA scatter's
+    silent clip-into-bin-0 semantics; see
+    :func:`metrics_tpu.ops.bincount_dispatch`. ``x`` is passed through
+    un-coerced so host-resident inputs keep their free validation. Lazy
+    import: this module is imported by nearly every metric, ``ops`` only
+    by its users."""
+    from metrics_tpu.ops import bincount_dispatch
+
+    return bincount_dispatch(x, minlength)
 
 
 def stable_sort_with_payloads(

@@ -85,6 +85,12 @@ AUX_FIELDS: Dict[str, str] = {
     "windowed_compiles": "lower",
     "collector_fold_per_sec": "higher",
     "wire_bytes_per_snapshot": "lower",
+    # the ops kernel-suite bench (``ops_kernel_dispatch_throughput``)
+    # carries the worst dispatched-vs-direct wall ratio across ops: the
+    # shared dispatch layer growing a per-call tax on every bincount /
+    # segment-scatter / compaction is a regression even when the headline
+    # throughput still passes
+    "ops_dispatch_overhead": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -103,6 +109,13 @@ BOOL_FIELDS: Tuple[str, ...] = (
     # leaves + byte-identical exposition) — broken determinism is data
     # corruption however fast the fold runs
     "collector_fold_deterministic",
+    # ops kernel-vs-fallback parity on integer-exact data (interpret mode
+    # runs the real kernel bodies): a kernel diverging from its jnp
+    # fallback is data corruption on every metric built on it, however
+    # fast it dispatches
+    "ops_bincount_parity",
+    "ops_segment_sum_parity",
+    "ops_qsketch_compact_parity",
 )
 
 

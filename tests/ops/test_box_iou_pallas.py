@@ -110,6 +110,35 @@ def test_compiled_batched_on_tpu():
     )
 
 
+def test_no_pallas_env_forces_jnp_fallback(monkeypatch):
+    """The METRICS_TPU_NO_PALLAS kill switch: on a (fake) TPU backend at a
+    density the route would send to the tile kernel, the env var must force
+    the jnp fallback — on CPU an attempted real pallas_call would crash, so
+    a correct result proves the routing (same proof shape as the f64
+    test)."""
+    from metrics_tpu.ops import NO_PALLAS_ENV
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv(NO_PALLAS_ENV, "1")
+    rng = np.random.default_rng(5)
+    b1, b2 = _boxes(rng, 64), _boxes(rng, 48)
+    got = box_iou_dispatch(jnp.asarray(b1), jnp.asarray(b2), min_elems=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(box_iou(b1, b2)), atol=1e-6)
+
+
+def test_registry_reroute_keeps_interpret_parity():
+    """box_iou through the shared registry's interpret mode agrees with the
+    jnp broadcast — the re-route must not change the kernel the dispatch
+    reaches."""
+    from metrics_tpu import ops
+
+    rng = np.random.default_rng(6)
+    b1, b2 = _boxes(rng, 40), _boxes(rng, 70)
+    with ops.forced_backend("interpret"):
+        got = box_iou_dispatch(jnp.asarray(b1), jnp.asarray(b2))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(box_iou(b1, b2)), atol=1e-5)
+
+
 def test_dispatch_routes_float64_to_jnp_fallback(monkeypatch):
     """Under x64, float64 boxes must take the jnp fallback on BOTH dispatch
     shapes — the Pallas kernels compute in f32 and would silently downgrade

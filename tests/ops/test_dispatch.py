@@ -1,0 +1,177 @@
+"""The shared ops dispatch layer: registry, routing, escape hatches,
+observability. Runs entirely on CPU — TPU routing is proven with a faked
+``jax.default_backend`` exactly like the box-IoU f64 routing test (a wrong
+route would attempt a real ``pallas_call`` on CPU and crash)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import ops
+from metrics_tpu.ops.dispatch import choose_backend
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    yield
+    _DEFAULT_RECORDER.disable()
+    _DEFAULT_RECORDER.reset()
+
+
+def test_registry_holds_the_suite():
+    names = ops.kernel_names()
+    for expected in ("bincount", "box_iou", "qsketch_compact", "segment_max", "segment_min", "segment_sum"):
+        assert expected in names
+
+
+def test_get_kernel_unknown_name_raises():
+    with pytest.raises(KeyError, match="no kernel 'nope'"):
+        ops.get_kernel("nope")
+
+
+def test_register_requires_callable_fallback():
+    with pytest.raises(TypeError, match="jnp_fn must be callable"):
+        ops.register_kernel("bad", pallas_fn=None, jnp_fn=None)
+
+
+def test_jnp_only_op_never_routes_pallas(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    spec = ops.get_kernel("segment_max")
+    assert choose_backend(spec, jnp.ones((512,)), jnp.zeros(512, jnp.int32), 128) == "jnp"
+
+
+def test_route_respected_on_fake_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    spec = ops.get_kernel("segment_sum")
+    big = (jnp.ones((2048, 4)), jnp.zeros(2048, jnp.int32), 256)
+    small = (jnp.ones((8, 4)), jnp.zeros(8, jnp.int32), 4)
+    assert choose_backend(spec, *big) == "pallas"
+    assert choose_backend(spec, *small) == "jnp"  # below the density floor
+    ints = (jnp.ones((2048, 4), jnp.int32), jnp.zeros(2048, jnp.int32), 256)
+    assert choose_backend(spec, *ints) == "jnp"  # int partials: exact fallback
+    bf16 = (jnp.ones((2048, 4), jnp.bfloat16), jnp.zeros(2048, jnp.int32), 256)
+    assert choose_backend(spec, *bf16) == "jnp"  # jnp accumulates bf16 IN bf16
+    wide = (jnp.ones((2048, 4096), jnp.float32), jnp.zeros(2048, jnp.int32), 256)
+    assert choose_backend(spec, *wide) == "jnp"  # untiled feature dim: VMEM bound
+    jax.config.update("jax_enable_x64", True)
+    try:
+        f64 = (jnp.ones((2048, 4), jnp.float64), jnp.zeros(2048, jnp.int32), 256)
+        assert choose_backend(spec, *f64) == "jnp"  # dtype guard
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_no_pallas_env_is_absolute(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv(ops.NO_PALLAS_ENV, "1")
+    spec = ops.get_kernel("segment_sum")
+    args = (jnp.ones((2048, 4)), jnp.zeros(2048, jnp.int32), 256)
+    assert ops.pallas_disabled()
+    assert choose_backend(spec, *args) == "jnp"
+    # the kill switch beats even a forced interpret parity mode
+    with ops.forced_backend("interpret"):
+        assert choose_backend(spec, *args) == "jnp"
+
+
+def test_no_pallas_env_dispatch_still_correct(monkeypatch):
+    """With the hatch set on a (fake) TPU backend, the dispatched value is
+    the jnp fallback's — on CPU an attempted real pallas_call would crash,
+    so agreement proves the routing."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv(ops.NO_PALLAS_ENV, "1")
+    vals = jnp.asarray(np.random.RandomState(0).randint(0, 5, (1024, 2)).astype(np.float32))
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 100, 1024), jnp.int32)
+    got = ops.segment_sum_dispatch(vals, ids, 100)
+    want = jax.ops.segment_sum(vals, ids, num_segments=100)
+    assert jnp.array_equal(got, want)
+
+
+def test_forced_backend_validates_and_restores():
+    with pytest.raises(ValueError, match="forced_backend mode"):
+        with ops.forced_backend("tpu"):
+            pass
+    spec = ops.get_kernel("segment_sum")
+    args = (jnp.ones((512,)), jnp.zeros(512, jnp.int32), 128)
+    assert choose_backend(spec, *args) == "jnp"  # CPU default
+    with ops.forced_backend("interpret"):
+        assert choose_backend(spec, *args) == "interpret"
+        with ops.forced_backend("jnp"):
+            assert choose_backend(spec, *args) == "jnp"
+        assert choose_backend(spec, *args) == "interpret"
+    assert choose_backend(spec, *args) == "jnp"
+
+
+def test_dispatch_mode_tracks_routing_state(monkeypatch):
+    base = ops.dispatch_mode()
+    with ops.forced_backend("interpret"):
+        assert ops.dispatch_mode() != base
+    monkeypatch.setenv(ops.NO_PALLAS_ENV, "1")
+    assert ops.dispatch_mode() != base
+    monkeypatch.delenv(ops.NO_PALLAS_ENV)
+    assert ops.dispatch_mode() == base
+
+
+def test_dispatch_counters_by_op_and_backend():
+    _DEFAULT_RECORDER.reset()
+    _DEFAULT_RECORDER.enable()
+    x = jnp.asarray([0, 1, 1, 2], jnp.int32)
+    ops.bincount_dispatch(x, 4)
+    with ops.forced_backend("interpret"):
+        ops.bincount_dispatch(x, 4)
+    ops.segment_max_dispatch(jnp.ones(4), x, 4)
+    totals = _DEFAULT_RECORDER.ops_dispatch_totals()
+    assert totals["bincount|jnp"] == 1
+    assert totals["bincount|interpret"] == 1
+    assert totals["segment_max|jnp"] == 1
+
+
+def test_dispatch_counters_off_when_disabled():
+    _DEFAULT_RECORDER.reset()
+    assert not _DEFAULT_RECORDER.enabled
+    ops.bincount_dispatch(jnp.asarray([0, 1], jnp.int32), 2)
+    assert _DEFAULT_RECORDER.ops_dispatch_totals() == {}
+
+
+def test_counters_ride_aggregate_and_prometheus():
+    from metrics_tpu.observability import aggregate_across_hosts
+    from metrics_tpu.observability.exporters import render_prometheus
+
+    _DEFAULT_RECORDER.reset()
+    _DEFAULT_RECORDER.enable()
+    ops.bincount_dispatch(jnp.asarray([0, 1, 1], jnp.int32), 3)
+    agg = aggregate_across_hosts(_DEFAULT_RECORDER)
+    assert agg["ops_dispatch_totals"]["bincount|jnp"] == 1
+    page = render_prometheus(recorder=_DEFAULT_RECORDER, aggregate=agg)
+    assert 'metrics_tpu_ops_dispatch_total{op="bincount",backend="jnp"' in page
+
+
+def test_fused_compile_cache_keyed_on_dispatch_mode():
+    """The fused AOT cache must fold in the ops routing state: a flipped
+    kill switch or a forced parity mode has to RECOMPILE, not keep
+    executing a stale trace with the old backend baked in."""
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.classification import ConfusionMatrix
+
+    col = MetricCollection({"cm": ConfusionMatrix(num_classes=3)})
+    handle = col.compile_update()
+    labels = jnp.asarray([0, 1, 2, 2], jnp.int32)
+    col.update(labels, labels)
+    n0 = handle.n_compiles
+    with ops.forced_backend("interpret"):
+        col.update(labels, labels)
+        assert handle.n_compiles == n0 + 1  # new routing state -> new trace
+    col.update(labels, labels)
+    assert handle.n_compiles == n0 + 1  # original trace reused
+    assert int(jnp.asarray(col["cm"].confmat).trace()) == 12
+
+
+def test_aggregate_merge_sums_and_tolerates_old_payloads():
+    from metrics_tpu.observability.aggregate import merge_payloads
+
+    new = {"process": 0, "ops_dispatch_totals": {"bincount|pallas": 3, "segment_sum|jnp": 1}}
+    newer = {"process": 1, "ops_dispatch_totals": {"bincount|pallas": 2}}
+    old = {"process": 2}  # pre-suite build: family absent, merges as identity
+    merged = merge_payloads([new, newer, old])
+    assert merged["ops_dispatch_totals"] == {"bincount|pallas": 5, "segment_sum|jnp": 1}

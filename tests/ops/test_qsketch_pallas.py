@@ -1,0 +1,183 @@
+"""Fused qsketch compaction kernel vs the jnp reference path.
+
+The parity tiers the module advertises (ops/qsketch_pallas.py docstring):
+
+* integer-valued keys/weights — prefix sums and centroid moments are
+  order-independent-exact in f32, so sorted order, bucket ids, and merged
+  rows are BIT-identical to ``_compact_rows_jnp``;
+* arbitrary float keys — summation-order rounding can flip a bucket
+  boundary, so parity is pinned at the sketch level: element tolerance on
+  the compacted rows and quantile queries within the advertised
+  ``rank_error_bound``.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import ops
+from metrics_tpu.ops.qsketch_pallas import (
+    _qsketch_compact_pallas,
+    _qsketch_route,
+    qsketch_sort_bucket_tiled,
+)
+from metrics_tpu.sketches.quantile import (
+    _compact_rows_jnp,
+    qsketch_init,
+    qsketch_insert,
+    qsketch_merge,
+    qsketch_quantile,
+    qsketch_total_weight,
+    rank_error_bound,
+)
+
+
+def _int_rows(rng, n, n_occ, cols, weighted=False):
+    rows = np.zeros((n, cols), np.float32)
+    rows[:n_occ, 0] = rng.integers(1, 5, n_occ) if weighted else 1.0
+    rows[:n_occ, 1] = rng.integers(-500, 500, n_occ)
+    if cols > 2:
+        rows[:n_occ, 2:] = rng.integers(0, 3, (n_occ, cols - 2))
+    return jnp.asarray(rows)
+
+
+@pytest.mark.parametrize(
+    "cap,n,n_occ,cols",
+    [
+        (16, 33, 33, 2),  # minimum-ish capacity, just past overflow
+        (64, 128, 128, 3),  # power-of-two rows
+        (64, 777, 500, 4),  # ragged row count, unoccupied tail interleaved
+        (256, 512, 512, 2),
+    ],
+)
+def test_compact_interpret_bit_identical_on_integer_rows(cap, n, n_occ, cols):
+    rng = np.random.default_rng(cap + n + cols)
+    rows = _int_rows(rng, n, n_occ, cols, weighted=True)
+    want = _compact_rows_jnp(rows, cap)
+    got = _qsketch_compact_pallas(rows, cap, interpret=True)
+    assert jnp.array_equal(got, want)
+
+
+def test_compact_float_keys_within_tolerance():
+    rng = np.random.default_rng(0)
+    cap, n = 128, 256
+    rows = np.zeros((n, 3), np.float32)
+    rows[:, 0] = 1.0
+    rows[:, 1] = rng.standard_normal(n)
+    rows[:, 2] = rng.integers(0, 2, n)
+    rows = jnp.asarray(rows)
+    want = np.asarray(_compact_rows_jnp(rows, cap))
+    got = np.asarray(_qsketch_compact_pallas(rows, cap, interpret=True))
+    # same centroid count, same total mass, elementwise tolerance
+    assert (got[:, 0] > 0).sum() == (want[:, 0] > 0).sum()
+    np.testing.assert_allclose(got[:, 0].sum(), want[:, 0].sum(), rtol=1e-6)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_sort_bucket_stage_matches_lexsort():
+    """The bitonic network with the index tiebreak reproduces the stable
+    ``lexsort((arange, key))`` permutation exactly — duplicate keys
+    included."""
+    rng = np.random.default_rng(2)
+    n, cap = 96, 64
+    rows = np.zeros((n, 2), np.float32)
+    rows[:80, 0] = 1.0
+    rows[:80, 1] = rng.integers(0, 10, 80)  # heavy duplication
+    rows = jnp.asarray(rows)
+    wvals, bucket = qsketch_sort_bucket_tiled(rows, cap, interpret=True)
+    # reference: the jnp path's stable sort, then w and w*key columns
+    key = np.where(np.asarray(rows[:, 0]) > 0, np.asarray(rows[:, 1]), np.inf)
+    order = np.lexsort((np.arange(n), key))
+    srt = np.asarray(rows)[order]
+    want_w = srt[:, 0]
+    want_wkey = srt[:, 0] * srt[:, 1]
+    got = np.asarray(wvals)
+    assert got.shape[0] >= n  # padded to the next power of two
+    np.testing.assert_array_equal(got[:n, 0], want_w)
+    np.testing.assert_array_equal(got[:n, 1], want_wkey)
+    assert np.all(got[n:, 0] == 0)  # pads carry no weight
+    b = np.asarray(bucket)[:n]
+    assert np.all(np.diff(b) >= 0)  # k1 buckets non-decreasing in key order
+
+
+def test_insert_overflow_through_interpret_kernel_bit_identical():
+    """The real consumer path: qsketch_insert past capacity triggers
+    _absorb -> _compact_rows -> the dispatched kernel. Integer keys keep
+    both backends bit-identical through MULTIPLE compaction rounds, and
+    the dispatch-mode jit key must not let a stale jnp trace shadow the
+    forced interpret mode."""
+    rng = np.random.default_rng(4)
+    keys = [jnp.asarray(rng.integers(0, 1000, 40).astype(np.float32)) for _ in range(8)]
+    plain = qsketch_init(64)
+    for k in keys:
+        plain = qsketch_insert(plain, k)
+    with ops.forced_backend("interpret"):
+        forced = qsketch_init(64)
+        for k in keys:
+            forced = qsketch_insert(forced, k)
+    assert jnp.array_equal(plain, forced)
+    assert float(qsketch_total_weight(forced)) == 8 * 40
+
+
+def test_merge_through_interpret_kernel_bit_identical():
+    rng = np.random.default_rng(5)
+    a = qsketch_insert(qsketch_init(32), jnp.asarray(rng.integers(0, 99, 32).astype(np.float32)))
+    b = qsketch_insert(qsketch_init(32), jnp.asarray(rng.integers(0, 99, 32).astype(np.float32)))
+    want = qsketch_merge(a, b)
+    with ops.forced_backend("interpret"):
+        got = qsketch_merge(a, b)
+    assert jnp.array_equal(got, want)
+
+
+def test_float_stream_quantiles_within_advertised_bound():
+    """Adversarial float stream: per-row structure may differ across
+    backends at bucket boundaries, but quantile queries must agree within
+    the advertised rank-error envelope."""
+    rng = np.random.default_rng(6)
+    cap, total = 64, 640
+    stream = rng.standard_normal(total).astype(np.float32)
+    plain = qsketch_init(cap)
+    with ops.forced_backend("interpret"):
+        forced = qsketch_init(cap)
+        for lo in range(0, total, 40):
+            forced = qsketch_insert(forced, jnp.asarray(stream[lo : lo + 40]))
+    for lo in range(0, total, 40):
+        plain = qsketch_insert(plain, jnp.asarray(stream[lo : lo + 40]))
+    qs = jnp.asarray([0.05, 0.25, 0.5, 0.75, 0.95])
+    pv = np.asarray(qsketch_quantile(plain, qs))
+    fv = np.asarray(qsketch_quantile(forced, qs))
+    srt = np.sort(stream)
+    bound = rank_error_bound(total, cap)
+    for backend_vals in (pv, fv):
+        for q, v in zip(np.asarray(qs), backend_vals):
+            true_rank = np.searchsorted(srt, v)
+            assert abs(true_rank - q * total) <= bound + 1
+
+
+def test_route_bounds():
+    small = jnp.zeros((128, 3), jnp.float32)
+    big = jnp.zeros((1 << 16, 3), jnp.float32)
+    ok = jnp.zeros((4096, 3), jnp.float32)
+    wide = jnp.zeros((4096, 32), jnp.float32)
+    assert not _qsketch_route(small, 64)  # below the win floor
+    assert not _qsketch_route(big, 8192)  # past the VMEM budget
+    assert not _qsketch_route(wide, 2048)  # too many payload columns
+    assert _qsketch_route(ok, 2048)
+
+
+def test_windowed_sketch_leaves_compose_through_dispatch():
+    """Ring-of-sketches composition (the WindowedMetric + telemetry
+    shape): per-slot sketches that compact under the forced interpret
+    kernel fold to the same result as the jnp path."""
+    rng = np.random.default_rng(7)
+    slots_data = [rng.integers(0, 50, 48).astype(np.float32) for _ in range(4)]
+    plain_slots = [qsketch_insert(qsketch_init(32), jnp.asarray(d)) for d in slots_data]
+    plain = plain_slots[0]
+    for s in plain_slots[1:]:
+        plain = qsketch_merge(plain, s)
+    with ops.forced_backend("interpret"):
+        forced_slots = [qsketch_insert(qsketch_init(32), jnp.asarray(d)) for d in slots_data]
+        forced = forced_slots[0]
+        for s in forced_slots[1:]:
+            forced = qsketch_merge(forced, s)
+    assert jnp.array_equal(plain, forced)

@@ -1,0 +1,243 @@
+"""Fused bincount / segment-scatter kernel vs the jnp path.
+
+Interpret mode runs the REAL kernel body on CPU (the ``tests/ops/``
+convention from test_box_iou_pallas.py). Integer-valued data makes every
+f32 partial sum exact, so those cases pin BIT-identical agreement; the
+composition cases drive the kernels through the same entry points the
+metrics use (``_bincount``, ``SlicedMetric._update``, the fused collection
+dispatch)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import ops
+from metrics_tpu.ops.scatter_pallas import segment_sum_tiled
+from metrics_tpu.utils.data import _bincount
+
+
+@pytest.mark.parametrize(
+    "b,d,s",
+    [(1, 1, 1), (300, 3, 40), (512, 1, 128), (1024, 130, 7), (2048, 5, 1000)],
+)
+def test_segment_sum_interpret_bit_identical(b, d, s):
+    """Ragged/padded tails included: B, D, S all off the tile multiples."""
+    rng = np.random.default_rng(b * 31 + d * 7 + s)
+    vals = jnp.asarray(rng.integers(-9, 9, (b, d)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, s, b), jnp.int32)
+    got = segment_sum_tiled(vals, ids, s, interpret=True)
+    want = jax.ops.segment_sum(vals, ids, num_segments=s)
+    assert got.shape == (s, d)
+    assert jnp.array_equal(got, want)
+
+
+def test_segment_sum_1d_vals_keep_rank():
+    vals = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    ids = jnp.asarray([0, 1, 0, 2], jnp.int32)
+    got = segment_sum_tiled(vals, ids, 3, interpret=True)
+    assert got.shape == (3,)
+    assert jnp.array_equal(got, jnp.asarray([4.0, 2.0, 4.0]))
+
+
+def test_segment_sum_drops_negative_and_oob_ids():
+    """jax.ops.segment_sum's documented semantics: ids outside
+    [0, num_segments) contribute nothing — on BOTH backends."""
+    vals = jnp.ones((6,), jnp.float32)
+    ids = jnp.asarray([-3, -1, 0, 1, 4, 99], jnp.int32)
+    want = jax.ops.segment_sum(vals, ids, num_segments=4)
+    got = segment_sum_tiled(vals, ids, 4, interpret=True)
+    assert jnp.array_equal(got, want)
+    assert jnp.array_equal(got, jnp.asarray([1.0, 1.0, 0.0, 0.0]))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32, jnp.bfloat16, jnp.int8])
+def test_segment_sum_dispatch_preserves_dtype(dtype):
+    """Small-integer data: exact in every listed dtype's f32 image, so the
+    cast-back matches the fallback bit for bit."""
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(rng.integers(0, 4, (400, 2)), dtype)
+    ids = jnp.asarray(rng.integers(0, 25, 400), jnp.int32)
+    with ops.forced_backend("interpret"):
+        got = ops.segment_sum_dispatch(vals, ids, 25)
+    want = jax.ops.segment_sum(vals, ids, num_segments=25)
+    assert got.dtype == want.dtype == jnp.dtype(dtype)
+    assert jnp.array_equal(got, want)
+
+
+def test_segment_sum_dispatch_flattens_trailing_dims():
+    rng = np.random.default_rng(5)
+    vals = jnp.asarray(rng.integers(0, 4, (128, 3, 5)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 11, 128), jnp.int32)
+    with ops.forced_backend("interpret"):
+        got = ops.segment_sum_dispatch(vals, ids, 11)
+    want = jax.ops.segment_sum(vals, ids, num_segments=11)
+    assert got.shape == (11, 3, 5)
+    assert jnp.array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# bincount: hardening + parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,length", [(1, 1), (700, 13), (4096, 1000), (5000, 10000)])
+def test_bincount_interpret_bit_identical(n, length):
+    rng = np.random.default_rng(n + length)
+    x = jnp.asarray(rng.integers(0, length, n), jnp.int32)
+    want = jnp.bincount(x, length=length)
+    with ops.forced_backend("interpret"):
+        got = ops.bincount_dispatch(x, length)
+    assert got.dtype == want.dtype
+    assert jnp.array_equal(got, want)
+
+
+def test_bincount_positive_path_via_data_helper():
+    x = jnp.asarray([0, 2, 2, 5, 1], jnp.int32)
+    assert jnp.array_equal(_bincount(x, minlength=6), jnp.asarray([1, 1, 2, 0, 0, 1]))
+
+
+def test_bincount_negative_host_values_raise():
+    """Host-resident indices (numpy / Python sequences) are validated for
+    free — no device round-trip."""
+    with pytest.raises(ValueError, match="non-negative"):
+        _bincount(np.asarray([0, -1, 2], np.int32), minlength=3)
+    with pytest.raises(ValueError, match="non-negative"):
+        ops.bincount_dispatch([0, -1, 2], 3)
+
+
+def test_bincount_narrow_dtype_sentinel_cannot_wrap():
+    """int8/int16 indices promote to int32 before the drop mask: in int8
+    the minlength sentinel (e.g. 300) would wrap to 44 — a VALID bin —
+    silently re-crediting the masked negatives."""
+
+    @jax.jit  # traced: the drop-mask path
+    def f(x):
+        return ops.bincount_dispatch(x, 300)
+
+    got = f(jnp.asarray([-1, -1, 0, 44], jnp.int8))
+    want = jnp.zeros(300, got.dtype).at[0].set(1).at[44].set(1)
+    assert jnp.array_equal(got, want)
+
+
+def test_bincount_device_negatives_drop_without_sync():
+    """Device arrays are NOT pulled back to host for validation (that
+    blocking sync would serialize every eager classification update);
+    negatives deterministically DROP instead — same fate as too-large
+    ids, never raw scatter's silent bin-0 clip."""
+    got = ops.bincount_dispatch(jnp.asarray([0, -1, 2], jnp.int32), 3)
+    assert jnp.array_equal(got, jnp.asarray([1, 0, 1]))
+
+
+def test_bincount_float_dtype_raises():
+    with pytest.raises(TypeError, match="integer-typed"):
+        ops.bincount_dispatch(jnp.asarray([0.5, 1.0]), 3)
+
+
+@pytest.mark.parametrize("bad", [0, -1, 2.0, None, True])
+def test_bincount_minlength_validated(bad):
+    with pytest.raises(ValueError, match="minlength"):
+        ops.bincount_dispatch(jnp.asarray([0, 1], jnp.int32), bad)
+
+
+def test_bincount_traced_negatives_drop_not_clip():
+    """Under a trace values cannot be inspected; negatives must be DROPPED
+    (the fate of too-large ids), never silently clipped into bin 0 the way
+    raw XLA scatter would credit them."""
+
+    @jax.jit
+    def f(x):
+        return ops.bincount_dispatch(x, 3)
+
+    got = f(jnp.asarray([-1, -7, 0, 2], jnp.int32))
+    assert jnp.array_equal(got, jnp.asarray([1, 0, 1]))
+    # raw jnp.bincount clips the two negatives into bin 0 — the hazard
+    raw = jnp.bincount(jnp.asarray([-1, -7, 0, 2], jnp.int32), length=3)
+    assert jnp.array_equal(raw, jnp.asarray([3, 0, 1]))
+
+
+def test_bincount_traced_negatives_drop_in_interpret_too():
+    @jax.jit
+    def f(x):
+        return ops.bincount_dispatch(x, 3)
+
+    with ops.forced_backend("interpret"):
+        got = f(jnp.asarray([-1, -7, 0, 2], jnp.int32))
+    assert jnp.array_equal(got, jnp.asarray([1, 0, 1]))
+
+
+# ---------------------------------------------------------------------------
+# composition: the metric entry points that ride the dispatched ops
+# ---------------------------------------------------------------------------
+
+
+def test_confusion_matrix_through_interpret_kernel():
+    from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix
+
+    rng = np.random.default_rng(11)
+    preds = jnp.asarray(rng.integers(0, 7, 500), jnp.int32)
+    target = jnp.asarray(rng.integers(0, 7, 500), jnp.int32)
+    want = confusion_matrix(preds, target, num_classes=7)
+    with ops.forced_backend("interpret"):
+        got = confusion_matrix(preds, target, num_classes=7)
+    assert jnp.array_equal(got, want)
+
+
+def test_sliced_scatter_through_interpret_kernel():
+    """SlicedMetric's per-leaf scatter (sum leaves + the row counter)
+    through the real kernel body: integer-valued data, states bit-equal."""
+    from metrics_tpu.regression import MeanSquaredError
+    from metrics_tpu.sliced import SlicedMetric
+
+    rng = np.random.default_rng(13)
+    ids = jnp.asarray(rng.integers(0, 50, 600), jnp.int32)
+    preds = jnp.asarray(rng.integers(0, 6, 600).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 6, 600).astype(np.float32))
+
+    plain = SlicedMetric(MeanSquaredError(), num_slices=50)
+    plain.update(ids, preds, target)
+    forced = SlicedMetric(MeanSquaredError(), num_slices=50)
+    with ops.forced_backend("interpret"):
+        forced.update(ids, preds, target)
+    for leaf in ("sum_squared_error", "total", "_slice_rows"):
+        assert jnp.array_equal(getattr(plain, leaf), getattr(forced, leaf)), leaf
+
+
+def test_fused_sliced_composition_matches_eager():
+    """The dispatched ops inside a compiled fused collection (tracers at
+    the dispatch boundary: the concrete-value validation must skip, the
+    routing must resolve at trace time) — final states equal the eager
+    per-metric path."""
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.classification import ConfusionMatrix
+    from metrics_tpu.regression import MeanSquaredError
+    from metrics_tpu.sliced import SlicedMetric
+
+    rng = np.random.default_rng(17)
+    batches = [
+        (
+            jnp.asarray(rng.integers(0, 10, 256), jnp.int32),
+            jnp.asarray(rng.integers(0, 4, 256), jnp.int32),
+        )
+        for _ in range(3)
+    ]
+
+    fused = MetricCollection({"cm": ConfusionMatrix(num_classes=4)})
+    fused.compile_update()
+    eager = ConfusionMatrix(num_classes=4)
+    for ids, labels in batches:
+        fused.update(labels, labels)
+        eager.update(labels, labels)
+    assert jnp.array_equal(fused["cm"].confmat, eager.confmat)
+
+    sliced = SlicedMetric(MeanSquaredError(), num_slices=10)
+    ref = [MeanSquaredError() for _ in range(10)]
+    for ids, labels in batches:
+        vals = labels.astype(jnp.float32)
+        sliced.update(ids, vals, vals * 0)
+        ids_np = np.asarray(ids)
+        for i in np.unique(ids_np):
+            m = ids_np == i
+            ref[int(i)].update(vals[m], (vals * 0)[m])
+    stacked = jnp.stack([jnp.asarray(r.sum_squared_error) for r in ref])
+    assert jnp.array_equal(sliced.sum_squared_error, stacked)
