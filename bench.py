@@ -271,11 +271,20 @@ def bench_map() -> None:
 
 
 def bench_retrieval() -> None:
-    """queries/sec through an NDCG+MAP MetricCollection update+compute
-    (BASELINE config 4, MSLR-WEB30K-shaped: many queries, ~40-200 candidate
-    docs each). Both sides use their MetricCollection so both get their own
-    state-sharing machinery (compute groups); ours additionally shares the
-    device pack across the group's metrics (pack_queries_cached)."""
+    """Retrieval throughput, two records.
+
+    1. ``mslr_shaped_ndcg_map_throughput`` — the historical config-4 record
+       (MSLR-WEB30K-shaped, ``exact=True`` cat-state + the packed device
+       compute path), kept on the exact mode so the number stays
+       comparable across rounds.
+    2. ``fused_retrieval_throughput`` — the ISSUE 15 gate record: the
+       fixed-capacity table default through ``compile_update`` at 10k
+       queries across 3 ragged shapes, against the eager per-query group
+       loop (the reference's dict-loop shape). AUX fields gate the >= 5x
+       acceptance floor and the one-compile anchor; BOOLs pin in-window
+       bit parity (dyadic-valued metric: exact by construction) and the
+       top-k / segment-extremum kernels' interpret-mode parity.
+    """
     import jax.numpy as jnp
     from metrics_tpu import MetricCollection
     from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
@@ -302,7 +311,13 @@ def bench_retrieval() -> None:
     ]
 
     def run_once(j_idx, j_preds, j_target):
-        col = MetricCollection([RetrievalNormalizedDCG(), RetrievalMAP()])
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            col = MetricCollection(
+                [RetrievalNormalizedDCG(exact=True), RetrievalMAP(exact=True)]
+            )
         col.update(j_preds, j_target, indexes=j_idx)
         # scalar readbacks so the timed region includes kernel completion
         return {k: float(v) for k, v in col.compute().items()}
@@ -347,7 +362,150 @@ def bench_retrieval() -> None:
                 "unit": "queries/sec",
                 "vs_baseline": round(ours / ref_qps, 3) if ref_qps else None,
             }
+        ),
+        flush=True,
+    )
+    _bench_fused_retrieval()
+
+
+def _bench_fused_retrieval() -> None:
+    """The ISSUE 15 acceptance record (see :func:`bench_retrieval`)."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.retrieval import RetrievalPrecision
+
+    rng = np.random.RandomState(15)
+    n_queries = 10_000
+    # 3 ragged shapes cycling through the stream (bucketing must absorb
+    # them in ONE compile); ~12 docs/query keeps the table in-window so
+    # the parity BOOL is exact
+    kw = dict(max_queries=1 << 14, max_docs=16, k=4)
+    counts = rng.randint(8, 16, n_queries)
+    idx = np.repeat(np.arange(n_queries), counts)
+    order = np.arange(len(idx))
+    preds = (rng.randint(0, 4096, len(idx)) / 4096.0).astype(np.float32)
+    target = (rng.rand(len(idx)) < 0.3).astype(np.int32)
+    shapes = (4096, 6144, 8192)
+    batches = []
+    lo = 0
+    si = 0
+    while lo < len(idx):
+        hi = min(lo + shapes[si % 3], len(idx))
+        batches.append(
+            (
+                jnp.asarray(preds[lo:hi]),
+                jnp.asarray(target[lo:hi]),
+                jnp.asarray(idx[lo:hi]),
+            )
         )
+        si += 1
+        lo = hi
+
+    # --- fused table side: update stream + compute, min-of-2 epochs ------
+    # ONE bucket absorbs all three ragged shapes -> exactly one compile
+    table_handle = {}
+
+    def fused_epoch():
+        m = MetricCollection([RetrievalPrecision(**kw)])
+        handle = m.compile_update(buckets=[max(shapes)])
+        for p, t, i in batches:
+            m.update(p, t, indexes=i)
+        val = float(m.compute()["RetrievalPrecision"])
+        table_handle["qtable"] = m["RetrievalPrecision"].qtable
+        return val, len(handle._cache)
+
+    fused_epoch()  # compile epoch (the cache is per-collection, rebuilt)
+    t0 = time.perf_counter()
+    fused_val, n_compiles = fused_epoch()
+    fused_wall = time.perf_counter() - t0
+    best = fused_wall
+    t0 = time.perf_counter()
+    fused_epoch()
+    best = min(best, time.perf_counter() - t0)
+    fused_qps = n_queries / best
+
+    # --- eager per-query group loop (the reference dict-loop shape) ------
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loop = RetrievalPrecision(exact=True, k=4)
+    for p, t, i in batches:
+        loop.update(p, t, indexes=i)
+    t0 = time.perf_counter()
+    loop_val = float(loop._compute_host_loop())
+    loop_wall = time.perf_counter() - t0
+    loop_qps = n_queries / loop_wall
+
+    # in-window bit parity at the STATE level: the table's unpacked padded
+    # layout must reproduce the exact path's device pack bit-for-bit
+    # (query order, doc order, masks, values). The final scalar is gated
+    # within a few f32 ulp — XLA lowers the one mean division differently
+    # per array shape (reciprocal-multiply vs true divide), which is the
+    # only tolerated divergence.
+    from metrics_tpu.functional.retrieval.padded import pack_queries
+    from metrics_tpu.retrieval.table import retrieval_table_layout
+
+    ep, et, em = pack_queries(
+        jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target)
+    )
+    tp_, tt_, tm_, trv, *_ = retrieval_table_layout(table_handle["qtable"])
+    rows = np.flatnonzero(np.asarray(trv))
+    dmax = ep.shape[1]
+    sl_p, sl_t, sl_m = (np.asarray(x)[rows][:, :dmax] for x in (tp_, tt_, tm_))
+    window_bit_exact = bool(
+        len(rows) == ep.shape[0]
+        and bool(np.array_equal(sl_m, np.asarray(em)))
+        and bool(np.array_equal(sl_p, np.asarray(ep), equal_nan=True))
+        and bool(np.array_equal(sl_t, np.asarray(et)))
+        and not np.asarray(tm_)[rows][:, dmax:].any()
+        and abs(fused_val - loop_val) <= 4 * np.finfo(np.float32).eps
+    )
+
+    # --- kernel parity BOOLs (real bodies, interpret mode) ---------------
+    tp = jnp.asarray(rng.randint(0, 64, (64, 256)).astype(np.float32) / 16.0)
+    tv = jnp.asarray((rng.rand(64, 256) < 0.8).astype(np.float32))
+    tt = jnp.asarray(rng.randint(0, 2, (64, 256)).astype(np.float32))
+    from metrics_tpu.ops.topk_pallas import _row_topk_jnp, row_topk_tiled
+
+    want = _row_topk_jnp(tp, tt, tv, 16)
+    got = row_topk_tiled(tp, tt, tv, 16, interpret=True)
+    topk_parity = all(bool(jnp.array_equal(a, b, equal_nan=True)) for a, b in zip(got, want))
+    from metrics_tpu.ops.scatter_pallas import segment_extremum_tiled
+
+    sv = jnp.asarray(rng.randn(1024, 4).astype(np.float32))
+    si_ = jnp.asarray(rng.randint(0, 200, 1024), jnp.int32)
+    smax_parity = bool(
+        jnp.array_equal(
+            segment_extremum_tiled(sv, si_, 200, is_max=True, interpret=True),
+            jax.ops.segment_max(sv, si_, num_segments=200),
+        )
+    )
+    smin_parity = bool(
+        jnp.array_equal(
+            segment_extremum_tiled(sv, si_, 200, is_max=False, interpret=True),
+            jax.ops.segment_min(sv, si_, num_segments=200),
+        )
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "fused_retrieval_throughput",
+                "value": round(fused_qps, 1),
+                "unit": "queries/sec",
+                "eager_group_loop_qps": round(loop_qps, 1),
+                "retrieval_fused_vs_eager": round(fused_qps / loop_qps, 2),
+                "retrieval_fused_compiles": n_compiles,
+                "bucketed_shapes": 3,
+                "retrieval_window_bit_exact": window_bit_exact,
+                "ops_row_topk_parity": topk_parity,
+                "ops_segment_max_parity": smax_parity,
+                "ops_segment_min_parity": smin_parity,
+            }
+        ),
+        flush=True,
     )
 
 
@@ -1592,6 +1750,96 @@ def bench_ops() -> None:
     )
 
 
+def bench_ops_ab() -> None:
+    """Route-floor A/B sweep for bincount / qsketch_compact (ROADMAP item
+    1's open tuning note; the BASELINE.md "bincount/qsketch A/B" table).
+
+    For a grid of sizes straddling each op's route floors, emits per cell:
+    the ROUTE DECISION a TPU backend would take (the host-static
+    predicate, evaluated directly — no hardware needed), the measured jnp
+    fallback wall, and the dispatched wall on THIS backend. On the CPU CI
+    box both walls resolve to the same jnp kernel, so their ratio
+    isolates the dispatch-layer tax per size; on a TPU box the same
+    sweep's dispatched column becomes the Pallas side and the table is
+    the floor-tuning instrument. One JSON record; the human-readable
+    table lands in BASELINE.md.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import ops
+    from metrics_tpu.ops.scatter_pallas import _bincount_route
+    from metrics_tpu.ops.qsketch_pallas import _qsketch_route
+    from metrics_tpu.sketches.quantile import _compact_rows_jnp
+
+    rng = np.random.RandomState(16)
+
+    def best_of(fn, *args, reps=5, inner=4):
+        fn(*args)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / inner)
+        return best
+
+    cells = []
+    # --- bincount: sweep batch across the b >= 256 floor and the segment
+    # count across the num_segments >= 64 floor
+    for n in (128, 256, 1 << 12, 1 << 16, 1 << 20):
+        for c in (32, 64, 4096, 1_000_000):
+            x = jnp.asarray(rng.randint(0, c, n), jnp.int32)
+            t_disp = best_of(lambda a: ops.bincount_dispatch(a, c), x)
+            t_jnp = best_of(lambda a: jnp.bincount(a, length=c), x)
+            cells.append(
+                {
+                    "op": "bincount",
+                    "n": n,
+                    "segments": c,
+                    "tpu_route": "pallas" if _bincount_route(x, c) else "jnp",
+                    "jnp_us": round(t_jnp * 1e6, 1),
+                    "dispatched_us": round(t_disp * 1e6, 1),
+                    "overhead_ratio": round(t_disp / t_jnp, 3),
+                }
+            )
+    # --- qsketch_compact: sweep row count across the 2**10..2**15 window
+    for cap in (256, 1024, 8192, 1 << 15):
+        n = cap * 2
+        rows = np.zeros((n, 3), np.float32)
+        rows[:, 0] = 1.0
+        rows[:, 1] = rng.randint(0, 100_000, n)
+        rows[:, 2] = rng.randint(0, 2, n)
+        rows = jnp.asarray(rows)
+        t_disp = best_of(lambda r: ops.qsketch_compact_dispatch(r, cap), rows, reps=3, inner=2)
+        t_jnp = best_of(lambda r: _compact_rows_jnp(r, cap), rows, reps=3, inner=2)
+        cells.append(
+            {
+                "op": "qsketch_compact",
+                "n": n,
+                "segments": cap,
+                "tpu_route": "pallas" if _qsketch_route(rows, cap) else "jnp",
+                "jnp_us": round(t_jnp * 1e6, 1),
+                "dispatched_us": round(t_disp * 1e6, 1),
+                "overhead_ratio": round(t_disp / t_jnp, 3),
+            }
+        )
+
+    worst = max(c["overhead_ratio"] for c in cells)
+    print(
+        json.dumps(
+            {
+                "metric": "ops_route_floor_ab",
+                "value": round(worst, 3),
+                "unit": "ratio",
+                "backend": jax.default_backend(),
+                "cells": cells,
+            }
+        )
+    )
+
+
 def bench_telemetry() -> None:
     """Micro-bench for the telemetry zero-overhead-when-disabled contract:
     per-call wall cost of ``Metric.update`` with the recorder disabled vs
@@ -1706,6 +1954,7 @@ SUBCOMMANDS = {
     "windowed": bench_windowed,
     "collector": bench_collector,
     "ops": bench_ops,
+    "ops_ab": bench_ops_ab,
 }
 
 
@@ -1788,7 +2037,7 @@ def main() -> None:
     import subprocess
 
     records = []  # every emitted JSON object, for the --baseline check
-    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry", "ops"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry", "ops", "ops_ab"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],

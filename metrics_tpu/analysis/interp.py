@@ -155,6 +155,7 @@ _DISPATCHED_OPS = {
     "segment_max_dispatch",
     "segment_min_dispatch",
     "qsketch_compact_dispatch",
+    "row_topk_dispatch",
     "box_iou_dispatch",
 }
 
@@ -1397,9 +1398,15 @@ _CONTAINER_UNKNOWN = "unknown"
 #: jnp constructors whose first argument is the shape
 _SHAPED_CTORS = {"zeros", "ones", "empty", "full"}
 
-#: metrics_tpu/sketches/ state initializers: fixed-shape float32 leaves
-#: with the capacity as the leading dim
-_SKETCH_INIT_CTORS = {"qsketch_init", "ranksketch_init", "reservoir_init", "hist_init"}
+#: metrics_tpu/sketches/ (and retrieval-table) state initializers:
+#: fixed-shape float32 leaves with the capacity as the leading dim
+_SKETCH_INIT_CTORS = {
+    "qsketch_init",
+    "ranksketch_init",
+    "reservoir_init",
+    "hist_init",
+    "retrieval_table_init",
+}
 
 _DTYPE_DEFAULTS = {"zeros": "float32", "ones": "float32", "empty": "float32", "full": None}
 

@@ -336,46 +336,79 @@ def sorted_row_layout(
 
 
 @functools.lru_cache(maxsize=None)
-def _padded_compute_fn(kernel: Callable, k: Optional[int], empty_target_action: str):
+def _padded_compute_fn(
+    kernel: Callable, k: Optional[int], empty_target_action: str, weighted: bool = False
+):
     """One jitted function: vmapped per-query SORTED kernel + empty policy +
     mean, over the shared sorted layout. Kernels that consume the ideal
     ranking (NDCG) derive it INSIDE this jit from the raw padded target —
     lazy for the seven kernels that never read it, and no extra device
-    launch for the one that does."""
+    launch for the one that does.
+
+    ``weighted=True`` is the fixed-capacity table-state entry
+    (retrieval/base.py::_compute_table): the padded layout has a STATIC
+    ``max_queries`` row count, so the run function takes an extra
+    per-row weight vector (0 for unoccupied rows) that multiplies into
+    the empty-policy mean. The unweighted exact-path signature is kept
+    verbatim — its jitted cache entries and bit behavior are untouched."""
     sorted_fn = getattr(kernel, "sorted_fn", None)
+    needs_ideal = getattr(sorted_fn, "needs_ideal", False)
 
-    if getattr(sorted_fn, "needs_ideal", False):
-
-        @jax.jit
-        def run(st: Array, sm: Array, padded_target: Array, empty: Array) -> Array:
+    def _body(st: Array, sm: Array, padded_target: Array, empty: Array, row_w) -> Array:
+        if needs_ideal:
             ideal = -jnp.sort(-padded_target, axis=-1)
             vals = jax.vmap(lambda a, b, c: sorted_fn(a, b, c, k))(st, sm, ideal)
-            return _reduce_with_empty_policy(vals, empty, empty_target_action)
+        else:
+            vals = jax.vmap(lambda a, b: sorted_fn(a, b, a, k))(st, sm)
+        return _reduce_with_empty_policy(vals, empty, empty_target_action, row_w)
+
+    if weighted:
+
+        @jax.jit
+        def run(st: Array, sm: Array, padded_target: Array, empty: Array, row_w: Array) -> Array:
+            return _body(st, sm, padded_target, empty, row_w)
 
     else:
 
         @jax.jit
-        def run(st: Array, sm: Array, _unused: Array, empty: Array) -> Array:
-            vals = jax.vmap(lambda a, b: sorted_fn(a, b, a, k))(st, sm)
-            return _reduce_with_empty_policy(vals, empty, empty_target_action)
+        def run(st: Array, sm: Array, padded_target: Array, empty: Array) -> Array:
+            return _body(st, sm, padded_target, empty, None)
 
     return run
 
 
 @functools.lru_cache(maxsize=None)
-def _padded_compute_fn_raw(kernel: Callable, k: Optional[int], empty_target_action: str):
+def _padded_compute_fn_raw(
+    kernel: Callable, k: Optional[int], empty_target_action: str, weighted: bool = False
+):
     """Legacy path for user-supplied row kernels without a sorted variant:
-    vmapped raw kernel over the padded buffers."""
+    vmapped raw kernel over the padded buffers (``weighted`` as above)."""
 
-    @jax.jit
-    def run(padded_preds: Array, padded_target: Array, mask: Array, empty: Array) -> Array:
+    def _body(padded_preds: Array, padded_target: Array, mask: Array, empty: Array, row_w) -> Array:
         vals = jax.vmap(lambda p, t, m: kernel(p, t, m, k))(padded_preds, padded_target, mask)
-        return _reduce_with_empty_policy(vals, empty, empty_target_action)
+        return _reduce_with_empty_policy(vals, empty, empty_target_action, row_w)
+
+    if weighted:
+
+        @jax.jit
+        def run(padded_preds: Array, padded_target: Array, mask: Array, empty: Array, row_w: Array) -> Array:
+            return _body(padded_preds, padded_target, mask, empty, row_w)
+
+    else:
+
+        @jax.jit
+        def run(padded_preds: Array, padded_target: Array, mask: Array, empty: Array) -> Array:
+            return _body(padded_preds, padded_target, mask, empty, None)
 
     return run
 
 
-def _reduce_with_empty_policy(vals: Array, empty: Array, empty_target_action: str) -> Array:
+def _reduce_with_empty_policy(
+    vals: Array, empty: Array, empty_target_action: str, row_valid: Optional[Array] = None
+) -> Array:
+    """Empty-query policy + mean. ``row_valid`` (the table-state path)
+    zero-weights structurally absent rows — padding rows of the fixed
+    ``[max_queries]`` layout — before the policy weights apply."""
     if empty_target_action == "pos":
         vals = jnp.where(empty, 1.0, vals)
         weights = jnp.ones_like(vals)
@@ -386,5 +419,7 @@ def _reduce_with_empty_policy(vals: Array, empty: Array, empty_target_action: st
         weights = (~empty).astype(vals.dtype)
     else:  # "error" is raised host-side before this runs
         weights = jnp.ones_like(vals)
+    if row_valid is not None:
+        weights = weights * row_valid.astype(vals.dtype)
     total = jnp.sum(weights)
     return jnp.where(total > 0, jnp.sum(vals * weights) / jnp.maximum(total, 1.0), 0.0)

@@ -9,9 +9,11 @@ on the telemetry recorder (``metrics_tpu_ops_dispatch_total``).
 
 Registered ops: ``box_iou`` (tiled pairwise/batched IoU), ``bincount`` /
 ``segment_sum`` (the tiled one-hot MXU scatter serving confusion-matrix
-metrics and the ``SlicedMetric`` slice axis; ``segment_max`` /
-``segment_min`` are jnp-only slots), and ``qsketch_compact`` (the fused
-sort->bucket->segment-merge t-digest compaction). See docs/ops_kernels.md.
+metrics and the ``SlicedMetric`` slice axis), ``segment_max`` /
+``segment_min`` (the masked-select extremum scatter), ``qsketch_compact``
+(the fused sort->bucket->segment-merge t-digest compaction), and
+``row_topk`` (the fused per-row top-k + payload gather behind the
+retrieval table's compaction and merge). See docs/ops_kernels.md.
 """
 from metrics_tpu.ops.dispatch import (  # noqa: F401
     NO_PALLAS_ENV,
@@ -26,6 +28,7 @@ from metrics_tpu.ops.dispatch import (  # noqa: F401
 )
 from metrics_tpu.ops.scatter_pallas import (  # noqa: F401
     bincount_dispatch,
+    segment_extremum_tiled,
     segment_max_dispatch,
     segment_min_dispatch,
     segment_sum_dispatch,
@@ -34,5 +37,9 @@ from metrics_tpu.ops.scatter_pallas import (  # noqa: F401
 from metrics_tpu.ops.qsketch_pallas import (  # noqa: F401
     qsketch_compact_dispatch,
     qsketch_sort_bucket_tiled,
+)
+from metrics_tpu.ops.topk_pallas import (  # noqa: F401
+    row_topk_dispatch,
+    row_topk_tiled,
 )
 from metrics_tpu.ops.box_iou_pallas import box_iou_dispatch, box_iou_tiled  # noqa: F401

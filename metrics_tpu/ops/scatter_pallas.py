@@ -30,9 +30,10 @@ past ``2**24`` would round silently where XLA's scatter is exact.
 Entry points: :func:`segment_sum_tiled` (the raw kernel wrapper),
 :func:`segment_sum_dispatch` / :func:`bincount_dispatch` (registry-routed,
 see :mod:`metrics_tpu.ops.dispatch`). ``segment_max`` / ``segment_min``
-register as jnp-only ops — extremum scatters have no measured Pallas win
-yet, but routing them through the registry counts their traffic and
-reserves the slot.
+fill their formerly jnp-only registry slots with a masked-select VPU
+kernel (:func:`segment_extremum_tiled`) behind the same f32 routing
+floors; extremum folds never round, so their kernel-vs-fallback parity is
+bit-exact on every input.
 """
 import functools
 from typing import Any, Union
@@ -177,19 +178,125 @@ register_kernel(
     jnp_fn=_segment_sum_jnp,
     route=_segment_route,
 )
+
+
+# ---------------------------------------------------------------------------
+# segment extremum kernels (the formerly jnp-only registry slots)
+# ---------------------------------------------------------------------------
+
+#: batch rows folded per extremum grid step: the [_TILE_BE, _TILE_S, D]
+#: masked-select temporary is the kernel's VMEM high-water mark, so the
+#: batch tile stays one sublane group
+_TILE_BE = 8
+
+
+def _make_segment_ext_kernel(is_max: bool):
+    fill = -jnp.inf if is_max else jnp.inf
+    combine = jnp.maximum if is_max else jnp.minimum
+
+    def kernel(ids_ref, vals_ref, out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            out_ref[:, :] = jnp.full_like(out_ref, fill)
+
+        ids = ids_ref[0, :]  # [_TILE_BE] int32
+        seg = i * _TILE_S + jax.lax.broadcasted_iota(jnp.int32, (_TILE_BE, _TILE_S), 1)
+        onehot = ids[:, None] == seg  # [_TILE_BE, _TILE_S]
+        # masked select then fold the batch axis: unlike the sum kernel
+        # there is no matmul form for an extremum, so this is VPU work
+        # over a [_TILE_BE, _TILE_S, D] temporary
+        cand = jnp.where(onehot[:, :, None], vals_ref[:, :][:, None, :], fill)
+        out_ref[:, :] = combine(out_ref[:, :], cand.max(axis=0) if is_max else cand.min(axis=0))
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "is_max", "interpret"))
+def segment_extremum_tiled(
+    vals: ArrayLike, ids: ArrayLike, num_segments: int, is_max: bool, interpret: bool = False
+) -> Array:
+    """Segment-max/min ``[B, D] x [B] -> [num_segments, D]`` with the same
+    tiling scheme as :func:`segment_sum_tiled` (pad rows carry id ``-1``
+    and match no segment; empty segments hold the extremum identity —
+    exactly ``jax.ops.segment_max/min``'s fill). Extremum folds have no
+    rounding, so parity with the fallback is bit-exact for every input,
+    not just the integer window."""
+    fill = -jnp.inf if is_max else jnp.inf
+    vals = jnp.asarray(vals, jnp.float32)
+    squeeze = vals.ndim == 1
+    if squeeze:
+        vals = vals[:, None]
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    b, d = vals.shape
+    b_pad = -(-max(b, 1) // _TILE_BE) * _TILE_BE
+    d_pad = -(-max(d, 1) // 128) * 128
+    s_pad = -(-max(num_segments, 1) // _TILE_S) * _TILE_S
+
+    ids_p = jnp.full((1, b_pad), -1, jnp.int32).at[0, :b].set(ids)
+    vals_p = jnp.full((b_pad, d_pad), fill, jnp.float32).at[:b, :d].set(vals)
+
+    ms = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
+    out = pl.pallas_call(
+        _make_segment_ext_kernel(is_max),
+        out_shape=jax.ShapeDtypeStruct((s_pad, d_pad), jnp.float32),
+        grid=(s_pad // _TILE_S, b_pad // _TILE_BE),
+        in_specs=[
+            pl.BlockSpec((1, _TILE_BE), lambda i, j: (0, j), **ms),
+            pl.BlockSpec((_TILE_BE, d_pad), lambda i, j: (j, 0), **ms),
+        ],
+        out_specs=pl.BlockSpec((_TILE_S, d_pad), lambda i, j: (i, 0), **ms),
+        interpret=interpret,
+    )(ids_p, vals_p)
+    out = out[:num_segments, :d]
+    return out[:, 0] if squeeze else out
+
+
+def _segment_ext_route(vals: Any, ids: Array, num_segments: int) -> bool:
+    """The segment-sum route's f32-only floors verbatim, minus the 2**24
+    exactness cap (an extremum never rounds) and with a tighter feature
+    bound (the masked-select temporary scales with D). The kernel handles
+    rank 1-2 only; the dispatch wrappers flatten ND values first, but a
+    direct ``dispatch()`` caller with ND values must take the fallback."""
+    b = ids.shape[0]
+    d = 1 if len(vals.shape) == 1 else vals.shape[1]
+    return (
+        len(vals.shape) <= 2
+        and _route_dtype_ok(vals.dtype)
+        and b >= 256
+        and num_segments >= 64
+        and -(-max(d, 1) // 128) * 128 <= 256
+        and b * (-(-num_segments // _TILE_S) * _TILE_S) * max(d, 1) <= 1 << 36
+    )
+
+
+def _segment_max_pallas(vals, ids, num_segments, interpret=False):
+    out = segment_extremum_tiled(vals, ids, num_segments, is_max=True, interpret=interpret)
+    return out.astype(jnp.asarray(vals).dtype)
+
+
+def _segment_min_pallas(vals, ids, num_segments, interpret=False):
+    out = segment_extremum_tiled(vals, ids, num_segments, is_max=False, interpret=interpret)
+    return out.astype(jnp.asarray(vals).dtype)
+
+
 register_kernel(
     "segment_max",
-    pallas_fn=None,
+    pallas_fn=_segment_max_pallas,
     jnp_fn=lambda vals, ids, num_segments: jax.ops.segment_max(
         vals, ids, num_segments=num_segments
     ),
+    route=_segment_ext_route,
 )
 register_kernel(
     "segment_min",
-    pallas_fn=None,
+    pallas_fn=_segment_min_pallas,
     jnp_fn=lambda vals, ids, num_segments: jax.ops.segment_min(
         vals, ids, num_segments=num_segments
     ),
+    route=_segment_ext_route,
 )
 
 
@@ -209,15 +316,30 @@ def segment_sum_dispatch(vals: ArrayLike, ids: ArrayLike, num_segments: int) -> 
     return out
 
 
+def _segment_ext_dispatch(name: str, vals: ArrayLike, ids: ArrayLike, num_segments: int) -> Array:
+    # trailing dims flatten through the 2-D kernel and restore — exact for
+    # an elementwise extremum (the segment_sum_dispatch contract)
+    vals = jnp.asarray(vals)
+    ids = jnp.asarray(ids)
+    lead = vals.shape[0] if vals.ndim else 0
+    flat = vals.reshape(lead, -1) if vals.ndim > 2 else vals
+    out = dispatch(name, flat, ids, num_segments)
+    if vals.ndim > 2:
+        out = out.reshape((num_segments,) + vals.shape[1:])
+    return out
+
+
 def segment_max_dispatch(vals: ArrayLike, ids: ArrayLike, num_segments: int) -> Array:
-    """Registry-routed segment-max (jnp-only today; empty segments fill
-    with the dtype minimum — the extremum identity)."""
-    return dispatch("segment_max", jnp.asarray(vals), jnp.asarray(ids), num_segments)
+    """Registry-routed segment-max over the LEADING axis (the masked-select
+    Pallas kernel on TPU inside the f32 route floors, ``jax.ops.segment_max``
+    elsewhere; trailing dims flatten through the kernel and restore; empty
+    segments fill with the extremum identity on both paths)."""
+    return _segment_ext_dispatch("segment_max", vals, ids, num_segments)
 
 
 def segment_min_dispatch(vals: ArrayLike, ids: ArrayLike, num_segments: int) -> Array:
-    """Registry-routed segment-min (jnp-only today)."""
-    return dispatch("segment_min", jnp.asarray(vals), jnp.asarray(ids), num_segments)
+    """Registry-routed segment-min (see :func:`segment_max_dispatch`)."""
+    return _segment_ext_dispatch("segment_min", vals, ids, num_segments)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,216 @@
+"""Pallas TPU kernel: fused per-row top-k + payload gather.
+
+The retrieval table's hot path — overflow compaction and cross-rank
+merges (``retrieval/table.py``) — selects each query row's top-``k``
+documents by score and carries the target (and validity) payloads through
+the permutation. XLA lowers ``lax.top_k`` + two ``take_along_axis``
+gathers as separate HBM round-trips; this kernel keeps the whole
+select-and-gather resident in VMEM:
+
+* **Sort** — a row-parallel bitonic compare-exchange network over the
+  padded power-of-two column count (pure reshape + ``where`` stages, the
+  same machinery as the qsketch compaction kernel's sort, lifted to a
+  leading row-tile axis). Each element carries its column index as a
+  tiebreak, so the output order is EXACTLY the fallback's stable
+  descending sort — bitonic networks are not stable, but the index
+  tiebreak makes every composite key distinct.
+* **Gather** — the target and validity payloads ride the same
+  compare-exchange swaps; no index materialization, no second pass.
+
+Invalid slots sort last (their key is ``-inf``); valid scores are clipped
+to the finite f32 range by the CALLER (``retrieval/table.py``) so a real
+document always beats an empty slot.
+
+Parity contract (pinned in ``tests/ops/test_topk_pallas.py``): the
+kernel's (keys, payload, validity) triple is BIT-identical to the jnp
+fallback (`stable_sort_with_payloads` descending + slice) for every
+input — selection and permutation are value-exact operations, so unlike
+the segment-sum kernel there is no summation-order caveat.
+"""
+import functools
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops.dispatch import dispatch, register_kernel
+
+try:  # TPU-specific memory spaces; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    _VMEM = None
+
+Array = jax.Array
+ArrayLike = Union[Array, np.ndarray]
+
+#: rows sorted per grid step (sublane-aligned)
+_TILE_R = 8
+#: widest padded column count the network accepts: 4 resident
+#: [_TILE_R, n_pad] f32 buffers plus swap temporaries stay well under the
+#: VMEM budget, and the unrolled network depth stays compile-friendly
+_MAX_SORT_COLS = 1 << 11
+#: below this the sort is too small for a kernel launch to matter
+_MIN_SORT_COLS = 1 << 7
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _row_bitonic_desc(key: Array, idx: Array, payloads, n_pad: int):
+    """Descending row-parallel bitonic network on composite
+    ``(key desc, idx asc)``; every array in ``payloads`` rides the swaps.
+    ``key``/``idx``/payloads are ``[rows, n_pad]``. Static Python loops —
+    the network fully unrolls at trace time."""
+    rows = key.shape[0]
+    payloads = list(payloads)
+    k = 2
+    while k <= n_pad:
+        j = k // 2
+        while j >= 1:
+            m = n_pad // (2 * j)
+
+            def _r(x):
+                return x.reshape(rows, m, 2, j)
+
+            kr, ir = _r(key), _r(idx)
+            klo, khi = kr[:, :, 0, :], kr[:, :, 1, :]
+            ilo, ihi = ir[:, :, 0, :], ir[:, :, 1, :]
+            # descending by key, ascending index on ties
+            lt = (klo < khi) | ((klo == khi) & (ilo > ihi))
+            gt = (klo > khi) | ((klo == khi) & (ilo < ihi))
+            blk = jax.lax.broadcasted_iota(jnp.int32, (1, m, 1), 1)
+            desc = ((blk * (2 * j)) & k) == 0
+            swap = jnp.where(desc, lt, gt)  # [1|rows, m, j]
+
+            def _apply(x):
+                xr = _r(x)
+                xlo, xhi = xr[:, :, 0, :], xr[:, :, 1, :]
+                return jnp.stack(
+                    [jnp.where(swap, xhi, xlo), jnp.where(swap, xlo, xhi)], axis=2
+                ).reshape(rows, n_pad)
+
+            key = _apply(key)
+            idx = _apply(idx)
+            payloads = [_apply(p) for p in payloads]
+            j //= 2
+        k *= 2
+    return key, payloads
+
+
+def _make_topk_kernel(n_pad: int):
+    def kernel(keys_ref, pay_ref, val_ref, out_k_ref, out_p_ref, out_v_ref):
+        keys = keys_ref[:, :]
+        idx = jax.lax.broadcasted_iota(jnp.float32, keys.shape, 1)
+        skey, (spay, sval) = _row_bitonic_desc(
+            keys, idx, (pay_ref[:, :], val_ref[:, :]), n_pad
+        )
+        out_k_ref[:, :] = skey
+        out_p_ref[:, :] = spay
+        out_v_ref[:, :] = sval
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def row_topk_tiled(
+    preds: ArrayLike, payload: ArrayLike, valid: ArrayLike, k: int, interpret: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Per-row top-``k`` by ``preds`` with the payload and validity rows
+    gathered through the same permutation:
+    ``[R, N] x3 -> ([R, k] keys, [R, k] payload, [R, k] validity)``.
+    Invalid slots (``valid <= 0``) key as ``-inf`` and sort last; pad
+    rows/columns are sliced back off."""
+    preds = jnp.asarray(preds, jnp.float32)
+    payload = jnp.asarray(payload, jnp.float32)
+    valid = jnp.asarray(valid, jnp.float32)
+    r, n = preds.shape
+    n_pad = _next_pow2(max(n, 2))
+    r_pad = -(-max(r, 1) // _TILE_R) * _TILE_R
+
+    def _pad(x, fill):
+        return jnp.full((r_pad, n_pad), fill, jnp.float32).at[:r, :n].set(x)
+
+    keys = _pad(jnp.where(valid > 0, preds, -jnp.inf), -jnp.inf)
+    pay = _pad(payload, 0.0)
+    val = _pad(valid, 0.0)
+
+    ms = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
+    spec = pl.BlockSpec((_TILE_R, n_pad), lambda i: (i, 0), **ms)
+    out_k, out_p, out_v = pl.pallas_call(
+        _make_topk_kernel(n_pad),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((r_pad, n_pad), jnp.float32) for _ in range(3)
+        ),
+        grid=(r_pad // _TILE_R,),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec, spec),
+        interpret=interpret,
+    )(keys, pay, val)
+    kk = min(k, n)
+    return out_k[:r, :kk], out_p[:r, :kk], out_v[:r, :kk]
+
+
+# ---------------------------------------------------------------------------
+# registry-routed entry point
+# ---------------------------------------------------------------------------
+
+
+def _row_topk_jnp(preds, payload, valid, k):
+    from metrics_tpu.utils.data import stable_sort_with_payloads
+
+    preds = jnp.asarray(preds, jnp.float32)
+    payload = jnp.asarray(payload, jnp.float32)
+    valid = jnp.asarray(valid, jnp.float32)
+    keys = jnp.where(valid > 0, preds, -jnp.inf)
+    sk, sp, sv = stable_sort_with_payloads(keys, payload, valid, descending=True)
+    kk = min(k, preds.shape[-1])
+    return sk[:, :kk], sp[:, :kk], sv[:, :kk]
+
+
+def _row_topk_pallas(preds, payload, valid, k, interpret=False):
+    return row_topk_tiled(preds, payload, valid, k, interpret=interpret)
+
+
+def _row_topk_route(preds, payload, valid, k) -> bool:
+    r, n = preds.shape
+    return (
+        jnp.dtype(preds.dtype) == jnp.dtype(jnp.float32)
+        and _MIN_SORT_COLS <= n
+        and _next_pow2(n) <= _MAX_SORT_COLS
+        and r >= 64  # tiny tables: launch overhead beats the fused gather
+        # unrolled network work is r_pad * n_pad * log^2(n_pad); cap where
+        # the XLA sort + gathers would win back on sheer bandwidth
+        and r * _next_pow2(n) <= 1 << 24
+    )
+
+
+register_kernel(
+    "row_topk",
+    pallas_fn=_row_topk_pallas,
+    jnp_fn=_row_topk_jnp,
+    route=_row_topk_route,
+)
+
+
+def row_topk_dispatch(
+    preds: ArrayLike, payload: ArrayLike, valid: ArrayLike, k: int
+) -> Tuple[Array, Array, Array]:
+    """Registry-routed per-row top-``k`` + payload gather (see module
+    docstring for the bit-parity contract). ``k`` must be a positive
+    static int; rows with fewer than ``k`` valid entries pad with
+    ``(-inf, 0, 0)`` slots — callers mask on the returned validity."""
+    if not isinstance(k, int) or isinstance(k, bool) or k <= 0:
+        raise ValueError(f"`k` must be a positive static int, got {k!r}")
+    preds = jnp.asarray(preds)
+    if preds.ndim != 2:
+        raise ValueError(f"`preds` must be [rows, cols], got shape {preds.shape}")
+    return dispatch("row_topk", preds, jnp.asarray(payload), jnp.asarray(valid), k)

@@ -1,4 +1,12 @@
 from metrics_tpu.retrieval.base import RetrievalMetric  # noqa: F401
+from metrics_tpu.retrieval.table import (  # noqa: F401
+    retrieval_table_fill,
+    retrieval_table_init,
+    retrieval_table_insert,
+    retrieval_table_layout,
+    retrieval_table_merge,
+    retrieval_table_merge_fx,
+)
 from metrics_tpu.retrieval.average_precision import RetrievalMAP  # noqa: F401
 from metrics_tpu.retrieval.fall_out import RetrievalFallOut  # noqa: F401
 from metrics_tpu.retrieval.hit_rate import RetrievalHitRate  # noqa: F401

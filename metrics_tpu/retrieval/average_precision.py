@@ -14,6 +14,10 @@ Array = jax.Array
 class RetrievalMAP(RetrievalMetric):
     """Mean average precision over queries.
 
+    Default state is the fixed-capacity per-query table (fusible /
+    async / mesh-synced; ``max_queries`` / ``max_docs`` size it);
+    ``exact=True`` restores the unbounded cat-state reference path.
+
     Example:
         >>> import jax.numpy as jnp
         >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])

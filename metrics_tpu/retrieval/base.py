@@ -1,47 +1,104 @@
 """RetrievalMetric base: grouped-by-query mean of a per-query metric.
 
 Behavior parity with /root/reference/torchmetrics/retrieval/base.py:27-150:
-cat-states ``indexes/preds/target``; compute = concat -> group by query id ->
-per-group ``_metric`` -> mean; ``empty_target_action`` in neg/pos/skip/error.
+compute = group by query id -> per-group ``_metric`` -> mean;
+``empty_target_action`` in neg/pos/skip/error.
 
-The reference groups with a Python dict loop (utilities/data.py:244-253, a
-known hot spot — SURVEY.md §3.6). TPU-native compute path (SURVEY §7.5):
-the ragged per-query structure is packed once into static
-``[num_queries, max_docs]`` device buffers (sort + scatter on device), and the per-query
-kernel, empty-query policy, and final mean all run as ONE jitted vmapped
-call (functional/retrieval/padded.py). Subclasses declare their padded row
-kernel via ``_padded_metric``; user subclasses that only implement
-``_metric`` fall back to the host group loop (exact-parity mode).
+**Default state — the fixed-capacity per-query table**
+(:mod:`metrics_tpu.retrieval.table`). ``update(preds, target, indexes)``
+segment-scatters each document into its query's row of a packed
+``[max_queries, 7 + 2*max_docs]`` leaf: exact per-query counters
+(docs seen / positive mass / negative count) plus the stored document
+slots, with a deterministic hash-key reservoir over query rows and a
+fused top-k compaction over document slots past capacity. The update is a
+pure fixed-shape ``jnp`` transform, so retrieval metrics fuse
+(``MetricCollection.compile_update``), bucket ragged shapes (the
+``n_valid`` pad-mask contract), ingest asynchronously, and sync across a
+mesh in the fused collective round like any sketch-state metric. Inside
+the lossless window — distinct queries ``<= max_queries`` and per-query
+documents ``<= max_docs`` — results are bit-identical to the cat-state
+path on integer-exact data; past it, metrics degrade to their
+depth-truncated (top-k-pooled) variants while the empty-query policy
+stays exact through the counters.
+
+**`exact=True`** restores the reference's unbounded cat-state
+(``indexes/preds/target`` lists) bit-for-bit — including the packed
+``[num_queries, max_docs]`` device compute path (SURVEY §7.5) and the
+host group-loop fallback for heavily skewed query sizes. Exact instances
+flip instance-level ``__jit_unsafe__`` and stay on the eager path.
+
+Subclasses declare their padded row kernel via ``_padded_metric``
+(functional/retrieval/padded.py); both state modes share those kernels.
+User subclasses that only implement ``_metric`` fall back to a host group
+loop in either mode (exact-parity semantics, eager speed).
 """
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.core.metric import Metric
+from collections import OrderedDict
+
 from metrics_tpu.functional.retrieval.padded import (
+    _memoized,
     _padded_compute_fn,
     _padded_compute_fn_raw,
     pack_queries_cached,
     sorted_row_layout,
 )
-from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.retrieval.table import (
+    retrieval_table_fill,
+    retrieval_table_init,
+    retrieval_table_insert,
+    retrieval_table_layout,
+    retrieval_table_merge_fx,
+)
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
+from metrics_tpu.utils.checks import (
+    _check_retrieval_inputs,
+    _check_retrieval_inputs_static,
+    _is_concrete,
+)
 from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
 Array = jax.Array
+
+#: table-leaf identity -> unpacked padded layout, the table-state analog
+#: of the exact path's _PACK_CACHE: a compute group's metrics share ONE
+#: qtable leaf by reference, so memoizing the unpack on its id() lets the
+#: group (and repeated computes on an unchanged table) reuse one layout —
+#: and, because the cached layout returns the SAME array objects, one
+#: shared per-row sort through sorted_row_layout's identity cache.
+#: Entries die with their leaf (weakref finalizers, see _memoized).
+_LAYOUT_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+
+def _table_layout_cached(qtable: Array):
+    if isinstance(qtable, jax.core.Tracer):  # never cache traced values
+        return retrieval_table_layout(qtable)
+    return _memoized(_LAYOUT_CACHE, (qtable,), lambda: retrieval_table_layout(qtable))
 
 
 class RetrievalMetric(Metric, ABC):
     """Base class for retrieval metrics over (indexes, preds, target) triples."""
 
     higher_is_better = True
-    __jit_unsafe__ = True  # grouping by query id has data-dependent shapes
+    __jit_unsafe__ = False  # table-state default: fixed-shape trace-safe update
+    __exact_mode_attr__ = "_exact"
+    #: bucketed fused dispatch threads ``n_valid`` so edge-pad rows are
+    #: masked out of the table insert instead of needing a pad correction
+    __fused_mask_valid__ = True
 
     def __init__(
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        exact: bool = False,
+        max_queries: int = 1024,
+        max_docs: int = 128,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -56,25 +113,48 @@ class RetrievalMetric(Metric, ABC):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
         self.ignore_index = ignore_index
 
-        self.add_state("indexes", default=[], dist_reduce_fx=None)
-        self.add_state("preds", default=[], dist_reduce_fx=None)
-        self.add_state("target", default=[], dist_reduce_fx=None)
+        self._exact = bool(exact)
+        if self._exact:
+            register_exact_list_states(self, ("indexes", "preds", "target"), dist_reduce_fx=None)
+            warn_exact_buffer(type(self).__name__, "indexes, targets and predictions")
+        else:
+            self.max_queries = max_queries
+            self.max_docs = max_docs
+            self.add_state(
+                "qtable",
+                default=retrieval_table_init(max_queries, max_docs),
+                dist_reduce_fx=retrieval_table_merge_fx(),
+            )
 
-    def _update(self, preds: Array, target: Array, indexes: Array) -> None:
+    def _update(
+        self, preds: Array, target: Array, indexes: Array, n_valid: Optional[Array] = None
+    ) -> None:
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
 
-        indexes, preds, target = _check_retrieval_inputs(
+        if self._exact:
+            indexes, preds, target = _check_retrieval_inputs(
+                indexes,
+                preds,
+                target,
+                allow_non_binary_target=self.allow_non_binary_target,
+                ignore_index=self.ignore_index,
+            )
+            self.indexes.append(indexes)
+            self.preds.append(preds)
+            self.target.append(target)
+            return
+
+        indexes, preds, target, valid = _check_retrieval_inputs_static(
             indexes,
             preds,
             target,
             allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,
         )
-
-        self.indexes.append(indexes)
-        self.preds.append(preds)
-        self.target.append(target)
+        self.qtable = retrieval_table_insert(
+            self.qtable, indexes, preds, target, valid=valid, n_valid=n_valid
+        )
 
     #: padded per-query row kernel ``(preds, target, mask, k) -> value`` from
     #: functional/retrieval/padded.py; None falls back to the host group loop
@@ -91,14 +171,91 @@ class RetrievalMetric(Metric, ABC):
         """Vectorized ``_group_empty`` over the padded layout (override to invert)."""
         return (padded_target * mask).sum(-1) == 0
 
+    def _table_empty_rows(self, pos_mass: Array, neg_count: Array) -> Array:
+        """``_empty_rows`` from the table's EXACT counters — never degraded
+        by document truncation (override to invert, see FallOut)."""
+        return pos_mass <= 0
+
     def _empty_error_message(self) -> str:
         return "`compute` method was provided with a query with no positive target."
 
     def _compute(self) -> Array:
+        if not self._exact:
+            return self._compute_table()
         if self._padded_metric is not None:
             return self._compute_padded()
         return self._compute_host_loop()
 
+    # ------------------------------------------------------------------
+    # table-state compute (the fixed-capacity default)
+    # ------------------------------------------------------------------
+    def _compute_table(self) -> Array:
+        """Compute over the fixed-capacity table: rows unpack to the same
+        padded layout the exact path's device pack produces (query-id
+        order, so in-window results match bit-for-bit on integer-exact
+        data), empty flags come from the exact counters, and unoccupied
+        rows carry zero weight in the final mean."""
+        qtable = self.qtable
+        if _is_concrete(qtable) and int(retrieval_table_fill(qtable)) == 0:
+            raise ValueError(
+                "`indexes` is empty — the retrieval metric has no accumulated samples;"
+                " call `update` before `compute`."
+            )
+        padded_preds, padded_target, mask, row_valid, pos_mass, neg_count, _ = (
+            _table_layout_cached(qtable)
+        )
+        empty = self._table_empty_rows(pos_mass, neg_count)
+        if self.empty_target_action == "error" and _is_concrete(qtable):
+            if bool(jnp.any(empty & row_valid)):
+                raise ValueError(self._empty_error_message())
+
+        kernel = type(self)._padded_metric
+        if kernel is None:
+            # user subclasses without a padded kernel: host loop over the
+            # occupied rows (exact-parity semantics, eager speed)
+            return self._compute_table_host_loop(
+                padded_preds, padded_target, mask, row_valid, empty
+            )
+        weights = row_valid.astype(jnp.float32)
+        sorted_fn = getattr(kernel, "sorted_fn", None)
+        if sorted_fn is not None:
+            st, sm = sorted_row_layout(padded_preds, padded_target, mask)
+            run = _padded_compute_fn(
+                kernel, self._padded_k, self.empty_target_action, weighted=True
+            )
+            return run(st, sm, padded_target, jnp.asarray(empty), weights)
+        run = _padded_compute_fn_raw(
+            kernel, self._padded_k, self.empty_target_action, weighted=True
+        )
+        return run(padded_preds, padded_target, mask, jnp.asarray(empty), weights)
+
+    def _compute_table_host_loop(
+        self, padded_preds: Array, padded_target: Array, mask: Array, row_valid: Array, empty: Array
+    ) -> Array:
+        res = []
+        fills = np.asarray(jnp.sum(mask, axis=-1))
+        rv = np.asarray(row_valid)
+        emp = np.asarray(empty)
+        for r in range(padded_preds.shape[0]):
+            if not rv[r]:
+                continue
+            if emp[r]:
+                if self.empty_target_action == "error":
+                    raise ValueError(self._empty_error_message())
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                n = int(fills[r])
+                res.append(self._metric(padded_preds[r, :n], padded_target[r, :n]))
+        if res:
+            return jnp.mean(jnp.stack([jnp.asarray(x, jnp.float32) for x in res]))
+        return jnp.asarray(0.0, jnp.float32)
+
+    # ------------------------------------------------------------------
+    # exact-mode (cat-state) compute paths
+    # ------------------------------------------------------------------
     def _compute_padded(self) -> Array:
         """Device-resident compute over the packed [num_queries, max_docs]
         layout: pack (sort + scatter), per-query kernels, empty policy, and

@@ -17,7 +17,13 @@ Array = jax.Array
 
 
 class RetrievalFallOut(RetrievalMetric):
-    """Mean fall-out@k over queries. Lower is better."""
+    """Mean fall-out@k over queries. Lower is better.
+
+    Default state is the fixed-capacity per-query table (fusible /
+    async / mesh-synced; ``max_queries`` / ``max_docs`` size it, the
+    empty-query inversion reads the exact negative-document counter);
+    ``exact=True`` restores the unbounded cat-state reference path.
+    """
 
     _padded_metric = staticmethod(fall_out_row)
 
@@ -41,6 +47,11 @@ class RetrievalFallOut(RetrievalMetric):
     def _empty_rows(self, padded_target, mask):
         # queries with no NEGATIVE target are "empty" for fall-out
         return ((1.0 - padded_target) * mask).sum(-1) == 0
+
+    def _table_empty_rows(self, pos_mass, neg_count):
+        # the table's exact negative-document counter — never degraded by
+        # document truncation past capacity
+        return neg_count <= 0
 
     def _group_empty(self, mini_target: Array) -> bool:
         # a query is degenerate when it has no NEGATIVE target

@@ -15,7 +15,12 @@ Array = jax.Array
 
 
 class RetrievalHitRate(RetrievalMetric):
-    """Mean hit rate@k over queries."""
+    """Mean hit rate@k over queries.
+
+    Default state is the fixed-capacity per-query table (fusible /
+    async / mesh-synced; ``max_queries`` / ``max_docs`` size it);
+    ``exact=True`` restores the unbounded cat-state reference path.
+    """
 
     _padded_metric = staticmethod(hit_rate_row)
 

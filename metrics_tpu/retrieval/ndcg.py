@@ -16,7 +16,12 @@ Array = jax.Array
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """Mean nDCG@k over queries."""
+    """Mean nDCG@k over queries.
+
+    Default state is the fixed-capacity per-query table (fusible /
+    async / mesh-synced; ``max_queries`` / ``max_docs`` size it);
+    ``exact=True`` restores the unbounded cat-state reference path.
+    """
 
     _padded_metric = staticmethod(ndcg_row)
 

@@ -12,7 +12,12 @@ Array = jax.Array
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """Mean R-precision over queries."""
+    """Mean R-precision over queries.
+
+    Default state is the fixed-capacity per-query table (fusible /
+    async / mesh-synced; ``max_queries`` / ``max_docs`` size it);
+    ``exact=True`` restores the unbounded cat-state reference path.
+    """
 
     _padded_metric = staticmethod(r_precision_row)
 

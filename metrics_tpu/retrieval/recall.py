@@ -15,7 +15,12 @@ Array = jax.Array
 
 
 class RetrievalRecall(RetrievalMetric):
-    """Mean recall@k over queries."""
+    """Mean recall@k over queries.
+
+    Default state is the fixed-capacity per-query table (fusible /
+    async / mesh-synced; ``max_queries`` / ``max_docs`` size it);
+    ``exact=True`` restores the unbounded cat-state reference path.
+    """
 
     _padded_metric = staticmethod(recall_row)
 

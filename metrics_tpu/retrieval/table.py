@@ -1,0 +1,536 @@
+"""Fixed-capacity per-query retrieval state table (packed single leaf).
+
+The retrieval family's cat-states (``indexes/preds/target`` lists) are the
+largest remaining jit-unsafe surface: unbounded memory and permanent
+exclusion from ``FusedUpdate``/``compile_update_async``. This module is the
+replacement — the retrieval analog of the quantile sketch: one packed
+
+    ``[max_queries, 7 + 2 * max_docs]`` float32
+
+leaf where each ROW owns one query's documents and exact per-query
+counters, with three pure, fixed-shape, jit-safe transforms:
+
+* ``retrieval_table_init(max_queries, max_docs) -> leaf``
+* ``retrieval_table_insert(leaf, indexes, preds, target, ...) -> leaf``
+* ``retrieval_table_merge(a, b) -> leaf``  (``dist_reduce_fx`` material)
+
+Row layout (columns)::
+
+    0: KEY   deterministic reservoir key in (0, 1] hashed from the query
+             id (0 = empty row)
+    1: QHI   query id bits 24..31   (uint32 split, exact in f32)
+    2: QLO   query id bits 0..23
+    3: NSEEN total documents seen for this query (exact counter)
+    4: POS   sum of target over ALL seen documents (exact; drives the
+             empty-query policy even past doc capacity)
+    5: NEG   count of ``target == 0`` documents seen (exact; FallOut's
+             inverted empty policy)
+    6: FILL  documents currently stored in the slot region
+    7            .. 7+max_docs-1:   stored preds
+    7+max_docs   .. 7+2*max_docs-1: stored targets
+
+**Row policy — deterministic bottom-k reservoir.** Every query id hashes
+to a fixed KEY; the table maintains the invariant *rows == the
+``max_queries`` largest ``(KEY, -qid)`` priorities among every query ever
+seen*. Because priorities are a pure function of the id, the sampled query
+SET is independent of arrival order and batch chunking, a query that will
+survive is admitted at first sight and never evicted (the table minimum
+only rises once full), and two ranks inserting the same query agree on its
+fate without sharing RNG state — ``merge`` is a pure top-``Q`` of the row
+union. While distinct queries fit in ``max_queries`` nothing is sampled at
+all.
+
+**Doc policy — top-``max_docs`` truncation.** Documents append into free
+slots in arrival order (the segment-scatter shape: one flat
+``.at[row * cap + fill + col].set`` per leaf region). When a row's slots
+would overflow, the stored + incoming documents compact to the top
+``max_docs // 2`` by score through the fused top-k + gather kernel
+(:mod:`metrics_tpu.ops.topk_pallas`) under a ``lax.cond`` — in-window
+streams never pay the sort, mirroring the qsketch absorb contract. Beyond
+capacity a query's metrics become their depth-truncated (top-``k``-pooled)
+variants while NSEEN/POS/NEG stay exact, so the empty-query policy and
+positive mass never degrade.
+
+**Lossless window.** While every query holds at most ``max_docs``
+documents and distinct queries fit in ``max_queries``, the table stores
+the exact stream in arrival order: unpacking (:func:`retrieval_table_layout`)
+reproduces ``pack_queries``'s padded layout and the compute results are
+bit-identical to the cat-state path on integer-exact data. Cross-rank
+merges concatenate same-query documents in rank order — the gather-concat
+order — so the window extends across a mesh sync.
+
+Everything is plain ``jnp`` (sorts, ``searchsorted`` joins, scatters,
+``lax.cond``) — no host syncs, no data-dependent shapes — so retrieval
+updates fuse, bucket (``n_valid`` pad masking), and mesh-sync like any
+sketch-state metric.
+"""
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: column layout (see module docstring)
+COL_KEY, COL_QHI, COL_QLO, COL_NSEEN, COL_POS, COL_NEG, COL_FILL = range(7)
+#: number of metadata columns before the preds/targets slot regions
+META_COLS = 7
+
+#: finite stand-in for +/-inf so stored scores always beat the -inf empty
+#: sentinel in top-k selection (real f32 data is unaffected by the clip)
+_FMAX = jnp.float32(3.4e38)
+_I32_MAX = jnp.int32(2**31 - 1)
+
+#: docs absorbed per fixed-shape chunk. Chunk size does NOT affect
+#: in-window results (appends are order-preserving whatever the split;
+#: the overflow branch widens over the WHOLE chunk) — it only bounds the
+#: transient ``[max_queries, chunk]`` overflow scratch and amortizes the
+#: per-chunk join sort over more documents.
+_INSERT_CHUNK = 2048
+
+
+def table_capacity(table: Array) -> Tuple[int, int]:
+    """``(max_queries, max_docs)`` encoded in the leaf's static shape."""
+    q, c = table.shape
+    if c < META_COLS + 2 or (c - META_COLS) % 2:
+        raise ValueError(f"not a retrieval table leaf: shape {table.shape}")
+    return q, (c - META_COLS) // 2
+
+
+def retrieval_table_init(max_queries: int, max_docs: int) -> Array:
+    """Fresh empty table leaf ``[max_queries, 7 + 2 * max_docs]``."""
+    if not (isinstance(max_queries, int) and max_queries > 0):
+        raise ValueError(f"`max_queries` must be a positive int, got {max_queries!r}")
+    if not (isinstance(max_docs, int) and max_docs >= 2):
+        raise ValueError(f"`max_docs` must be an int >= 2, got {max_docs!r}")
+    return jnp.zeros((max_queries, META_COLS + 2 * max_docs), jnp.float32)
+
+
+def _retain(max_docs: int) -> int:
+    """Docs kept per row by an overflow compaction (top-k by score)."""
+    return max(1, max_docs // 2)
+
+
+def _qid_key(qid: Array) -> Array:
+    """Deterministic per-query reservoir key in ``(0, 1]`` (24-bit
+    granularity — exact in f32; hash collisions tie-break on the id).
+    A pure function of the id so every rank, every replay, and every
+    chunking of the stream draws the same priority for the same query."""
+    x = qid.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return ((x >> 8).astype(jnp.float32) + 1.0) / jnp.float32(1 << 24)
+
+
+def _split_qid(qid: Array) -> Tuple[Array, Array]:
+    """int32 id -> (hi, lo) f32 lanes, each exact below 2**24."""
+    u = qid.astype(jnp.uint32)
+    return (u >> 24).astype(jnp.float32), (u & jnp.uint32(0xFFFFFF)).astype(jnp.float32)
+
+
+def _join_qid(qhi: Array, qlo: Array) -> Array:
+    """(hi, lo) f32 lanes -> the original int32 id (two's complement)."""
+    u = (qhi.astype(jnp.uint32) << 24) | qlo.astype(jnp.uint32)
+    return u.astype(jnp.int32)
+
+
+def _unpack(table: Array):
+    q, cap = table_capacity(table)
+    return (
+        table[:, COL_KEY],
+        _join_qid(table[:, COL_QHI], table[:, COL_QLO]),
+        table[:, COL_NSEEN],
+        table[:, COL_POS],
+        table[:, COL_NEG],
+        table[:, COL_FILL],
+        table[:, META_COLS : META_COLS + cap],
+        table[:, META_COLS + cap :],
+    )
+
+
+def _pack(key, qid, nseen, pos, neg, fill, preds, target) -> Array:
+    qhi, qlo = _split_qid(qid)
+    return jnp.concatenate(
+        [
+            key[:, None],
+            qhi[:, None],
+            qlo[:, None],
+            nseen[:, None],
+            pos[:, None],
+            neg[:, None],
+            fill[:, None],
+            preds,
+            target,
+        ],
+        axis=1,
+    ).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# insert
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("_mode",))
+def _chunk_insert(table: Array, qid: Array, preds: Array, target: Array, valid: Array, _mode: Any = None) -> Array:
+    """One fixed-shape chunk (``<= _INSERT_CHUNK`` docs) into the table:
+    searchsorted join of batch query ids against the resident rows, a
+    greedy sorted pairing for reservoir admission/eviction, a flat
+    segment-scatter append of documents into free slots, and a
+    ``lax.cond``-gated top-k compaction when any row would overflow.
+    Jitted on its own so eager updates pay one cached dispatch; ``_mode``
+    is the ops-dispatch routing state folded into the cache key (the
+    compaction backend is a trace-time decision)."""
+    from metrics_tpu.ops import row_topk_dispatch, segment_sum_dispatch
+
+    num_q, cap = table_capacity(table)
+    keep = _retain(cap)
+    b = qid.shape[0]
+    key_t, qid_t, nseen, pos_m, neg_c, fill, pt, tt = _unpack(table)
+    occ = key_t > 0
+
+    # ---- batch segment layout: stable sort by query id, invalid rows last
+    skey = jnp.where(valid, qid, _I32_MAX)
+    order = jnp.lexsort((jnp.arange(b, dtype=jnp.int32), skey))
+    sq = skey[order]
+    sv = valid[order]
+    sp = jnp.clip(preds[order].astype(jnp.float32), -_FMAX, _FMAX)
+    st = target[order].astype(jnp.float32)
+    change = jnp.concatenate([jnp.ones(1, bool), sq[1:] != sq[:-1]])
+    pos_i = jnp.arange(b, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(change, pos_i, 0))
+    col = pos_i - seg_start
+
+    # ---- join: which resident row owns each batch doc's query?
+    qkey_t = jnp.where(occ, qid_t, _I32_MAX)
+    torder = jnp.lexsort(((~occ).astype(jnp.int32), qkey_t))
+    tq_sorted = qkey_t[torder]
+    occ_sorted = occ[torder]
+    loc = jnp.clip(jnp.searchsorted(tq_sorted, sq, side="left"), 0, num_q - 1)
+    matched = (tq_sorted[loc] == sq) & occ_sorted[loc] & sv
+    match_row = jnp.where(matched, torder[loc], -1)
+
+    # ---- reservoir admission: distinct unmatched queries vs resident rows
+    is_cand = change & sv & ~matched
+    ckey = jnp.where(is_cand, _qid_key(sq), 0.0)
+    cand_order = jnp.lexsort((sq, -ckey))  # priority desc: key desc, qid asc
+    cq = sq[cand_order]
+    ck = ckey[cand_order]
+    # resident rows ascending by priority (KEY, -qid): free rows (KEY 0)
+    # first, then occupied rows from the smallest key upward; qid DESC
+    # breaks key ties (larger id = lower priority, the strict total order)
+    neg_qid = jnp.invert(qid_t)  # ~x = -x-1: monotone signed flip, no overflow
+    row_order = jnp.lexsort((neg_qid, key_t))
+    n_pair = min(b, num_q)
+    rslots = row_order[:n_pair]
+    rkey = key_t[rslots]
+    rqid = qid_t[rslots]
+    ckp, cqp = ck[:n_pair], cq[:n_pair]
+    beats = (ckp > rkey) | ((ckp == rkey) & (cqp < rqid))
+    accept = (ckp > 0) & ((rkey <= 0) | beats)
+    target_row = jnp.where(accept, rslots, num_q)  # num_q = dropped scatter
+
+    # evicted/admitted rows restart fresh with the new query's identity
+    key_t = key_t.at[target_row].set(ckp, mode="drop")
+    qhi_new, qlo_new = _split_qid(cqp)
+    qhi_t, qlo_t = _split_qid(qid_t)
+    qhi_t = qhi_t.at[target_row].set(qhi_new, mode="drop")
+    qlo_t = qlo_t.at[target_row].set(qlo_new, mode="drop")
+    qid_t = _join_qid(qhi_t, qlo_t)
+    zeros_pair = jnp.zeros(n_pair, jnp.float32)
+    nseen = nseen.at[target_row].set(zeros_pair, mode="drop")
+    pos_m = pos_m.at[target_row].set(zeros_pair, mode="drop")
+    neg_c = neg_c.at[target_row].set(zeros_pair, mode="drop")
+    fill = fill.at[target_row].set(zeros_pair, mode="drop")
+
+    # map admissions back to the sorted batch: the accepted candidate at
+    # sorted position p carries its row to every doc of its group
+    admit_row = jnp.full(b, -1, jnp.int32).at[cand_order[:n_pair]].set(
+        jnp.where(accept, rslots, -1), mode="drop"
+    )
+    # a row evicted THIS chunk belongs to its new query now: docs of the
+    # evicted (matched-before-eviction) query must drop, not scatter into
+    # the new owner's slots
+    evicted = jnp.zeros(num_q, bool).at[target_row].set(accept, mode="drop")
+    still_owned = matched & ~evicted[jnp.clip(match_row, 0, num_q - 1)]
+    row_doc = jnp.where(still_owned, match_row, admit_row[seg_start])
+    row_doc = jnp.where(sv & (row_doc >= 0), row_doc, num_q)  # num_q drops
+
+    # ---- exact per-query counters (the scatter the sliced metric shares)
+    live = row_doc < num_q
+    ones = jnp.where(live, 1.0, 0.0).astype(jnp.float32)
+    n_inc = segment_sum_dispatch(ones, row_doc, num_q)
+    nseen = nseen + n_inc
+    pos_m = pos_m + segment_sum_dispatch(jnp.where(live, st, 0.0), row_doc, num_q)
+    neg_c = neg_c + segment_sum_dispatch(
+        jnp.where(live & (st == 0), 1.0, 0.0), row_doc, num_q
+    )
+
+    # ---- document append: flat segment-scatter into each row's free slots
+    row_c = jnp.clip(row_doc, 0, num_q - 1)
+    slot = fill[row_c].astype(jnp.int32) + col
+    flat = jnp.where(live & (slot < cap), row_c * cap + slot, num_q * cap)
+    p_app = pt.reshape(-1).at[flat].set(sp, mode="drop").reshape(num_q, cap)
+    t_app = tt.reshape(-1).at[flat].set(st, mode="drop").reshape(num_q, cap)
+    fill_app = jnp.minimum(fill + n_inc, float(cap))
+
+    over = fill + n_inc > cap
+
+    def no_overflow(operands):
+        p_a, t_a, f_a = operands[:3]
+        return p_a, t_a, f_a
+
+    def with_overflow(operands):
+        p_a, t_a, f_a, p_old, t_old, f_old = operands
+        # widen: stored slots + this chunk's docs scattered into scratch
+        # columns (within-group col < chunk size by construction), then the
+        # fused top-k + gather kernel keeps the best `keep` per row
+        scratch_p = jnp.zeros((num_q, b), jnp.float32)
+        scratch_t = jnp.zeros((num_q, b), jnp.float32)
+        sflat = jnp.where(live, row_c * b + col, num_q * b)
+        scratch_p = scratch_p.reshape(-1).at[sflat].set(sp, mode="drop").reshape(num_q, b)
+        scratch_t = scratch_t.reshape(-1).at[sflat].set(st, mode="drop").reshape(num_q, b)
+        scratch_v = (
+            jnp.zeros((num_q, b), jnp.float32)
+            .reshape(-1)
+            .at[sflat]
+            .set(ones, mode="drop")
+            .reshape(num_q, b)
+        )
+        wide_p = jnp.concatenate([p_old, scratch_p], axis=1)
+        wide_t = jnp.concatenate([t_old, scratch_t], axis=1)
+        iota = jnp.arange(cap, dtype=jnp.float32)[None, :]
+        wide_v = jnp.concatenate([(iota < f_old[:, None]).astype(jnp.float32), scratch_v], axis=1)
+        top_p, top_t, _ = row_topk_dispatch(wide_p, wide_t, wide_v, keep)
+        p_k = jnp.zeros((num_q, cap), jnp.float32).at[:, :keep].set(top_p)
+        t_k = jnp.zeros((num_q, cap), jnp.float32).at[:, :keep].set(top_t)
+        f_k = jnp.minimum(f_old + n_inc, float(keep))
+        sel = over[:, None]
+        return (
+            jnp.where(sel, p_k, p_a),
+            jnp.where(sel, t_k, t_a),
+            jnp.where(over, f_k, f_a),
+        )
+
+    p_new, t_new, fill_new = jax.lax.cond(
+        jnp.any(over),
+        with_overflow,
+        no_overflow,
+        (p_app, t_app, fill_app, pt, tt, fill),
+    )
+    return _pack(key_t, qid_t, nseen, pos_m, neg_c, fill_new, p_new, t_new)
+
+
+def retrieval_table_insert(
+    table: Array,
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    valid: Optional[Array] = None,
+    n_valid: Optional[Array] = None,
+) -> Array:
+    """Insert a batch of ``(query id, pred, target)`` documents; pure and
+    jit-safe. ``valid`` masks rows out entirely (the ``ignore_index``
+    contract); ``n_valid`` masks trailing pad rows (the fused bucketing
+    pad-and-mask contract). Batches larger than one chunk are absorbed in
+    fixed chunks (host loop over static slices)."""
+    from metrics_tpu.ops.dispatch import dispatch_mode
+
+    indexes = jnp.asarray(indexes, jnp.int32).reshape(-1)
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target).astype(jnp.float32).reshape(-1)
+    b = indexes.shape[0]
+    v = jnp.ones(b, bool) if valid is None else jnp.asarray(valid, bool).reshape(-1)
+    if n_valid is not None:
+        v = v & (jnp.arange(b) < n_valid)
+    mode = dispatch_mode()
+    step = _INSERT_CHUNK
+    for lo in range(0, b, step):
+        table = _chunk_insert(
+            table,
+            indexes[lo : lo + step],
+            preds[lo : lo + step],
+            target[lo : lo + step],
+            v[lo : lo + step],
+            _mode=mode,
+        )
+    return table
+
+
+# ---------------------------------------------------------------------------
+# merge (dist_reduce_fx)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("_mode",))
+def _merge_impl(a: Array, b: Array, _mode: Any = None) -> Array:
+    num_q, cap = table_capacity(a)
+    rows = jnp.concatenate([a, b], axis=0)  # rank order: a's rows first
+    key, qid, nseen, pos_m, neg_c, fill, pt, tt = _unpack(rows)
+    occ = key > 0
+    n2 = 2 * num_q
+
+    # sort by query id (occupied first, original order as tiebreak) so
+    # duplicate queries — present on both sides — become adjacent pairs,
+    # with the a-side row first (stable: each side holds unique qids)
+    qkey = jnp.where(occ, qid, _I32_MAX)
+    order = jnp.lexsort((jnp.arange(n2, dtype=jnp.int32), qkey, (~occ).astype(jnp.int32)))
+    key, qid, nseen, pos_m, neg_c, fill = (
+        x[order] for x in (key, qid, nseen, pos_m, neg_c, fill)
+    )
+    pt, tt = pt[order], tt[order]
+    occ = key > 0
+    dup_next = jnp.concatenate([occ[1:] & occ[:-1] & (qid[1:] == qid[:-1]), jnp.zeros(1, bool)])
+    is_dup = jnp.concatenate([jnp.zeros(1, bool), dup_next[:-1]])
+
+    # fold the duplicate partner into its primary: docs concatenate in
+    # rank (a-then-b) order — the gather-concat order the cat path syncs in
+    nxt = jnp.minimum(jnp.arange(n2) + 1, n2 - 1)
+    part_fill = jnp.where(dup_next, fill[nxt], 0.0)
+    wide_p = jnp.concatenate([pt, jnp.where(dup_next[:, None], pt[nxt], 0.0)], axis=1)
+    wide_t = jnp.concatenate([tt, jnp.where(dup_next[:, None], tt[nxt], 0.0)], axis=1)
+    iota = jnp.arange(cap, dtype=jnp.float32)[None, :]
+    wide_v = jnp.concatenate(
+        [
+            (iota < fill[:, None]).astype(jnp.float32),
+            jnp.where(dup_next[:, None], (iota < part_fill[:, None]).astype(jnp.float32), 0.0),
+        ],
+        axis=1,
+    )
+    f_comb = fill + part_fill
+
+    # arrival-order repack (valid slots first, a-side columns before
+    # b-side) — exact while the combined docs fit
+    arr_key = jnp.where(wide_v > 0, jnp.arange(2 * cap, dtype=jnp.float32)[None, :], jnp.float32(4 * cap))
+    arr_order = jnp.argsort(arr_key, axis=1)
+    packed_p = jnp.take_along_axis(wide_p, arr_order, axis=1)[:, :cap]
+    packed_t = jnp.take_along_axis(wide_t, arr_order, axis=1)[:, :cap]
+
+    def no_overflow(ops):
+        pp, ptg = ops[:2]
+        return pp, ptg, jnp.minimum(f_comb, float(cap))
+
+    def with_overflow(ops):
+        pp, ptg, wp, wt, wv = ops
+        from metrics_tpu.ops import row_topk_dispatch
+
+        top_p, top_t, _ = row_topk_dispatch(wp, wt, wv, cap)
+        sel = (f_comb > cap)[:, None]
+        return (
+            jnp.where(sel, top_p, pp),
+            jnp.where(sel, top_t, ptg),
+            jnp.minimum(f_comb, float(cap)),
+        )
+
+    packed_p, packed_t, fill = jax.lax.cond(
+        jnp.any(f_comb > cap),
+        with_overflow,
+        no_overflow,
+        (packed_p, packed_t, wide_p, wide_t, wide_v),
+    )
+    nseen = nseen + jnp.where(dup_next, nseen[nxt], 0.0)
+    pos_m = pos_m + jnp.where(dup_next, pos_m[nxt], 0.0)
+    neg_c = neg_c + jnp.where(dup_next, neg_c[nxt], 0.0)
+    # absorbed partners leave the row set
+    key = jnp.where(is_dup, 0.0, key)
+
+    # reservoir: keep the top-num_q (KEY, -qid) priorities of the union
+    # (key descending, qid ascending on ties — the insert path's order)
+    keep_order = jnp.lexsort((qid, -key))[:num_q]
+    return _pack(
+        key[keep_order],
+        qid[keep_order],
+        nseen[keep_order],
+        pos_m[keep_order],
+        neg_c[keep_order],
+        fill[keep_order],
+        packed_p[keep_order],
+        packed_t[keep_order],
+    )
+
+
+def retrieval_table_merge(a: Array, b: Array) -> Array:
+    """Merge two tables of identical geometry (``dist_reduce_fx``
+    material): same-query rows fold doc-wise in rank order (top-``cap`` by
+    score past capacity), distinct queries compete through the
+    deterministic key reservoir. Exact — and bit-identical to the
+    cat-state gather — while the union fits both capacities."""
+    if a.shape != b.shape:
+        raise ValueError(f"cannot merge retrieval tables with layouts {a.shape} and {b.shape}")
+    from metrics_tpu.ops.dispatch import dispatch_mode
+
+    return _merge_impl(a, b, _mode=dispatch_mode())
+
+
+class _RetrievalTableReduce:
+    """``dist_reduce_fx`` for retrieval-table leaves: folds
+    :func:`retrieval_table_merge` over the stacked per-rank leaves
+    ``[world, Q, C]`` in rank order — inside the lossless window this
+    reproduces the cat-state gather's concatenation order bit-for-bit. A
+    module-level class (picklable/deepcopy-able) tagged ``merge_like`` /
+    ``sketch_kind`` so ``merge_states``, ``sync_pytree_in_mesh``'s fused
+    gather round, TL-FLOW, and the footprint accounting all treat the
+    table like the other fixed-capacity sketch kinds."""
+
+    merge_like = True
+    sketch_kind = "retrieval_table"
+    __name__ = "retrieval_table_reduce"
+
+    def __call__(self, stacked: Array) -> Array:
+        stacked = jnp.asarray(stacked)
+        if stacked.ndim == 2:  # single-rank passthrough
+            return stacked
+        out = stacked[0]
+        for i in range(1, stacked.shape[0]):
+            out = retrieval_table_merge(out, stacked[i])
+        return out
+
+
+_TABLE_REDUCE = _RetrievalTableReduce()
+
+
+def retrieval_table_merge_fx() -> _RetrievalTableReduce:
+    """The shared retrieval-table ``dist_reduce_fx`` (see
+    :class:`_RetrievalTableReduce`)."""
+    return _TABLE_REDUCE
+
+
+# ---------------------------------------------------------------------------
+# queries (pure unless noted)
+# ---------------------------------------------------------------------------
+
+
+def retrieval_table_fill(table: Array) -> Array:
+    """Occupied query rows (int32 scalar)."""
+    return jnp.sum(table[:, COL_KEY] > 0).astype(jnp.int32)
+
+
+def retrieval_table_layout(table: Array):
+    """Unpack to the padded compute layout, rows ordered by ascending
+    query id (the ``pack_queries`` order, so in-window results match the
+    cat-state path bit-for-bit on integer-exact data):
+
+    ``(padded_preds [Q, cap], padded_target [Q, cap], mask [Q, cap],
+    row_valid [Q], pos_mass [Q], neg_count [Q], n_seen [Q])``
+
+    Padding slots carry ``preds=-inf``/``target=0``/``mask=False`` —
+    the row kernels' contract.
+    """
+    key, qid, nseen, pos_m, neg_c, fill, pt, tt = _unpack(table)
+    occ = key > 0
+    order = jnp.lexsort((qid, (~occ).astype(jnp.int32)))
+    occ, fill = occ[order], fill[order]
+    mask = (jnp.arange(pt.shape[1], dtype=jnp.float32)[None, :] < fill[:, None]) & occ[:, None]
+    padded_preds = jnp.where(mask, pt[order], -jnp.inf)
+    padded_target = jnp.where(mask, tt[order], 0.0)
+    return (
+        padded_preds,
+        padded_target,
+        mask,
+        occ,
+        pos_m[order],
+        neg_c[order],
+        nseen[order],
+    )

@@ -421,6 +421,50 @@ def _check_retrieval_inputs(
     return indexes.astype(jnp.int32).reshape(-1), preds, target
 
 
+def _check_retrieval_inputs_static(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Fixed-shape variant of :func:`_check_retrieval_inputs` for the
+    table-state update path: instead of FILTERING ``ignore_index`` rows
+    (a data-dependent shape that cannot trace), it returns a ``valid``
+    mask alongside the flattened arrays, and value-level checks (binary
+    target) only fire when the data is concrete — under a fused/jitted
+    trace the shapes and dtypes are still validated host-side."""
+    indexes = jnp.asarray(indexes)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not indexes.size or not indexes.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_ or _is_floating(target)):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    target = target.reshape(-1)
+    if not allow_non_binary_target and _is_concrete(target):
+        checkable = target
+        if ignore_index is not None:
+            checkable = jnp.where(target == ignore_index, 0, target)
+        if int(jnp.max(checkable)) > 1 or int(jnp.min(checkable)) < 0:
+            raise ValueError("`target` must contain `binary` values")
+    valid = (
+        jnp.ones(target.shape, bool) if ignore_index is None else target != ignore_index
+    )
+    # a batch that ignore_index erases completely is the reference's
+    # empty-tensor error; value-dependent, so eager-path only
+    if ignore_index is not None and _is_concrete(valid) and not bool(jnp.any(valid)):
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    target = target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
+    return indexes.astype(jnp.int32).reshape(-1), preds.astype(jnp.float32).reshape(-1), target, valid
+
+
 def _check_retrieval_k(k):
     """Shared @k validation for retrieval metrics."""
     if (k is not None) and not (isinstance(k, int) and k > 0):

@@ -91,6 +91,11 @@ AUX_FIELDS: Dict[str, str] = {
     # segment-scatter / compaction is a regression even when the headline
     # throughput still passes
     "ops_dispatch_overhead": "lower",
+    # fused table-state retrieval (``fused_retrieval_throughput``): the
+    # ISSUE 15 acceptance floor (>= 5x over the eager per-query group
+    # loop at 10k queries) and the one-compile-across-ragged-shapes anchor
+    "retrieval_fused_vs_eager": "higher",
+    "retrieval_fused_compiles": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -116,6 +121,14 @@ BOOL_FIELDS: Tuple[str, ...] = (
     "ops_bincount_parity",
     "ops_segment_sum_parity",
     "ops_qsketch_compact_parity",
+    # retrieval table-state window parity (state-level reconstruction
+    # bit-equality + value within f32 ulp of the exact path) and the new
+    # kernels' interpret-mode parity — a false bit is data corruption on
+    # every retrieval metric regardless of the throughput ratio
+    "retrieval_window_bit_exact",
+    "ops_row_topk_parity",
+    "ops_segment_max_parity",
+    "ops_segment_min_parity",
 )
 
 

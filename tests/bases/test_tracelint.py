@@ -2002,3 +2002,91 @@ class M(Metric):
         assert kid["verdict"] == "unsafe" and kid["reason"] == "host-sync"
         # sketch leaves serialize their merge reducer
         assert metrics["classification/auroc.py::AUROC"]["states"]["csketch"]["dist_reduce_fx"] == "merge"
+
+
+# ---------------------------------------------------------------------------
+# retrieval-table teaching (ISSUE 15): the scatter-into-table write shape
+# ---------------------------------------------------------------------------
+
+
+class TestRetrievalTableFlow:
+    """TL-FLOW fixtures for the new scatter-into-table write shape: the
+    table leaf is a ``"merge"`` (tagged ``retrieval_table_merge_fx``)
+    packed structure, so the ONLY consistent accumulation is the
+    insert-into-prior transform — exactly the qsketch contract, pinned
+    here for the retrieval spelling."""
+
+    _PREAMBLE = """
+from metrics_tpu.retrieval.table import (
+    retrieval_table_init, retrieval_table_insert, retrieval_table_merge_fx,
+)
+"""
+
+    def test_table_insert_into_prior_passes(self):
+        kept, _ = _check(
+            self._PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("qtable", default=retrieval_table_init(64, 16), dist_reduce_fx=retrieval_table_merge_fx())
+    def _update(self, preds, target, indexes):
+        self.qtable = retrieval_table_insert(self.qtable, indexes, preds, target)
+    def _compute(self):
+        return jnp.sum(self.qtable)
+"""
+        )
+        assert "TL-FLOW" not in _rules_of(kept)
+
+    def test_table_additive_write_flags(self):
+        kept, _ = _check(
+            self._PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("qtable", default=retrieval_table_init(64, 16), dist_reduce_fx=retrieval_table_merge_fx())
+    def _update(self, preds, target, indexes):
+        self.qtable = self.qtable + jnp.sum(preds)
+    def _compute(self):
+        return jnp.sum(self.qtable)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+        assert any("not element-wise summable" in v.message for v in kept)
+
+    def test_table_overwrite_without_prior_flags(self):
+        kept, _ = _check(
+            self._PREAMBLE
+            + """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("qtable", default=retrieval_table_init(64, 16), dist_reduce_fx=retrieval_table_merge_fx())
+    def _update(self, preds, target, indexes):
+        self.qtable = retrieval_table_insert(retrieval_table_init(64, 16), indexes, preds, target)
+    def _compute(self):
+        return jnp.sum(self.qtable)
+"""
+        )
+        assert "TL-FLOW" in _rules_of(kept)
+        assert any("without reading its prior value" in v.message for v in kept)
+
+
+class TestRetrievalTableInterpTeaching:
+    def test_retrieval_family_fusible_in_committed_manifest(self):
+        """The ISSUE 15 acceptance pin: all 9 retrieval classes carry
+        fusible verdicts in the COMMITTED manifest, with the table leaf's
+        merge reducer serialized per leaf (fusible count 23 -> >= 32)."""
+        import json
+        from pathlib import Path
+
+        manifest = json.loads(Path("scripts/fusibility_manifest.json").read_text())
+        metrics = manifest["metrics"]
+        family = [k for k in metrics if k.startswith("retrieval/")]
+        assert len(family) == 9
+        for key in family:
+            assert metrics[key]["verdict"] == "fusible", (key, metrics[key]["verdict"])
+            assert metrics[key]["states"]["qtable"]["dist_reduce_fx"] == "merge", key
+        fusible_count = sum(1 for v in metrics.values() if v["verdict"] == "fusible")
+        assert fusible_count >= 32, fusible_count

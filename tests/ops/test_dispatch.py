@@ -37,9 +37,18 @@ def test_register_requires_callable_fallback():
 
 
 def test_jnp_only_op_never_routes_pallas(monkeypatch):
+    # segment_max/min grew real kernels (PR 15), so the jnp-only contract
+    # is pinned on a synthetic slot the way future reservations register
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    spec = ops.get_kernel("segment_max")
-    assert choose_backend(spec, jnp.ones((512,)), jnp.zeros(512, jnp.int32), 128) == "jnp"
+    spec = ops.register_kernel("_test_jnp_only", pallas_fn=None, jnp_fn=lambda x: x)
+    try:
+        assert choose_backend(spec, jnp.ones((512,))) == "jnp"
+    finally:
+        import sys
+
+        _d = sys.modules["metrics_tpu.ops.dispatch"]  # package attr is the function
+        with _d._REGISTRY_LOCK:
+            _d._REGISTRY.pop("_test_jnp_only", None)
 
 
 def test_route_respected_on_fake_tpu(monkeypatch):

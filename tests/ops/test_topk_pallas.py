@@ -1,0 +1,217 @@
+"""Fused per-row top-k + gather kernel vs the jnp path, plus the new
+segment-extremum kernels filling the formerly jnp-only dispatch slots.
+
+Interpret mode runs the REAL kernel bodies on CPU (the ``tests/ops/``
+convention). Selection and permutation are value-exact operations, so —
+unlike segment-sum — EVERY case here pins BIT-identical agreement, ties
+and invalid slots included.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import ops
+from metrics_tpu.ops.dispatch import choose_backend
+from metrics_tpu.ops.scatter_pallas import segment_extremum_tiled
+from metrics_tpu.ops.topk_pallas import _row_topk_jnp, row_topk_tiled
+
+_rng = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------------------
+# row_topk
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "r,n,k",
+    [(1, 2, 1), (8, 100, 5), (20, 300, 7), (65, 257, 32), (3, 16, 16), (5, 9, 20)],
+)
+def test_row_topk_interpret_bit_identical(r, n, k):
+    """Ragged row/col counts off the tile multiples, k above and below the
+    column count, heavy ties (quantized scores) — all bit-identical."""
+    preds = (_rng.randint(0, 16, (r, n)) / 4.0).astype(np.float32)
+    valid = (_rng.rand(r, n) < 0.7).astype(np.float32)
+    payload = _rng.randint(0, 2, (r, n)).astype(np.float32)
+    got = row_topk_tiled(preds, payload, valid, k, interpret=True)
+    want = _row_topk_jnp(jnp.asarray(preds), jnp.asarray(payload), jnp.asarray(valid), k)
+    for g, w, name in zip(got, want, ("keys", "payload", "valid")):
+        assert jnp.array_equal(g, w, equal_nan=True), name
+
+
+def test_row_topk_tie_break_is_stable():
+    """Equal keys keep the LOWER column index first — the stable descending
+    sort order — on both backends, so kernel-vs-fallback agreement holds
+    even when the selection boundary lands inside a tie run."""
+    preds = jnp.asarray([[1.0, 2.0, 2.0, 2.0, 0.5]], jnp.float32)
+    payload = jnp.asarray([[10.0, 11.0, 12.0, 13.0, 14.0]], jnp.float32)
+    valid = jnp.ones((1, 5), jnp.float32)
+    for backend in ("interpret", "jnp"):
+        with ops.forced_backend(backend):
+            keys, pay, val = ops.row_topk_dispatch(preds, payload, valid, 2)
+        assert keys.tolist() == [[2.0, 2.0]]
+        assert pay.tolist() == [[11.0, 12.0]]  # first-occurrence order
+
+
+def test_row_topk_invalid_slots_sort_last():
+    preds = jnp.asarray([[5.0, 4.0, 3.0]], jnp.float32)
+    valid = jnp.asarray([[0.0, 1.0, 1.0]], jnp.float32)  # best score invalid
+    payload = jnp.asarray([[1.0, 2.0, 3.0]], jnp.float32)
+    for backend in ("interpret", "jnp"):
+        with ops.forced_backend(backend):
+            keys, pay, val = ops.row_topk_dispatch(preds, payload, valid, 3)
+        assert pay.tolist() == [[2.0, 3.0, 1.0]]
+        assert val.tolist() == [[1.0, 1.0, 0.0]]
+        assert keys[0, 2] == -jnp.inf
+
+
+def test_row_topk_k_validation():
+    with pytest.raises(ValueError, match="positive static int"):
+        ops.row_topk_dispatch(jnp.ones((2, 4)), jnp.ones((2, 4)), jnp.ones((2, 4)), 0)
+    with pytest.raises(ValueError, match="rows, cols"):
+        ops.row_topk_dispatch(jnp.ones(4), jnp.ones(4), jnp.ones(4), 2)
+
+
+def test_row_topk_route_floors(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    spec = ops.get_kernel("row_topk")
+    big = (jnp.ones((256, 512), jnp.float32), jnp.ones((256, 512)), jnp.ones((256, 512)), 8)
+    tiny = (jnp.ones((4, 16), jnp.float32), jnp.ones((4, 16)), jnp.ones((4, 16)), 4)
+    wide = (jnp.ones((64, 1 << 12), jnp.float32),) * 3 + (8,)
+    bf16 = (jnp.ones((256, 512), jnp.bfloat16), jnp.ones((256, 512)), jnp.ones((256, 512)), 8)
+    assert choose_backend(spec, *big) == "pallas"
+    assert choose_backend(spec, *tiny) == "jnp"  # below the size floors
+    assert choose_backend(spec, *wide) == "jnp"  # past the network width cap
+    assert choose_backend(spec, *bf16) == "jnp"  # f32-only route
+
+
+# ---------------------------------------------------------------------------
+# segment extremum kernels
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("is_max", [True, False], ids=["max", "min"])
+@pytest.mark.parametrize(
+    "b,d,s", [(1, 1, 1), (300, 3, 40), (512, 1, 128), (1000, 5, 257)]
+)
+def test_segment_extremum_interpret_bit_identical(is_max, b, d, s):
+    """Extremum folds never round: parity is bit-exact for arbitrary float
+    data (not just the integer window), ragged tails included."""
+    ids = _rng.randint(-2, s + 3, b)  # OOB and negative ids drop
+    vals = _rng.randn(b, d).astype(np.float32)
+    got = segment_extremum_tiled(vals, ids, s, is_max=is_max, interpret=True)
+    ref = (jax.ops.segment_max if is_max else jax.ops.segment_min)(
+        jnp.asarray(vals), jnp.asarray(ids), num_segments=s
+    )
+    assert jnp.array_equal(got, ref)
+
+
+def test_segment_extremum_1d_and_empty_segment_identity():
+    vals = jnp.asarray([1.0, 5.0, -3.0], jnp.float32)
+    ids = jnp.asarray([0, 0, 2])
+    mx = segment_extremum_tiled(vals, ids, 4, is_max=True, interpret=True)
+    assert mx.shape == (4,)
+    assert mx[0] == 5.0 and mx[2] == -3.0
+    assert mx[1] == -jnp.inf and mx[3] == -jnp.inf  # empty = identity
+    mn = segment_extremum_tiled(vals, ids, 4, is_max=False, interpret=True)
+    assert mn[0] == 1.0 and mn[1] == jnp.inf
+
+
+def test_segment_extremum_nd_values_flatten_and_route_guard(monkeypatch):
+    """ND max/min leaves (a SlicedMetric wrapping a 2-D extremum state)
+    flatten through the 2-D kernel and restore; the route itself refuses
+    ND so a direct dispatch caller can never crash the kernel on TPU."""
+    vals = _rng.randn(512, 4, 4).astype(np.float32)
+    ids = _rng.randint(0, 128, 512)
+    want = jax.ops.segment_max(jnp.asarray(vals), jnp.asarray(ids), num_segments=128)
+    with ops.forced_backend("interpret"):
+        got = ops.segment_max_dispatch(vals, ids, 128)
+    assert got.shape == (128, 4, 4)
+    assert jnp.array_equal(got, want)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    spec = ops.get_kernel("segment_max")
+    nd = (jnp.ones((512, 4, 4), jnp.float32), jnp.zeros(512, jnp.int32), 128)
+    assert choose_backend(spec, *nd) == "jnp"
+
+
+def test_segment_extremum_dispatch_interpret_parity():
+    ids = _rng.randint(0, 50, 400)
+    vals = _rng.randn(400).astype(np.float32)
+    want_max = jax.ops.segment_max(jnp.asarray(vals), jnp.asarray(ids), num_segments=50)
+    want_min = jax.ops.segment_min(jnp.asarray(vals), jnp.asarray(ids), num_segments=50)
+    with ops.forced_backend("interpret"):
+        got_max = ops.segment_max_dispatch(vals, ids, 50)
+        got_min = ops.segment_min_dispatch(vals, ids, 50)
+    assert jnp.array_equal(got_max, want_max)
+    assert jnp.array_equal(got_min, want_min)
+
+
+def test_segment_extremum_route_mirrors_sum_floors(monkeypatch):
+    """ISSUE 15 satellite: the extremum kernels route behind the SAME f32
+    / batch / segment floors as segment-sum (minus the 2**24 exactness cap
+    an extremum doesn't need), with a tighter feature bound."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    for name in ("segment_max", "segment_min"):
+        spec = ops.get_kernel(name)
+        big = (jnp.ones((2048, 4), jnp.float32), jnp.zeros(2048, jnp.int32), 256)
+        small = (jnp.ones((8, 4), jnp.float32), jnp.zeros(8, jnp.int32), 4)
+        ints = (jnp.ones((2048, 4), jnp.int32), jnp.zeros(2048, jnp.int32), 256)
+        bf16 = (jnp.ones((2048, 4), jnp.bfloat16), jnp.zeros(2048, jnp.int32), 256)
+        wide = (jnp.ones((2048, 512), jnp.float32), jnp.zeros(2048, jnp.int32), 256)
+        assert choose_backend(spec, *big) == "pallas", name
+        assert choose_backend(spec, *small) == "jnp", name
+        assert choose_backend(spec, *ints) == "jnp", name
+        assert choose_backend(spec, *bf16) == "jnp", name
+        assert choose_backend(spec, *wide) == "jnp", name  # feature bound
+
+
+# ---------------------------------------------------------------------------
+# composition: the retrieval table's hot paths through the kernels
+# ---------------------------------------------------------------------------
+
+
+def test_retrieval_table_compaction_through_interpret_kernels():
+    """Doc-overflow compaction and a cross-rank merge, with every dispatch
+    forced through the real kernel bodies: final tables bit-identical to
+    the jnp-path run."""
+    from metrics_tpu.retrieval.table import (
+        retrieval_table_init,
+        retrieval_table_insert,
+        retrieval_table_merge,
+    )
+
+    rng = np.random.RandomState(3)
+    idx = np.repeat(np.arange(6), 40)  # 40 docs into max_docs=16 -> compacts
+    preds = rng.rand(240).astype(np.float32)
+    target = (rng.rand(240) < 0.5).astype(np.int32)
+
+    def run():
+        t = retrieval_table_insert(retrieval_table_init(16, 16), idx, preds, target)
+        other = retrieval_table_insert(
+            retrieval_table_init(16, 16), idx + 3, preds[::-1].copy(), target[::-1].copy()
+        )
+        return retrieval_table_merge(t, other)
+
+    plain = run()
+    with ops.forced_backend("interpret"):
+        kernel = run()
+    assert jnp.array_equal(plain, kernel)
+
+
+def test_ops_dispatch_counters_cover_new_ops():
+    from metrics_tpu.observability import get_recorder
+
+    rec = get_recorder()
+    rec.enable()
+    try:
+        with ops.forced_backend("jnp"):
+            ops.row_topk_dispatch(jnp.ones((4, 8)), jnp.ones((4, 8)), jnp.ones((4, 8)), 2)
+            ops.segment_max_dispatch(jnp.ones(8), jnp.zeros(8, jnp.int32), 4)
+        totals = rec.ops_dispatch_totals()
+        assert totals.get("row_topk|jnp", 0) >= 1
+        assert totals.get("segment_max|jnp", 0) >= 1
+    finally:
+        rec.disable()
+        rec.reset()

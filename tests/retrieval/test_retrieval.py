@@ -231,7 +231,7 @@ def test_padded_compute_equals_host_loop(metric_class, metric_args, action):
     preds = jnp.asarray(np.concatenate(preds_list))
     target = jnp.asarray(np.concatenate(target_list))
 
-    m = metric_class(empty_target_action=action, **metric_args)
+    m = metric_class(empty_target_action=action, exact=True, **metric_args)
     assert type(m)._padded_metric is not None  # library classes all have kernels
     m.update(preds, target, indexes=indexes)
     padded_val = np.asarray(m._compute())
@@ -245,7 +245,7 @@ def test_padded_graded_ndcg_equals_host_loop():
     indexes = jnp.asarray(np.concatenate([np.full(n, q) for q, n in enumerate(n_per)]))
     preds = jnp.asarray(rng.random(sum(n_per)).astype(np.float32))
     target = jnp.asarray(rng.integers(0, 6, sum(n_per)).astype(np.int32))  # graded
-    m = RetrievalNormalizedDCG(k=4)
+    m = RetrievalNormalizedDCG(k=4, exact=True)
     m.update(preds, target, indexes=indexes)
     np.testing.assert_allclose(np.asarray(m._compute()), np.asarray(m._compute_host_loop()), atol=1e-6)
 
@@ -283,7 +283,7 @@ def test_skewed_groups_fall_back_to_host_loop():
 
     assert pack_queries(jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target), max_expand=16) is None
 
-    m = RetrievalMAP()
+    m = RetrievalMAP(exact=True)
     m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     np.testing.assert_allclose(np.asarray(m._compute()), np.asarray(m._compute_host_loop()), atol=1e-6)
 
@@ -321,7 +321,7 @@ def test_collection_shares_one_pack_across_metrics(monkeypatch):
     preds = rng.random(400).astype(np.float32)
     target = rng.integers(0, 2, 400).astype(np.int32)
 
-    col = MetricCollection([RetrievalNormalizedDCG(), RetrievalMAP()])
+    col = MetricCollection([RetrievalNormalizedDCG(exact=True), RetrievalMAP(exact=True)])
     col.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     out = col.compute()
     assert calls["n"] == 1  # one pack for both metrics
@@ -332,7 +332,7 @@ def test_collection_shares_one_pack_across_metrics(monkeypatch):
     assert calls["n"] == 2
 
     # parity vs an independent metric (its own state -> its own pack)
-    solo = RetrievalMAP()
+    solo = RetrievalMAP(exact=True)
     solo.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     np.testing.assert_allclose(
         np.asarray(out["RetrievalMAP"]), np.asarray(solo.compute()), atol=1e-6
@@ -348,7 +348,7 @@ def test_pack_cache_entry_freed_with_its_arrays():
     import metrics_tpu.functional.retrieval.padded as padded
 
     padded._PACK_CACHE.clear()
-    m = RetrievalMAP()
+    m = RetrievalMAP(exact=True)
     m.update(
         jnp.asarray([0.3, 0.7, 0.2, 0.9]), jnp.asarray([0, 1, 1, 0]), indexes=jnp.asarray([0, 0, 1, 1])
     )
@@ -382,12 +382,12 @@ def test_collection_shares_one_row_sort(monkeypatch):
     preds = rng.random(240).astype(np.float32)
     target = rng.integers(0, 2, 240).astype(np.int32)
 
-    col = MetricCollection([RetrievalNormalizedDCG(), RetrievalMAP()])
+    col = MetricCollection([RetrievalNormalizedDCG(exact=True), RetrievalMAP(exact=True)])
     col.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     out = col.compute()
     assert calls["n"] == 1  # one argsort for both metrics
 
-    solo = RetrievalMAP()
+    solo = RetrievalMAP(exact=True)
     solo.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     np.testing.assert_allclose(
         np.asarray(out["RetrievalMAP"]), np.asarray(solo._compute_host_loop()), atol=1e-6

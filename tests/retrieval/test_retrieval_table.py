@@ -1,0 +1,453 @@
+"""Fixed-capacity per-query table state (retrieval/table.py) vs the
+``exact=True`` cat-state path.
+
+The contract under test (docs/retrieval_states.md):
+
+* **In-window parity** — distinct queries <= max_queries and per-query
+  docs <= max_docs: per-query values are bit-identical to the exact
+  path; the final mean over queries is bit-identical whenever the value
+  sum is exactly representable (dyadic values — hit-rate, precision@2^k)
+  and within float tolerance otherwise (the fixed [max_queries] row
+  count can re-associate the final reduction tree).
+* **Policy exactness** — all four ``empty_target_action`` modes and
+  ``ignore_index`` behave identically to exact mode (the table's
+  POS/NEG counters never truncate).
+* **Reservoir determinism** — the sampled query set past capacity is a
+  pure function of the query-id set: independent of arrival order,
+  batch chunking, and rank placement.
+* **Composition** — fused single-dispatch, ragged-shape bucketing (one
+  compile), async ingest, and the 8-device mesh merge round all produce
+  the same states as eager updates.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.retrieval.table import (
+    _unpack,
+    retrieval_table_fill,
+    retrieval_table_init,
+    retrieval_table_insert,
+    retrieval_table_layout,
+    retrieval_table_merge,
+)
+
+ALL_CLASSES = [
+    (RetrievalMAP, {}),
+    (RetrievalMRR, {}),
+    (RetrievalPrecision, {"k": 2}),
+    (RetrievalRecall, {"k": 3}),
+    (RetrievalHitRate, {"k": 2}),
+    (RetrievalFallOut, {"k": 2}),
+    (RetrievalRPrecision, {}),
+    (RetrievalNormalizedDCG, {}),
+    (RetrievalNormalizedDCG, {"k": 3}),
+]
+
+
+def _stream(seed=0, n_q=19, lo=1, hi=9, all_pos_every=7, all_neg_every=5):
+    rng = np.random.RandomState(seed)
+    idx_l, p_l, t_l = [], [], []
+    for q in range(n_q):
+        n = int(rng.randint(lo, hi))
+        idx_l.append(np.full(n, q * 13 + 5))  # sparse non-contiguous ids
+        p_l.append((rng.randint(0, 64, n) / 64.0).astype(np.float32))
+        if q % all_neg_every == 0:
+            t = np.zeros(n)
+        elif q % all_pos_every == 0:
+            t = np.ones(n)
+        else:
+            t = rng.randint(0, 2, n)
+        t_l.append(t.astype(np.int32))
+    return (
+        np.concatenate(idx_l),
+        np.concatenate(p_l),
+        np.concatenate(t_l),
+    )
+
+
+def _pair(cls, action="neg", ignore_index=None, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        exact = cls(empty_target_action=action, ignore_index=ignore_index, exact=True, **kw)
+    table = cls(
+        empty_target_action=action,
+        ignore_index=ignore_index,
+        max_queries=64,
+        max_docs=16,
+        **kw,
+    )
+    return exact, table
+
+
+# ---------------------------------------------------------------------------
+# in-window parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls, kw", ALL_CLASSES, ids=lambda c: getattr(c, "__name__", str(c)))
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_table_matches_exact_all_actions(cls, kw, action):
+    idx, preds, target = _stream(1)
+    exact, table = _pair(cls, action=action, **kw)
+    cuts = [0, 17, 18, 60, len(idx)]
+    for lo, hi in zip(cuts, cuts[1:]):
+        if hi > lo:
+            for m in (exact, table):
+                m.update(
+                    jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]), indexes=jnp.asarray(idx[lo:hi])
+                )
+    np.testing.assert_allclose(
+        np.asarray(exact.compute()), np.asarray(table.compute()), atol=1e-6
+    )
+
+
+@pytest.mark.parametrize(
+    "cls, kw",
+    [(RetrievalHitRate, {"k": 2}), (RetrievalPrecision, {"k": 2}), (RetrievalPrecision, {"k": 4})],
+)
+def test_table_bit_identical_on_dyadic_values(cls, kw):
+    """Hit-rate / precision@2^k per-query values are dyadic rationals, so
+    their sum is exact in f32 whatever the reduction tree — the table and
+    exact paths must agree BIT-for-bit, not just within tolerance."""
+    idx, preds, target = _stream(2)
+    exact, table = _pair(cls, **kw)
+    for m in (exact, table):
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    assert float(exact.compute()) == float(table.compute())
+
+
+def test_table_ignore_index_matches_exact():
+    rng = np.random.RandomState(3)
+    idx, preds, target = _stream(3)
+    target = target.copy()
+    target[rng.rand(len(target)) < 0.25] = -100  # ignored docs
+    exact, table = _pair(RetrievalMAP, ignore_index=-100)
+    for m in (exact, table):
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(
+        np.asarray(exact.compute()), np.asarray(table.compute()), atol=1e-6
+    )
+
+
+def test_table_error_action_parity():
+    exact, table = _pair(RetrievalMAP, action="error")
+    z = jnp.zeros(4, jnp.int32)
+    for m in (exact, table):
+        m.update(jnp.asarray([0.1, 0.2, 0.3, 0.4]), z, indexes=jnp.asarray([0, 0, 1, 1]))
+        with pytest.raises(ValueError, match="no positive"):
+            m.compute()
+
+
+def test_table_fall_out_inverted_empty_counter():
+    """FallOut's empty flag reads the NEG counter — all-positive queries
+    trip the inverted error exactly as the cat path does."""
+    exact, table = _pair(RetrievalFallOut, action="error")
+    ones = jnp.ones(4, jnp.int32)
+    for m in (exact, table):
+        m.update(jnp.asarray([0.1, 0.2, 0.3, 0.4]), ones, indexes=jnp.asarray([0, 0, 1, 1]))
+        with pytest.raises(ValueError, match="no negative"):
+            m.compute()
+
+
+def test_table_graded_ndcg_matches_exact():
+    rng = np.random.RandomState(4)
+    n_per = [3, 8, 5, 12, 1, 7]
+    idx = np.concatenate([np.full(n, q * 3) for q, n in enumerate(n_per)])
+    preds = (rng.randint(0, 64, sum(n_per)) / 64.0).astype(np.float32)
+    target = rng.randint(0, 6, sum(n_per)).astype(np.int32)
+    exact, table = _pair(RetrievalNormalizedDCG, k=4)
+    for m in (exact, table):
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(
+        np.asarray(exact.compute()), np.asarray(table.compute()), atol=1e-6
+    )
+
+
+def test_exact_mode_is_jit_unsafe_table_is_not():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        exact = RetrievalMAP(exact=True)
+    table = RetrievalMAP()
+    assert exact.__jit_unsafe__ is True  # instance-level flip
+    assert getattr(table, "__jit_unsafe__") is False
+    assert isinstance(exact.indexes, list)
+    assert isinstance(table.qtable, jnp.ndarray)
+
+
+def test_empty_compute_raises_descriptive():
+    m = RetrievalMAP(max_queries=8, max_docs=8)
+    m._update_called = True  # silence the warn; the raise is the contract
+    with pytest.raises(ValueError, match="no accumulated samples"):
+        m.compute()
+
+
+# ---------------------------------------------------------------------------
+# ragged chunking / capacity semantics
+# ---------------------------------------------------------------------------
+
+
+def test_chunking_invariance():
+    """One big update == many ragged updates == doc-level dribble."""
+    idx, preds, target = _stream(5, n_q=11)
+    ms = [RetrievalMAP(max_queries=32, max_docs=16) for _ in range(3)]
+    ms[0].update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    for lo in range(0, len(idx), 7):
+        ms[1].update(
+            jnp.asarray(preds[lo : lo + 7]), jnp.asarray(target[lo : lo + 7]), indexes=jnp.asarray(idx[lo : lo + 7])
+        )
+    for lo in range(0, len(idx), 1):
+        ms[2].update(
+            jnp.asarray(preds[lo : lo + 1]), jnp.asarray(target[lo : lo + 1]), indexes=jnp.asarray(idx[lo : lo + 1])
+        )
+    vals = [float(m.compute()) for m in ms]
+    assert vals[0] == vals[1] == vals[2]
+
+
+def test_doc_overflow_keeps_counters_exact_and_truncates_topk():
+    """A query streaming far past max_docs: NSEEN/POS/NEG stay exact, the
+    stored docs are the top-scored survivors, and the empty policy still
+    reads the exact counters."""
+    rng = np.random.RandomState(6)
+    n = 300
+    preds = rng.rand(n).astype(np.float32)
+    target = (rng.rand(n) < 0.3).astype(np.int32)
+    m = RetrievalPrecision(k=4, max_queries=4, max_docs=16)
+    for lo in range(0, n, 37):
+        m.update(
+            jnp.asarray(preds[lo : lo + 37]), jnp.asarray(target[lo : lo + 37]), indexes=jnp.zeros(min(37, n - lo), jnp.int32)
+        )
+    key, qid, nseen, pos, neg, fill, pt, tt = _unpack(m.qtable)
+    occ = np.asarray(key) > 0
+    assert occ.sum() == 1
+    r = int(np.nonzero(occ)[0][0])
+    assert int(np.asarray(nseen)[r]) == n
+    assert int(np.asarray(pos)[r]) == int(target.sum())
+    assert int(np.asarray(neg)[r]) == int((target == 0).sum())
+    f = int(np.asarray(fill)[r])
+    assert f <= 16
+    # stored docs are the global top-f by score: precision@4 over them
+    # equals precision@4 over the full stream (truncation keeps the top)
+    order = np.argsort(-preds, kind="stable")
+    expect = float(target[order[:4]].sum() / 4.0)
+    assert float(m.compute()) == pytest.approx(expect)
+
+
+def test_query_reservoir_is_order_and_chunking_invariant():
+    """Past max_queries the retained query SET is a pure function of the
+    id set (deterministic hash keys): permuted arrival and different batch
+    sizes land the same rows, and compute() is identical."""
+    rng = np.random.RandomState(7)
+    qids = np.repeat(np.arange(40) * 7 + 3, 4)
+    preds = rng.rand(160).astype(np.float32)
+    target = (rng.rand(160) < 0.5).astype(np.int32)
+
+    def run(order_seed, batch):
+        m = RetrievalMAP(max_queries=16, max_docs=8)
+        o = np.random.RandomState(order_seed).permutation(160)
+        qi, pp, tt = qids[o], preds[o], target[o]
+        for lo in range(0, 160, batch):
+            m.update(jnp.asarray(pp[lo : lo + batch]), jnp.asarray(tt[lo : lo + batch]), indexes=jnp.asarray(qi[lo : lo + batch]))
+        key, qid, *_ = _unpack(m.qtable)
+        kept = sorted(int(q) for q, k in zip(np.asarray(qid), np.asarray(key)) if k > 0)
+        return kept, float(m.compute())
+
+    k1, v1 = run(0, 160)
+    k2, v2 = run(1, 13)
+    k3, v3 = run(2, 41)
+    assert k1 == k2 == k3 and len(k1) == 16
+    assert v1 == v2 == v3
+
+
+def test_admitted_query_docs_are_complete():
+    """A query surviving the reservoir was admitted at FIRST sight (the
+    table minimum only rises), so its stored docs are the full stream —
+    pinned by comparing against an uncapped table over the kept subset."""
+    rng = np.random.RandomState(8)
+    qids = np.repeat(np.arange(30), 5)
+    preds = rng.rand(150).astype(np.float32)
+    target = (rng.rand(150) < 0.5).astype(np.int32)
+    small = RetrievalMAP(max_queries=8, max_docs=8)
+    for lo in range(0, 150, 11):
+        small.update(jnp.asarray(preds[lo : lo + 11]), jnp.asarray(target[lo : lo + 11]), indexes=jnp.asarray(qids[lo : lo + 11]))
+    key, qid, nseen, *_ = _unpack(small.qtable)
+    kept = {int(q) for q, k in zip(np.asarray(qid), np.asarray(key)) if k > 0}
+    assert len(kept) == 8
+    for q in kept:
+        want = int((qids == q).sum())
+        got = int(np.asarray(nseen)[np.asarray(qid) == q][0])
+        assert got == want
+    # compute == exact mean restricted to the sampled queries
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = RetrievalMAP(exact=True)
+    mask = np.isin(qids, sorted(kept))
+    ref.update(jnp.asarray(preds[mask]), jnp.asarray(target[mask]), indexes=jnp.asarray(qids[mask]))
+    np.testing.assert_allclose(float(small.compute()), float(ref.compute()), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# merge / distributed
+# ---------------------------------------------------------------------------
+
+
+def test_merge_states_equals_single_stream():
+    idx, preds, target = _stream(9)
+    half = len(idx) // 2
+    m1 = RetrievalMAP(max_queries=64, max_docs=16)
+    m2 = RetrievalMAP(max_queries=64, max_docs=16)
+    m1.update(jnp.asarray(preds[:half]), jnp.asarray(target[:half]), indexes=jnp.asarray(idx[:half]))
+    m2.update(jnp.asarray(preds[half:]), jnp.asarray(target[half:]), indexes=jnp.asarray(idx[half:]))
+    merged = m1.merge_states(
+        {k: getattr(m1, k) for k in m1._defaults}, {k: getattr(m2, k) for k in m2._defaults}
+    )
+    full = RetrievalMAP(max_queries=64, max_docs=16)
+    full.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    got = float(full.compute_state(merged))
+    assert got == float(full.compute())
+
+
+def test_merge_commutes_in_window():
+    idx, preds, target = _stream(10)
+    half = len(idx) // 2
+    t1 = retrieval_table_insert(
+        retrieval_table_init(64, 16), idx[:half], preds[:half], target[:half]
+    )
+    t2 = retrieval_table_insert(
+        retrieval_table_init(64, 16), idx[half:], preds[half:], target[half:]
+    )
+    ab = retrieval_table_merge(t1, t2)
+    ba = retrieval_table_merge(t2, t1)
+    # row multiset equality (row order differs; canonicalize by qid)
+    la = retrieval_table_layout(ab)
+    lb = retrieval_table_layout(ba)
+    for xa, xb in zip(la, lb):
+        assert jnp.array_equal(jnp.asarray(xa), jnp.asarray(xb))
+
+
+def test_mesh_merge_round_equals_host_fold():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+    from metrics_tpu.utils.compat import shard_map
+
+    n_dev = 8
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("rank",))
+    rng = np.random.RandomState(11)
+    per_rank, streams = [], []
+    for r in range(n_dev):
+        m = RetrievalMAP(max_queries=128, max_docs=16)
+        counts = rng.randint(1, 6, 5)
+        idx = np.repeat(np.arange(r * 5, r * 5 + 5), counts)
+        p = rng.rand(len(idx)).astype(np.float32)
+        t = (rng.rand(len(idx)) < 0.5).astype(np.int32)
+        m.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        per_rank.append(jnp.asarray(m.qtable))
+        streams.append((idx, p, t))
+    template = RetrievalMAP(max_queries=128, max_docs=16)
+    reductions = template.state_reductions()
+    stacked = jnp.stack(per_rank)
+
+    def body(tab):
+        return sync_pytree_in_mesh({"qtable": tab[0]}, reductions, "rank")["qtable"]
+
+    synced = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(P("rank"),), out_specs=P())
+    )(stacked)
+    assert jnp.array_equal(synced, reductions["qtable"](stacked))
+    # in-window: fold == one metric over the union stream
+    union = RetrievalMAP(max_queries=128, max_docs=16)
+    for idx, p, t in streams:
+        union.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+    assert float(union.compute_state({"qtable": synced})) == float(union.compute())
+
+
+# ---------------------------------------------------------------------------
+# fused / bucketed / async composition
+# ---------------------------------------------------------------------------
+
+
+def _ragged_batches(seed=12):
+    rng = np.random.RandomState(seed)
+    out = []
+    for base, n_q in ((0, 10), (10, 13), (23, 7)):
+        counts = rng.randint(2, 8, n_q)
+        idx = np.repeat(np.arange(base, base + n_q), counts)
+        n = len(idx)
+        out.append(
+            (
+                jnp.asarray(rng.rand(n).astype(np.float32)),
+                jnp.asarray((rng.rand(n) < 0.4).astype(np.int32)),
+                jnp.asarray(idx),
+            )
+        )
+    return out
+
+
+def test_fused_bucketed_single_compile_bit_parity():
+    kw = dict(max_queries=256, max_docs=32)
+    fused = MetricCollection([RetrievalNormalizedDCG(**kw), RetrievalMAP(**kw)])
+    eager = MetricCollection([RetrievalNormalizedDCG(**kw), RetrievalMAP(**kw)])
+    handle = fused.compile_update(buckets=[64, 128, 256])
+    for p, t, i in _ragged_batches():
+        fused.update(p, t, indexes=i)
+        eager.update(p, t, indexes=i)
+    rf = {k: float(v) for k, v in fused.compute().items()}
+    re_ = {k: float(v) for k, v in eager.compute().items()}
+    assert rf == re_
+    assert len(handle._cache) == 1  # ONE compile across 3 ragged shapes
+    assert not handle._eager_names  # nobody fell back eagerly
+    # state-level bit parity, not just the computed scalars
+    for name in ("RetrievalNormalizedDCG", "RetrievalMAP"):
+        assert jnp.array_equal(fused[name].qtable, eager[name].qtable)
+
+
+def test_async_ingest_bit_parity():
+    kw = dict(max_queries=256, max_docs=32)
+    a = MetricCollection([RetrievalMAP(**kw)])
+    b = MetricCollection([RetrievalMAP(**kw)])
+    a.compile_update_async(buckets=[64, 128, 256])
+    for p, t, i in _ragged_batches(13):
+        a.update_async(p, t, indexes=i)
+        b.update(p, t, indexes=i)
+    assert float(a.compute()["RetrievalMAP"]) == float(b.compute()["RetrievalMAP"])
+
+
+def test_manifest_seeds_fused_build_without_probe():
+    from metrics_tpu.core.metric import Metric
+
+    entry = RetrievalMAP.static_fusibility()
+    assert entry is not None and entry["verdict"] == "fusible"
+    assert entry["states"]["qtable"]["dist_reduce_fx"] == "merge"
+
+
+# ---------------------------------------------------------------------------
+# observability surface
+# ---------------------------------------------------------------------------
+
+
+def test_footprint_under_sketch_prefix_and_fill_ratio():
+    m = RetrievalMAP(max_queries=32, max_docs=8)
+    fp = m.state_footprint()
+    assert list(fp) == ["sketch/qtable"]
+    assert fp["sketch/qtable"] == 32 * (7 + 16) * 4
+    idx, preds, target = _stream(14, n_q=5)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    ratios = m.sketch_fill_ratios()
+    assert ratios["qtable"] == pytest.approx(5 / 32)
+    assert int(retrieval_table_fill(m.qtable)) == 5
