@@ -1940,6 +1940,135 @@ def bench_telemetry() -> None:
     )
 
 
+def bench_reads() -> None:
+    """Read-plane bench (ISSUE 16 satellite): subset-read throughput of a
+    ``SlicedMetric`` at S=100k while a background thread keeps the async
+    ingest queue busy — the serving regime the read telemetry instruments.
+
+    Three gated figures ride the committed BENCH_r16.json anchor:
+
+    * ``read_event_overhead_ratio`` (AUX, higher is better) — reads/sec
+      with the recorder + windowed time-series ON divided by reads/sec with
+      the recorder OFF. Every ``compute(slice_ids=)`` on the instrumented
+      side emits a typed ``read`` event and feeds the read/freshness
+      series; the ratio is the whole read-plane's enablement price.
+    * ``freshness_stamp_exact`` (BOOL) — inject a known-age stream: ingest
+      at a recorded wall time, sleep a known delta, take the collection's
+      :meth:`freshness` stamp, and record a stamped probe read. The
+      event's ``staleness_s`` must land within ONE telemetry bucket
+      (``bucket_seconds=1.0``) of the ground-truth age, proving the stamp
+      is threaded causally (ingest wall clock -> stamp -> read event),
+      not re-derived from queue-depth heuristics.
+    * the headline reads/sec value itself (instrumented side).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.observability import get_recorder
+    from metrics_tpu.regression import MeanSquaredError
+    from metrics_tpu.sliced import SlicedMetric
+
+    rng = np.random.RandomState(16)
+    S = 100_000
+    batch = 4096
+
+    col = MetricCollection({"m": SlicedMetric(MeanSquaredError(), num_slices=S)})
+    ids = jnp.asarray(rng.randint(0, S, batch))
+    preds = jnp.asarray(rng.randint(0, 8, batch).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 8, batch).astype(np.float32))
+    col.update(ids, preds, target)  # discovery
+    handle = col.compile_update_async(queue_depth=2, policy="drop")
+    handle.update_async(ids, preds, target)
+    handle.flush()
+
+    sliced = col["m"]
+    query = jnp.asarray(rng.randint(0, S, 256))
+    jax.block_until_ready(sliced.compute(slice_ids=query))  # warm the subset path
+
+    rec = get_recorder()
+    was_enabled = rec.enabled
+
+    # background ingest: keep the async queue non-empty for the whole
+    # measured window so reads race real in-flight writes (the regime the
+    # freshness plane exists for), throttled so the 2-vCPU box's reader
+    # thread still gets scheduled
+    stop = threading.Event()
+
+    def ingest() -> None:
+        while not stop.is_set():
+            handle.update_async(ids, preds, target)
+            time.sleep(0.002)
+
+    n_reads = 150
+
+    def reads_per_sec() -> float:
+        best = 0.0
+        for _ in range(3):  # min-of-3 wall time: noisy-neighbor CPU steal
+            t0 = time.perf_counter()
+            for _ in range(n_reads):
+                jax.block_until_ready(sliced.compute(slice_ids=query))
+            best = max(best, n_reads / (time.perf_counter() - t0))
+        return best
+
+    worker = threading.Thread(target=ingest, daemon=True)
+    worker.start()
+    try:
+        rec.disable()
+        off_rps = reads_per_sec()
+        rec.enable()
+        rec.attach_timeseries(bucket_seconds=1.0, n_buckets=60, sketch_capacity=128)
+        jax.block_until_ready(sliced.compute(slice_ids=query))  # warm series path
+        on_rps = reads_per_sec()
+    finally:
+        stop.set()
+        worker.join(timeout=10)
+    handle.flush()
+
+    # --- freshness exactness on an injected known-age stream (recorder ON) ---
+    probe_col = MetricCollection({"mse": MeanSquaredError()})
+    t_ingest = time.time()
+    probe_col.update(preds, target)
+    time.sleep(0.25)  # the known age
+    stamp = probe_col.freshness()
+    rec.record_read("probe", duration_s=0.0, freshness=stamp)
+    probe_events = [
+        e for e in rec.events() if e.get("type") == "read" and e.get("kind") == "probe"
+    ]
+    measured = float(probe_events[-1].get("staleness_s", float("nan"))) if probe_events else float("nan")
+    truth = time.time() - t_ingest
+    exact = bool(probe_events) and abs(measured - truth) <= 1.0  # one bucket
+
+    handle.close()
+    rec.disable()
+    rec.detach_timeseries()
+    rec.reset()
+    if was_enabled:
+        rec.enable()
+
+    print(
+        json.dumps(
+            {
+                "metric": "read_plane_throughput",
+                "value": round(on_rps, 1),
+                "unit": "reads/sec",
+                "num_slices": S,
+                "reads_per_sec_off": round(off_rps, 1),
+                "read_event_overhead_ratio": round(on_rps / off_rps, 4),
+                "freshness_stamp_exact": exact,
+                "freshness_measured_s": round(measured, 3) if measured == measured else None,
+                "freshness_truth_s": round(truth, 3),
+                "note": "S=100k subset reads under concurrent async ingest;"
+                " ratio is instrumented/off reads per sec (higher is"
+                " better); stamp exactness = staleness_s within one 1s"
+                " telemetry bucket of the injected ground-truth age",
+            }
+        )
+    )
+
+
 SUBCOMMANDS = {
     "map": bench_map,
     "retrieval": bench_retrieval,
@@ -1955,6 +2084,7 @@ SUBCOMMANDS = {
     "collector": bench_collector,
     "ops": bench_ops,
     "ops_ab": bench_ops_ab,
+    "reads": bench_reads,
 }
 
 
@@ -2037,7 +2167,7 @@ def main() -> None:
     import subprocess
 
     records = []  # every emitted JSON object, for the --baseline check
-    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry", "ops", "ops_ab"):
+    for name in ("map", "retrieval", "image", "inference", "sync", "fused", "async", "sliced", "sketch", "windowed", "telemetry", "ops", "ops_ab", "reads"):
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), name],

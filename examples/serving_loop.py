@@ -20,8 +20,10 @@ telemetry, windowed quantiles, and health the whole time:
   (a reader holding the state snapshot lock, i.e. a slow consumer),
   ``recompiles`` (ragged batch shapes), ``skew`` (one hot tenant),
   ``drift`` (a shifted score distribution vs the reference window frozen
-  during warmup), or ``all`` — followed by a recovery phase in which
-  every alarm clears.
+  during warmup), ``stale-reader`` (the dashboard reader pauses past the
+  freshness bound while ingest continues — the ``freshness_slo`` /
+  ``read_latency`` signal), or ``all`` — followed by a recovery phase in
+  which every alarm clears.
 
 Artifacts land in ``--out-dir``: ``metrics.prom`` (Prometheus page incl.
 windowed quantiles + health families), ``telemetry.jsonl`` (event log),
@@ -67,7 +69,7 @@ from metrics_tpu.observability import (
 )
 from metrics_tpu.sliced import SlicedMetric
 
-INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "drift", "all")
+INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "drift", "stale-reader", "all")
 
 #: phase boundaries as fractions of --duration: steady warmup, fault
 #: injection, recovery (the collection is reset at the recovery boundary —
@@ -154,6 +156,11 @@ def run(
             # injected shift measures 2-19 PSI
             drift_threshold=0.5,
             drift_freeze_after=6 * batch_size,
+            # the stale-reader fault pauses the dashboard reader for the
+            # whole fault window (a few seconds); both read-path bounds
+            # sit well inside it and well above healthy probe readings
+            freshness_bound_s=1.5,
+            read_latency_limit_ms=400.0,
         ),
         recorder=rec,
         alarm_log_path=str(out / "health_alarms.jsonl"),
@@ -197,15 +204,41 @@ def run(
     froze_ref = False
     last_probe = 0.0
     ragged_step = 0
+    # the dashboard's view: the FreshnessStamp captured at its last
+    # completed read (collection ingest walls + async accept->apply age),
+    # and — under the stale-reader fault — when its stuck read began
+    last_stamp = collection.freshness()
+    read_start = None
 
-    def probe():
+    def probe(reading_stalled: bool = False):
         """Cheap live probes the loop can afford every few hundred ms: the
-        compute-snapshot staleness gauge straight from the handle's pending
-        counter (no drain, no device work) and the sketch fill ratios as a
-        direct leaf read under the snapshot lock (a full compute() would
-        re-trace the curve kernels per fill count — that readback belongs
-        at epoch boundaries, not on the observatory's poll path)."""
+        queue-staleness gauge straight from the handle's pending counter
+        (no drain, no device work), the end-to-end freshness stamp
+        (``collection.freshness()`` — accept/apply walls, no device work
+        either) recorded as a ``probe`` read, and the sketch fill ratios
+        as a direct leaf read under the snapshot lock (a full compute()
+        would re-trace the curve kernels per fill count — that readback
+        belongs at epoch boundaries, not on the observatory's poll path).
+
+        ``reading_stalled`` simulates the stale-reader fault: the
+        dashboard reader is paused mid-read, so the probe keeps reporting
+        the LAST completed read's stamp (its ingest-to-visible age grows
+        against the live clock — ``freshness_slo``'s signal) and the
+        stuck read's elapsed time (``read_latency``'s signal)."""
+        nonlocal last_stamp, read_start
         rec.record_async_event("snapshot", staleness_steps=handle.pending)
+        now = time.time()
+        if reading_stalled:
+            if read_start is None:
+                read_start = now
+            rec.record_read("probe", duration_s=now - read_start, freshness=last_stamp)
+        else:
+            t0 = time.perf_counter()
+            last_stamp = collection.freshness(now)
+            read_start = None
+            rec.record_read(
+                "probe", duration_s=time.perf_counter() - t0, freshness=last_stamp
+            )
         with handle.snapshot():
             ratios = auroc.sketch_fill_ratios()
         if ratios:
@@ -221,6 +254,7 @@ def run(
             in_fault = fault_lo <= elapsed < fault_hi
             skewing = in_fault and inject in ("skew", "all")
             drifting = in_fault and inject in ("drift", "all")
+            reader_paused = in_fault and inject in ("stale-reader", "all")
 
             if not froze_ref and elapsed >= 0.9 * fault_lo:
                 # end of warmup: freeze the drift reference from the
@@ -262,7 +296,7 @@ def run(
                 burst_until = min(now + 0.2, t_start + fault_hi)
                 while time.time() < burst_until:
                     handle.update_async(preds, target)
-                probe()
+                probe(reading_stalled=reader_paused)
             elif in_fault and inject in ("stall", "all"):
                 # slow consumer: a reader holds the state snapshot lock, so
                 # the worker cannot install batches while the producer keeps
@@ -291,7 +325,7 @@ def run(
 
             if now - last_probe >= export_interval_s / 2:
                 last_probe = now
-                probe()
+                probe(reading_stalled=reader_paused)
 
         # epoch-end publish: one full (drained) compute, then the second
         # epoch boundary — reset so the tail starts with empty sketches
@@ -351,6 +385,8 @@ def run(
             "max_queue_depth": async_totals["max_queue_depth"],
             "max_staleness_steps": async_totals["max_staleness_steps"],
         },
+        "reads": rec.read_totals(),
+        "freshness": rec.freshness_totals(),
         "export_errors": rec.export_errors(),
     }
     (out / "report.json").write_text(json.dumps(report, indent=2) + "\n")

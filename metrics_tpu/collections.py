@@ -11,6 +11,7 @@ pre-filters on static state *definitions* (names, shapes, reducers) before
 the value comparison, so no array data is fetched for obviously-different
 metrics.
 """
+import time
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
@@ -19,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability.freshness import FreshnessStamp, merge_stamps
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.trace import span as _span
 from metrics_tpu.utils.exceptions import MetricsUserError
@@ -69,6 +71,11 @@ class MetricCollection:
         self._fused = None  # FusedUpdate handle once compile_update() is called
         self._async = None  # AsyncUpdateHandle once compile_update_async() is called
         self._bulk_insert = False  # add_metrics defers the membership handler
+        # wall clock of the first/last batch ingested through THIS object
+        # (telemetry-enabled updates only) — covers the fused path, whose
+        # member metrics never see their own update() stamps
+        self._ingest_first_t: Optional[float] = None
+        self._ingest_last_t: Optional[float] = None
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -159,6 +166,10 @@ class MetricCollection:
         if not _TELEMETRY.enabled:
             self._update_impl(*args, **kwargs)
             return
+        now = time.time()
+        if self._ingest_first_t is None:
+            self._ingest_first_t = now
+        self._ingest_last_t = now
         # the collection span parents every member metric's own span, so the
         # per-metric rows nest instead of reading as unrelated siblings
         with _span("MetricCollection.update", n_metrics=len(self._metrics)):
@@ -348,6 +359,23 @@ class MetricCollection:
                         m._computed = None
         return self._compute_metrics()
 
+    def freshness(self, now: Optional[float] = None) -> FreshnessStamp:
+        """The collection's :class:`~metrics_tpu.observability.freshness.
+        FreshnessStamp`: the merge (min/max monoid fold) of the collection-
+        level ingest span, every member metric's own stamp, and — when an
+        async handle is open — the pipeline's applied-span + in-flight-age
+        stamp. This is THE read-side staleness answer serving loops should
+        use instead of hand-rolled `pending`-count math."""
+        stamps: List[FreshnessStamp] = [
+            FreshnessStamp(
+                min_event_t=self._ingest_first_t, max_event_t=self._ingest_last_t
+            )
+        ]
+        stamps.extend(m.freshness_stamp(now) for m in self._metrics.values())
+        if self._async is not None and not self._async.closed:
+            stamps.append(self._async.freshness(now))
+        return merge_stamps(stamps)
+
     def _compute_metrics(self) -> Dict[str, Any]:
         if self._enable_compute_groups and self._groups_checked:
             for cg in self._groups.values():
@@ -505,6 +533,8 @@ class MetricCollection:
         if self._async is not None:
             self._async.close(drain=False)
             self._async = None
+        self._ingest_first_t = None
+        self._ingest_last_t = None
         for m in self._metrics.values():
             m.reset()
 

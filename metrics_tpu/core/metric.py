@@ -50,6 +50,7 @@ from metrics_tpu.utils.data import (
 )
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.observability.freshness import FreshnessStamp
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import SKETCH_FOOTPRINT_PREFIX, _nbytes
 from metrics_tpu.observability.trace import span as _span
@@ -161,6 +162,11 @@ class Metric(ABC):
         self._should_unsync = True
         self._forward_cache: Any = None
         self._computed: Any = None
+        # wall clock of the first/last ingested batch (telemetry-enabled
+        # updates only — freshness stamping is part of the telemetry plane
+        # and the disabled hot path must stay one bool check)
+        self._ingest_first_t: Optional[float] = None
+        self._ingest_last_t: Optional[float] = None
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
@@ -390,6 +396,10 @@ class Metric(ABC):
             self._bump_auto_count()
             return
         t0 = time.perf_counter()
+        now = time.time()
+        if self._ingest_first_t is None:
+            self._ingest_first_t = now
+        self._ingest_last_t = now
         coerced_args = _coerce_foreign(args)
         coerced_kwargs = _coerce_foreign(kwargs)
         with self._trace_annotation("update"):  # annotation + telemetry span
@@ -421,6 +431,14 @@ class Metric(ABC):
                 UserWarning,
             )
         if self._computed is not None:
+            if _TELEMETRY.enabled:  # disabled read path stays ONE bool check
+                _TELEMETRY.record_read(
+                    "compute",
+                    self,
+                    cache_hit=True,
+                    leaves=len(self._defaults),
+                    freshness=self.freshness_stamp(),
+                )
             return self._computed
 
         # capture the gate once: a recorder enabled mid-call must not record
@@ -444,13 +462,36 @@ class Metric(ABC):
                     value = self._compute()
                 self._computed = _squeeze_if_scalar(value)
             if rec is not None:
-                rec.record_call("compute", self, time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                rec.record_call("compute", self, dt)
+                rec.record_read(
+                    "compute",
+                    self,
+                    duration_s=dt,
+                    leaves=len(self._defaults),
+                    freshness=self.freshness_stamp(),
+                    **self._read_extras(),
+                )
                 # sketch occupancy is read on the cold compute path only
                 # (it syncs the leaf); no-op for metrics without sketch leaves
                 ratios = self.sketch_fill_ratios()
                 if ratios:
                     rec.record_sketch_fill(self, ratios)
         return self._computed
+
+    def freshness_stamp(self, now: Optional[float] = None) -> "FreshnessStamp":
+        """The :class:`~metrics_tpu.observability.freshness.FreshnessStamp`
+        of this metric's accumulated state: wall clock of the first/last
+        ingested batch. Identity until a telemetry-enabled ``update`` runs
+        (ingest times are stamped only while the recorder is on)."""
+        return FreshnessStamp(
+            min_event_t=self._ingest_first_t, max_event_t=self._ingest_last_t
+        )
+
+    def _read_extras(self) -> Dict[str, Any]:
+        """Extra ``record_read`` fields a subclass' ``_compute`` wants on
+        the read event (e.g. RetrievalMetric's table rows unpacked)."""
+        return {}
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Update global state AND return the metric for just this batch.
@@ -516,6 +557,8 @@ class Metric(ABC):
                 object.__setattr__(self, attr, jnp.array(default))
         self._cache = None
         self._is_synced = False
+        self._ingest_first_t = None
+        self._ingest_last_t = None
 
     # ------------------------------------------------------------------
     # distributed sync state machine

@@ -58,6 +58,7 @@ import time
 import weakref
 from typing import Any, Dict, Optional, Tuple
 
+from metrics_tpu.observability.freshness import FreshnessStamp
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import _nbytes
 from metrics_tpu.utils.exceptions import MetricsUserError
@@ -196,6 +197,13 @@ class AsyncUpdateHandle:
         self._applied = 0
         self._dropped = 0
         self._error: Optional[Tuple[int, BaseException]] = None
+        # freshness bookkeeping (guarded by _cond): wall-clock accept time
+        # per accepted-but-unapplied batch index, and the wall times of the
+        # first/last batch actually applied — what freshness() composes into
+        # a FreshnessStamp (min/max contributing event-time + in-flight age)
+        self._pending_wall: Dict[int, float] = {}
+        self._first_apply_wall: Optional[float] = None
+        self._last_apply_wall: Optional[float] = None
         self._closed = False
         self._discard = False  # close(drain=False): worker drops queued items
         self._staleness_override: Optional[int] = None
@@ -278,6 +286,25 @@ class AsyncUpdateHandle:
                 self._snapshot_waiters -= 1
                 self._cond.notify_all()
 
+    def freshness(self, now: Optional[float] = None) -> FreshnessStamp:
+        """The pipeline's contribution to a read's
+        :class:`~metrics_tpu.observability.freshness.FreshnessStamp`:
+        wall clock of the first/last APPLIED batch (the ingest span of
+        everything a snapshot can see) plus the age of the oldest batch
+        accepted but not yet applied (``async_age_s`` — data a bounded-
+        staleness read is allowed to be missing). Identity before any
+        batch is accepted."""
+        now = time.time() if now is None else now
+        with self._cond:
+            oldest = min(self._pending_wall.values()) if self._pending_wall else None
+            first = self._first_apply_wall
+            last = self._last_apply_wall
+        return FreshnessStamp(
+            min_event_t=first,
+            max_event_t=last,
+            async_age_s=max(0.0, now - oldest) if oldest is not None else 0.0,
+        )
+
     @property
     def in_flight_bytes(self) -> int:
         """Bytes pinned by queued batch payloads plus (on donating backends)
@@ -311,6 +338,7 @@ class AsyncUpdateHandle:
             self._enqueued += 1
             self._pending += 1
             self._in_flight_bytes += nbytes
+            self._pending_wall[idx] = time.time()
         # the accept timestamp rides with the item: the worker reports the
         # enqueue->apply age at dequeue — the live staleness signal the
         # windowed telemetry layer (async_age_ms) alarms on
@@ -347,6 +375,7 @@ class AsyncUpdateHandle:
                 self._enqueued -= 1
                 self._pending -= 1
                 self._in_flight_bytes -= nbytes
+                self._pending_wall.pop(idx, None)
                 if self.policy == "drop":
                     self._dropped += 1
                 inflight = self._in_flight_bytes
@@ -382,6 +411,7 @@ class AsyncUpdateHandle:
                     self._enqueued -= 1
                     self._pending -= 1
                     self._in_flight_bytes -= nbytes
+                    self._pending_wall.pop(idx, None)
                     raise MetricsUserError(
                         "async update worker thread is not running; the"
                         " queue cannot drain (was the interpreter shutting"
@@ -525,6 +555,7 @@ class AsyncUpdateHandle:
                 with self._cond:
                     self._pending -= 1
                     self._in_flight_bytes -= item[3]
+                    self._pending_wall.pop(item[0], None)
                     self._cond.notify_all()
         # liveness-guarded: with drain=True the queue may still be full and
         # the sentinel put waits for the worker's FIFO drain to open a slot
@@ -609,10 +640,15 @@ class AsyncUpdateHandle:
         with self._cond:
             self._pending -= 1
             self._in_flight_bytes -= nbytes + donated
+            t_wall = self._pending_wall.pop(idx, None)
             if err is not None and self._error is None:
                 self._error = (idx, err)
             if err is None and not poisoned:
                 self._applied += 1
+                if t_wall is not None:
+                    if self._first_apply_wall is None:
+                        self._first_apply_wall = t_wall
+                    self._last_apply_wall = t_wall
             depth = self._pending
             inflight = self._in_flight_bytes
             self._cond.notify_all()

@@ -59,6 +59,11 @@ from metrics_tpu.observability.health import (
     default_rules,
     render_health,
 )
+from metrics_tpu.observability.freshness import (
+    FreshnessStamp,
+    merge_stamps,
+    stamp_from_payload,
+)
 from metrics_tpu.observability.profiling import compiled_cost, metric_compile_cost
 from metrics_tpu.observability.recorder import (
     _DEFAULT_RECORDER,
@@ -74,7 +79,7 @@ from metrics_tpu.observability.timeseries import (
     registry_from_payload,
     series_from_payload,
 )
-from metrics_tpu.observability.trace import export_perfetto, span
+from metrics_tpu.observability.trace import current_span_context, export_perfetto, span
 from metrics_tpu.observability.wire import (
     Snapshot,
     WireError,
@@ -104,6 +109,7 @@ __all__ = [
     "metric_compile_cost",
     "span",
     "current_span_id",
+    "current_span_context",
     "export_perfetto",
     "aggregate_across_hosts",
     "counter_payload",
@@ -125,6 +131,9 @@ __all__ = [
     "merge_registry_payloads",
     "registry_from_payload",
     "series_from_payload",
+    "FreshnessStamp",
+    "merge_stamps",
+    "stamp_from_payload",
     "AlarmState",
     "BurnRateRule",
     "DriftRule",

@@ -76,6 +76,8 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "drift_scores": dict(rec.drift_scores()),
         "fleet_totals": dict(rec.fleet_totals()),
         "ops_dispatch_totals": dict(rec.ops_dispatch_totals()),
+        "read_totals": dict(rec.read_totals()),
+        "freshness": dict(rec.freshness_totals()),
         "export_errors": rec.export_errors(),
         # windowed time series ride the same payload path: per-bucket
         # sketches serialize JSON-safe and merge by qsketch_merge, so a
@@ -148,6 +150,8 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
         "ops_dispatch_totals": _merge_sum(
             [p.get("ops_dispatch_totals", {}) for p in payloads]
         ),
+        "read_totals": _merge_reads([p.get("read_totals", {}) for p in payloads]),
+        "freshness": _merge_freshness([p.get("freshness", {}) for p in payloads]),
         "export_errors": sum(p.get("export_errors", 0) for p in payloads),
         "timeseries": _merge_timeseries([p.get("timeseries", {}) for p in payloads]),
         "dropped_events": sum(p.get("dropped_events", 0) for p in payloads),
@@ -206,6 +210,50 @@ def _merge_fleet(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
     sums = _merge_sum([{k: v for k, v in m.items() if k in _FLEET_SUM_KEYS} for m in maps])
     maxes = _merge_max([{k: v for k, v in m.items() if k not in _FLEET_SUM_KEYS} for m in maps])
     return {**maxes, **sums}
+
+
+#: read-path counter keys that are extensive (summed); the per-read maxima
+#: are high-water marks (maxed)
+_READ_SUM_KEYS = (
+    "reads", "cache_hits", "leaves_folded", "ring_buckets_folded",
+    "table_rows_unpacked", "fanin", "read_s_total",
+)
+
+
+def _merge_reads(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Read-path totals: read/fold counts sum across ranks; the worst
+    single read (latency, fan-in) maxes — a rank that never computes
+    contributes nothing, like every other family."""
+    sums = _merge_sum([{k: v for k, v in m.items() if k in _READ_SUM_KEYS} for m in maps])
+    maxes = _merge_max([{k: v for k, v in m.items() if k not in _READ_SUM_KEYS} for m in maps])
+    return {**maxes, **sums}
+
+
+def _merge_freshness(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Freshness totals merge like the stamps they summarize: min of the
+    mins, max of the maxes (``None`` is the identity for the event-time
+    bounds, matching :class:`~metrics_tpu.observability.freshness.
+    FreshnessStamp`'s monoid), stamp counts sum. A payload from a rank
+    without the freshness layer contributes the identity."""
+    maps = [m for m in maps if m]
+    out: Dict[str, Any] = {
+        "stamps": 0, "min_event_t": None, "max_event_t": None,
+        "max_staleness_s": 0.0, "max_async_age_s": 0.0,
+        "max_ring_span_s": 0.0, "max_watermark_lag_s": 0.0,
+    }
+    if not maps:
+        return out
+    for m in maps:
+        out["stamps"] += int(m.get("stamps", 0) or 0)
+        lo = m.get("min_event_t")
+        if lo is not None:
+            out["min_event_t"] = lo if out["min_event_t"] is None else min(out["min_event_t"], lo)
+        hi = m.get("max_event_t")
+        if hi is not None:
+            out["max_event_t"] = hi if out["max_event_t"] is None else max(out["max_event_t"], hi)
+        for key in ("max_staleness_s", "max_async_age_s", "max_ring_span_s", "max_watermark_lag_s"):
+            out[key] = max(out[key], float(m.get(key, 0.0) or 0.0))
+    return out
 
 
 #: sketch counter keys that are extensive (summed); the fill ratios are

@@ -127,10 +127,18 @@ class SnapshotSink:
         telemetry: Optional[Any] = None,
         mode: str = "state",
         t: Optional[float] = None,
+        span: Optional[Dict[str, Any]] = None,
     ) -> str:
         """Encode and atomically land one snapshot file; returns its path.
         ``states``/``telemetry`` as in :func:`~metrics_tpu.observability.
-        wire.encode_snapshot`; the sink supplies the provenance header."""
+        wire.encode_snapshot`; the sink supplies the provenance header.
+        ``span`` defaults to the CALLER'S active trace-span context (wire
+        v2), so a publish inside ``with span("publish_tick"):`` is
+        automatically stitchable from the collector side."""
+        if span is None:
+            from metrics_tpu.observability.trace import current_span_context
+
+            span = current_span_context()
         with self._lock:
             seq = self._seq
             self._seq += 1
@@ -145,6 +153,7 @@ class SnapshotSink:
                 states=states,
                 states_template=states_template,
                 telemetry=telemetry,
+                span=span,
             )
             path = self._write(blob, seq)
             self.last_path = path
@@ -244,7 +253,7 @@ class _Pub:
         "publisher", "host", "process", "tier", "seen", "pending",
         "newest", "delta_states", "delta_frontier", "telemetry",
         "telemetry_seq", "last_seq", "last_t", "last_arrival",
-        "absorbed", "duplicates", "late_dropped", "retired",
+        "absorbed", "duplicates", "late_dropped", "retired", "spans",
     )
 
     def __init__(self, publisher: str) -> None:
@@ -266,6 +275,10 @@ class _Pub:
         self.duplicates = 0
         self.late_dropped = 0
         self.retired = False
+        # publisher-side trace-span contexts from snapshot headers (wire
+        # v2), newest last, bounded — export_perfetto's fleet mode reads
+        # them to draw publish instants + flow links per publisher track
+        self.spans: List[Dict[str, Any]] = []
 
 
 class FleetCollector:
@@ -287,17 +300,25 @@ class FleetCollector:
         recorder: Optional[Any] = None,
         clock: Optional[Callable[[], float]] = None,
         name: str = "collector",
+        max_skew_s: float = 30.0,
     ) -> None:
         if late_window_s < 0:
             raise ValueError(f"late_window_s must be >= 0, got {late_window_s}")
         if stale_after_s <= 0:
             raise ValueError(f"stale_after_s must be positive, got {stale_after_s}")
+        if max_skew_s < 0:
+            raise ValueError(f"max_skew_s must be >= 0, got {max_skew_s}")
         self.queue = SnapshotQueue(directory) if directory is not None else None
         self.template = template
         self._template_key = states_key(template) if template is not None else None
         self._template_members = members_of(template) if template is not None else {}
         self.late_window_s = float(late_window_s)
         self.stale_after_s = float(stale_after_s)
+        #: a publisher clock running AHEAD of the collector would drag the
+        #: watermark forward and late-drop every honest peer; snapshot
+        #: times beyond ``arrival + max_skew_s`` are clamped (and counted)
+        #: before they touch the watermark or liveness accounting
+        self.max_skew_s = float(max_skew_s)
         self.name = name
         self.clock = clock if clock is not None else time.time
         self._recorder = recorder
@@ -306,6 +327,8 @@ class FleetCollector:
         self._max_t = float("-inf")
         self.fold_errors = 0
         self.fold_error_details: List[str] = []  # bounded ring, newest last
+        self.clock_skew_clamps = 0
+        self._max_clock_skew_s = 0.0  # largest ahead-of-collector skew observed
         self._reported = {"absorbed": 0, "duplicates": 0, "late_dropped": 0, "fold_errors": 0}
 
     # ------------------------------------------------------------------
@@ -352,6 +375,8 @@ class FleetCollector:
             return False
         return self._ingest_snapshot(snap, now=now)
 
+    MAX_PUB_SPANS = 256
+
     def _ingest_snapshot(self, snap: Snapshot, now: Optional[float] = None) -> bool:
         arrival = self.clock() if now is None else float(now)
         with self._lock:
@@ -366,20 +391,35 @@ class FleetCollector:
             # publisher process is alive and shipping
             pub.last_arrival = arrival
             pub.retired = False
+            # clamp a fast publisher clock BEFORE it touches the watermark
+            # (one skewed peer must not late-drop every honest one)
+            skew = snap.t - arrival
+            if skew > 0:
+                self._max_clock_skew_s = max(self._max_clock_skew_s, skew)
+            t_eff = snap.t
+            if skew > self.max_skew_s:
+                t_eff = arrival + self.max_skew_s
+                self.clock_skew_clamps += 1
             if snap.seq in pub.seen or snap.seq in pub.pending or (
                 snap.mode == "delta" and snap.seq <= pub.delta_frontier
             ):
                 pub.duplicates += 1
                 return False
-            if snap.t <= self.watermark:
+            if t_eff <= self.watermark:
                 pub.late_dropped += 1
                 return False
             if snap.states is not None and not self._states_compatible(snap):
                 return False
-            pub.seen[snap.seq] = snap.t
+            pub.seen[snap.seq] = t_eff
             pub.last_seq = max(pub.last_seq, snap.seq)
-            pub.last_t = max(pub.last_t, snap.t)
-            self._max_t = max(self._max_t, snap.t)
+            pub.last_t = max(pub.last_t, t_eff)
+            self._max_t = max(self._max_t, t_eff)
+            if snap.span is not None:
+                # wire-v2 trace stitching: keep the publisher's publish-time
+                # span context for the fleet Perfetto timeline
+                pub.spans.append({"t": t_eff, "seq": snap.seq, **snap.span})
+                if len(pub.spans) > self.MAX_PUB_SPANS:
+                    pub.spans = pub.spans[-self.MAX_PUB_SPANS :]
             if snap.telemetry and snap.seq > pub.telemetry_seq:
                 # telemetry payloads are cumulative counters: newest wins
                 # per publisher, whatever the states mode. Each payload is
@@ -500,8 +540,16 @@ class FleetCollector:
                 "duplicates": sum(p.duplicates for p in self._pubs.values()),
                 "late_dropped": sum(p.late_dropped for p in self._pubs.values()),
                 "fold_errors": self.fold_errors,
+                "clock_skew_clamps": self.clock_skew_clamps,
                 "publishers": len(self._pubs),
             }
+
+    def publisher_spans(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Per-publisher publish-time span contexts (wire v2 headers),
+        newest last — the raw material of :func:`~metrics_tpu.
+        observability.trace.export_perfetto`'s fleet mode."""
+        with self._lock:
+            return {name: list(p.spans) for name, p in sorted(self._pubs.items()) if p.spans}
 
     def backlog(self) -> int:
         """Unfolded work: queued snapshot files plus pending (in-window)
@@ -626,7 +674,35 @@ class FleetCollector:
     def fold_values(self) -> Dict[str, Any]:
         """``compute`` over the global fold: the fleet-wide metric VALUES
         (the number a dashboard wants), via each template member's pure
-        ``compute_state``. Empty when there is nothing to fold."""
+        ``compute_state``. Empty when there is nothing to fold.
+
+        A fleet-tier ``read`` event rides every call when the recorder is
+        enabled: fan-in (contributing publishers), fold wall time, and a
+        :class:`~metrics_tpu.observability.freshness.FreshnessStamp`
+        carrying the contributing snapshot-time span plus the watermark
+        lag — the dashboard's exact ingest-to-visible staleness."""
+        rec = self._recorder
+        if rec is None:
+            from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as rec  # noqa: N813
+        if not rec.enabled:  # fast path: the disabled fold pays one check
+            return self._fold_values_impl()
+        from metrics_tpu.observability.trace import span as _span
+
+        # the fold span LINKS to each contributing publisher's publish-time
+        # span (wire v2 header) — the cross-process edge perfetto draws
+        with self._lock:
+            links = [
+                {"publisher": name, "span_id": p.spans[-1].get("span_id"), "seq": p.spans[-1].get("seq")}
+                for name, p in sorted(self._pubs.items())
+                if p.spans
+            ]
+        t0 = time.perf_counter()
+        with _span("fleet_fold", recorder=rec, collector=self.name, links=links):
+            out = self._fold_values_impl()
+        self._record_fleet_read(rec, time.perf_counter() - t0, leaves=len(out))
+        return out
+
+    def _fold_values_impl(self) -> Dict[str, Any]:
         folded = self.fold_states()
         if folded is None:
             return {}
@@ -637,6 +713,33 @@ class FleetCollector:
             except Exception as err:  # noqa: BLE001
                 self._count_fold_error(f"compute over fold failed for {name!r}: {err!r}")
         return out
+
+    def _record_fleet_read(self, rec: Any, dur_s: float, leaves: int) -> None:
+        """Emit the fleet-tier read event + freshness stamp (best effort:
+        telemetry must never break the fold)."""
+        try:
+            from metrics_tpu.observability.freshness import FreshnessStamp
+
+            with self._lock:
+                contrib = [
+                    p.last_t
+                    for p in self._pubs.values()
+                    if (p.newest is not None or p.delta_states is not None)
+                    and p.last_t > float("-inf")
+                ]
+                wm = self._max_t - self.late_window_s
+            lag = max(0.0, self.clock() - wm) if contrib else 0.0
+            stamp = FreshnessStamp(
+                min_event_t=min(contrib) if contrib else None,
+                max_event_t=max(contrib) if contrib else None,
+                watermark_lag_s=lag,
+            )
+            rec.record_read(
+                "fleet", None, duration_s=dur_s, leaves=leaves,
+                fanin=len(contrib), freshness=stamp, collector=self.name,
+            )
+        except Exception:  # noqa: BLE001
+            pass
 
     def fold_telemetry(self) -> List[Dict[str, Any]]:
         """Every publisher's newest telemetry payload list, concatenated
@@ -751,6 +854,12 @@ class FleetCollector:
             lines.append(
                 f"metrics_tpu_fleet_snapshots_total{_labels(outcome=outcome)} {totals[key]}"
             )
+        lines.append("# HELP metrics_tpu_fleet_clock_skew_seconds Largest ahead-of-collector publisher clock skew observed.")
+        lines.append("# TYPE metrics_tpu_fleet_clock_skew_seconds gauge")
+        lines.append(f"metrics_tpu_fleet_clock_skew_seconds {self._max_clock_skew_s:g}")
+        lines.append("# HELP metrics_tpu_fleet_clock_skew_clamps_total Snapshot times clamped to now + max_skew_s before watermark accounting.")
+        lines.append("# TYPE metrics_tpu_fleet_clock_skew_clamps_total counter")
+        lines.append(f"metrics_tpu_fleet_clock_skew_clamps_total {totals['clock_skew_clamps']}")
         lines.append("# HELP metrics_tpu_fleet_backlog Unfolded snapshots (queued files + in-window pending deltas).")
         lines.append("# TYPE metrics_tpu_fleet_backlog gauge")
         lines.append(f"metrics_tpu_fleet_backlog {self.backlog()}")

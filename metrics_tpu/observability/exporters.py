@@ -128,6 +128,15 @@ WINDOW_EXPORT_SECONDS = 60.0
 #: quantiles rendered per distribution series on the Prometheus page
 WINDOW_EXPORT_QUANTILES = (0.5, 0.95, 0.99)
 
+#: fixed bucket edges (``le`` bounds) for the qsketch-backed exposition
+#: histograms: log-spaced 1ms..5000s in base units, wide enough to cover
+#: millisecond latencies and multi-minute staleness ages with one shared
+#: grid — FIXED so the fleet merge and PromQL ``histogram_quantile`` see
+#: the same ``le`` set from every rank
+WINDOW_HISTOGRAM_EDGES = (
+    0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 10.0, 50.0, 250.0, 1000.0, 5000.0,
+)
+
 
 def _timeseries_lines(registry: Any, window_s: float = WINDOW_EXPORT_SECONDS) -> List[str]:
     """Windowed families from a TimeSeriesRegistry (or a registry rebuilt
@@ -186,7 +195,56 @@ def _timeseries_lines(registry: Any, window_s: float = WINDOW_EXPORT_SECONDS) ->
             lines.append(
                 f"metrics_tpu_window_quantile{_labels(series=name, q=q, window_s=f'{w:g}')} {v:g}"
             )
+    lines.extend(_histogram_lines(registry, names, eff_window))
     return lines
+
+
+def _histogram_lines(registry: Any, names: List[str], eff_window: Any) -> List[str]:
+    """Real Prometheus histograms for the distribution series: cumulative
+    ``_bucket{le=}`` counts from the window sketch's CDF at the fixed
+    :data:`WINDOW_HISTOGRAM_EDGES`, plus ``_sum``/``_count`` from the
+    series' exact windowed totals — so PromQL ``histogram_quantile`` and
+    the existing quantile gauges answer from the same sketch. Sketch-
+    estimated bucket counts are forced monotone non-decreasing and capped
+    at the exact ``_count`` (a strict-parser requirement the CDF estimate
+    alone cannot guarantee)."""
+    samples: List[str] = []
+    for name in names:
+        s = registry.get(name)
+        if s.kind != "distribution":
+            continue
+        w = eff_window(s)
+        n = s.count(w)
+        if not n:
+            continue
+        sketch = s.window_sketch(w)
+        if sketch is None:
+            continue
+        import numpy as np
+
+        from metrics_tpu.sketches.quantile import qsketch_cdf
+
+        edges = np.asarray(WINDOW_HISTOGRAM_EDGES, np.float32)
+        cdf = np.asarray(qsketch_cdf(sketch, edges))
+        if np.any(np.isnan(cdf)):
+            continue
+        counts = np.minimum(np.maximum.accumulate(np.clip(cdf, 0.0, 1.0)) * n, n)
+        labels = {"series": name, "window_s": f"{w:g}"}
+        for edge, c in zip(WINDOW_HISTOGRAM_EDGES, counts):
+            samples.append(
+                f"metrics_tpu_window_hist_bucket{_labels(le=f'{edge:g}', **labels)} {c:g}"
+            )
+        samples.append(f"metrics_tpu_window_hist_bucket{_labels(le='+Inf', **labels)} {n}")
+        samples.append(f"metrics_tpu_window_hist_sum{_labels(**labels)} {s.total(w):g}")
+        samples.append(f"metrics_tpu_window_hist_count{_labels(**labels)} {n}")
+    if not samples:
+        return []
+    return [
+        "# HELP metrics_tpu_window_hist Sketch-backed distribution histogram over the"
+        " trailing window (window_s label = seconds covered) per series.",
+        "# TYPE metrics_tpu_window_hist histogram",
+        *samples,
+    ]
 
 
 def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[str, Any]] = None) -> str:
@@ -406,6 +464,67 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
                 f"metrics_tpu_ops_dispatch_total"
                 f"{_labels(op=op, backend=backend, **proc_label(payload))} {n}"
             )
+    # read-path telemetry plane: every compute/window/sliced/fleet read
+    # emits a typed event; these families are its cumulative face. The two
+    # cache outcomes are disjoint (hit + miss = reads), so sum()/rate()
+    # over the family is meaningful.
+    lines.append("# HELP metrics_tpu_read_total Metric reads by cache outcome (hit|miss; disjoint).")
+    lines.append("# TYPE metrics_tpu_read_total counter")
+    for payload in per_proc:
+        totals = payload.get("read_totals", {})
+        reads = totals.get("reads", 0)
+        hits = totals.get("cache_hits", 0)
+        lines.append(
+            f"metrics_tpu_read_total{_labels(cache='hit', **proc_label(payload))} {hits}"
+        )
+        lines.append(
+            f"metrics_tpu_read_total{_labels(cache='miss', **proc_label(payload))} {max(reads - hits, 0)}"
+        )
+    lines.append("# HELP metrics_tpu_read_seconds_total Cumulative wall time spent serving metric reads.")
+    lines.append("# TYPE metrics_tpu_read_seconds_total counter")
+    for payload in per_proc:
+        totals = payload.get("read_totals", {})
+        lines.append(
+            f"metrics_tpu_read_seconds_total{_labels(**proc_label(payload))}"
+            f" {totals.get('read_s_total', 0.0):.6f}"
+        )
+    lines.append("# HELP metrics_tpu_read_fanin Contributors folded by a single read (fleet-tier publisher fan-in; last window high-water).")
+    lines.append("# TYPE metrics_tpu_read_fanin gauge")
+    for payload in per_proc:
+        totals = payload.get("read_totals", {})
+        lines.append(
+            f"metrics_tpu_read_fanin{_labels(window='max', **proc_label(payload))}"
+            f" {totals.get('max_fanin', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_read_folded_total State folded while serving reads, by unit (leaves|ring_buckets|table_rows).")
+    lines.append("# TYPE metrics_tpu_read_folded_total counter")
+    for payload in per_proc:
+        totals = payload.get("read_totals", {})
+        for unit, key in (
+            ("leaves", "leaves_folded"),
+            ("ring_buckets", "ring_buckets_folded"),
+            ("table_rows", "table_rows_unpacked"),
+        ):
+            lines.append(
+                f"metrics_tpu_read_folded_total"
+                f"{_labels(unit=unit, **proc_label(payload))} {totals.get(key, 0)}"
+            )
+    lines.append("# HELP metrics_tpu_freshness_stamps_total Reads that carried an ingest-to-visible freshness stamp.")
+    lines.append("# TYPE metrics_tpu_freshness_stamps_total counter")
+    for payload in per_proc:
+        fresh = payload.get("freshness", {})
+        lines.append(
+            f"metrics_tpu_freshness_stamps_total{_labels(**proc_label(payload))}"
+            f" {fresh.get('stamps', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_freshness_staleness_seconds Worst ingest-to-visible staleness observed at a read (high-water).")
+    lines.append("# TYPE metrics_tpu_freshness_staleness_seconds gauge")
+    for payload in per_proc:
+        fresh = payload.get("freshness", {})
+        lines.append(
+            f"metrics_tpu_freshness_staleness_seconds{_labels(window='max', **proc_label(payload))}"
+            f" {fresh.get('max_staleness_s', 0.0):g}"
+        )
     lines.append("# HELP metrics_tpu_drift_score Last reference-vs-live drift score per watched source and statistic.")
     lines.append("# TYPE metrics_tpu_drift_score gauge")
     for payload in per_proc:

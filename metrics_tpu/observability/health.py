@@ -27,12 +27,13 @@ Three rule shapes cover the standard serving-loop failure modes:
   the score-drift alarm, the "is the MODEL healthy" complement to the
   pipeline alarms above.
 
-:func:`default_rules` wires the ten standard alarm classes — seven
-serving-loop classes plus the three fleet-collector classes
-(``publisher_stale``/``snapshot_backlog``/``fold_error``) — over the
-standard series names the recorder feeds (``SERIES_*`` in
-``recorder.py``); every threshold is a keyword so deployments tune rather
-than reimplement. ``examples/serving_loop.py`` drives the serving layer
+:func:`default_rules` wires the eleven standard alarm classes — seven
+serving-loop classes, the three fleet-collector classes
+(``publisher_stale``/``snapshot_backlog``/``fold_error``), and the
+read-path freshness class (``freshness_slo``, with its ``read_latency``
+companion) — over the standard series names the recorder feeds
+(``SERIES_*`` in ``recorder.py``); every threshold is a keyword so
+deployments tune rather than reimplement. ``examples/serving_loop.py`` drives the serving layer
 and ``examples/fleet_collector.py`` the fleet layer under fault
 injection. See docs/observability.md for the rule reference.
 """
@@ -52,8 +53,10 @@ from metrics_tpu.observability.recorder import (
     SERIES_ASYNC_STALENESS,
     SERIES_COLLECTOR_BACKLOG,
     SERIES_FOLD_ERRORS,
+    SERIES_FRESHNESS_AGE_S,
     SERIES_HOT_SLICE_SHARE,
     SERIES_PUBLISHER_LAG,
+    SERIES_READ_MS,
     SERIES_RECOMPILES,
     SERIES_SCORES,
     SERIES_SKETCH_FILL,
@@ -683,10 +686,13 @@ def default_rules(
     publisher_lag_limit_s: float = 30.0,
     backlog_limit: float = 64,
     fold_errors_per_window: float = 1,
+    freshness_bound_s: float = 10.0,
+    read_latency_limit_ms: float = 250.0,
 ) -> List[Rule]:
-    """The ten standard alarm classes — seven serving-loop classes plus
-    the three fleet-collector classes — over the standard recorder-fed
-    series, every threshold tunable:
+    """The eleven standard alarm classes — seven serving-loop classes,
+    the three fleet-collector classes, and the read-path freshness class
+    (plus its ``read_latency`` companion) — over the standard
+    recorder-fed series, every threshold tunable:
 
     * ``queue_saturation`` (warn) / ``queue_saturation_critical`` — p95 /
       max of the async queue depth against the configured limit.
@@ -710,10 +716,21 @@ def default_rules(
     * ``fold_error`` (critical) — ANY fold error in the window: a
       snapshot the collector could not decode, validate, or merge is
       fleet data loss.
+    * ``freshness_slo`` — p95 ingest-to-visible staleness (the
+      ``freshness_age_s`` series every stamped read feeds: wall-clock age
+      of the newest event visible in the answer, see
+      :mod:`metrics_tpu.observability.freshness`) against
+      ``freshness_bound_s`` — the "is the dashboard showing old data"
+      alarm, distinct from ``staleness`` (queued batches) and
+      ``score_drift`` (distribution shape).
+    * ``read_latency`` — p95 read wall time (``read_ms``, fed by every
+      ``compute``/``window_state``/sliced/fleet read) against
+      ``read_latency_limit_ms``.
 
     The three fleet classes watch series only a
     :class:`~metrics_tpu.observability.collector.FleetCollector` feeds —
-    in a job without a collector they never fire, like any absent series.
+    in a job without a collector they never fire, like any absent series;
+    the two read-path classes likewise stay silent until something reads.
     """
     short = short_window_s if short_window_s is not None else max(window_s / 3.0, 1.0)
     return [
@@ -832,5 +849,27 @@ def default_rules(
             op=">=",
             severity="critical",
             description="snapshots failed to decode/validate/fold — fleet data loss",
+        ),
+        ThresholdRule(
+            "freshness_slo",
+            SERIES_FRESHNESS_AGE_S,
+            stat="p95",
+            threshold=freshness_bound_s,
+            window_s=window_s,
+            op=">",
+            severity="warn",
+            min_count=3,
+            description="ingest-to-visible staleness past the freshness bound — readers are seeing old data",
+        ),
+        ThresholdRule(
+            "read_latency",
+            SERIES_READ_MS,
+            stat="p95",
+            threshold=read_latency_limit_ms,
+            window_s=window_s,
+            op=">",
+            severity="warn",
+            min_count=3,
+            description="metric reads (compute/window/fleet fold) persistently slow",
         ),
     ]

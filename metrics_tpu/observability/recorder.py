@@ -146,6 +146,18 @@ SERIES_COLLECTOR_BACKLOG = "collector_backlog"
 #: fleet collector: fold errors (undecodable/foreign/mismatched/failed
 #: snapshots) per poll — the ``fold_error`` signal
 SERIES_FOLD_ERRORS = "collector_fold_errors"
+#: read plane: reads served (counter — compute()/window_state()/
+#: fold_values() calls, cache hits included)
+SERIES_READS = "reads"
+#: read plane: per-read wall time distribution (ms) — the ``read_latency``
+#: alarm signal
+SERIES_READ_MS = "read_ms"
+#: read plane: fan-in (contributing publishers/states folded) per fleet read
+SERIES_READ_FANIN = "read_fanin"
+#: read plane: observed ingest-to-visible staleness per read (seconds) —
+#: the ``freshness_slo`` alarm signal, fed from FreshnessStamp-carrying
+#: reads (see observability/freshness.py)
+SERIES_FRESHNESS_AGE_S = "freshness_age_s"
 
 #: the standard counter-kind series; every other standard series is a
 #: distribution (sketch-backed)
@@ -157,11 +169,46 @@ COUNTER_SERIES = (
     SERIES_SLICED_ROWS,
     SERIES_EXPORT_ERRORS,
     SERIES_FOLD_ERRORS,
+    SERIES_READS,
 )
 
 
 def _new_sliced_totals() -> Dict[str, int]:
     return {"scatter_events": 0, "rows": 0, "max_slices": 0}
+
+
+def _new_read_totals() -> Dict[str, float]:
+    """Zeroed read-plane counters: reads served and what they folded
+    (extensive — summed across hosts) plus high-water gauges for the
+    worst read latency and the widest fleet fan-in (maxed across hosts)."""
+    return {
+        "reads": 0,
+        "cache_hits": 0,
+        "leaves_folded": 0,
+        "ring_buckets_folded": 0,
+        "table_rows_unpacked": 0,
+        "fanin": 0,
+        "read_s_total": 0.0,
+        "max_read_ms": 0.0,
+        "max_fanin": 0,
+    }
+
+
+def _new_freshness_totals() -> Dict[str, Any]:
+    """Zeroed freshness aggregates, merged via MIN/MAX identity like the
+    gauge families: ``min_event_t``/``max_event_t`` (wall clock of the
+    oldest/newest contribution visible to any read; ``None`` until a
+    stamped read happens — the identity element) plus high-water gauges
+    for the observed staleness components."""
+    return {
+        "stamps": 0,
+        "min_event_t": None,
+        "max_event_t": None,
+        "max_staleness_s": 0.0,
+        "max_async_age_s": 0.0,
+        "max_ring_span_s": 0.0,
+        "max_watermark_lag_s": 0.0,
+    }
 
 
 def _new_sketch_totals() -> Dict[str, float]:
@@ -319,6 +366,8 @@ class MetricRecorder:
         self._sliced = _new_sliced_totals()
         self._sliced_slice_counts: Dict[str, int] = {}
         self._sketch = _new_sketch_totals()
+        self._reads = _new_read_totals()
+        self._freshness = _new_freshness_totals()
         #: "source|stat" -> last observed drift score (gauges; fed by the
         #: health layer's DriftRule evaluations — see record_drift_score)
         self._drift: Dict[str, float] = {}
@@ -416,6 +465,8 @@ class MetricRecorder:
             self._sliced = _new_sliced_totals()
             self._sliced_slice_counts = {}
             self._sketch = _new_sketch_totals()
+            self._reads = _new_read_totals()
+            self._freshness = _new_freshness_totals()
             self._drift = {}
             self._fleet = _new_fleet_totals()
             self._ops_dispatch = {}
@@ -527,6 +578,23 @@ class MetricRecorder:
         ``record_fleet_poll``."""
         with self._lock:
             return dict(self._fleet)
+
+    def read_totals(self) -> Dict[str, float]:
+        """Read-plane counters: reads served (cache hits included) and what
+        they folded — state leaves, ring buckets, retrieval-table rows —
+        plus high-water gauges for the worst read latency and the widest
+        fleet fan-in. Fed by ``record_read`` from every ``compute()``/
+        ``window_state()``/``fold_values()`` entry point."""
+        with self._lock:
+            return dict(self._reads)
+
+    def freshness_totals(self) -> Dict[str, Any]:
+        """Freshness aggregates from stamped reads: wall clock of the
+        oldest/newest contribution any read saw (``None`` identity until a
+        stamped read happens) plus high-water staleness-component gauges.
+        Merged across hosts via min/max identity like the gauge families."""
+        with self._lock:
+            return dict(self._freshness)
 
     def ops_dispatch_totals(self) -> Dict[str, int]:
         """Kernel-registry dispatches per ``"op|backend"`` key (backend in
@@ -1098,6 +1166,102 @@ class MetricRecorder:
             self._observe(SERIES_ASYNC_STALENESS, int(staleness_steps))
         if queue_depth is not None:
             self._observe(SERIES_ASYNC_QUEUE_DEPTH, int(queue_depth))
+
+    def record_read(
+        self,
+        kind: str,
+        metric: Any = None,
+        duration_s: float = 0.0,
+        cache_hit: bool = False,
+        leaves: int = 0,
+        ring_buckets: int = 0,
+        table_rows: int = 0,
+        fanin: int = 0,
+        freshness: Optional[Any] = None,
+        **extra: Any,
+    ) -> None:
+        """Record one read-path serve (the typed ``read`` event family).
+
+        ``kind`` names the entry point — ``"compute"`` (Metric.compute,
+        cache hit or cold), ``"window"`` (WindowedMetric.window_state /
+        compute(window=)), ``"sliced"`` (SlicedMetric.compute with
+        slice_ids/top_k), ``"fleet"`` (FleetCollector.fold_values), or
+        ``"probe"`` (a serving loop's dashboard-age probe). The fold-size
+        arguments say what the read paid for: state ``leaves`` folded,
+        ``ring_buckets`` folded oldest-first, retrieval-table rows
+        unpacked, and the fleet ``fanin`` (contributing publishers).
+
+        ``freshness`` is an optional :class:`~metrics_tpu.observability.
+        freshness.FreshnessStamp` (duck-typed — only its attributes are
+        read, keeping this module import-free): when present, the stamp's
+        min/max contributing event-times and staleness components fold
+        into the freshness aggregates and the observed ingest-to-visible
+        staleness feeds the windowed ``freshness_age_s`` series the
+        ``freshness_slo`` alarm watches.
+        """
+        label = metric if isinstance(metric, str) else (
+            type(metric).__name__ if metric is not None else kind
+        )
+        dur_ms = round(float(duration_s) * 1e3, 4)
+        staleness_s: Optional[float] = None
+        with self._lock:
+            r = self._reads
+            r["reads"] += 1
+            if cache_hit:
+                r["cache_hits"] += 1
+            r["leaves_folded"] += int(leaves)
+            r["ring_buckets_folded"] += int(ring_buckets)
+            r["table_rows_unpacked"] += int(table_rows)
+            r["fanin"] += int(fanin)
+            r["read_s_total"] += float(duration_s)
+            r["max_read_ms"] = max(r["max_read_ms"], dur_ms)
+            r["max_fanin"] = max(r["max_fanin"], int(fanin))
+            event: Dict[str, Any] = {
+                "type": "read",
+                "kind": kind,
+                "metric": label,
+                "t": round(time.time() - self._t0, 6),
+                "dur_ms": dur_ms,
+                "cache_hit": bool(cache_hit),
+            }
+            if leaves:
+                event["leaves"] = int(leaves)
+            if ring_buckets:
+                event["ring_buckets"] = int(ring_buckets)
+            if table_rows:
+                event["table_rows"] = int(table_rows)
+            if fanin:
+                event["fanin"] = int(fanin)
+            if freshness is not None:
+                fr = self._freshness
+                fr["stamps"] += 1
+                lo = getattr(freshness, "min_event_t", None)
+                hi = getattr(freshness, "max_event_t", None)
+                if lo is not None:
+                    fr["min_event_t"] = lo if fr["min_event_t"] is None else min(fr["min_event_t"], lo)
+                if hi is not None:
+                    fr["max_event_t"] = hi if fr["max_event_t"] is None else max(fr["max_event_t"], hi)
+                    staleness_s = max(0.0, time.time() - float(hi))
+                    event["staleness_s"] = round(staleness_s, 6)
+                    fr["max_staleness_s"] = max(fr["max_staleness_s"], staleness_s)
+                for attr, key in (
+                    ("async_age_s", "max_async_age_s"),
+                    ("ring_span_s", "max_ring_span_s"),
+                    ("watermark_lag_s", "max_watermark_lag_s"),
+                ):
+                    v = float(getattr(freshness, attr, 0.0) or 0.0)
+                    if v:
+                        event[attr] = round(v, 6)
+                        fr[key] = max(fr[key], v)
+            event.update(extra)
+            self._append(event)
+        # windowed feeds (outside the lock; no-ops when detached)
+        self._observe(SERIES_READS, 1)
+        self._observe(SERIES_READ_MS, dur_ms)
+        if fanin:
+            self._observe(SERIES_READ_FANIN, int(fanin))
+        if staleness_s is not None:
+            self._observe(SERIES_FRESHNESS_AGE_S, staleness_s)
 
     def record_event(self, etype: str, **fields: Any) -> None:
         """Record a free-form auxiliary event (e.g. ``tracker_increment``)."""

@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER, _SPAN_STACK, current_span_id
 from metrics_tpu.utils.prints import _process_index
 
-__all__ = ["span", "current_span_id", "export_perfetto"]
+__all__ = ["span", "current_span_id", "current_span_context", "export_perfetto"]
 
 #: process-wide monotonically increasing span ids; ``itertools.count`` is
 #: atomic under the GIL, so concurrent threads never share an id
@@ -105,7 +105,34 @@ def _resolve(recorder: Optional[Any]) -> Any:
     return recorder if recorder is not None else _DEFAULT_RECORDER
 
 
-def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
+def current_span_context(recorder: Optional[Any] = None) -> Optional[Dict[str, Any]]:
+    """The calling context's active span as a JSON-safe dict, or ``None``
+    when the recorder is disabled or no span is open.
+
+    This is the cross-process half of span nesting: a publisher embeds it
+    in the snapshot wire header (schema v2 ``span`` field) and the fleet
+    collector attaches it to the fold span it opens for that snapshot, so
+    :func:`export_perfetto`'s fleet mode can draw a flow arrow from the
+    publish site in one process to the fold in another. Shape::
+
+        {"span_id": int, "parent_id": int | None, "t": wall-clock seconds}
+    """
+    rec = _resolve(recorder)
+    if not rec.enabled:
+        return None
+    stack = _SPAN_STACK.get()
+    if not stack:
+        return None
+    return {
+        "span_id": stack[-1],
+        "parent_id": stack[-2] if len(stack) > 1 else None,
+        "t": time.time(),
+    }
+
+
+def export_perfetto(
+    path: str, recorder: Optional[Any] = None, collector: Optional[Any] = None
+) -> Optional[str]:
     """Write the recorded span log as Chrome/Perfetto trace-event JSON.
 
     Every ``span`` event becomes one complete ("X") trace event with
@@ -120,10 +147,20 @@ def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
     land on their own LABELED track (``metrics-tpu-async-update``) instead
     of interleaving with the main thread. Rank-zero gated: returns the
     path written, or ``None`` on non-zero ranks.
+
+    **Fleet mode** — pass ``collector`` (a :class:`~metrics_tpu.
+    observability.collector.FleetCollector`): the per-publisher
+    publish-span contexts stored from wire-v2 snapshot headers render as
+    one labeled Perfetto *process track per publisher* (publish instants),
+    and each ``fleet_fold`` span's ``links`` become flow arrows from the
+    publish site in the publisher's process to the fold in the
+    collector's — one merged ingest-to-visible timeline across the fleet.
     """
     if _process_index() != 0:
         return None
     rec = _resolve(recorder)
+    if collector is not None and recorder is None and getattr(collector, "_recorder", None) is not None:
+        rec = collector._recorder
     pid = _process_index()
     all_events = rec.events()
     # spans carry the real thread id; other rows only carry the enclosing
@@ -185,6 +222,8 @@ def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
                 "args": args,
             }
         )
+    if collector is not None:
+        trace_events.extend(_fleet_trace_events(collector, rec, pid, all_events))
     doc = {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
@@ -194,6 +233,65 @@ def export_perfetto(path: str, recorder: Optional[Any] = None) -> Optional[str]:
 
     _atomic_write(path, json.dumps(doc))
     return path
+
+
+def _fleet_trace_events(
+    collector: Any, rec: Any, collector_pid: int, all_events: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Per-publisher tracks + publish->fold flow arrows (fleet mode).
+
+    Publisher span contexts carry WALL-clock publish times; the collector
+    recorder's rows are relative to its start anchor (``rec._t0``), so
+    publisher instants are re-anchored onto the same timeline. Flows pair
+    by ``(publisher, seq)``: the ``s`` end sits on the publish instant in
+    the publisher's process, the ``f`` end on the collector's matching
+    ``fleet_fold`` span."""
+    t0_wall = float(getattr(rec, "_t0", 0.0))
+    out: List[Dict[str, Any]] = []
+    spans_by_pub = collector.publisher_spans()
+    # stable small pids per publisher, offset clear of real process indices
+    pub_pid = {name: 1000 + i for i, name in enumerate(sorted(spans_by_pub))}
+    flow_ids = itertools.count(1_000_000)
+    # (publisher, seq) -> flow id, created at the publish instant
+    flow_of: Dict[Any, int] = {}
+    for name, ctxs in sorted(spans_by_pub.items()):
+        ppid = pub_pid[name]
+        out.append(
+            {"name": "process_name", "ph": "M", "pid": ppid, "tid": 0,
+             "args": {"name": f"publisher {name}"}}
+        )
+        for ctx in ctxs:
+            ts = round(max((float(ctx.get("t", t0_wall)) - t0_wall) * 1e6, 0.0), 3)
+            seq = ctx.get("seq")
+            fid = next(flow_ids)
+            flow_of[(name, seq)] = fid
+            out.append(
+                {"name": f"publish[{seq}]", "cat": "fleet", "ph": "i", "s": "p",
+                 "ts": ts, "pid": ppid, "tid": 0,
+                 "args": {k: v for k, v in ctx.items() if _json_safe(v)}}
+            )
+            out.append(
+                {"name": "publish->fold", "cat": "fleet", "ph": "s", "id": fid,
+                 "ts": ts, "pid": ppid, "tid": 0}
+            )
+    # bind each fold span's links to the publish flows
+    for ev in all_events:
+        if ev.get("type") != "span" or ev.get("name") != "fleet_fold":
+            continue
+        links = (ev.get("attributes") or {}).get("links") or []
+        dur_ms = float(ev.get("dur_ms") or 0.0)
+        end_us = float(ev.get("t", 0.0)) * 1e6
+        ts = round(max(end_us - dur_ms * 1e3, 0.0), 3)
+        tid = int(ev.get("tid") or 0)
+        for link in links:
+            fid = flow_of.get((link.get("publisher"), link.get("seq")))
+            if fid is None:
+                continue
+            out.append(
+                {"name": "publish->fold", "cat": "fleet", "ph": "f", "bp": "e",
+                 "id": fid, "ts": ts, "pid": collector_pid, "tid": tid}
+            )
+    return out
 
 
 def _json_safe(value: Any) -> bool:

@@ -74,7 +74,10 @@ WIRE_MAGIC = "metrics-tpu-snapshot"
 
 #: current wire schema. Decoders accept any version <= this and refuse
 #: newer ones — an old collector must never misread a future layout.
-WIRE_SCHEMA_VERSION = 1
+#: v2 adds the OPTIONAL ``span`` header field (the publisher's active
+#: trace-span context, for cross-process trace stitching); v1 snapshots
+#: decode unchanged with ``span=None``.
+WIRE_SCHEMA_VERSION = 2
 
 #: accepted snapshot modes (see module docstring)
 MODES = ("state", "delta")
@@ -260,6 +263,10 @@ class Snapshot:
     states: Optional[Dict[str, Dict[str, Any]]] = None
     states_key: Optional[Dict[str, Any]] = None
     telemetry: List[Dict[str, Any]] = field(default_factory=list)
+    #: publisher's active trace-span context at publish time (schema v2+):
+    #: ``{"span_id": int, "parent_id": int|None, "trace": [span events]}``.
+    #: None on v1 snapshots and span-less publishers — folds are unaffected.
+    span: Optional[Dict[str, Any]] = None
 
     @property
     def key(self) -> Tuple[str, int]:
@@ -280,6 +287,7 @@ def encode_snapshot(
     states_template: Optional[Any] = None,
     telemetry: Optional[Any] = None,
     manifest_hash: Optional[str] = None,
+    span: Optional[Dict[str, Any]] = None,
 ) -> bytes:
     """Serialize one snapshot to wire bytes (UTF-8 JSON, array leaves as
     base64 raw buffers).
@@ -290,7 +298,10 @@ def encode_snapshot(
     :func:`states_key` so the collector can verify layout agreement.
     ``telemetry`` is one counter payload or a list of them. ``t`` defaults
     to the wall clock; ``manifest_hash`` to the live
-    :func:`manifest_fingerprint`."""
+    :func:`manifest_fingerprint`. ``span`` (schema v2) optionally carries
+    the publisher's active trace-span context so the collector can stitch
+    cross-process traces (see :func:`~metrics_tpu.observability.trace.
+    current_span_context`)."""
     if mode not in MODES:
         raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
     if not publisher:
@@ -324,6 +335,8 @@ def encode_snapshot(
             doc["states_key"] = states_key(states_template)
     if payloads:
         doc["telemetry"] = payloads
+    if span is not None:
+        doc["span"] = span
     return json.dumps(doc, sort_keys=True).encode("utf-8")
 
 
@@ -378,4 +391,5 @@ def decode_snapshot(data: bytes) -> Snapshot:
         states=states,
         states_key=doc.get("states_key"),
         telemetry=telemetry,
+        span=doc.get("span") if isinstance(doc.get("span"), dict) else None,
     )

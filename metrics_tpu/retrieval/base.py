@@ -56,6 +56,7 @@ from metrics_tpu.retrieval.table import (
     retrieval_table_layout,
     retrieval_table_merge_fx,
 )
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
 from metrics_tpu.utils.checks import (
     _check_retrieval_inputs,
@@ -125,6 +126,8 @@ class RetrievalMetric(Metric, ABC):
                 default=retrieval_table_init(max_queries, max_docs),
                 dist_reduce_fx=retrieval_table_merge_fx(),
             )
+        #: occupied rows unpacked by the last table compute (read telemetry only)
+        self._last_table_rows = 0
 
     def _update(
         self, preds: Array, target: Array, indexes: Array, n_valid: Optional[Array] = None
@@ -186,6 +189,10 @@ class RetrievalMetric(Metric, ABC):
             return self._compute_padded()
         return self._compute_host_loop()
 
+    def _read_extras(self) -> dict:
+        # surfaced on the typed ``read`` event emitted by Metric.compute
+        return {"table_rows": self._last_table_rows}
+
     # ------------------------------------------------------------------
     # table-state compute (the fixed-capacity default)
     # ------------------------------------------------------------------
@@ -204,6 +211,8 @@ class RetrievalMetric(Metric, ABC):
         padded_preds, padded_target, mask, row_valid, pos_mass, neg_count, _ = (
             _table_layout_cached(qtable)
         )
+        if _TELEMETRY.enabled and _is_concrete(row_valid):
+            self._last_table_rows = int(jnp.sum(row_valid))
         empty = self._table_empty_rows(pos_mass, neg_count)
         if self.empty_target_action == "error" and _is_concrete(qtable):
             if bool(jnp.any(empty & row_valid)):

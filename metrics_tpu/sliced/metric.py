@@ -39,6 +39,7 @@ counter tracks rows (not batches) per slice and powers top-k-by-count
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -321,6 +322,10 @@ class SlicedMetric(Metric):
             return super().compute()
         if slice_ids is not None and top_k is not None:
             raise MetricsUserError("pass either `slice_ids` or `top_k`, not both")
+        # subset reads bypass the base compute cycle (no cache, no sync), so
+        # they emit their own typed read event — one bool check when disabled
+        rec = _TELEMETRY if _TELEMETRY.enabled else None
+        t0 = time.perf_counter() if rec is not None else 0.0
         m = self._template
         if top_k is not None:
             if not isinstance(top_k, int) or top_k <= 0:
@@ -346,6 +351,15 @@ class SlicedMetric(Metric):
                 )
         states = {name: jnp.asarray(getattr(self, name))[ids] for name in m._defaults}
         values = jax.vmap(m.compute_state)(states)
+        if rec is not None:
+            # leaves folded = wrapped leaves gathered per selected slice
+            rec.record_read(
+                "sliced",
+                self,
+                duration_s=time.perf_counter() - t0,
+                leaves=len(m._defaults) * int(ids.shape[0]) if _is_concrete(ids) else len(m._defaults),
+                freshness=self.freshness_stamp(),
+            )
         return (ids, values) if top_k is not None else values
 
     # ------------------------------------------------------------------

@@ -42,6 +42,7 @@ docs/windowed_metrics.md.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -49,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric
+from metrics_tpu.observability.freshness import FreshnessStamp
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import WINDOWED_FOOTPRINT_PREFIX
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_max, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.exceptions import MetricsUserError
@@ -193,6 +196,13 @@ class WindowedMetric(Metric):
         # pad correction (or threads n_valid into a masking template), so
         # bucketed fused dispatches stay exact — see _update/_pad_correct
         self.__fused_mask_valid__ = True
+        # host-side ring clock for freshness stamps: wall time of each live
+        # bucket's FIRST eager write (telemetry-enabled eager updates only —
+        # fused/traced updates have no host hook, so stamps are best-effort
+        # and a stamp-free ring folds as identity)
+        self._bucket_wall: List[Optional[float]] = [None] * max(self.window, 1)
+        self._last_fold_buckets = 0
+        self._last_fold_oldest_wall: Optional[float] = None
 
     # ------------------------------------------------------------------
     # construction-time validation
@@ -351,6 +361,13 @@ class WindowedMetric(Metric):
 
         count = jnp.asarray(getattr(self, RING_COUNT))
         k, r = self.updates_per_bucket, self.window
+        if _TELEMETRY.enabled and not isinstance(count, jax.core.Tracer):
+            # eager path with a concrete clock: stamp the bucket's first
+            # write so window folds can report their wall-clock reach
+            c = int(count)  # tracelint: disable=TL-TRACE — the isinstance(Tracer) guard above makes this eager-only
+            s = (c // k) % r
+            if c % k == 0 or self._bucket_wall[s] is None:
+                self._bucket_wall[s] = time.time()
         slot = (count // k) % r
         fresh = (count % k) == 0
         defaults = {name: jnp.asarray(v) for name, v in m._defaults.items()}
@@ -394,10 +411,18 @@ class WindowedMetric(Metric):
             )
         rows: List[Dict[str, Array]] = []
         counts = np.asarray(getattr(self, RING_ROWS))
+        walls: List[float] = []
         for b in range(lo, cur + 1):
             if counts[b % r] <= 0:
                 continue  # a bucket `before` skipped past (never filled)
             rows.append({name: jnp.asarray(getattr(self, name))[b % r] for name in m._defaults})
+            w_b = self._bucket_wall[b % r]
+            if w_b is not None:
+                walls.append(w_b)
+        # read-event side channel: how many ring buckets this fold covered
+        # and how far back (wall clock) the oldest one reaches
+        self._last_fold_buckets = len(rows)
+        self._last_fold_oldest_wall = min(walls) if walls else None
         return rows
 
     def window_state(self, window: Optional[int] = None, *, before: int = 0) -> Dict[str, Array]:
@@ -405,7 +430,27 @@ class WindowedMetric(Metric):
         buckets (default: the whole ring) ending ``before`` buckets back —
         the unit :mod:`metrics_tpu.observability.drift` compares. Rows fold
         oldest-first through the wrapped reducers (``merge_states``), so
-        sum leaves are exact and sketch leaves keep arrival order."""
+        sum leaves are exact and sketch leaves keep arrival order.
+
+        Every direct call is a READ: with telemetry enabled it emits one
+        typed ``read`` event (kind ``"window"``) carrying the ring buckets
+        folded and a :class:`FreshnessStamp` with the fold's wall-clock
+        reach (``ring_span_s``). The internal fold ``_compute`` runs is
+        not re-counted — plain ``compute()`` emits its own read event."""
+        if not _TELEMETRY.enabled:  # disabled read path stays ONE bool check
+            return self._window_state_impl(window, before=before)
+        t0 = time.perf_counter()
+        state = self._window_state_impl(window, before=before)
+        _TELEMETRY.record_read(
+            "window",
+            self,
+            duration_s=time.perf_counter() - t0,
+            ring_buckets=self._last_fold_buckets,
+            freshness=self._window_freshness(),
+        )
+        return state
+
+    def _window_state_impl(self, window: Optional[int] = None, *, before: int = 0) -> Dict[str, Array]:
         if self.mode != "ring":
             raise MetricsUserError(
                 "window_state() is a ring-mode query; decay mode keeps one decayed state"
@@ -436,7 +481,9 @@ class WindowedMetric(Metric):
         m = self._template
         if self.mode == "decay":
             return m.compute_state({name: getattr(self, name) for name in m._defaults})
-        return m.compute_state(self.window_state())
+        # the un-instrumented fold: the enclosing Metric.compute() emits the
+        # read event and picks the fold size up through _read_extras()
+        return m.compute_state(self._window_state_impl())
 
     def compute(self, *, window: Optional[int] = None, before: Optional[int] = None) -> Any:
         """The wrapped metric over the window.
@@ -459,6 +506,49 @@ class WindowedMetric(Metric):
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+    def _window_freshness(self, now: Optional[float] = None) -> FreshnessStamp:
+        """Stamp for the most recent window fold: the oldest in-window
+        bucket's first-write wall time bounds the window's reach
+        (``ring_span_s``); identity components when the ring was filled
+        through a traced (fused) path that leaves no host stamps."""
+        now = time.time() if now is None else now
+        oldest = self._last_fold_oldest_wall
+        return FreshnessStamp(
+            min_event_t=oldest,
+            max_event_t=self._ingest_last_t,
+            ring_span_s=max(0.0, now - oldest) if oldest is not None else 0.0,
+        )
+
+    def freshness_stamp(self, now: Optional[float] = None) -> FreshnessStamp:
+        """Ring-aware stamp: data older than the live ring was evicted, so
+        ``min_event_t`` is the oldest LIVE bucket's first write, not the
+        first ingest since reset, and ``ring_span_s`` is the ring's
+        wall-clock reach."""
+        base = super().freshness_stamp(now)
+        if self.mode != "ring":
+            return base
+        walls = [w for w in self._bucket_wall if w is not None]
+        if not walls:
+            return base
+        oldest = min(walls)
+        now = time.time() if now is None else now
+        return FreshnessStamp(
+            min_event_t=oldest if base.min_event_t is None else max(base.min_event_t, oldest),
+            max_event_t=base.max_event_t,
+            ring_span_s=max(0.0, now - oldest),
+        )
+
+    def _read_extras(self) -> Dict[str, Any]:
+        if self.mode != "ring":
+            return {}
+        return {"ring_buckets": self._last_fold_buckets}
+
+    def reset(self) -> None:
+        super().reset()
+        self._bucket_wall = [None] * max(self.window, 1)
+        self._last_fold_buckets = 0
+        self._last_fold_oldest_wall = None
+
     def state_footprint(self, include_children: bool = True) -> Dict[str, int]:
         """Per-state bytes with every key under ``windowed/`` — the
         telemetry recorder splits on the prefix so the ``R``-fold window

@@ -96,6 +96,11 @@ AUX_FIELDS: Dict[str, str] = {
     # loop at 10k queries) and the one-compile-across-ragged-shapes anchor
     "retrieval_fused_vs_eager": "higher",
     "retrieval_fused_compiles": "lower",
+    # the read-plane bench (``read_plane_throughput``): instrumented-vs-off
+    # subset-read throughput under concurrent async ingest — the typed read
+    # event + freshness stamp growing a per-read tax is a regression even
+    # when the absolute reads/sec still passes
+    "read_event_overhead_ratio": "higher",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -129,6 +134,11 @@ BOOL_FIELDS: Tuple[str, ...] = (
     "ops_row_topk_parity",
     "ops_segment_max_parity",
     "ops_segment_min_parity",
+    # freshness-stamp exactness on an injected known-age stream: the read
+    # event's staleness must land within one telemetry bucket of ground
+    # truth — a stamp that drifts from the ingest wall clock is a lying
+    # dashboard however cheap the read plane is
+    "freshness_stamp_exact",
 )
 
 

@@ -1,0 +1,398 @@
+"""Freshness observatory tests (ISSUE 16 tentpole + satellites): the
+FreshnessStamp monoid and payload round-trip, the typed ``read`` event
+from every entry point (compute cache hit/miss, windowed folds, sliced
+subset reads, retrieval table unpacks, fleet folds), the read/freshness
+Prometheus families and the qsketch-backed window histograms under a
+strict exposition parser, heterogeneous-fleet identity merges through
+``merge_payloads`` AND ``render_prometheus``, the wire v2 span header
+(v1 snapshots keep decoding), the collector clock-skew clamp, the
+fleet-mode Perfetto export's publish->fold flow arrows, and the
+``freshness_slo`` / ``read_latency`` alarm classes firing and clearing."""
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import MeanMetric, SumMetric
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.observability import (
+    FleetCollector,
+    FreshnessStamp,
+    HealthMonitor,
+    SnapshotSink,
+    counter_payload,
+    decode_snapshot,
+    default_rules,
+    encode_snapshot,
+    export_perfetto,
+    get_recorder,
+    merge_payloads,
+    merge_stamps,
+    render_prometheus,
+    snapshot_states,
+    span,
+)
+from metrics_tpu.observability.freshness import IDENTITY
+from metrics_tpu.observability.recorder import (
+    SERIES_FRESHNESS_AGE_S,
+    SERIES_READ_MS,
+)
+from metrics_tpu.observability.timeseries import TimeSeriesRegistry
+from metrics_tpu.retrieval import RetrievalMAP
+from metrics_tpu.sliced import SlicedMetric
+from metrics_tpu.windowed import WindowedMetric
+
+T0 = 1_000_000.0
+
+
+@pytest.fixture
+def recorder():
+    """The default recorder, enabled for one test and ALWAYS disabled+reset
+    after — the session-level conftest asserts nothing leaks."""
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.detach_timeseries()
+        rec.reset()
+
+
+def read_events(rec, kind=None):
+    evs = [e for e in rec.events() if e.get("type") == "read"]
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+# ----------------------------------------------------------------------
+# the stamp itself: monoid laws, staleness semantics, payload round-trip
+# ----------------------------------------------------------------------
+class TestFreshnessStamp:
+    def test_identity_and_commutativity(self):
+        a = FreshnessStamp(min_event_t=10.0, max_event_t=20.0, async_age_s=1.0)
+        b = FreshnessStamp(min_event_t=5.0, max_event_t=15.0, ring_span_s=3.0)
+        assert a.merge(IDENTITY) == a and IDENTITY.merge(a) == a
+        assert a.merge(b) == b.merge(a)
+        m = a.merge(b)
+        assert m.min_event_t == 5.0 and m.max_event_t == 20.0
+        assert m.async_age_s == 1.0 and m.ring_span_s == 3.0
+
+    def test_associativity(self):
+        a = FreshnessStamp(min_event_t=10.0, max_event_t=20.0)
+        b = FreshnessStamp(min_event_t=5.0, watermark_lag_s=2.0)
+        c = FreshnessStamp(max_event_t=30.0, async_age_s=4.0)
+        assert a.merge(b).merge(c) == a.merge(b.merge(c))
+        assert merge_stamps([a, None, b, c]) == a.merge(b).merge(c)
+
+    def test_staleness_components(self):
+        s = FreshnessStamp(min_event_t=90.0, max_event_t=100.0, async_age_s=3.0,
+                           watermark_lag_s=1.0)
+        assert s.visible_age_s(now=107.0) == 7.0
+        # visible age + max(async, watermark): components overlap, not add
+        assert s.staleness_s(now=107.0) == 10.0
+        assert IDENTITY.staleness_s(now=107.0) == 0.0 and IDENTITY.is_identity
+
+    def test_payload_round_trip_and_missing_is_identity(self):
+        s = FreshnessStamp(min_event_t=1.0, max_event_t=2.0, ring_span_s=0.5)
+        assert FreshnessStamp.from_payload(s.to_payload()) == s
+        assert FreshnessStamp.from_payload(None) == IDENTITY
+        assert FreshnessStamp.from_payload({}) == IDENTITY
+
+
+# ----------------------------------------------------------------------
+# the typed read event, per entry point
+# ----------------------------------------------------------------------
+class TestReadEvents:
+    def test_compute_cold_then_cache_hit(self, recorder):
+        m = MeanMetric()
+        m.update(jnp.ones((4,)))
+        float(m.compute())              # cold fold
+        float(m.compute())              # cached
+        evs = read_events(recorder, "compute")
+        assert [e["cache_hit"] for e in evs] == [False, True]
+        assert all(e["metric"] == "MeanMetric" for e in evs)
+        # ingested while enabled -> the stamp carries real event times
+        assert evs[0].get("staleness_s") is not None
+        totals = recorder.read_totals()
+        assert totals["reads"] == 2 and totals["cache_hits"] == 1
+        assert recorder.freshness_totals()["stamps"] == 2
+
+    def test_disabled_read_path_records_nothing(self):
+        rec = get_recorder()
+        assert not rec.enabled
+        m = MeanMetric()
+        m.update(jnp.ones((4,)))
+        float(m.compute())
+        assert rec.events() == []
+        assert rec.read_totals()["reads"] == 0
+
+    def test_windowed_fold_counts_ring_buckets(self, recorder):
+        wm = WindowedMetric(MeanSquaredError(), window=3, updates_per_bucket=1)
+        for err in (9.0, 9.0, 0.0, 0.0, 0.0):
+            wm.update(jnp.array([err]), jnp.array([0.0]))
+        wm.window_state(3)
+        evs = read_events(recorder, "window")
+        assert evs and evs[-1]["ring_buckets"] == 3
+        assert evs[-1].get("ring_span_s", 0.0) >= 0.0
+        # plain compute() goes through Metric.compute and picks the fold
+        # size up via _read_extras — counted once, as a "compute" read
+        float(wm.compute())
+        cevs = read_events(recorder, "compute")
+        assert cevs and cevs[-1]["ring_buckets"] == 3
+
+    def test_sliced_subset_read(self, recorder):
+        sm = SlicedMetric(MeanSquaredError(), num_slices=8)
+        ids = jnp.asarray([0, 1, 2, 3])
+        sm.update(ids, jnp.ones((4,)), jnp.zeros((4,)))
+        sm.compute(slice_ids=jnp.asarray([1, 2]))
+        evs = read_events(recorder, "sliced")
+        assert len(evs) == 1
+        # 2 selected slices x the wrapped metric's state leaves
+        assert evs[0]["leaves"] == 2 * len(sm._template._defaults)
+        assert evs[0].get("staleness_s") is not None
+
+    def test_retrieval_table_rows(self, recorder):
+        rm = RetrievalMAP()
+        idx = jnp.asarray(np.repeat(np.arange(3), 5))
+        preds = jnp.asarray(np.linspace(0.0, 1.0, 15, dtype=np.float32))
+        target = jnp.asarray((np.arange(15) % 5 == 0).astype(np.int64))
+        rm.update(preds, target, indexes=idx)
+        float(rm.compute())
+        evs = read_events(recorder, "compute")
+        # the table packs one row per query group: 3 occupied rows unpacked
+        assert evs and evs[-1]["table_rows"] == 3
+
+    def test_fleet_fold_read(self, recorder, tmp_path):
+        col = MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()})
+        col.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        sink.publish(states=snapshot_states(col), states_template=col, t=time.time())
+        fleet = FleetCollector(
+            str(tmp_path),
+            template=MetricCollection({"acc": Accuracy(num_classes=2), "mse": MeanSquaredError()}),
+            recorder=recorder,
+        )
+        fleet.poll()
+        vals = fleet.fold_values()
+        assert vals
+        evs = read_events(recorder, "fleet")
+        assert len(evs) == 1 and evs[0]["fanin"] == 1
+        assert evs[0].get("watermark_lag_s", 0.0) >= 0.0
+        assert recorder.read_totals()["max_fanin"] == 1
+
+
+# ----------------------------------------------------------------------
+# exposition: read/freshness families + strict-parser window histograms
+# ----------------------------------------------------------------------
+def parse_prometheus_strict(page):
+    """A strict text-exposition parser: HELP/TYPE must precede their
+    family's samples contiguously, histogram buckets must be cumulative
+    with a terminal +Inf equal to _count. Returns {family: [(labels, v)]}."""
+    families, types, current = {}, {}, None
+    for line in page.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            current = line.split()[2]
+            families.setdefault(current, [])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[2] == current, f"TYPE {parts[2]} not under its HELP"
+            types[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        name_and_labels, value = line.rsplit(" ", 1)
+        if "{" in name_and_labels:
+            name, raw = name_and_labels.split("{", 1)
+            labels = dict(
+                kv.split("=", 1) for kv in raw.rstrip("}").split(",") if kv
+            )
+            labels = {k: v.strip('"') for k, v in labels.items()}
+        else:
+            name, labels = name_and_labels, {}
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+        assert base == current or name == current, (
+            f"sample {name} interleaved outside its family block ({current})"
+        )
+        families.setdefault(base, []).append((name, labels, float(value)))
+    return families, types
+
+
+class TestExposition:
+    def test_read_and_freshness_families(self, recorder):
+        m = MeanMetric()
+        m.update(jnp.ones((4,)))
+        float(m.compute())
+        float(m.compute())
+        page = render_prometheus(recorder)
+        assert 'metrics_tpu_read_total{' in page and 'cache="hit"' in page
+        assert "metrics_tpu_read_seconds_total" in page
+        assert 'metrics_tpu_read_folded_total{' in page
+        assert "metrics_tpu_freshness_stamps_total" in page
+        assert "metrics_tpu_freshness_staleness_seconds" in page
+        parse_prometheus_strict(page)  # whole page must stay well-formed
+
+    def test_window_histograms_strict(self, recorder):
+        recorder.attach_timeseries(bucket_seconds=60.0, n_buckets=4, sketch_capacity=64)
+        m = SumMetric()
+        for _ in range(40):
+            m.update(jnp.asarray(1.0))   # feeds the update_ms distribution
+        page = render_prometheus(recorder)
+        families, types = parse_prometheus_strict(page)
+        assert types.get("metrics_tpu_window_hist") == "histogram"
+        samples = families["metrics_tpu_window_hist"]
+        buckets = [
+            s for s in samples
+            if s[0].endswith("_bucket") and s[1].get("series") == "update_ms"
+        ]
+        assert buckets, "update_ms histogram missing"
+        les = [b[1]["le"] for b in buckets]
+        assert les[-1] == "+Inf" and len(set(les)) == len(les)
+        counts = [b[2] for b in buckets]
+        assert counts == sorted(counts), "histogram buckets must be cumulative"
+        count_rows = [
+            s for s in samples
+            if s[0].endswith("_count") and s[1].get("series") == "update_ms"
+        ]
+        assert count_rows and count_rows[0][2] == counts[-1] == 40.0
+
+    def test_heterogeneous_fleet_merge(self, recorder):
+        m = MeanMetric()
+        m.update(jnp.ones((4,)))
+        float(m.compute())
+        new = counter_payload(recorder)
+        assert new["read_totals"]["reads"] == 1 and new["freshness"]["stamps"] == 1
+        old = {k: v for k, v in new.items() if k not in ("read_totals", "freshness")}
+        old["process"] = 1
+        merged = merge_payloads([new, old])
+        # the v1 payload merges as identity: totals unchanged, not poisoned
+        assert merged["read_totals"]["reads"] == 1
+        fr = merged["freshness"]
+        assert fr["stamps"] == 1
+        assert fr["min_event_t"] == new["freshness"]["min_event_t"]
+        assert fr["max_event_t"] == new["freshness"]["max_event_t"]
+        # and the merged payload still renders a clean page (satellite 4
+        # is pinned through BOTH merge_payloads and render_prometheus)
+        page = render_prometheus(recorder, aggregate=merged)
+        assert "metrics_tpu_read_total" in page
+        parse_prometheus_strict(page)
+
+
+# ----------------------------------------------------------------------
+# wire v2 span header + collector clock-skew clamp + fleet perfetto
+# ----------------------------------------------------------------------
+def make_collection():
+    return MetricCollection({"mse": MeanSquaredError()})
+
+
+class TestWireAndCollector:
+    def test_span_header_round_trip(self):
+        ctx = {"span_id": 7, "parent_id": 3, "t": T0}
+        blob = encode_snapshot(publisher="p0", seq=0, t=T0, span=ctx)
+        snap = decode_snapshot(blob)
+        assert snap.span == ctx
+
+    def test_v1_snapshot_still_decodes(self):
+        blob = encode_snapshot(publisher="p0", seq=0, t=T0, span={"span_id": 1, "t": T0})
+        doc = json.loads(blob.decode("utf-8"))
+        doc["schema"] = 1
+        doc.pop("span")
+        snap = decode_snapshot(json.dumps(doc).encode("utf-8"))
+        assert snap.span is None and snap.publisher == "p0"
+
+    def test_publish_captures_active_span(self, recorder, tmp_path):
+        sink = SnapshotSink(str(tmp_path), publisher="p0")
+        col = make_collection()
+        col.update(jnp.ones((2,)), jnp.zeros((2,)))
+        with span("publish_cycle"):
+            sink.publish(states=snapshot_states(col), states_template=col)
+        snap = decode_snapshot(open(sink.last_path, "rb").read())
+        assert snap.span is not None and snap.span["span_id"] is not None
+
+    def test_clock_skew_clamp(self, tmp_path):
+        fleet = FleetCollector(
+            str(tmp_path), template=make_collection(),
+            clock=lambda: T0, max_skew_s=30.0, late_window_s=5.0,
+        )
+        sink = SnapshotSink(str(tmp_path), publisher="honest")
+        rogue = SnapshotSink(str(tmp_path), publisher="rogue")
+        col = make_collection()
+        col.update(jnp.ones((2,)), jnp.zeros((2,)))
+        # rogue clock runs 10 minutes ahead; unclamped it would place the
+        # watermark at T0+600-late_window and late-drop the honest peer
+        rogue.publish(states=snapshot_states(col), states_template=col, t=T0 + 600.0)
+        sink.publish(states=snapshot_states(col), states_template=col, t=T0)
+        fleet.poll(now=T0)
+        totals = fleet.totals()
+        assert totals["clock_skew_clamps"] == 1
+        assert totals["absorbed"] == 2 and totals["late_dropped"] == 0
+        assert fleet.watermark <= T0 + fleet.max_skew_s
+        page = "\n".join(fleet.prometheus_lines(now=T0))
+        assert "metrics_tpu_fleet_clock_skew_clamps_total 1" in page
+        assert "metrics_tpu_fleet_clock_skew_seconds 600" in page
+
+    def test_fleet_perfetto_flow_arrows(self, recorder, tmp_path):
+        qdir = tmp_path / "q"
+        qdir.mkdir()
+        sink = SnapshotSink(str(qdir), publisher="p0")
+        col = make_collection()
+        col.update(jnp.ones((2,)), jnp.zeros((2,)))
+        with span("publish_cycle"):
+            sink.publish(states=snapshot_states(col), states_template=col)
+        fleet = FleetCollector(str(qdir), template=make_collection(), recorder=recorder)
+        fleet.poll()
+        assert "p0" in fleet.publisher_spans()
+        fleet.fold_values()  # emits the linked fleet_fold span
+        out = tmp_path / "trace.json"
+        assert export_perfetto(str(out), collector=fleet) == str(out)
+        doc = json.loads(out.read_text())
+        evs = doc["traceEvents"]
+        procs = {e["args"]["name"] for e in evs if e.get("name") == "process_name"}
+        assert "publisher p0" in procs
+        starts = [e for e in evs if e.get("ph") == "s" and e.get("name") == "publish->fold"]
+        ends = [e for e in evs if e.get("ph") == "f" and e.get("name") == "publish->fold"]
+        assert len(starts) == 1 and len(ends) == 1
+        assert starts[0]["id"] == ends[0]["id"]          # one paired flow
+        assert starts[0]["pid"] != ends[0]["pid"]        # crosses processes
+
+
+# ----------------------------------------------------------------------
+# the alarm classes: freshness_slo + read_latency fire AND clear
+# ----------------------------------------------------------------------
+class TestFreshnessAlarms:
+    def test_default_rules_cover_eleven_classes(self):
+        rules = default_rules()
+        names = {r.name for r in rules}
+        assert {"freshness_slo", "read_latency"} <= names
+        assert len(rules) == 13  # 11 classes; queue + freshness have companions
+
+    def test_fire_and_clear(self):
+        registry = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=60)
+        monitor = HealthMonitor(
+            default_rules(freshness_bound_s=5.0, read_latency_limit_ms=100.0),
+            registry=registry,
+        )
+        t0 = T0
+        for i in range(6):
+            registry.observe(SERIES_FRESHNESS_AGE_S, 30.0, t=t0 + i)   # stale reads
+            registry.observe(SERIES_READ_MS, 500.0, t=t0 + i)          # slow reads
+        snap = monitor.evaluate(now=t0 + 6)
+        firing = {a.name for a in snap.firing}
+        assert {"freshness_slo", "read_latency"} <= firing
+        # recovery: fresh fast reads, old window rolls off
+        for i in range(6):
+            registry.observe(SERIES_FRESHNESS_AGE_S, 0.1, t=t0 + 62 + i)
+            registry.observe(SERIES_READ_MS, 1.0, t=t0 + 62 + i)
+        snap = monitor.evaluate(now=t0 + 68)
+        assert snap.status == "ok"
+        assert {"freshness_slo", "read_latency"} <= set(monitor.fired_and_cleared())
