@@ -245,6 +245,12 @@ def run(
     fault_lo, fault_hi = FAULT_LO_FRAC * duration, FAULT_HI_FRAC * duration
     pause_lo, pause_hi = fault_lo, fault_lo + 0.6 * (fault_hi - fault_lo)
     t_start = time.time()
+    # the collector-side fault window is anchored to the FIRST ABSORBED
+    # snapshot, not to subprocess spawn: each publisher pays several
+    # seconds of jax import before it ships anything, and a polling pause
+    # scheduled on the spawn clock can land entirely inside that silence
+    # on a slow box — no snapshots pile up, snapshot_backlog never fires
+    fault_t0 = None
     corrupted = False
     polls = 0
     try:
@@ -253,7 +259,9 @@ def run(
         tail_end = None
         while True:
             now = time.time()
-            elapsed = now - t_start
+            if fault_t0 is None and collector.totals()["absorbed"] > 0:
+                fault_t0 = now
+            elapsed = (now - fault_t0) if fault_t0 is not None else -1.0
             for i, p in enumerate(procs):
                 if p.poll() is not None:
                     # clean shutdown deregisters the publisher from

@@ -178,7 +178,12 @@ def run(
     # per-tenant sliced MSE (hot-slice signal), and a deliberately
     # shape-fragile "canary" whose ragged updates simulate an unpadded
     # pipeline for the recompile storm
-    auroc = AUROC(pos_label=1, sketch_capacity=sketch_capacity)
+    # shape_stable_reads: the probe computes this metric every poll tick on
+    # a growing stream — the lossless exact kernels would re-trace per fill
+    # count (~1s/read), so reads ride the fixed-shape bucketed weighted
+    # kernels from row one (rank-error envelope instead of bit-parity; the
+    # right trade for a dashboard, never the default)
+    auroc = AUROC(pos_label=1, sketch_capacity=sketch_capacity, shape_stable_reads=True)
     collection = MetricCollection({"auroc": auroc, "mse": MeanSquaredError()})
     handle = collection.compile_update_async(queue_depth=queue_depth, policy="drop")
     per_tenant = SlicedMetric(MeanSquaredError(), num_slices=tenants)
@@ -211,14 +216,17 @@ def run(
     read_start = None
 
     def probe(reading_stalled: bool = False):
-        """Cheap live probes the loop can afford every few hundred ms: the
-        queue-staleness gauge straight from the handle's pending counter
-        (no drain, no device work), the end-to-end freshness stamp
-        (``collection.freshness()`` — accept/apply walls, no device work
-        either) recorded as a ``probe`` read, and the sketch fill ratios
-        as a direct leaf read under the snapshot lock (a full compute()
-        would re-trace the curve kernels per fill count — that readback
-        belongs at epoch boundaries, not on the observatory's poll path).
+        """The dashboard's REAL read, every few hundred ms: a plain
+        bounded-staleness ``handle.compute()`` plus ``per_tenant.compute()``
+        through the incremental read plane — epoch-keyed result caches,
+        dirty-slice folds, memoized window folds, and shape-bucketed sketch
+        kernels make a full ``compute()`` cheap enough for the poll path,
+        so the old hand-rolled probe (freshness stamp + raw fill-leaf peek
+        that dodged the per-fill-count retrace) is gone. The staleness
+        bound is the queue depth: the probe OBSERVES a saturated queue
+        (the bursts fault's signal) instead of draining it away, and the
+        cold compute path records the sketch fill ratios the fill alarm
+        watches as part of the read cycle.
 
         ``reading_stalled`` simulates the stale-reader fault: the
         dashboard reader is paused mid-read, so the probe keeps reporting
@@ -226,23 +234,32 @@ def run(
         against the live clock — ``freshness_slo``'s signal) and the
         stuck read's elapsed time (``read_latency``'s signal)."""
         nonlocal last_stamp, read_start
-        rec.record_async_event("snapshot", staleness_steps=handle.pending)
         now = time.time()
         if reading_stalled:
+            rec.record_async_event("snapshot", staleness_steps=handle.pending)
             if read_start is None:
                 read_start = now
             rec.record_read("probe", duration_s=now - read_start, freshness=last_stamp)
         else:
             t0 = time.perf_counter()
+            try:
+                # records its own "snapshot" staleness gauge via the
+                # handle's _before_compute hook
+                handle.compute(max_staleness=queue_depth)
+                per_tenant.compute()
+            except (ValueError, RuntimeError):
+                # empty-state read right after an epoch-boundary reset
+                # (async ingest not yet applied): nothing to serve yet
+                rec.record_async_event("snapshot", staleness_steps=handle.pending)
             last_stamp = collection.freshness(now)
             read_start = None
             rec.record_read(
                 "probe", duration_s=time.perf_counter() - t0, freshness=last_stamp
             )
-        with handle.snapshot():
-            ratios = auroc.sketch_fill_ratios()
-        if ratios:
-            rec.record_sketch_fill(auroc, ratios)
+        # deferred telemetry housekeeping: fold pending time-series
+        # observations here, between probe reads, so bucket compaction
+        # never lands inside a timed read
+        rec.tick()
         monitor.evaluate()
 
     try:

@@ -94,6 +94,41 @@ _STATE_WRITE_TOKENS = (
 )
 _STATE_WRITE_METHODS = {"__init__", "set_dtype", "to_device", "shard_states", "state_dict"}
 
+#: the epoch-keyed result-cache fields (core/metric.py): the write-epoch
+#: clock and the cached compute value/epoch stamp. Outside the lifecycle,
+#: mutating them directly bypasses ``_mark_state_written()`` — the hook
+#: subclasses override to degrade their incremental read caches (dirty
+#: slices, window fold memos) — so a bare ``self._write_epoch += 1``
+#: silently leaves a partial-fold cache claiming to be current.
+_CACHE_PLANE_FIELDS = {"_computed", "_computed_epoch", "_write_epoch"}
+
+#: method-name patterns additionally allowed to touch the cache-plane
+#: fields: the compute cycle itself stamps them, and the ``_mark_*`` hooks
+#: ARE the sanctioned out-of-band write path
+_CACHE_PLANE_TOKENS = _STATE_WRITE_TOKENS + ("compute", "mark")
+
+#: host-side incremental-read bookkeeping: epoch/dirty-set counters, fold
+#: memos, per-slice value caches, last-read stats. These are NOT registered
+#: state — they never enter ``_defaults``, sync, or merge; they live on the
+#: host and the read plane rebuilds them from real state on any degrade —
+#: so writing them from ANY method (including traced ones, where they are
+#: Python-level trace-time no-ops) is legal. TL-STATE must never flag them;
+#: the carve-out is pinned by tests/analysis fixtures.
+HOST_COUNTER_ATTRS = {
+    "_dirty",
+    "_svc",
+    "_fold_memo",
+    "_wstate_memo",
+    "_borrowed_epoch",
+    "_last_fold_fanin",
+    "_last_fold_buckets",
+    "_last_fold_oldest_wall",
+    "_last_read_cache_hit",
+    "_last_layout_cache_hit",
+    "_last_table_rows",
+    "_readers",
+}
+
 #: attributes that are static under tracing — touching them is NOT a host
 #: round-trip (shape/dtype-derived control flow compiles away)
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
@@ -697,6 +732,7 @@ class StateRule(Rule):
                 continue
             yield from self._check_reducers(ctx, info)
             yield from self._check_state_writes(ctx, info, classes)
+            yield from self._check_cache_plane_writes(ctx, info)
             yield from self._check_declarations(ctx, info, classes)
 
     def _check_reducers(self, ctx: FileContext, info: ClassInfo) -> Iterator[Violation]:
@@ -735,6 +771,9 @@ class StateRule(Rule):
                         and isinstance(tgt.value, ast.Name)
                         and tgt.value.id == "self"
                         and tgt.attr in states
+                        # host-side epoch/dirty/memo counters are legal
+                        # non-leaf writes anywhere (see HOST_COUNTER_ATTRS)
+                        and tgt.attr not in HOST_COUNTER_ATTRS
                     ):
                         yield self.violation(
                             ctx,
@@ -742,6 +781,33 @@ class StateRule(Rule):
                             f"registered state `{tgt.attr}` assigned in `{name}`, outside "
                             "the update/reset/sync lifecycle; state writes elsewhere "
                             "desync the reset defaults and the sync cache",
+                        )
+
+    def _check_cache_plane_writes(self, ctx: FileContext, info: ClassInfo) -> Iterator[Violation]:
+        for method in info.methods():
+            name = method.name
+            if name in _STATE_WRITE_METHODS or any(tok in name for tok in _CACHE_PLANE_TOKENS):
+                continue
+            for node in ast.walk(method):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and tgt.attr in _CACHE_PLANE_FIELDS
+                    ):
+                        yield self.violation(
+                            ctx,
+                            node,
+                            f"epoch-cache field `{tgt.attr}` assigned in `{name}`, outside "
+                            "the compute/update/reset lifecycle; call "
+                            "`_mark_state_written()` (or `_mark_fused_written()`) instead "
+                            "so subclass incremental read caches degrade with the epoch",
                         )
 
     def _check_declarations(self, ctx: FileContext, info: ClassInfo, classes: Dict[str, ClassInfo]) -> Iterator[Violation]:

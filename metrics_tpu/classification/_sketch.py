@@ -37,6 +37,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.core.readers import ReaderCache, round_up_bucket
 from metrics_tpu.sketches.quantile import (
     qsketch_fill,
     qsketch_init,
@@ -68,13 +69,23 @@ class SketchCurveMixin:
     _sketch_cols: Optional[int] = None  # None = binary; C = per-class rows
     _sketch_tgt_kind: Optional[str] = None  # "int" (one-hot) | "indicator"
     _exact: bool = False
+    _shape_stable_reads: bool = False
 
-    def _init_sketch_curve(self, sketch_capacity: int, num_classes: Optional[int]) -> None:
+    def _init_sketch_curve(
+        self,
+        sketch_capacity: int,
+        num_classes: Optional[int],
+        shape_stable_reads: bool = False,
+    ) -> None:
         if not (isinstance(sketch_capacity, int) and sketch_capacity > 0):
             raise ValueError(
                 f"Argument `sketch_capacity` must be a positive int, got {sketch_capacity}"
             )
         self._sketch_capacity = sketch_capacity
+        self._shape_stable_reads = bool(shape_stable_reads)
+        # AOT reader cache for the weighted compute path (one pre-lowered
+        # executable per shape bucket — see core/readers.py)
+        self._readers = ReaderCache()
         self._sketch_cols = num_classes if (num_classes is not None and num_classes >= 2) else None
         payload = 1 if self._sketch_cols is None else 2 * self._sketch_cols
         self.add_state(
@@ -178,6 +189,26 @@ class SketchCurveMixin:
             )
         return int(fill) == int(n_seen)
 
+    def _sketch_reads_exact(self) -> bool:
+        """Should this read take the lossless exact-kernel path?  Yes inside
+        the lossless window — unless ``shape_stable_reads`` is on, in which
+        case only the EMPTY sketch keeps today's empty-stream behavior and
+        every non-empty read rides the fixed-shape weighted kernels instead.
+
+        ``shape_stable_reads=True`` is the serving/poll-path trade: the
+        exact kernels have data-dependent output shapes (they cannot be
+        bucketed or jitted), so each new fill count re-traces every eager
+        curve op — ~1s per read on a growing stream.  The weighted kernels
+        see O(log capacity) bucketed shapes total, at the cost of giving up
+        the lossless window's bit-parity with ``exact=True`` (unit-weight
+        rows keep the result within float-accumulation distance; past the
+        window the two paths coincide anyway)."""
+        if not self._sketch_is_lossless():
+            return False
+        if not self._shape_stable_reads:
+            return True
+        return int(jnp.asarray(self.n_seen)) == 0
+
     def _sketch_rows(self):
         """Occupied rows as ``(w, key, payload)`` host-sliced arrays."""
         leaf = jnp.asarray(self.csketch)
@@ -203,8 +234,21 @@ class SketchCurveMixin:
     def _sketch_weighted_arrays(self):
         """Post-compaction view: ``(scores, y, w)`` with y the (possibly
         fractional) per-row positive mass; per-class case returns
-        ``([n, C] scores, [n, C] y, [n] w)``."""
-        w, key, payload = self._sketch_rows()
+        ``([n, C] scores, [n, C] y, [n] w)``.
+
+        Rows are padded up to a shape BUCKET with zero-weight rows (the
+        sketch packs occupied rows first, so the tail past the fill count
+        is already ``w == 0``): the weighted kernels sort invalid rows
+        last and weight every cumulant, so pad rows are no-ops by design —
+        and the downstream jitted kernels see O(log capacity) distinct
+        shapes instead of one retrace per fill count. The LOSSLESS path
+        (:meth:`_sketch_exact_arrays`) stays exact-sliced: it feeds the
+        unbounded exact kernels whose bit-parity is pinned per shape."""
+        leaf = jnp.asarray(self.csketch)
+        n = int(qsketch_fill(leaf))
+        b = round_up_bucket(max(n, 1), leaf.shape[0])
+        rows = leaf[:b]
+        w, key, payload = rows[:, 0], rows[:, 1], rows[:, 2:]
         if self._sketch_cols is None:
             return key, payload[:, 0], w
         c = self._sketch_cols

@@ -67,6 +67,7 @@ class AUROC(SketchCurveMixin, CapacityCurveMixin, Metric):
         capacity: Optional[int] = None,
         exact: bool = False,
         sketch_capacity: int = DEFAULT_SKETCH_CAPACITY,
+        shape_stable_reads: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -111,7 +112,9 @@ class AUROC(SketchCurveMixin, CapacityCurveMixin, Metric):
             register_exact_list_states(self, ("preds", "target"))
             warn_exact_buffer("AUROC")
         else:
-            self._init_sketch_curve(sketch_capacity, num_classes)
+            self._init_sketch_curve(
+                sketch_capacity, num_classes, shape_stable_reads=shape_stable_reads
+            )
 
     _multiclass_capacity: bool = False
 
@@ -152,7 +155,7 @@ class AUROC(SketchCurveMixin, CapacityCurveMixin, Metric):
             return _auroc_compute(
                 preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
             )
-        if self._sketch_is_lossless():
+        if self._sketch_reads_exact():
             preds, target, pos_label = self._sketch_exact_arrays()
             return _auroc_compute(
                 preds, target, self.mode, self.num_classes, pos_label, self.average, self.max_fpr
@@ -160,8 +163,13 @@ class AUROC(SketchCurveMixin, CapacityCurveMixin, Metric):
         return self._sketch_approx_compute()
 
     def _sketch_approx_compute(self) -> Array:
-        """Weighted AUROC from the compacted sketch rows (beyond the
-        lossless window; error bounded by the sketch's rank-error envelope)."""
+        """Weighted AUROC from the (bucket-padded) sketch rows: beyond the
+        lossless window, or on every non-empty read under
+        ``shape_stable_reads``; error bounded by the sketch's rank-error
+        envelope.  The whole weighted pipeline runs as ONE pre-lowered
+        executable per (mode, shape bucket) from the reader cache, so a
+        dashboard polling a growing stream compiles O(log capacity) kernels
+        total instead of re-tracing every eager curve op per fill count."""
         scores, y, w = self._sketch_weighted_arrays()
         if self.max_fpr is not None and self.mode != DataType.BINARY:
             # the exact/lossless paths raise this inside _auroc_compute; the
@@ -170,14 +178,30 @@ class AUROC(SketchCurveMixin, CapacityCurveMixin, Metric):
                 "Partial AUC computation not available in multilabel/multiclass setting,"
                 f" 'max_fpr' must be set to `None`, received `{self.max_fpr}`."
             )
-        if self.mode == DataType.BINARY:
-            if self.max_fpr is not None and self.max_fpr < 1:
-                return binary_auroc_max_fpr_weighted(scores, y, w, self.max_fpr)
-            return binary_auroc_weighted(scores, y, w)
-        if self.mode == DataType.MULTILABEL and self.average == AverageMethod.MICRO:
-            flat_w = jnp.broadcast_to(w[:, None], y.shape).reshape(-1)
-            return binary_auroc_weighted(scores.reshape(-1), y.reshape(-1), flat_w)
-        per_class = jax.vmap(binary_auroc_weighted, in_axes=(1, 1, None))(scores, y, w)
-        supports = weighted_class_supports(y, w)
-        average = None if self.average == AverageMethod.NONE else self.average
-        return average_class_scores(per_class, supports, average)
+        mode, average, max_fpr = self.mode, self.average, self.max_fpr
+
+        def build():
+            def fn(scores, y, w):
+                if mode == DataType.BINARY:
+                    if max_fpr is not None and max_fpr < 1:
+                        return binary_auroc_max_fpr_weighted(scores, y, w, max_fpr)
+                    return binary_auroc_weighted(scores, y, w)
+                if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
+                    flat_w = jnp.broadcast_to(w[:, None], y.shape).reshape(-1)
+                    return binary_auroc_weighted(scores.reshape(-1), y.reshape(-1), flat_w)
+                per_class = jax.vmap(binary_auroc_weighted, in_axes=(1, 1, None))(scores, y, w)
+                supports = weighted_class_supports(y, w)
+                avg = None if average == AverageMethod.NONE else average
+                return average_class_scores(per_class, supports, avg)
+
+            return fn
+
+        reader = self._readers.get(
+            f"auroc_weighted:{mode}:{average}:{max_fpr}",
+            build,
+            scores,
+            y,
+            w,
+            bucket=int(jnp.asarray(w).shape[0]),
+        )
+        return reader(scores, y, w)

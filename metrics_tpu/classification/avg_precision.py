@@ -60,6 +60,7 @@ class AveragePrecision(SketchCurveMixin, CapacityCurveMixin, Metric):
         multilabel: bool = False,
         exact: bool = False,
         sketch_capacity: int = DEFAULT_SKETCH_CAPACITY,
+        shape_stable_reads: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -92,7 +93,9 @@ class AveragePrecision(SketchCurveMixin, CapacityCurveMixin, Metric):
                 register_exact_list_states(self, ("preds", "target"))
                 warn_exact_buffer("AveragePrecision")
             else:
-                self._init_sketch_curve(sketch_capacity, num_classes)
+                self._init_sketch_curve(
+                    sketch_capacity, num_classes, shape_stable_reads=shape_stable_reads
+                )
 
     def _update(self, preds: Array, target: Array, n_valid: Optional[Array] = None) -> None:
         if self._capacity is not None:
@@ -125,7 +128,7 @@ class AveragePrecision(SketchCurveMixin, CapacityCurveMixin, Metric):
             preds = dim_zero_cat(self.preds)
             target = dim_zero_cat(self.target)
             return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)
-        if self._sketch_is_lossless():
+        if self._sketch_reads_exact():
             preds, target, pos_label = self._sketch_exact_arrays()
             return _average_precision_compute(preds, target, self.num_classes, pos_label, self.average)
         return self._sketch_approx_compute()

@@ -385,7 +385,16 @@ class MetricCollection:
                     for state in m0._defaults:
                         object.__setattr__(mi, state, getattr(m0, state))
                     mi._update_called = m0._update_called
-                    mi._computed = None
+                    # epoch-aware borrow: installing the leader's states is
+                    # an out-of-band write ONLY when the leader actually
+                    # advanced since the last borrow — a repeat compute on
+                    # an unchanged group re-installs identical arrays, and
+                    # wiping the member's cache there would force a cold
+                    # fold per member per read forever
+                    src_epoch = (cg[0], m0._write_epoch)
+                    if getattr(mi, "_borrowed_epoch", None) != src_epoch:
+                        mi._mark_state_written()
+                        mi._borrowed_epoch = src_epoch
         res = {k: m.compute() for k, m in self.items(keep_base=True)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}

@@ -577,8 +577,7 @@ class FusedUpdate:
                 m = col._metrics[mname]
                 for k, v in new_states[name].items():
                     object.__setattr__(m, k, v)
-                m._update_called = True
-                m._computed = None
+                m._mark_fused_written()
         return bucket, cache_hit
 
     def _pick_bucket(self, dyn: List[Array], names: List[str]) -> Optional[int]:

@@ -162,6 +162,21 @@ class Metric(ABC):
         self._should_unsync = True
         self._forward_cache: Any = None
         self._computed: Any = None
+        # --- write-epoch clock (incremental read plane) ---------------
+        # `_write_epoch` is a host-side monotonic counter bumped on EVERY
+        # state mutation (update, fused/async apply, reset, restore,
+        # checkpoint load, collection group-borrow); `_computed_epoch` is
+        # the epoch the cached `_computed` value was folded at. The pair
+        # replaces the blunt `_computed = None` wipe as the cache-validity
+        # test (`_computed` is still nulled on writes for back-compat with
+        # callers that poke it), and gives subclasses a single clock to key
+        # their own incremental caches on: SlicedMetric's per-slice value
+        # cache, WindowedMetric's partial ring folds, and RetrievalMetric's
+        # table-layout memo are all epoch-keyed. Plain Python ints — never
+        # traced, never device-resident — so tracelint's TL-STATE rule
+        # whitelists them as legal non-leaf writes.
+        self._write_epoch: int = 0
+        self._computed_epoch: int = -1
         # wall clock of the first/last ingested batch (telemetry-enabled
         # updates only — freshness stamping is part of the telemetry plane
         # and the disabled hot path must stay one bool check)
@@ -218,7 +233,7 @@ class Metric(ABC):
             if n in snap["children"]:
                 c._restore_state(snap["children"][n])
         self._update_called = snap["update_called"]
-        self._computed = None
+        self._mark_state_written()
         self._is_synced = False
 
     # ------------------------------------------------------------------
@@ -379,6 +394,32 @@ class Metric(ABC):
             return
         object.__setattr__(self, _AUTO_COUNT, jnp.where(count < 0, count, count + 1))
 
+    def _mark_state_written(self) -> None:
+        """Record an OUT-OF-BAND state mutation on the write-epoch clock:
+        reset, snapshot restore, checkpoint load, distributed install, and
+        collection group-borrow all route here. Bumps ``_write_epoch`` and
+        nulls the cached value; subclasses with incremental read caches
+        override to additionally degrade them to cold (all-dirty /
+        fold-memo drop) — external writers cannot say WHAT changed, so the
+        only never-wrong answer is "everything". ``update()`` does NOT call
+        this: its own inline bump lets ``_update`` implementations keep
+        fine-grained dirty information (e.g. SlicedMetric marking only the
+        scattered slice ids)."""
+        self._write_epoch += 1
+        self._computed = None
+
+    def _mark_fused_written(self) -> None:
+        """Install hook for the fused single-dispatch apply path
+        (``FusedUpdate``/async drain): the kernel just wrote this metric's
+        states, so advance the epoch clock and mark the update observed.
+        The fused trace saw only tracers, so the base behavior is the
+        all-dirty degrade of :meth:`_mark_state_written`; subclasses whose
+        fused kernel performs exactly their normal state transform (e.g.
+        WindowedMetric's ring rotation) override to keep their incremental
+        caches warm instead."""
+        self._update_called = True
+        self._mark_state_written()
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate into global state. Parity with reference metric.py:421-428,460-463.
 
@@ -388,6 +429,7 @@ class Metric(ABC):
         reference users can switch frameworks without touching their data
         pipeline. Strings and other non-array leaves pass through untouched.
         """
+        self._write_epoch += 1
         self._computed = None
         self._update_called = True
         if not _TELEMETRY.enabled:  # disabled telemetry costs this ONE check
@@ -430,7 +472,12 @@ class Metric(ABC):
                 " the ``update`` method which may lead to errors, as metric states have not yet been updated.",
                 UserWarning,
             )
-        if self._computed is not None:
+        # epoch-keyed cache hit: the cached value must exist AND have been
+        # folded at the current write epoch — a concurrent async apply (or
+        # any out-of-band install) that bumped the clock mid-/post-compute
+        # makes the pair unequal and forces a cold fold, so a stale value is
+        # never served even when `_computed` survived the wipe
+        if self._computed is not None and self._computed_epoch == self._write_epoch:
             if _TELEMETRY.enabled:  # disabled read path stays ONE bool check
                 _TELEMETRY.record_read(
                     "compute",
@@ -441,6 +488,9 @@ class Metric(ABC):
                 )
             return self._computed
 
+        # stamp the epoch BEFORE the fold: writes that land while _compute
+        # runs (async ingest) bump past the stamp and invalidate the result
+        epoch0 = self._write_epoch
         # capture the gate once: a recorder enabled mid-call must not record
         # a duration measured against the 0.0 placeholder
         rec = _TELEMETRY if _TELEMETRY.enabled else None
@@ -461,6 +511,7 @@ class Metric(ABC):
                 with self._profiler_annotation("compute"):
                     value = self._compute()
                 self._computed = _squeeze_if_scalar(value)
+                self._computed_epoch = epoch0
             if rec is not None:
                 dt = time.perf_counter() - t0
                 rec.record_call("compute", self, dt)
@@ -549,7 +600,7 @@ class Metric(ABC):
         """Restore every state to its default. Parity with reference metric.py:491-506."""
         self._update_called = False
         self._forward_cache = None
-        self._computed = None
+        self._mark_state_written()
         for attr, default in self._defaults.items():
             if isinstance(default, list):
                 object.__setattr__(self, attr, [])
@@ -979,6 +1030,8 @@ class Metric(ABC):
             and prefix + _AUTO_COUNT not in state_dict
         ):
             object.__setattr__(self, _AUTO_COUNT, jnp.asarray(-1, jnp.int32))
+        if restored_real_state:
+            self._mark_state_written()
         for cname, child in self._iter_child_metrics():
             child.load_state_dict(state_dict, prefix=f"{prefix}{cname}.")
 

@@ -1,0 +1,164 @@
+"""AOT-compiled reader executables for the incremental read plane.
+
+Read-side kernels (subset gathers, top-k selection, partial window folds)
+historically re-traced per call-site shape: ``compute(slice_ids=ids)``
+compiled once per distinct subset length, ``compute(top_k=k)`` once per
+distinct ``k``, and the sketch/window folds once per fill count. Each
+retrace is tens of milliseconds of host work on a path whose budget is a
+serving-loop probe tick.
+
+This module fixes the class of problem once:
+
+* **Shape buckets** (:func:`round_up_bucket`) collapse the family of read
+  shapes to a small fixed set — callers pad their index vector up to the
+  bucket (:func:`pad_ids`, repeating the last id: re-reading a slice is
+  idempotent, so the padding rows are exact no-ops on the result prefix).
+* **A reader cache** (:class:`ReaderCache`) holds pre-lowered
+  ``jax.jit(fn).lower(...).compile()`` executables keyed on
+  ``(kind, shape-bucket, input signature, dispatch_mode())``. The ops
+  dispatch mode is part of the key for the same reason it keys the fused
+  update cache (core/fused.py): a flipped ``METRICS_TPU_NO_PALLAS`` /
+  forced-backend test mode must recompile the reader, not keep serving a
+  stale trace of the disabled kernel.
+
+Readers are pure jnp programs, so AOT compilation changes WHEN the compile
+happens, never WHAT is computed — the bit-parity discipline of the
+incremental read plane (docs/incremental_reads.md) is untouched.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+#: the small bucket family read shapes round up into; reads larger than the
+#: last entry double from there (and every bucket is capped at the caller's
+#: axis size, so a full read never pads)
+DEFAULT_ID_BUCKETS: Tuple[int, ...] = (8, 64, 512, 4096)
+
+#: reader-cache entries per instance before the leak warning fires — the
+#: key space is (kinds x buckets x dispatch modes), all small and bounded,
+#: so unbounded growth means a caller is keying on something per-call
+READER_CACHE_WARN_ENTRIES = 64
+
+
+def round_up_bucket(
+    n: int, cap: Optional[int] = None, buckets: Tuple[int, ...] = DEFAULT_ID_BUCKETS
+) -> int:
+    """Smallest bucket ``>= n`` from the family (doubling past the last
+    entry), capped at ``cap`` (the axis size — a full-axis read is its own
+    exact bucket)."""
+    n = max(int(n), 1)
+    if cap is not None and n >= cap:
+        return cap
+    for b in buckets:
+        if b >= n:
+            return min(b, cap) if cap is not None else b
+    b = buckets[-1]
+    while b < n:
+        b *= 2
+    return min(b, cap) if cap is not None else b
+
+
+def pad_ids(ids: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad a 1-D host id vector up to ``bucket`` rows by repeating the last
+    id (int32). Re-reading an id is idempotent, so padded rows change
+    nothing; callers slice the result back to the real prefix."""
+    ids = np.asarray(ids, dtype=np.int32).reshape(-1)
+    if ids.size == 0:
+        raise ValueError("pad_ids: cannot pad an empty id vector")
+    if ids.size >= bucket:
+        return ids[:bucket]
+    return np.concatenate([ids, np.full(bucket - ids.size, ids[-1], np.int32)])
+
+
+def _leaf_sig(leaf: Any) -> Tuple[Tuple[int, ...], str]:
+    """Shape/dtype signature WITHOUT materializing the leaf — `np.asarray`
+    on a device array would drag the whole state to host per cache probe."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        arr = np.asarray(leaf)
+        shape, dtype = arr.shape, arr.dtype
+    return (tuple(shape), str(dtype))
+
+
+class ReaderCache:
+    """Per-owner cache of pre-lowered read executables.
+
+    ``get(kind, build, *args, bucket=...)`` returns a compiled executable
+    for ``build()`` (a zero-arg factory returning the pure reader function)
+    specialized to the argument shapes/dtypes — compiling it on first use
+    and replaying the XLA executable afterwards. One instance lives on each
+    metric that serves incremental reads, so the closure identity problem
+    (readers close over the wrapped template) never reaches the key.
+    """
+
+    def __init__(self) -> None:
+        self._cache: Dict[Tuple, Any] = {}
+        self._fast: Dict[Tuple, Any] = {}
+        self._warned = False
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    # compiled XLA executables are neither copyable nor picklable; a
+    # cloned/restored metric starts with a cold reader cache and re-lowers
+    # on first read — behavior, not results, so parity is unaffected
+    def __deepcopy__(self, memo: Dict) -> "ReaderCache":
+        return ReaderCache()
+
+    def __getstate__(self) -> Dict:
+        return {}
+
+    def __setstate__(self, state: Dict) -> None:
+        self.__init__()
+
+    def clear(self) -> None:
+        self._cache.clear()
+        self._fast.clear()
+
+    def fast(self, kind: str, bucket: Optional[int]) -> Optional[Callable]:
+        """Signature-free probe: the executable the last :meth:`get` for
+        ``(kind, bucket)`` under the current dispatch mode resolved to.
+
+        Hashing the full leaf signature costs tens of microseconds per
+        probe — real money on a sub-millisecond incremental read. An owner
+        whose state shapes/dtypes are fixed for its lifetime (and who calls
+        :meth:`clear` on the mutations that do change them, e.g.
+        ``set_dtype``) can probe this first and fall back to :meth:`get`
+        on a miss."""
+        from metrics_tpu.ops.dispatch import dispatch_mode
+
+        return self._fast.get((kind, bucket, dispatch_mode()))
+
+    def get(
+        self,
+        kind: str,
+        build: Callable[[], Callable],
+        *example_args: Any,
+        bucket: Optional[int] = None,
+    ) -> Callable:
+        from metrics_tpu.ops.dispatch import dispatch_mode
+
+        mode = dispatch_mode()
+        sig = tuple(_leaf_sig(leaf) for leaf in jax.tree_util.tree_leaves(example_args))
+        key = (kind, bucket, sig, mode)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = jax.jit(build()).lower(*example_args).compile()
+            self._cache[key] = entry
+            if len(self._cache) == READER_CACHE_WARN_ENTRIES and not self._warned:
+                self._warned = True
+                from metrics_tpu.utils.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"ReaderCache: {READER_CACHE_WARN_ENTRIES} reader executables"
+                    " cached on one metric — a read path is keying on a per-call"
+                    " quantity instead of a shape bucket (see"
+                    " metrics_tpu/core/readers.py).",
+                    UserWarning,
+                )
+        self._fast[(kind, bucket, mode)] = entry
+        return entry

@@ -431,6 +431,20 @@ class MetricRecorder:
         self.timeseries = None
         return self
 
+    def tick(self) -> int:
+        """Deferred telemetry housekeeping: fold the attached time-series'
+        pending observations into their bucket sketches now, instead of
+        letting the bounded inline flush fire inside a latency-sensitive
+        read. Serving loops call this between probe reads; it is a no-op
+        (returning 0) with no registry attached."""
+        ts = self.timeseries
+        if ts is None:
+            return 0
+        try:
+            return int(ts.housekeep())
+        except Exception:  # noqa: BLE001 — telemetry must never take down the hot path
+            return 0
+
     def _observe(self, name: str, value: float) -> None:
         """Feed one observation into the attached registry (no-op when
         detached). Called OUTSIDE the recorder lock — the registry has its

@@ -141,6 +141,25 @@ class TelemetrySeries:
                 if len(b.pending) >= self._flush_at:
                     self._flush(b)
 
+    def housekeep(self) -> int:
+        """Fold every bucket's pending observations into its sketch NOW,
+        returning the number of values folded.
+
+        The hot path bounds its own worst case with the inline flush at
+        ``_flush_at`` pending values — but that flush (a few ms of sketch
+        compaction) then lands inside whichever :meth:`record` crosses
+        the threshold, i.e. inside somebody's timed read. A
+        latency-sensitive caller (a serving loop between probe reads)
+        calls this at a moment of its own choosing so the compaction
+        never rides a measured path."""
+        folded = 0
+        with self._lock:
+            for b in self._ring:
+                if b is not None and b.pending:
+                    folded += len(b.pending)
+                    self._flush(b)
+        return folded
+
     def _slot(self, idx: int) -> _Bucket:
         """The live bucket for absolute index ``idx`` — resetting the slot
         if its previous occupant has expired out of the ring's span.
@@ -477,6 +496,13 @@ class TimeSeriesRegistry:
 
     def get(self, name: str) -> Optional[TelemetrySeries]:
         return self._series.get(name)
+
+    def housekeep(self) -> int:
+        """Run :meth:`TelemetrySeries.housekeep` on every series; returns
+        the total number of pending values folded."""
+        with self._lock:
+            series = list(self._series.values())
+        return sum(s.housekeep() for s in series)
 
     def names(self) -> List[str]:
         with self._lock:

@@ -32,6 +32,8 @@ Subclasses declare their padded row kernel via ``_padded_metric``
 User subclasses that only implement ``_metric`` fall back to a host group
 loop in either mode (exact-parity semantics, eager speed).
 """
+import time
+import weakref
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Optional
 
@@ -42,8 +44,8 @@ import numpy as np
 from metrics_tpu.core.metric import Metric
 from collections import OrderedDict
 
+from metrics_tpu.core.readers import ReaderCache, pad_ids, round_up_bucket
 from metrics_tpu.functional.retrieval.padded import (
-    _memoized,
     _padded_compute_fn,
     _padded_compute_fn_raw,
     pack_queries_cached,
@@ -54,6 +56,7 @@ from metrics_tpu.retrieval.table import (
     retrieval_table_init,
     retrieval_table_insert,
     retrieval_table_layout,
+    retrieval_table_layout_rows,
     retrieval_table_merge_fx,
 )
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
@@ -67,20 +70,67 @@ from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
 Array = jax.Array
 
-#: table-leaf identity -> unpacked padded layout, the table-state analog
-#: of the exact path's _PACK_CACHE: a compute group's metrics share ONE
-#: qtable leaf by reference, so memoizing the unpack on its id() lets the
-#: group (and repeated computes on an unchanged table) reuse one layout —
-#: and, because the cached layout returns the SAME array objects, one
-#: shared per-row sort through sorted_row_layout's identity cache.
-#: Entries die with their leaf (weakref finalizers, see _memoized).
+#: hard LRU bound on the layout memo: a serving process computes a handful
+#: of retrieval metrics over one or two tables, so entries past this are
+#: leaks, not reuse (asserted by the retrieval test suite)
+_LAYOUT_CACHE_MAX = 8
+
+#: (owner id, write epoch) -> (table-leaf id, unpacked padded layout,
+#: weakref finalizer). The epoch key makes repeated reads of an unwritten
+#: metric pure cache hits — the table's WRITE CLOCK, not the array object,
+#: is what "unchanged" means (a device transfer or unsync can swap the
+#: object without changing a bit). The stored leaf id still guards the
+#: entry (an epoch hit with a different leaf recomputes) and feeds the
+#: identity scan: a compute group's metrics share ONE qtable leaf by
+#: reference, so a sibling's entry for the same leaf is aliased instead of
+#: re-unpacked — and, because the aliased layout returns the SAME array
+#: objects, the group shares one per-row sort through sorted_row_layout's
+#: identity cache. Entries die with their leaf (weakref finalizers) or by
+#: LRU eviction, whichever first.
 _LAYOUT_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 
 
-def _table_layout_cached(qtable: Array):
+def _layout_cache_evict(key: tuple) -> None:
+    entry = _LAYOUT_CACHE.pop(key, None)
+    if entry is not None and entry[2] is not None:
+        entry[2].detach()
+
+
+def _layout_cache_store(key: tuple, qtable: Array, layout: tuple) -> None:
+    try:
+        fin = weakref.finalize(qtable, _layout_cache_evict, key)
+    except TypeError:  # non-weakref-able leaf: serve uncached
+        return
+    _LAYOUT_CACHE[key] = (id(qtable), layout, fin)
+    while len(_LAYOUT_CACHE) > _LAYOUT_CACHE_MAX:
+        k0 = next(iter(_LAYOUT_CACHE))
+        _layout_cache_evict(k0)
+
+
+def _table_layout_cached(qtable: Array, epoch_key: Optional[tuple] = None):
+    """``(layout, cache_hit)`` — the memoized padded unpack of ``qtable``.
+    A hit means no unpack ran: either the owner's epoch key matched (same
+    write clock, same leaf) or the identity scan found a sibling's entry
+    for the same leaf object."""
     if isinstance(qtable, jax.core.Tracer):  # never cache traced values
-        return retrieval_table_layout(qtable)
-    return _memoized(_LAYOUT_CACHE, (qtable,), lambda: retrieval_table_layout(qtable))
+        return retrieval_table_layout(qtable), False
+    tid = id(qtable)
+    if epoch_key is not None:
+        hit = _LAYOUT_CACHE.get(epoch_key)
+        if hit is not None and hit[0] == tid:
+            _LAYOUT_CACHE.move_to_end(epoch_key)
+            return hit[1], True
+    # identity scan (bounded by _LAYOUT_CACHE_MAX): a compute-group sibling
+    # may have unpacked this exact leaf under its own epoch key
+    for k, (tid2, layout2, _) in _LAYOUT_CACHE.items():
+        if tid2 == tid:
+            _LAYOUT_CACHE.move_to_end(k)
+            if epoch_key is not None:
+                _layout_cache_store(epoch_key, qtable, layout2)
+            return layout2, True
+    layout = retrieval_table_layout(qtable)
+    _layout_cache_store(epoch_key if epoch_key is not None else ("id", tid), qtable, layout)
+    return layout, False
 
 
 class RetrievalMetric(Metric, ABC):
@@ -128,6 +178,10 @@ class RetrievalMetric(Metric, ABC):
             )
         #: occupied rows unpacked by the last table compute (read telemetry only)
         self._last_table_rows = 0
+        #: whether the last table compute reused a memoized layout
+        self._last_layout_cache_hit = False
+        #: pre-lowered subset-unpack executables (table-state reads only)
+        self._readers = ReaderCache()
 
     def _update(
         self, preds: Array, target: Array, indexes: Array, n_valid: Optional[Array] = None
@@ -191,7 +245,54 @@ class RetrievalMetric(Metric, ABC):
 
     def _read_extras(self) -> dict:
         # surfaced on the typed ``read`` event emitted by Metric.compute
-        return {"table_rows": self._last_table_rows}
+        return {
+            "table_rows": self._last_table_rows,
+            "cache_hit": self._last_layout_cache_hit,
+        }
+
+    def table_rows_layout(self, rows: Any):
+        """Subset unpack: the padded layout of just the given TABLE rows,
+        in caller order (no cross-row qid sort) — what an incremental
+        consumer that tracks its own row set reads instead of paying the
+        full ``[max_queries, cap]`` unpack. Returns ``(padded_preds,
+        padded_target, mask, row_valid, pos_mass, neg_count, n_seen,
+        qid)``, each leading with ``len(rows)``.
+
+        Concrete host row ids route through a pre-lowered subset reader
+        keyed on the row-count bucket (one executable per bucket, padding
+        by repeating the last row — re-reading a row is idempotent and the
+        pad rows are sliced back off); traced ids fall through to the
+        plain jnp unpack. Table-state mode only."""
+        if self._exact:
+            raise ValueError(
+                "table_rows_layout() reads the fixed-capacity table state;"
+                " exact=True metrics keep cat-state lists"
+            )
+        qtable = jnp.asarray(self.qtable)
+        if not _is_concrete(qtable) or isinstance(rows, jax.core.Tracer):
+            return retrieval_table_layout_rows(qtable, jnp.asarray(rows))
+        rows = np.asarray(rows, np.int32).reshape(-1)
+        if rows.size == 0:
+            raise ValueError("table_rows_layout() needs at least one row id")
+        n = rows.size
+        bucket = round_up_bucket(n, self.max_queries)
+        idx = jnp.asarray(pad_ids(rows, bucket))
+
+        def build():
+            return retrieval_table_layout_rows
+
+        t0 = time.perf_counter() if _TELEMETRY.enabled else 0.0
+        reader = self._readers.get("table_subset", build, qtable, idx, bucket=bucket)
+        out = tuple(x[:n] for x in reader(qtable, idx))
+        if _TELEMETRY.enabled:
+            _TELEMETRY.record_read(
+                "table",
+                self,
+                duration_s=time.perf_counter() - t0,
+                table_rows=n,
+                fanin=n,
+            )
+        return out
 
     # ------------------------------------------------------------------
     # table-state compute (the fixed-capacity default)
@@ -208,9 +309,13 @@ class RetrievalMetric(Metric, ABC):
                 "`indexes` is empty — the retrieval metric has no accumulated samples;"
                 " call `update` before `compute`."
             )
-        padded_preds, padded_target, mask, row_valid, pos_mass, neg_count, _ = (
-            _table_layout_cached(qtable)
-        )
+        # key the unpack on this metric's write epoch: repeated reads of an
+        # unwritten table are cache hits regardless of leaf identity; a
+        # synced (cross-rank) leaf has no local epoch, so it rides the
+        # identity scan only
+        epoch_key = None if self._is_synced else (id(self), self._write_epoch)
+        layout, self._last_layout_cache_hit = _table_layout_cached(qtable, epoch_key)
+        padded_preds, padded_target, mask, row_valid, pos_mass, neg_count, _ = layout
         if _TELEMETRY.enabled and _is_concrete(row_valid):
             self._last_table_rows = int(jnp.sum(row_valid))
         empty = self._table_empty_rows(pos_mass, neg_count)

@@ -534,3 +534,24 @@ def retrieval_table_layout(table: Array):
         neg_c[order],
         nseen[order],
     )
+
+
+def retrieval_table_layout_rows(table: Array, rows: Array):
+    """Subset unpack: the padded compute layout of just ``table[rows]``,
+    in CALLER order — no cross-row qid sort, so row ``i`` of every output
+    is the requested table row ``rows[i]``, and the per-row values are
+    bit-identical to the same row of :func:`retrieval_table_layout` (the
+    sort only reorders rows, never changes one). Returns the layout tuple
+    plus a trailing ``qid [n]`` so callers know which query each row
+    holds:
+
+    ``(padded_preds [n, cap], padded_target [n, cap], mask [n, cap],
+    row_valid [n], pos_mass [n], neg_count [n], n_seen [n], qid [n])``
+    """
+    rows = jnp.asarray(rows, jnp.int32)
+    key, qid, nseen, pos_m, neg_c, fill, pt, tt = _unpack(table[rows])
+    occ = key > 0
+    mask = (jnp.arange(pt.shape[1], dtype=jnp.float32)[None, :] < fill[:, None]) & occ[:, None]
+    padded_preds = jnp.where(mask, pt, -jnp.inf)
+    padded_target = jnp.where(mask, tt, 0.0)
+    return (padded_preds, padded_target, mask, occ, pos_m, neg_c, nseen, qid)

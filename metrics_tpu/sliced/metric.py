@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric
+from metrics_tpu.core.readers import ReaderCache, pad_ids, round_up_bucket
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 
 # the single source of the prefix: the recorder owns it (it splits the
@@ -129,6 +130,19 @@ class SlicedMetric(Metric):
                 dist_reduce_fx=red,
             )
         self.add_state(SLICE_ROWS, default=jnp.zeros(num_slices, jnp.int32), dist_reduce_fx="sum")
+        # --- incremental read plane (host-side, never traced) ----------
+        # dirty set: True where a slice was written since the per-slice
+        # value cache last folded it. Eager updates mark exactly the
+        # scattered concrete ids; traced ids (fused/async applies, jit)
+        # and every out-of-band install degrade to all-dirty — never
+        # wrong, at worst a full fold. Starts all-dirty (nothing cached).
+        self._dirty = np.ones(num_slices, dtype=bool)
+        # per-slice value cache: host pytree of [S]-leading arrays, shaped
+        # lazily from the first fold; a slice's entry is trusted iff its
+        # dirty bit is clear
+        self._svc: Optional[Any] = None
+        # pre-lowered subset-gather / top-k executables (core/readers.py)
+        self._readers = ReaderCache()
 
     # ------------------------------------------------------------------
     # construction-time sliceability validation
@@ -275,6 +289,16 @@ class SlicedMetric(Metric):
             SLICE_ROWS,
             counts + segment_sum_dispatch(jnp.ones(n_rows, jnp.int32), slice_ids, num),
         )
+        # dirty-slice tracking: concrete ids mark exactly the written
+        # slices (out-of-range ids are excluded — the scatter DROPS them,
+        # so the corresponding slices did not change); traced ids cannot
+        # say which slices the kernel will touch, so the whole axis goes
+        # dirty — degraded, never wrong
+        if _is_concrete(slice_ids):
+            written = np.asarray(slice_ids)
+            self._dirty[written[(written >= 0) & (written < num)]] = True
+        else:
+            self._dirty[:] = True
         if _TELEMETRY.enabled:
             # under the fused kernel this records once per TRACE (shapes are
             # static), on the eager path once per update — mirroring the
@@ -303,10 +327,106 @@ class SlicedMetric(Metric):
                 hot_rows=hot_rows,
             )
 
+    # ------------------------------------------------------------------
+    # incremental read plane
+    # ------------------------------------------------------------------
+    def _mark_state_written(self) -> None:
+        # out-of-band installs (reset, restore, checkpoint load, fused
+        # apply, group borrow) can't say WHICH slices changed
+        super()._mark_state_written()
+        dirty = getattr(self, "_dirty", None)
+        if dirty is not None:
+            dirty[:] = True
+
+    def set_dtype(self, dst_type) -> "Metric":
+        # cached per-slice values hold the OLD dtype's bits; a cast fold
+        # would mix dtypes in one assembled result
+        out = super().set_dtype(dst_type)
+        self._dirty[:] = True
+        self._svc = None
+        # cached reader executables were lowered for the old dtype's leaf
+        # signatures; the signature-free fast probe must never see them
+        self._readers.clear()
+        return out
+
+    def _subset_reader(self, states: Dict[str, Array], ids: Array, bucket: int):
+        """Pre-lowered subset fold: gather ``bucket`` slice rows out of the
+        full states and vmap the wrapped compute over them."""
+        m = self._template
+        names = tuple(m._defaults)
+
+        def build():
+            def read(state_leaves: Dict[str, Array], idx: Array) -> Any:
+                sub = {k: state_leaves[k][idx] for k in names}
+                return jax.vmap(m.compute_state)(sub)
+
+            return read
+
+        return self._readers.get("sliced_subset", build, states, ids, bucket=bucket)
+
+    def _fold_slices(self, req: np.ndarray) -> Tuple[Any, int]:
+        """Fold the DIRTY subset of ``req`` through the bucketed AOT reader,
+        refresh the per-slice value cache, and assemble the requested values
+        from it. Returns ``(values, n_folded)``. Bit-parity: cached entries
+        were produced by the same vmapped ``compute_state`` program a cold
+        full fold runs, so assembly never mixes provenances."""
+        m = self._template
+        # invariant: a clear dirty bit implies a valid cache entry (bits
+        # are cleared only after a fold scattered that slice), so folding
+        # exactly the dirty requested ids always leaves `req` assemblable
+        fold = np.unique(req[self._dirty[req]])
+        n_folded = int(fold.size)
+        if n_folded:
+            bucket = round_up_bucket(n_folded, self.num_slices)
+            # the pre-lowered executable device-puts its arguments itself;
+            # eager jnp conversions here would only add dispatch overhead
+            # on a sub-millisecond path
+            padded = pad_ids(fold, bucket)
+            states = {
+                k: v if isinstance(v, jax.Array) else jnp.asarray(v)
+                for k, v in ((k, getattr(self, k)) for k in m._defaults)
+            }
+            # state shapes/dtypes are fixed for this instance's lifetime
+            # (set_dtype clears the cache), so the signature-free probe is
+            # safe and skips per-read leaf hashing
+            reader = self._readers.fast("sliced_subset", bucket)
+            if reader is None:
+                reader = self._subset_reader(states, padded, bucket)
+            values = reader(states, padded)
+            host_vals = jax.tree_util.tree_map(np.asarray, values)
+            if self._svc is None:
+                self._svc = jax.tree_util.tree_map(
+                    lambda v: np.zeros((self.num_slices,) + v.shape[1:], v.dtype),
+                    host_vals,
+                )
+
+            def _scatter(cache: np.ndarray, vals: np.ndarray) -> np.ndarray:
+                cache[padded] = vals
+                return cache
+
+            jax.tree_util.tree_map(_scatter, self._svc, host_vals)
+            self._dirty[fold] = False
+        return (
+            jax.tree_util.tree_map(lambda c: jnp.asarray(c[req]), self._svc),
+            n_folded,
+        )
+
     def _compute(self) -> Any:
         m = self._template
-        states = {k: getattr(self, k) for k in m._defaults}
-        return jax.vmap(m.compute_state)(states)
+        # synced states are the cross-rank reduction, NOT the local
+        # accumulation the dirty set and value cache describe — and traced
+        # states have no host dirty set at all; both degrade to the plain
+        # full fold without touching the cache
+        if self._is_synced or not _is_concrete(getattr(self, SLICE_ROWS)):
+            states = {k: getattr(self, k) for k in m._defaults}
+            return jax.vmap(m.compute_state)(states)
+        values, n_folded = self._fold_slices(np.arange(self.num_slices))
+        self._last_fold_fanin = n_folded
+        return values
+
+    def _read_extras(self) -> Dict[str, Any]:
+        # partial-fold fan-in of the last cold compute on the read event
+        return {"fanin": getattr(self, "_last_fold_fanin", None)}
 
     def compute(self, *, slice_ids: Optional[Array] = None, top_k: Optional[int] = None) -> Any:
         """Per-slice values.
@@ -327,11 +447,12 @@ class SlicedMetric(Metric):
         rec = _TELEMETRY if _TELEMETRY.enabled else None
         t0 = time.perf_counter() if rec is not None else 0.0
         m = self._template
+        host_ids: Optional[np.ndarray] = None
         if top_k is not None:
             if not isinstance(top_k, int) or top_k <= 0:
                 raise MetricsUserError(f"`top_k` must be a positive int, got {top_k!r}")
             k = min(top_k, self.num_slices)
-            _, ids = jax.lax.top_k(self.slice_counts, k)
+            ids = self._top_ids(k)
         else:
             ids = jnp.asarray(slice_ids)
             if ids.ndim != 1 or not jnp.issubdtype(ids.dtype, jnp.integer):
@@ -341,16 +462,31 @@ class SlicedMetric(Metric):
                 )
             # unlike update() (XLA scatter DROPS out-of-range ids, documented),
             # a gather silently CLAMPS them — an off-by-one would return a
-            # neighboring slice's value; reject it where we can see the values
-            if ids.size and _is_concrete(ids) and (
-                int(jnp.min(ids)) < 0 or int(jnp.max(ids)) >= self.num_slices
-            ):
-                raise MetricsUserError(
-                    f"`slice_ids` out of range for num_slices={self.num_slices}:"
-                    f" min {int(jnp.min(ids))}, max {int(jnp.max(ids))}"
-                )
-        states = {name: jnp.asarray(getattr(self, name))[ids] for name in m._defaults}
-        values = jax.vmap(m.compute_state)(states)
+            # neighboring slice's value; reject it where we can see the
+            # values (on host: two eager jnp reductions would cost a device
+            # round-trip each on a path budgeted in hundreds of microseconds)
+            if ids.size and _is_concrete(ids):
+                host_ids = np.asarray(ids)
+                lo, hi = int(host_ids.min()), int(host_ids.max())
+                if lo < 0 or hi >= self.num_slices:
+                    raise MetricsUserError(
+                        f"`slice_ids` out of range for num_slices={self.num_slices}:"
+                        f" min {lo}, max {hi}"
+                    )
+        n_folded: Optional[int] = None
+        if ids.size and _is_concrete(ids) and not self._is_synced:
+            # the incremental path: fold only the dirty requested slices
+            # through the bucketed AOT reader, assemble the rest from the
+            # per-slice value cache (reuse the host copy the range check
+            # already paid for — a second device->host transfer per read
+            # is measurable at this scale)
+            if host_ids is None:
+                host_ids = np.asarray(ids)
+            values, n_folded = self._fold_slices(host_ids)
+        else:
+            # traced ids / synced states / empty subset: plain gather+fold
+            states = {name: jnp.asarray(getattr(self, name))[ids] for name in m._defaults}
+            values = jax.vmap(m.compute_state)(states)
         if rec is not None:
             # leaves folded = wrapped leaves gathered per selected slice
             rec.record_read(
@@ -358,9 +494,34 @@ class SlicedMetric(Metric):
                 self,
                 duration_s=time.perf_counter() - t0,
                 leaves=len(m._defaults) * int(ids.shape[0]) if _is_concrete(ids) else len(m._defaults),
+                cache_hit=n_folded == 0,
+                fanin=n_folded,
                 freshness=self.freshness_stamp(),
             )
         return (ids, values) if top_k is not None else values
+
+    def _top_ids(self, k: int) -> Array:
+        """Ids of the ``k`` fullest slices via a BUCKETED pre-lowered top-k:
+        ``lax.top_k(counts, k)`` compiles once per distinct ``k``, so a
+        dashboard sweeping k (top-5, top-10, top-50 panels) retraces per
+        panel — rounding k up to the reader-bucket family and slicing the
+        prefix keeps one executable per bucket. Exact: XLA top-k returns
+        descending order with ties broken by lower index, so the k-prefix
+        of a larger-k result IS the k result."""
+        kb = round_up_bucket(k, self.num_slices)
+        counts = self.slice_counts
+        if not _is_concrete(counts):
+            _, ids = jax.lax.top_k(counts, kb)
+            return ids[:k]
+
+        def build():
+            def read(c: Array) -> Array:
+                return jax.lax.top_k(c, kb)[1]
+
+            return read
+
+        reader = self._readers.get("sliced_topk", build, counts, bucket=kb)
+        return reader(counts)[:k]
 
     # ------------------------------------------------------------------
     # observability
@@ -374,8 +535,10 @@ class SlicedMetric(Metric):
             raise MetricsUserError(f"`k` must be a positive int, got {k!r}")
         counts = self.slice_counts
         total = jnp.clip(jnp.sum(counts), 1, None)
-        top_counts, ids = jax.lax.top_k(counts, min(k, self.num_slices))
-        return ids, top_counts.astype(jnp.float32) / total.astype(jnp.float32)
+        # bucketed selection (see _top_ids): one executable per k-bucket
+        # instead of one trace per distinct k
+        ids = self._top_ids(min(k, self.num_slices))
+        return ids, counts[ids].astype(jnp.float32) / total.astype(jnp.float32)
 
     def state_footprint(self, include_children: bool = True) -> Dict[str, int]:
         """Per-state bytes with every key under ``sliced/`` — the telemetry

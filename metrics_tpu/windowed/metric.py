@@ -43,13 +43,15 @@ docs/windowed_metrics.md.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric
+from metrics_tpu.core.readers import ReaderCache
 from metrics_tpu.observability.freshness import FreshnessStamp
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import WINDOWED_FOOTPRINT_PREFIX
@@ -75,6 +77,10 @@ DECAY_WEIGHT = "_decay_weight"
 _RESERVED = (RING_ROWS, RING_COUNT, DECAY_WEIGHT)
 
 _MODES = ("ring", "decay")
+
+#: LRU bound on the per-instance fold memos — one entry per distinct
+#: (window, before) read pattern; serving loops use one or two
+_FOLD_MEMO_MAX = 8
 
 
 def _reducer_name(red: Any) -> str:
@@ -203,6 +209,23 @@ class WindowedMetric(Metric):
         self._bucket_wall: List[Optional[float]] = [None] * max(self.window, 1)
         self._last_fold_buckets = 0
         self._last_fold_oldest_wall: Optional[float] = None
+        # --- incremental read plane (ring mode; docs/incremental_reads.md)
+        # Prefix-fold memo: window start bucket -> (highest completed bucket
+        # folded, left-associated fold over the non-empty completed buckets
+        # in that range, or None when all were empty). A completed bucket's
+        # row is immutable until overwritten a full ring later, and every
+        # queryable window satisfies w <= R, so a memoized prefix is
+        # bit-identical to refolding it — reads extend the prefix by newly
+        # completed buckets instead of refolding the whole window.
+        self._fold_memo: "OrderedDict[int, Tuple[int, Optional[Dict[str, Array]]]]" = OrderedDict()
+        # Final folded-state memo: (window, before) -> (ring clock, state).
+        # The clock advances on every rotation, so an equal clock means the
+        # rows — and therefore the fold — are identical: repeat reads at an
+        # idle clock are pure cache hits.
+        self._wstate_memo: "OrderedDict[Tuple[int, int], Tuple[int, Dict[str, Array]]]" = OrderedDict()
+        self._last_fold_fanin = 0
+        self._last_read_cache_hit = False
+        self._readers = ReaderCache()
 
     # ------------------------------------------------------------------
     # construction-time validation
@@ -389,6 +412,37 @@ class WindowedMetric(Metric):
         object.__setattr__(self, RING_COUNT, count + 1)
 
     # ------------------------------------------------------------------
+    # incremental read plane: install hooks
+    # ------------------------------------------------------------------
+    def _mark_state_written(self) -> None:
+        # out-of-band installs (reset/restore/load/group-borrow) replace
+        # states wholesale — the fold memos describe rows that no longer
+        # exist, so drop them; only ring rotations keep them warm
+        super()._mark_state_written()
+        memo = getattr(self, "_fold_memo", None)
+        if memo is not None:
+            memo.clear()
+            self._wstate_memo.clear()
+
+    def _mark_fused_written(self) -> None:
+        # a fused/async apply traces _update, so the kernel performed
+        # exactly the eager ring rotation: completed buckets stay immutable
+        # and the prefix-fold memo stays warm. Advance the epoch clock
+        # without the foreign-write memo wipe. (The final-state memo keys
+        # on the ring clock, so it self-invalidates as the clock advances.)
+        self._update_called = True
+        self._write_epoch += 1
+        self._computed = None
+
+    def set_dtype(self, dst_type) -> "Metric":
+        # memoized folds hold the OLD dtype's bits; extending them after a
+        # cast would mix dtypes in one fold
+        out = super().set_dtype(dst_type)
+        self._fold_memo.clear()
+        self._wstate_memo.clear()
+        return out
+
+    # ------------------------------------------------------------------
     # window folds / compute
     # ------------------------------------------------------------------
     def _window_rows(self, window: int, before: int = 0) -> List[Dict[str, Array]]:
@@ -446,6 +500,8 @@ class WindowedMetric(Metric):
             self,
             duration_s=time.perf_counter() - t0,
             ring_buckets=self._last_fold_buckets,
+            cache_hit=self._last_read_cache_hit,
+            fanin=self._last_fold_fanin,
             freshness=self._window_freshness(),
         )
         return state
@@ -469,13 +525,144 @@ class WindowedMetric(Metric):
         if not isinstance(before, int) or before < 0:
             raise MetricsUserError(f"`before` must be a non-negative int, got {before!r}")
         m = self._template
+        if not self._is_synced and not isinstance(
+            jnp.asarray(getattr(self, RING_COUNT)), jax.core.Tracer
+        ):
+            return self._window_state_incremental(w, before)
+        # synced (cross-rank) rows describe a different stream than the
+        # local fold memos — fold cold without reading or writing them
         rows = self._window_rows(w, before)
+        self._last_fold_fanin = len(rows)
+        self._last_read_cache_hit = False
         if not rows:
             return {name: jnp.array(v) for name, v in m._defaults.items()}
         state = rows[0]
         for row in rows[1:]:
             state = m.merge_states(state, row)
         return state
+
+    def _window_state_incremental(self, w: int, before: int) -> Dict[str, Array]:
+        """Memoized window fold (local states, concrete clock).
+
+        The fold over buckets ``[lo, cur]`` splits at the current bucket:
+        completed buckets ``[lo, cur-1]`` are immutable (a ring slot is only
+        overwritten a full ring later, and ``w <= R`` keeps every queryable
+        bucket ahead of that), so their left-associated prefix fold is
+        memoized per window start and extended only by newly completed
+        buckets; the still-filling bucket ``cur`` merges on top per read.
+        The merge op sequence is identical to the cold oldest-first fold,
+        so the result is bit-identical."""
+        m = self._template
+        count = int(getattr(self, RING_COUNT))
+        k, r = self.updates_per_bucket, self.window
+        cur = (count - 1) // k - before
+        if count == 0 or cur < 0:
+            self._last_fold_buckets = 0
+            self._last_fold_oldest_wall = None
+            self._last_fold_fanin = 0
+            self._last_read_cache_hit = False
+            return {name: jnp.array(v) for name, v in m._defaults.items()}
+        lo = max(cur - w + 1, 0)
+        if (count - 1) // k - lo >= r:
+            raise MetricsUserError(
+                f"window of {w} bucket(s) ending {before} back reaches past the"
+                f" ring span ({r} buckets); those buckets were already evicted"
+            )
+        counts = np.asarray(getattr(self, RING_ROWS))
+        live = [b for b in range(lo, cur + 1) if counts[b % r] > 0]
+        walls = [x for x in (self._bucket_wall[b % r] for b in live) if x is not None]
+        self._last_fold_buckets = len(live)
+        self._last_fold_oldest_wall = min(walls) if walls else None
+        if not live:
+            self._last_fold_fanin = 0
+            self._last_read_cache_hit = False
+            return {name: jnp.array(v) for name, v in m._defaults.items()}
+        # repeat read at an idle clock: identical rows, identical fold
+        hit = self._wstate_memo.get((w, before))
+        if hit is not None and hit[0] == count:
+            self._wstate_memo.move_to_end((w, before))
+            self._last_fold_fanin = 0
+            self._last_read_cache_hit = True
+            return dict(hit[1])
+        # prefix fold over the completed buckets [lo, cur-1]
+        stored = self._fold_memo.get(lo)
+        if stored is not None and stored[0] <= cur - 1:
+            prev_hi, prefix = stored
+        else:
+            # no memo for this window start, or a `before`-shifted read
+            # whose window ends before the stored prefix does (never
+            # truncate a longer prefix — refold this read from scratch)
+            prev_hi, prefix = lo - 1, None
+        fold = [b for b in live if prev_hi < b <= cur - 1]
+        fanin = len(fold)
+        if fold:
+            if prefix is None and len(fold) >= 2 and self._aot_foldable():
+                prefix = self._fold_rows_aot([b % r for b in fold])
+            else:
+                for b in fold:
+                    row = {name: jnp.asarray(getattr(self, name))[b % r] for name in m._defaults}
+                    prefix = row if prefix is None else m.merge_states(prefix, row)
+        if cur - 1 >= lo and (stored is None or stored[0] < cur - 1):
+            self._fold_memo[lo] = (cur - 1, prefix)
+            self._fold_memo.move_to_end(lo)
+            while len(self._fold_memo) > _FOLD_MEMO_MAX:
+                self._fold_memo.popitem(last=False)
+        state = prefix
+        if counts[cur % r] > 0:
+            row = {name: jnp.asarray(getattr(self, name))[cur % r] for name in m._defaults}
+            state = row if state is None else m.merge_states(state, row)
+            fanin += 1
+        self._last_fold_fanin = fanin
+        self._last_read_cache_hit = False
+        self._wstate_memo[(w, before)] = (count, state)
+        self._wstate_memo.move_to_end((w, before))
+        while len(self._wstate_memo) > _FOLD_MEMO_MAX:
+            self._wstate_memo.popitem(last=False)
+        # shallow copy: callers may treat the dict as theirs; the memoized
+        # leaves are immutable arrays, the dict must not be shared
+        return dict(state)
+
+    def _aot_foldable(self) -> bool:
+        """Pure sum/max/min templates refold through one pre-lowered
+        executable; merge-like (sketch) leaves fold eagerly so their
+        per-merge telemetry accounting keeps firing."""
+        m = self._template
+        return all(
+            red in (dim_zero_sum, dim_zero_max, dim_zero_min)
+            for red in m._reductions.values()
+        )
+
+    def _fold_rows_aot(self, slots: List[int]) -> Dict[str, Array]:
+        """Refold ``n`` completed buckets through one AOT-compiled
+        executable: the left-associated per-leaf merge sequence is unrolled
+        inside the trace (XLA preserves float op order), so the result is
+        bit-identical to the eager ``merge_states`` loop while the host
+        pays one dispatch instead of ``n``. Keyed on ``n`` — bounded by
+        the ring span ``R``."""
+        m = self._template
+        n = len(slots)
+        reds = dict(m._reductions)
+
+        def build():
+            def fold(stacked: Dict[str, Array]) -> Dict[str, Array]:
+                state = {name: v[0] for name, v in stacked.items()}
+                for i in range(1, n):
+                    for name, red in reds.items():
+                        a, b = state[name], stacked[name][i]
+                        if red is dim_zero_sum:
+                            state[name] = a + b
+                        elif red is dim_zero_max:
+                            state[name] = jnp.maximum(a, b)
+                        else:
+                            state[name] = jnp.minimum(a, b)
+                return state
+
+            return fold
+
+        idx = jnp.asarray(np.asarray(slots, np.int32))
+        stacked = {name: jnp.asarray(getattr(self, name))[idx] for name in m._defaults}
+        reader = self._readers.get("window_fold", build, stacked, bucket=n)
+        return dict(reader(stacked))
 
     def _compute(self) -> Any:
         m = self._template
@@ -541,7 +728,11 @@ class WindowedMetric(Metric):
     def _read_extras(self) -> Dict[str, Any]:
         if self.mode != "ring":
             return {}
-        return {"ring_buckets": self._last_fold_buckets}
+        return {
+            "ring_buckets": self._last_fold_buckets,
+            "cache_hit": self._last_read_cache_hit,
+            "fanin": self._last_fold_fanin,
+        }
 
     def reset(self) -> None:
         super().reset()

@@ -101,6 +101,13 @@ AUX_FIELDS: Dict[str, str] = {
     # event + freshness stamp growing a per-read tax is a regression even
     # when the absolute reads/sec still passes
     "read_event_overhead_ratio": "higher",
+    # the incremental read plane (ISSUE 17 acceptance floor): the median
+    # cold full fold over the median dirty-subset incremental read at
+    # <=0.5% dirty slices of S=100k must stay >= 5x — the dirty bitmap,
+    # per-slice value cache, and bucketed AOT subset readers losing their
+    # edge over a whole-axis refold is the regression this PR exists to
+    # prevent
+    "incremental_vs_full": "higher",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -139,6 +146,12 @@ BOOL_FIELDS: Tuple[str, ...] = (
     # truth — a stamp that drifts from the ingest wall clock is a lying
     # dashboard however cheap the read plane is
     "freshness_stamp_exact",
+    # incremental-read parity: every gated incremental read's values must
+    # be bit-identical (tobytes equality) to a cold full fold at the same
+    # ids — the incremental plane changes WHEN folds run, never WHAT they
+    # compute, and a fast-but-wrong cached read is data corruption however
+    # large the speedup ratio
+    "incremental_read_bit_exact",
 )
 
 

@@ -1,0 +1,295 @@
+"""Incremental read plane: bit-parity and cache accounting (ISSUE 17).
+
+The plane's contract has two halves, and each gets its property test here:
+
+* **Bit parity** — an interleaved update/read sequence served through the
+  incremental caches (epoch-keyed result cache, dirty-slice folds, window
+  fold memos, epoch-keyed retrieval layouts) returns results BIT-identical
+  to a cold full fold of the same state. "Cold" is forced through
+  ``_mark_state_written()`` — the out-of-band degrade hook — on a lockstep
+  twin, so the reference never benefits from a warm cache.
+* **Accounting** — every read entry point reports honest ``cache_hit`` /
+  partial-fold fan-in through the PR 16 recorder: a repeat read at the same
+  write epoch is a hit; any write degrades it back to a (partial) fold.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.observability import get_recorder
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.retrieval import RetrievalMAP
+from metrics_tpu.retrieval import base as retrieval_base
+from metrics_tpu.sliced import SlicedMetric
+from metrics_tpu.windowed import WindowedMetric
+
+
+@pytest.fixture
+def recorder():
+    rec = get_recorder()
+    rec.reset()
+    rec.enable(recompile_threshold=rec.DEFAULT_RECOMPILE_THRESHOLD, footprint_warn_bytes=None)
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.reset()
+
+
+def _bits_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.shape == b.shape and a.dtype == b.dtype
+    assert a.tobytes() == b.tobytes()
+
+
+def _tree_bits_equal(a, b):
+    if isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _bits_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _tree_bits_equal(x, y)
+    else:
+        _bits_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# core: epoch-keyed result cache
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_cache_serves_hit_until_any_write(recorder):
+    m = SumMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    v1 = m.compute()
+    v2 = m.compute()  # same epoch: cached
+    _bits_equal(v1, v2)
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "compute"]
+    assert [e["cache_hit"] for e in reads] == [False, True]
+
+    m.update(jnp.asarray([3.0]))
+    m.compute()
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "compute"]
+    assert [e["cache_hit"] for e in reads] == [False, True, False]
+
+    # out-of-band install degrades too, even though the value is unchanged
+    m._mark_state_written()
+    m.compute()
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "compute"]
+    assert reads[-1]["cache_hit"] is False
+
+
+# ---------------------------------------------------------------------------
+# sliced: dirty-set folds vs cold, S=1k
+# ---------------------------------------------------------------------------
+
+
+def test_sliced_interleaved_reads_bit_identical_to_cold():
+    S = 1000
+    rng = np.random.default_rng(17)
+    inc = SlicedMetric(MeanSquaredError(), num_slices=S)
+    cold = SlicedMetric(MeanSquaredError(), num_slices=S)
+
+    for step in range(30):
+        # update a small random id set (~0.5-3% of the axis) on both twins
+        n = int(rng.integers(4, 32))
+        ids = jnp.asarray(rng.integers(0, S, n))
+        preds = jnp.asarray(rng.random(n, dtype=np.float32))
+        target = jnp.asarray(rng.random(n, dtype=np.float32))
+        inc.update(ids, preds, target)
+        cold.update(ids, preds, target)
+
+        kind = step % 3
+        cold._mark_state_written()  # force the reference to a full cold fold
+        if kind == 0:
+            req = jnp.asarray(rng.choice(S, size=int(rng.integers(1, 40)), replace=False))
+            _tree_bits_equal(inc.compute(slice_ids=req), cold.compute(slice_ids=req))
+        elif kind == 1:
+            _tree_bits_equal(inc.compute(), cold.compute())
+        else:
+            k = int(rng.integers(1, 9))
+            ids_i, vals_i = inc.compute(top_k=k)
+            ids_c, vals_c = cold.compute(top_k=k)
+            _bits_equal(ids_i, ids_c)
+            _tree_bits_equal(vals_i, vals_c)
+
+
+def test_sliced_repeat_subset_read_is_pure_cache_hit(recorder):
+    S = 64
+    rng = np.random.default_rng(5)
+    m = SlicedMetric(MeanSquaredError(), num_slices=S)
+    ids = jnp.asarray(rng.integers(0, S, 32))
+    m.update(ids, jnp.asarray(rng.random(32, dtype=np.float32)), jnp.asarray(rng.random(32, dtype=np.float32)))
+    req = jnp.asarray([3, 7, 11])
+    v1 = m.compute(slice_ids=req)
+    v2 = m.compute(slice_ids=req)  # nothing written since: zero slices folded
+    _tree_bits_equal(v1, v2)
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "sliced"]
+    assert reads[0]["cache_hit"] is False and reads[0]["fanin"] >= 1
+    assert reads[1]["cache_hit"] is True and reads[1].get("fanin", 0) == 0
+
+    # a write to ONE requested slice refolds only the dirty part
+    m.update(jnp.asarray([7]), jnp.asarray([0.5]), jnp.asarray([0.25]))
+    m.compute(slice_ids=req)
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "sliced"]
+    assert reads[-1]["cache_hit"] is False and reads[-1]["fanin"] == 1
+
+
+# ---------------------------------------------------------------------------
+# windowed: ring fold memos vs cold, incl. wrap/self-eviction
+# ---------------------------------------------------------------------------
+
+
+def test_windowed_interleaved_reads_bit_identical_to_cold():
+    R, K = 6, 2
+    rng = np.random.default_rng(23)
+    inc = WindowedMetric(MeanSquaredError(), window=R, updates_per_bucket=K)
+    cold = WindowedMetric(MeanSquaredError(), window=R, updates_per_bucket=K)
+
+    # 3x more updates than the ring holds: the fold memos must survive
+    # rotation and self-eviction without ever serving an evicted bucket
+    for step in range(3 * R * K):
+        preds = jnp.asarray(rng.random(8, dtype=np.float32))
+        target = jnp.asarray(rng.random(8, dtype=np.float32))
+        inc.update(preds, target)
+        cold.update(preds, target)
+
+        cold._mark_state_written()
+        _tree_bits_equal(inc.window_state(), cold.window_state())
+        w = int(rng.integers(1, R + 1))
+        filled = (step + 1 + K - 1) // K
+        # a window ending `b` back must not reach past the ring span: w+b<=R
+        b = int(rng.integers(0, R - w + 1))
+        if filled - b >= 1:
+            cold._mark_state_written()
+            _tree_bits_equal(
+                inc.window_state(w, before=b), cold.window_state(w, before=b)
+            )
+            cold._mark_state_written()
+            _bits_equal(inc.compute(window=w), cold.compute(window=w))
+
+
+def test_windowed_same_clock_read_is_pure_cache_hit(recorder):
+    m = WindowedMetric(MeanSquaredError(), window=4, updates_per_bucket=2)
+    rng = np.random.default_rng(2)
+    for _ in range(6):
+        m.update(jnp.asarray(rng.random(4, dtype=np.float32)), jnp.asarray(rng.random(4, dtype=np.float32)))
+    s1 = m.window_state()
+    s2 = m.window_state()  # same ring clock: memo hit, zero merges
+    _tree_bits_equal(s1, s2)
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "window"]
+    assert reads[0]["cache_hit"] is False and reads[0]["fanin"] >= 1
+    assert reads[1]["cache_hit"] is True and reads[1].get("fanin", 0) == 0
+
+    # the next update completes bucket 2 and starts bucket 3: the refold
+    # extends the memoized prefix by the newly completed bucket and merges
+    # the still-filling one on top — two merges, never the whole window
+    m.update(jnp.asarray(rng.random(4, dtype=np.float32)), jnp.asarray(rng.random(4, dtype=np.float32)))
+    m.window_state()
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "window"]
+    assert reads[-1]["cache_hit"] is False and reads[-1]["fanin"] == 2
+    assert reads[-1]["fanin"] < reads[0]["fanin"]  # first cold fold paid 3
+
+
+# ---------------------------------------------------------------------------
+# retrieval: epoch-keyed layout cache vs cold
+# ---------------------------------------------------------------------------
+
+
+def test_retrieval_interleaved_reads_bit_identical_to_cold():
+    rng = np.random.default_rng(31)
+    inc = RetrievalMAP(max_queries=64, max_docs=16)
+    cold = RetrievalMAP(max_queries=64, max_docs=16)
+    for _ in range(12):
+        n = 24
+        idx = jnp.asarray(rng.integers(0, 40, n))
+        preds = jnp.asarray(rng.random(n, dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 2, n))
+        inc.update(preds, target, indexes=idx)
+        cold.update(preds, target, indexes=idx)
+
+        v_inc = inc.compute()  # epoch-keyed layout reuse across epochs
+        retrieval_base._LAYOUT_CACHE.clear()  # reference unpacks from scratch
+        cold._mark_state_written()
+        v_cold = cold.compute()
+        _bits_equal(v_inc, v_cold)
+
+
+def test_retrieval_layout_cache_hit_accounting(recorder):
+    rng = np.random.default_rng(7)
+    m = RetrievalMAP(max_queries=32, max_docs=8)
+    idx = jnp.asarray(rng.integers(0, 16, 20))
+    preds = jnp.asarray(rng.random(20, dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 2, 20))
+    m.update(preds, target, indexes=idx)
+
+    m.compute()  # cold: unpack + fold
+    m._computed = None  # drop the value cache, keep the epoch-keyed layout
+    m.compute()  # layout served from the epoch key
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "compute"]
+    assert reads[0]["cache_hit"] is False
+    assert reads[1]["cache_hit"] is True  # the layout memo's hit flag
+
+    m.update(preds, target, indexes=idx)  # write: epoch key moves on
+    m.compute()
+    reads = [e for e in recorder.events() if e["type"] == "read" and e["kind"] == "compute"]
+    assert reads[-1]["cache_hit"] is False
+
+
+def test_retrieval_layout_cache_stays_bounded():
+    rng = np.random.default_rng(11)
+    m = RetrievalMAP(max_queries=32, max_docs=8)
+    preds = jnp.asarray(rng.random(16, dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 2, 16))
+    idx = jnp.asarray(rng.integers(0, 12, 16))
+    for _ in range(3 * retrieval_base._LAYOUT_CACHE_MAX):
+        m.update(preds, target, indexes=idx)
+        m.compute()
+    assert len(retrieval_base._LAYOUT_CACHE) <= retrieval_base._LAYOUT_CACHE_MAX
+
+
+# ---------------------------------------------------------------------------
+# deferred telemetry housekeeping + AOT reader fast-path probe
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_tick_folds_pending_telemetry(recorder):
+    # no registry attached: tick is a no-op, never an error
+    assert recorder.tick() == 0
+
+    # wide buckets so no rotation happens mid-test; every observe lands as
+    # a pending value (well under the inline-flush threshold)
+    registry = recorder.attach_timeseries(bucket_seconds=60.0, n_buckets=4, sketch_capacity=64)
+    for v in range(10):
+        registry.observe("probe_ms", float(v))
+
+    assert recorder.tick() == 10  # folds exactly the pending values
+    assert recorder.tick() == 0  # nothing left pending after the fold
+
+    # the fold is compaction, not truncation: the values still count
+    payload = registry.payload()["probe_ms"]
+    assert sum(b["c"] for b in payload["buckets"]) == 10
+
+    recorder.detach_timeseries()
+    assert recorder.tick() == 0
+
+
+def test_reader_cache_fast_probe_tracks_get_and_clear():
+    from metrics_tpu.core.readers import ReaderCache
+
+    cache = ReaderCache()
+    assert cache.fast("double", 8) is None  # cold: no signature-free entry
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    fn = cache.get("double", lambda: lambda a: a * 2.0, x, bucket=8)
+    assert cache.fast("double", 8) is fn  # get() populated the probe
+    assert cache.fast("double", 64) is None  # other buckets stay cold
+    np.testing.assert_array_equal(np.asarray(fn(x)), np.arange(8, dtype=np.float32) * 2.0)
+
+    cache.clear()  # the set_dtype contract: mutations drop BOTH maps
+    assert cache.fast("double", 8) is None
+    assert len(cache) == 0

@@ -405,6 +405,81 @@ class M(Metric):
         )
         assert "TL-STATE" not in _rules_of(kept)
 
+    def test_host_counter_writes_pass_anywhere(self):
+        """The incremental-read-plane carve-out: host-side epoch/dirty-set
+        counters, fold memos, and per-slice value caches are NOT registered
+        state — writing them from traced methods or ad-hoc helpers is legal
+        (they are trace-time no-ops and the read plane rebuilds them from
+        real state on any degrade)."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._dirty = np.ones(8, dtype=bool)
+        self._fold_memo = {}
+        self._svc = None
+    def _update(self, preds, ids):
+        self.total = self.total + jnp.sum(preds)
+        self._dirty[np.asarray(ids)] = True
+    def _read_slices(self, ids):
+        self._fold_memo[0] = self.total
+        self._svc = np.zeros(8)
+        self._last_read_cache_hit = True
+        self._dirty[:] = False
+        return self.total
+"""
+        )
+        assert "TL-STATE" not in _rules_of(kept)
+
+    def test_cache_plane_write_outside_lifecycle_flags(self):
+        """Direct epoch-cache writes outside the lifecycle bypass
+        ``_mark_state_written()``'s subclass degrade hook — the blunt
+        ``self._computed = None`` invalidation this plane replaced."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def invalidate(self):
+        self._computed = None
+        self._write_epoch += 1
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-STATE" in _rules_of(kept)
+
+    def test_cache_plane_write_via_mark_hooks_passes(self):
+        """The sanctioned out-of-band write path: ``_mark_state_written``
+        overrides (and the compute cycle itself) may stamp the cache."""
+        kept, _ = _check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._fold_memo = {}
+    def _update(self, preds):
+        self.total = self.total + jnp.sum(preds)
+    def _mark_state_written(self):
+        self._write_epoch += 1
+        self._computed = None
+        self._fold_memo.clear()
+    def _mark_fused_written(self):
+        self._update_called = True
+        self._write_epoch += 1
+        self._computed = None
+    def _compute(self):
+        return self.total
+"""
+        )
+        assert "TL-STATE" not in _rules_of(kept)
+
 
 # ---------------------------------------------------------------------------
 # TL-COLLECTIVE

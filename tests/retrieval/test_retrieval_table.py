@@ -451,3 +451,17 @@ def test_footprint_under_sketch_prefix_and_fill_ratio():
     ratios = m.sketch_fill_ratios()
     assert ratios["qtable"] == pytest.approx(5 / 32)
     assert int(retrieval_table_fill(m.qtable)) == 5
+
+
+def test_layout_cache_bounded_across_epochs():
+    """The module-level epoch-keyed layout cache must stay LRU-bounded no
+    matter how many write/read epochs a long-lived metric cycles through —
+    a serving loop polling between ingest batches must not grow it."""
+    from metrics_tpu.retrieval import base as rbase
+
+    m = RetrievalMAP(max_queries=32, max_docs=8)
+    idx, preds, target = _stream(3, n_q=4)
+    for _ in range(3 * rbase._LAYOUT_CACHE_MAX):
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+        m.compute()
+    assert len(rbase._LAYOUT_CACHE) <= rbase._LAYOUT_CACHE_MAX
