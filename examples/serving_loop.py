@@ -22,8 +22,12 @@ telemetry, windowed quantiles, and health the whole time:
   ``drift`` (a shifted score distribution vs the reference window frozen
   during warmup), ``stale-reader`` (the dashboard reader pauses past the
   freshness bound while ingest continues — the ``freshness_slo`` /
-  ``read_latency`` signal), or ``all`` — followed by a recovery phase in
-  which every alarm clears.
+  ``read_latency`` signal), ``leak`` (host pages pinned outside any
+  ledgered state, so the memory observatory's unaccounted-bytes residue
+  grows monotonically — ``memory_leak``'s signal; released at recovery),
+  ``budget`` (the per-tenant byte ceiling is shrunk below the live sliced
+  state — ``memory_budget``; restored at recovery), or ``all`` —
+  followed by a recovery phase in which every alarm clears.
 
 Artifacts land in ``--out-dir``: ``metrics.prom`` (Prometheus page incl.
 windowed quantiles + health families), ``telemetry.jsonl`` (event log),
@@ -58,6 +62,8 @@ from metrics_tpu.aggregation import SumMetric
 from metrics_tpu.observability import (
     DriftRule,
     HealthMonitor,
+    MemoryBudget,
+    MemoryObservatory,
     PeriodicExporter,
     aggregate_across_hosts,
     default_rules,
@@ -69,7 +75,10 @@ from metrics_tpu.observability import (
 )
 from metrics_tpu.sliced import SlicedMetric
 
-INJECT_MODES = ("none", "bursts", "stall", "recompiles", "skew", "drift", "stale-reader", "all")
+INJECT_MODES = (
+    "none", "bursts", "stall", "recompiles", "skew", "drift", "stale-reader",
+    "leak", "budget", "all",
+)
 
 #: phase boundaries as fractions of --duration: steady warmup, fault
 #: injection, recovery (the collection is reset at the recovery boundary —
@@ -161,10 +170,23 @@ def run(
             # sit well inside it and well above healthy probe readings
             freshness_bound_s=1.5,
             read_latency_limit_ms=400.0,
+            # memory plane: the healthy per-tenant ceiling is generous (the
+            # budget fault trips it by SHRINKING the live rule's threshold,
+            # not by growing state); the leak bound sits well below the
+            # pinned-page injection total but above normal RSS jitter from
+            # recovery-phase recompiles
+            tenant_bytes_limit=16 * 1024,
+            unaccounted_growth_bytes=16 * 1024 * 1024,
         ),
         recorder=rec,
         alarm_log_path=str(out / "health_alarms.jsonl"),
     )
+    # the memory observatory feeds the mem_* series the two memory rules
+    # watch: the ledger walks live metric state, cache planes self-report,
+    # and the residue vs host RSS (no device backend on CPU) is the leak
+    # signal the pinned-page injection grows
+    observatory = MemoryObservatory(recorder=rec)
+    budget_rules = [r for r in monitor.rules if isinstance(r, MemoryBudget)]
     exporter = PeriodicExporter(
         interval_s=export_interval_s,
         prometheus_path=str(out / "metrics.prom"),
@@ -209,6 +231,8 @@ def run(
     froze_ref = False
     last_probe = 0.0
     ragged_step = 0
+    pinned: list = []  # leak-injection host pages (released at recovery)
+    budget_saved = None  # (rule, original threshold) pairs while shrunk
     # the dashboard's view: the FreshnessStamp captured at its last
     # completed read (collection ingest walls + async accept->apply age),
     # and — under the stale-reader fault — when its stuck read began
@@ -258,8 +282,10 @@ def run(
             )
         # deferred telemetry housekeeping: fold pending time-series
         # observations here, between probe reads, so bucket compaction
-        # never lands inside a timed read
+        # never lands inside a timed read; the memory poll rides the same
+        # cadence so the mem_* series are fresh for rule evaluation
         rec.tick()
+        observatory.observe()
         monitor.evaluate()
 
     try:
@@ -272,6 +298,24 @@ def run(
             skewing = in_fault and inject in ("skew", "all")
             drifting = in_fault and inject in ("drift", "all")
             reader_paused = in_fault and inject in ("stale-reader", "all")
+            leaking = in_fault and inject in ("leak", "all")
+            budget_fault = in_fault and inject in ("budget", "all")
+
+            if leaking and len(pinned) < 24:
+                # the leak: pin host pages OUTSIDE any ledgered state or
+                # registered cache plane, so only the unaccounted residue
+                # (RSS − ledger − planes) grows. 8 MB chunks are mmap'd by
+                # the allocator, so clearing the list at recovery returns
+                # the pages to the OS and the alarm's monotone-growth test
+                # goes quiet
+                pinned.append(np.full(1 << 20, float(step), np.float64))
+            if budget_fault and budget_saved is None:
+                # the budget fault: the ceiling drops below the live sliced
+                # state (ops shrinking a tenant's quota), not the state
+                # growing — restore at recovery clears it
+                budget_saved = [(r, r.threshold) for r in budget_rules]
+                for r in budget_rules:
+                    r.threshold = 1.0
 
             if not froze_ref and elapsed >= 0.9 * fault_lo:
                 # end of warmup: freeze the drift reference from the
@@ -297,6 +341,15 @@ def run(
                 handle = collection.compile_update_async(
                     queue_depth=queue_depth, policy="drop"
                 )
+                # memory recovery: drop the pinned pages (mmap'd chunks go
+                # back to the OS, so RSS — and with it the unaccounted
+                # residue — stops growing and the leak window rolls clear)
+                # and restore any shrunk per-tenant ceiling
+                pinned.clear()
+                if budget_saved is not None:
+                    for r, thresh in budget_saved:
+                        r.threshold = thresh
+                    budget_saved = None
                 did_reset = True
 
             preds, target, ids, host_scores = _make_batch(rng, batch_size, skewing, tenants, drifting)
@@ -404,6 +457,7 @@ def run(
         },
         "reads": rec.read_totals(),
         "freshness": rec.freshness_totals(),
+        "memory": rec.memory_totals(),
         "export_errors": rec.export_errors(),
     }
     (out / "report.json").write_text(json.dumps(report, indent=2) + "\n")

@@ -70,6 +70,7 @@ from __future__ import annotations
 
 import os
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -84,6 +85,7 @@ from metrics_tpu.analysis.manifest import (
 )
 from metrics_tpu.analysis.interp import VERDICT_FUSIBLE as _FUSIBLE
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric, _coerce_foreign
+from metrics_tpu.observability.memory import executable_nbytes, register_cache_plane
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.utils.data import dim_zero_max, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -140,13 +142,28 @@ def _default_pytree(metric: Metric) -> Dict[str, Array]:
 
 
 class _CacheEntry:
-    __slots__ = ("fn", "aot", "index", "calls")
+    __slots__ = ("fn", "aot", "index", "calls", "nbytes")
 
-    def __init__(self, fn: Any, aot: bool, index: int) -> None:
+    def __init__(self, fn: Any, aot: bool, index: int, nbytes: int = 0) -> None:
         self.fn = fn
         self.aot = aot
         self.index = index
         self.calls = 0
+        #: device bytes the compiled executable holds (compiler-reported
+        #: code + temp buffers; 0 for the non-AOT fallback and on backends
+        #: that report nothing) — the ``fused_compile`` plane sums these
+        self.nbytes = nbytes
+
+
+#: every live FusedUpdate handle (weak — handles die with their collection);
+#: the ``fused_compile`` memory plane fans out over this set
+_LIVE_FUSED: "weakref.WeakSet[FusedUpdate]" = weakref.WeakSet()
+
+
+def _fused_plane_nbytes() -> int:
+    return sum(
+        e.nbytes for h in list(_LIVE_FUSED) for e in list(h._cache.values())
+    )
 
 
 class FusedUpdate:
@@ -196,6 +213,7 @@ class FusedUpdate:
         #: part of the donated-bytes cache key.
         self._eager_names: set = set()
         self._donated_bytes_cache: Optional[Tuple[Tuple[bool, int], int]] = None
+        _LIVE_FUSED.add(self)
 
     # compiled executables (and the collection back-reference) must not be
     # deep-copied: MetricCollection.clone() drops the handle and the clone
@@ -545,6 +563,13 @@ class FusedUpdate:
         if entry is None:
             entry = self._compile(key, names, treedef, static, bucket, states, dyn, n_valid)
             if len(self._cache) == _CACHE_WARN_ENTRIES:
+                if _TELEMETRY.enabled:
+                    _TELEMETRY.record_cache_plane(
+                        "fused_compile",
+                        entries=len(self._cache),
+                        nbytes=sum(e.nbytes for e in self._cache.values()),
+                        reason="growth_warning",
+                    )
                 rank_zero_warn(
                     f"compile_update: the fused compile cache now holds"
                     f" {_CACHE_WARN_ENTRIES} entries — shape-varying batches (or a"
@@ -684,7 +709,9 @@ class FusedUpdate:
             t1 = time.perf_counter()
             compiled = lowered.compile()
             t2 = time.perf_counter()
-            entry = _CacheEntry(compiled, aot=True, index=index)
+            entry = _CacheEntry(
+                compiled, aot=True, index=index, nbytes=executable_nbytes(compiled)
+            )
         except Exception:
             # AOT pipeline unavailable: fall back to the jitted callable
             # (jax's own cache compiles on first call instead)
@@ -716,3 +743,8 @@ class FusedUpdate:
                 donated=self._donate and entry.aot,
             )
         return entry
+
+
+# one plane per cache KIND (see observability/memory.py): the fused compile
+# cache's device bytes, summed over every live handle's entries
+register_cache_plane("fused_compile", _fused_plane_nbytes)

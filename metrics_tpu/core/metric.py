@@ -51,6 +51,7 @@ from metrics_tpu.utils.data import (
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.observability.freshness import FreshnessStamp
+from metrics_tpu.observability.memory import _track_metric
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import SKETCH_FOOTPRINT_PREFIX, _nbytes
 from metrics_tpu.observability.trace import span as _span
@@ -190,6 +191,12 @@ class Metric(ABC):
 
         self._is_synced = False
         self._cache: Optional[Dict[str, StateValue]] = None
+
+        # weak registration with the memory observatory (observability/
+        # memory.py): the default MemoryLedger walks every live metric's
+        # state pytree without the user threading instances around. Weak —
+        # never extends the metric's lifetime — and never fails construction.
+        _track_metric(self)
 
     # ------------------------------------------------------------------
     # child-metric registry (minimal nn.Module-style nesting for wrappers)
@@ -461,7 +468,16 @@ class Metric(ABC):
 
             metric_compile_cost(self, coerced_args, coerced_kwargs, phase="update")
         if _TELEMETRY.footprint_warn_bytes is not None:
-            _TELEMETRY.record_footprint(self, self.state_footprint())
+            fp = self.state_footprint()
+            _TELEMETRY.record_footprint(
+                self,
+                fp,
+                theoretical_bytes=int(self.theoretical_state_bytes()),
+                live_bytes=int(sum(fp.values())),
+            )
+        # boundary counter is exact; the typed event row (with a live state
+        # walk) is throttled inside the recorder, so eager loops stay cheap
+        _TELEMETRY.record_memory_boundary("update", self, live_bytes=self.total_state_bytes)
 
     def compute(self) -> Any:
         """Compute (and cache) the metric from accumulated state, syncing across
@@ -528,6 +544,9 @@ class Metric(ABC):
                 ratios = self.sketch_fill_ratios()
                 if ratios:
                     rec.record_sketch_fill(self, ratios)
+                rec.record_memory_boundary(
+                    "compute", self, live_bytes=self.total_state_bytes
+                )
         return self._computed
 
     def freshness_stamp(self, now: Optional[float] = None) -> "FreshnessStamp":
@@ -610,6 +629,10 @@ class Metric(ABC):
         self._is_synced = False
         self._ingest_first_t = None
         self._ingest_last_t = None
+        if _TELEMETRY.enabled:  # disabled reset path stays ONE bool check
+            _TELEMETRY.record_memory_boundary(
+                "reset", self, live_bytes=self.total_state_bytes
+            )
 
     # ------------------------------------------------------------------
     # distributed sync state machine
@@ -948,6 +971,24 @@ class Metric(ABC):
         """Total bytes held by this metric's (and its children's) states."""
         return sum(self.state_footprint().values())
 
+    def theoretical_state_bytes(self) -> int:
+        """Bytes the registered state *defaults* predict at their current
+        dtypes — shape × itemsize over ``_defaults``, recursing children
+        (list states predict 0: their growth is data-dependent). For
+        fixed-shape metrics this equals the live :meth:`total_state_bytes`;
+        divergence means either a cat-accumulating state (expected) or a
+        leaf whose dtype drifted from its default's — the staleness the
+        ``footprint`` event's theoretical/live byte pair exists to catch
+        (``set_dtype`` must cast states AND defaults in lockstep)."""
+        total = 0
+        for default in self._defaults.values():
+            if isinstance(default, list):
+                continue
+            total += _nbytes(default)
+        for _, child in self._iter_child_metrics():
+            total += child.theoretical_state_bytes()
+        return total
+
     def sketch_fill_ratios(self) -> Dict[str, float]:
         """Occupancy per sketch-leaf state (``occupied slots / capacity``)
         — the number that says whether a sketch is still inside its
@@ -1085,10 +1126,33 @@ class Metric(ABC):
                 if isinstance(self._defaults[name], list)
                 else _cast(self._defaults[name])
             )
-        if self._computed is not None:
-            self._computed = apply_to_collection(self._computed, jnp.ndarray, _cast)
+        computed = self._computed
+        # the cast rewrote every floating leaf in place: route through the
+        # out-of-band write hook so the epoch clock advances and subclass
+        # incremental read caches (per-slice value cache, window fold memos)
+        # degrade to cold instead of serving values folded at the old dtype
+        self._mark_state_written()
+        if computed is not None:
+            # the cached value itself is cast too and stays correct —
+            # reinstall it stamped at the post-cast epoch
+            self._computed = apply_to_collection(computed, jnp.ndarray, _cast)
+            self._computed_epoch = self._write_epoch
         for _, child in self._iter_child_metrics():
             child.set_dtype(dst_type)
+        if _TELEMETRY.enabled:
+            # footprint events straddling a dtype flip must reflect the NEW
+            # leaf dtypes; states and defaults were cast in lockstep above,
+            # so theoretical (default-predicted) and live bytes agree for
+            # fixed-shape metrics — the event carries both so a stale cast
+            # shows up as a theoretical/live mismatch in telemetry
+            fp = self.state_footprint()
+            _TELEMETRY.record_footprint(
+                self,
+                fp,
+                theoretical_bytes=int(self.theoretical_state_bytes()),
+                live_bytes=int(sum(fp.values())),
+                cast_to=str(jnp.dtype(dst_type)),
+            )
         return self
 
     def to_device(self, device) -> "Metric":

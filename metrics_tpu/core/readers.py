@@ -27,10 +27,21 @@ incremental read plane (docs/incremental_reads.md) is untouched.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+from metrics_tpu.observability.memory import executable_nbytes, register_cache_plane
+
+#: every live ReaderCache instance (weak — caches die with their owning
+#: metric); the ``reader_cache`` memory plane below fans out over this set
+_LIVE_READER_CACHES: "weakref.WeakSet[ReaderCache]" = weakref.WeakSet()
+
+
+def _reader_plane_nbytes() -> int:
+    return sum(c.nbytes() for c in list(_LIVE_READER_CACHES))
 
 #: the small bucket family read shapes round up into; reads larger than the
 #: last entry double from there (and every bucket is capped at the caller's
@@ -98,10 +109,19 @@ class ReaderCache:
     def __init__(self) -> None:
         self._cache: Dict[Tuple, Any] = {}
         self._fast: Dict[Tuple, Any] = {}
+        self._nbytes: Dict[Tuple, int] = {}
         self._warned = False
+        _LIVE_READER_CACHES.add(self)
 
     def __len__(self) -> int:
         return len(self._cache)
+
+    def nbytes(self) -> int:
+        """Device bytes the cached executables hold (code + temp buffers,
+        per the compiler's own ``memory_analysis``; 0 where the backend
+        reports none, e.g. CPU) — this cache's contribution to the
+        ``reader_cache`` memory plane."""
+        return sum(self._nbytes.values())
 
     # compiled XLA executables are neither copyable nor picklable; a
     # cloned/restored metric starts with a cold reader cache and re-lowers
@@ -118,6 +138,7 @@ class ReaderCache:
     def clear(self) -> None:
         self._cache.clear()
         self._fast.clear()
+        self._nbytes.clear()
 
     def fast(self, kind: str, bucket: Optional[int]) -> Optional[Callable]:
         """Signature-free probe: the executable the last :meth:`get` for
@@ -149,10 +170,21 @@ class ReaderCache:
         if entry is None:
             entry = jax.jit(build()).lower(*example_args).compile()
             self._cache[key] = entry
+            self._nbytes[key] = executable_nbytes(entry)
             if len(self._cache) == READER_CACHE_WARN_ENTRIES and not self._warned:
                 self._warned = True
+                from metrics_tpu.observability.recorder import _DEFAULT_RECORDER
                 from metrics_tpu.utils.prints import rank_zero_warn
 
+                if _DEFAULT_RECORDER.enabled:
+                    # typed event carrying entries + bytes: the fleet alarms
+                    # on reader-cache bloat instead of losing it to stderr
+                    _DEFAULT_RECORDER.record_cache_plane(
+                        "reader_cache",
+                        entries=len(self._cache),
+                        nbytes=self.nbytes(),
+                        reason="growth_warning",
+                    )
                 rank_zero_warn(
                     f"ReaderCache: {READER_CACHE_WARN_ENTRIES} reader executables"
                     " cached on one metric — a read path is keying on a per-call"
@@ -162,3 +194,9 @@ class ReaderCache:
                 )
         self._fast[(kind, bucket, mode)] = entry
         return entry
+
+
+# one plane per cache KIND: the callback fans out over live instances, so
+# per-metric caches come and go without registry churn (idempotent —
+# re-import under a reloaded module simply replaces the callback)
+register_cache_plane("reader_cache", _reader_plane_nbytes)

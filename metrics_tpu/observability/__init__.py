@@ -54,10 +54,23 @@ from metrics_tpu.observability.health import (
     DriftRule,
     HealthMonitor,
     HealthSnapshot,
+    MemoryBudget,
+    MemoryLeak,
     Rule,
     ThresholdRule,
     default_rules,
     render_health,
+)
+from metrics_tpu.observability.memory import (
+    MemoryLedger,
+    MemoryObservatory,
+    backend_memory_stats,
+    cache_plane_inventory,
+    cache_plane_total,
+    host_rss_bytes,
+    live_metrics,
+    register_cache_plane,
+    unregister_cache_plane,
 )
 from metrics_tpu.observability.freshness import (
     FreshnessStamp,
@@ -139,6 +152,17 @@ __all__ = [
     "DriftRule",
     "HealthMonitor",
     "HealthSnapshot",
+    "MemoryBudget",
+    "MemoryLeak",
+    "MemoryLedger",
+    "MemoryObservatory",
+    "backend_memory_stats",
+    "cache_plane_inventory",
+    "cache_plane_total",
+    "host_rss_bytes",
+    "live_metrics",
+    "register_cache_plane",
+    "unregister_cache_plane",
     "Rule",
     "ThresholdRule",
     "categorical_drift",

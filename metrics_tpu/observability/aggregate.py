@@ -77,6 +77,7 @@ def counter_payload(recorder: Optional[Any] = None) -> Dict[str, Any]:
         "fleet_totals": dict(rec.fleet_totals()),
         "ops_dispatch_totals": dict(rec.ops_dispatch_totals()),
         "read_totals": dict(rec.read_totals()),
+        "memory": dict(rec.memory_totals()),
         "freshness": dict(rec.freshness_totals()),
         "export_errors": rec.export_errors(),
         # windowed time series ride the same payload path: per-bucket
@@ -151,6 +152,7 @@ def merge_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
             [p.get("ops_dispatch_totals", {}) for p in payloads]
         ),
         "read_totals": _merge_reads([p.get("read_totals", {}) for p in payloads]),
+        "memory": _merge_memory([p.get("memory", {}) for p in payloads]),
         "freshness": _merge_freshness([p.get("freshness", {}) for p in payloads]),
         "export_errors": sum(p.get("export_errors", 0) for p in payloads),
         "timeseries": _merge_timeseries([p.get("timeseries", {}) for p in payloads]),
@@ -226,6 +228,26 @@ def _merge_reads(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
     contributes nothing, like every other family."""
     sums = _merge_sum([{k: v for k, v in m.items() if k in _READ_SUM_KEYS} for m in maps])
     maxes = _merge_max([{k: v for k, v in m.items() if k not in _READ_SUM_KEYS} for m in maps])
+    return {**maxes, **sums}
+
+
+#: memory-plane counter keys that are extensive (summed); the byte gauges
+#: and their ``max_*`` high-water marks max — a fleet's ledger bytes are
+#: per-host numbers, and the merged view keeps the worst host's figure
+#: (per-host detail stays in the ``processes`` list)
+_MEMORY_SUM_KEYS = (
+    "events", "update_boundaries", "compute_boundaries", "reset_boundaries",
+    "observations", "cache_plane_events", "plane_evictions", "plane_evicted_bytes",
+)
+
+
+def _merge_memory(maps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Memory-observatory totals: boundary/observation counts sum across
+    ranks; the ledger / cache-plane / device / unaccounted byte gauges (and
+    their high-water marks) max — a rank without the memory plane
+    contributes nothing, like every other family."""
+    sums = _merge_sum([{k: v for k, v in m.items() if k in _MEMORY_SUM_KEYS} for m in maps])
+    maxes = _merge_max([{k: v for k, v in m.items() if k not in _MEMORY_SUM_KEYS} for m in maps])
     return {**maxes, **sums}
 
 

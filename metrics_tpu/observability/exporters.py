@@ -525,6 +525,71 @@ def render_prometheus(recorder: Optional[Any] = None, aggregate: Optional[Dict[s
             f"metrics_tpu_freshness_staleness_seconds{_labels(window='max', **proc_label(payload))}"
             f" {fresh.get('max_staleness_s', 0.0):g}"
         )
+    # memory-observatory families (observability/memory.py): the ledger /
+    # cache-plane / device / unaccounted byte gauges follow the async-gauge
+    # contiguity pattern (window='last' + window='max' per family)
+    lines.append("# HELP metrics_tpu_memory_boundaries_total Metric lifecycle memory boundaries by kind (update|compute|reset; disjoint).")
+    lines.append("# TYPE metrics_tpu_memory_boundaries_total counter")
+    for payload in per_proc:
+        totals = payload.get("memory", {})
+        for kind in ("update", "compute", "reset"):
+            lines.append(
+                f"metrics_tpu_memory_boundaries_total"
+                f"{_labels(boundary=kind, **proc_label(payload))}"
+                f" {totals.get(kind + '_boundaries', 0)}"
+            )
+    lines.append("# HELP metrics_tpu_memory_observations_total Full memory-observatory polls (ledger + cache planes + backend).")
+    lines.append("# TYPE metrics_tpu_memory_observations_total counter")
+    for payload in per_proc:
+        totals = payload.get("memory", {})
+        lines.append(
+            f"metrics_tpu_memory_observations_total{_labels(**proc_label(payload))}"
+            f" {totals.get('observations', 0)}"
+        )
+    for family, key, help_text in (
+        ("metrics_tpu_memory_ledger_bytes", "ledger_bytes",
+         "Live committed device bytes held by metric state pytrees, deduped"
+         " by buffer identity (last seen / high-water)."),
+        ("metrics_tpu_memory_cache_plane_bytes", "cache_plane_bytes",
+         "Bytes held by registered cache planes (reader/fused executables,"
+         " layout memo, value caches; last seen / high-water)."),
+        ("metrics_tpu_memory_device_bytes_in_use", "device_bytes_in_use",
+         "Allocator-reported bytes in use (backend memory_stats, or host RSS"
+         " where the backend reports none; last seen / high-water)."),
+        ("metrics_tpu_memory_unaccounted_bytes", "unaccounted_bytes",
+         "In-use bytes minus ledger minus cache planes — the residue the"
+         " memory_leak alarm watches (last seen / high-water)."),
+        ("metrics_tpu_memory_bytes_per_tenant", "bytes_per_tenant",
+         "Ledger bytes per sliced-state tenant — what the memory_budget"
+         " alarm ceilings (last seen / high-water)."),
+    ):
+        lines.append(f"# HELP {family} {help_text}")
+        lines.append(f"# TYPE {family} gauge")
+        for payload in per_proc:
+            totals = payload.get("memory", {})
+            lines.append(
+                f"{family}{_labels(window='last', **proc_label(payload))} {totals.get(key, 0)}"
+            )
+            lines.append(
+                f"{family}{_labels(window='max', **proc_label(payload))}"
+                f" {totals.get('max_' + key, 0)}"
+            )
+    lines.append("# HELP metrics_tpu_memory_plane_evictions_total Cache-plane entries evicted (layout memo LRU drops and finalizers).")
+    lines.append("# TYPE metrics_tpu_memory_plane_evictions_total counter")
+    for payload in per_proc:
+        totals = payload.get("memory", {})
+        lines.append(
+            f"metrics_tpu_memory_plane_evictions_total{_labels(**proc_label(payload))}"
+            f" {totals.get('plane_evictions', 0)}"
+        )
+    lines.append("# HELP metrics_tpu_memory_plane_evicted_bytes_total Bytes released by cache-plane evictions.")
+    lines.append("# TYPE metrics_tpu_memory_plane_evicted_bytes_total counter")
+    for payload in per_proc:
+        totals = payload.get("memory", {})
+        lines.append(
+            f"metrics_tpu_memory_plane_evicted_bytes_total{_labels(**proc_label(payload))}"
+            f" {totals.get('plane_evicted_bytes', 0)}"
+        )
     lines.append("# HELP metrics_tpu_drift_score Last reference-vs-live drift score per watched source and statistic.")
     lines.append("# TYPE metrics_tpu_drift_score gauge")
     for payload in per_proc:

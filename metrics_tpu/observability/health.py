@@ -27,11 +27,13 @@ Three rule shapes cover the standard serving-loop failure modes:
   the score-drift alarm, the "is the MODEL healthy" complement to the
   pipeline alarms above.
 
-:func:`default_rules` wires the eleven standard alarm classes — seven
+:func:`default_rules` wires the thirteen standard alarm classes — seven
 serving-loop classes, the three fleet-collector classes
-(``publisher_stale``/``snapshot_backlog``/``fold_error``), and the
+(``publisher_stale``/``snapshot_backlog``/``fold_error``), the
 read-path freshness class (``freshness_slo``, with its ``read_latency``
-companion) — over the standard series names the recorder feeds
+companion), and the two memory-observatory classes
+(:class:`MemoryBudget`/:class:`MemoryLeak`) — over the standard series
+names the recorder feeds
 (``SERIES_*`` in ``recorder.py``); every threshold is a keyword so
 deployments tune rather than reimplement. ``examples/serving_loop.py`` drives the serving layer
 and ``examples/fleet_collector.py`` the fleet layer under fault
@@ -55,6 +57,8 @@ from metrics_tpu.observability.recorder import (
     SERIES_FOLD_ERRORS,
     SERIES_FRESHNESS_AGE_S,
     SERIES_HOT_SLICE_SHARE,
+    SERIES_MEM_BYTES_PER_TENANT,
+    SERIES_MEM_UNACCOUNTED,
     SERIES_PUBLISHER_LAG,
     SERIES_READ_MS,
     SERIES_RECOMPILES,
@@ -68,6 +72,8 @@ __all__ = [
     "DriftRule",
     "HealthMonitor",
     "HealthSnapshot",
+    "MemoryBudget",
+    "MemoryLeak",
     "Rule",
     "ThresholdRule",
     "default_rules",
@@ -414,6 +420,100 @@ class DriftRule(Rule):
         )
 
 
+class MemoryBudget(ThresholdRule):
+    """Bytes/tenant ceiling on sliced (per-tenant) metric state — the
+    twelfth standard alarm class.
+
+    Watches the ``mem_bytes_per_tenant`` series the memory observatory
+    (:class:`~metrics_tpu.observability.memory.MemoryObservatory`) feeds:
+    the ledger's live SlicedMetric state bytes divided by the total slice
+    (tenant) count. Firing means each tenant's state grew past the budget
+    the deployment provisioned — the ROADMAP item-3 headline number going
+    out of bounds, e.g. a window/sketch capacity misconfiguration
+    multiplying per-tenant bytes. The threshold is a plain attribute, so
+    capacity tooling can tighten it live (``rule.threshold = ...``)."""
+
+    def __init__(
+        self,
+        limit_bytes_per_tenant: float,
+        name: str = "memory_budget",
+        window_s: float = 30.0,
+        severity: str = "warn",
+        min_count: int = 1,
+        description: str = "per-tenant sliced state bytes exceeded the provisioned budget",
+    ) -> None:
+        super().__init__(
+            name,
+            SERIES_MEM_BYTES_PER_TENANT,
+            stat="max",
+            threshold=float(limit_bytes_per_tenant),
+            window_s=window_s,
+            op=">",
+            severity=severity,
+            min_count=min_count,
+            description=description,
+        )
+
+
+class MemoryLeak(Rule):
+    """Monotone unaccounted-bytes growth — the thirteenth standard alarm
+    class, the "where did my HBM go" page.
+
+    Watches the ``mem_unaccounted_bytes`` residue series
+    (``device_in_use − ledger − cache planes``, fed by the memory
+    observatory). Bytes the ledger and the cache planes can both explain
+    are healthy; a residue that keeps GROWING is memory nobody accounts
+    for — a pinned compute cache, a leaked buffer reference, a foreign
+    allocation riding the device.
+
+    The monotone test splits the window in half and fires when the
+    *minimum* of the recent half exceeds the *maximum* of the prior half
+    by more than ``growth_bytes`` — every recent sample above every older
+    sample, so a noisy-but-flat residue (host-RSS jitter on CPU, allocator
+    fragmentation) never fires, while steady growth of any shape does.
+    An absent series (observatory not polling) never fires."""
+
+    def __init__(
+        self,
+        growth_bytes: float = 128 * 1024 * 1024,
+        name: str = "memory_leak",
+        series: str = SERIES_MEM_UNACCOUNTED,
+        window_s: float = 30.0,
+        min_count: int = 4,
+        severity: str = "warn",
+        description: str = "unaccounted device bytes growing monotonically — likely leak",
+    ) -> None:
+        super().__init__(name, severity=severity, description=description)
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        self.series = series
+        self.growth_bytes = float(growth_bytes)
+        self.window_s = float(window_s)
+        self.min_count = int(min_count)
+
+    def evaluate(self, registry: Any, now: Optional[float] = None) -> Tuple[bool, Optional[float], str]:
+        s = registry.get(self.series) if registry is not None else None
+        if s is None:
+            return False, None, f"series `{self.series}` absent"
+        t = time.time() if now is None else float(now)
+        n = s.count(self.window_s, now=t)
+        if n < self.min_count:
+            return False, None, f"only {n} observation(s) in window"
+        half = self.window_s / 2.0
+        prior_max = s.value_max(half, now=t - half)
+        recent_min = s.value_min(half, now=t)
+        if prior_max is None or recent_min is None:
+            return False, None, "both window halves not yet populated"
+        growth = float(recent_min) - float(prior_max)
+        firing = growth > self.growth_bytes
+        return (
+            bool(firing),
+            growth,
+            f"min(recent {half:g}s) - max(prior {half:g}s) of {self.series}"
+            f" = {growth:.4g} B (threshold {self.growth_bytes:g})",
+        )
+
+
 @dataclass(frozen=True)
 class AlarmState:
     """One rule's state inside a snapshot."""
@@ -688,11 +788,14 @@ def default_rules(
     fold_errors_per_window: float = 1,
     freshness_bound_s: float = 10.0,
     read_latency_limit_ms: float = 250.0,
+    tenant_bytes_limit: float = 16 * 1024,
+    unaccounted_growth_bytes: float = 128 * 1024 * 1024,
 ) -> List[Rule]:
-    """The eleven standard alarm classes — seven serving-loop classes,
-    the three fleet-collector classes, and the read-path freshness class
-    (plus its ``read_latency`` companion) — over the standard
-    recorder-fed series, every threshold tunable:
+    """The thirteen standard alarm classes — seven serving-loop classes,
+    the three fleet-collector classes, the read-path freshness class
+    (plus its ``read_latency`` companion), and the two memory-observatory
+    classes — over the standard recorder-fed series, every threshold
+    tunable:
 
     * ``queue_saturation`` (warn) / ``queue_saturation_critical`` — p95 /
       max of the async queue depth against the configured limit.
@@ -726,11 +829,21 @@ def default_rules(
     * ``read_latency`` — p95 read wall time (``read_ms``, fed by every
       ``compute``/``window_state``/sliced/fleet read) against
       ``read_latency_limit_ms``.
+    * ``memory_budget`` — the ledger's sliced state bytes per tenant
+      (``mem_bytes_per_tenant``, fed by memory-observatory polls) against
+      ``tenant_bytes_limit`` — the ROADMAP item-3 capacity headline as an
+      alarm.
+    * ``memory_leak`` — monotone growth of the unaccounted residue
+      (``mem_unaccounted_bytes`` = device in-use − ledger − cache planes)
+      beyond ``unaccounted_growth_bytes`` across the window: memory
+      nothing in the inventory explains, and it keeps growing.
 
     The three fleet classes watch series only a
     :class:`~metrics_tpu.observability.collector.FleetCollector` feeds —
     in a job without a collector they never fire, like any absent series;
-    the two read-path classes likewise stay silent until something reads.
+    the two read-path classes likewise stay silent until something reads,
+    and the two memory classes until a
+    :class:`~metrics_tpu.observability.memory.MemoryObservatory` polls.
     """
     short = short_window_s if short_window_s is not None else max(window_s / 3.0, 1.0)
     return [
@@ -871,5 +984,13 @@ def default_rules(
             severity="warn",
             min_count=3,
             description="metric reads (compute/window/fleet fold) persistently slow",
+        ),
+        MemoryBudget(
+            tenant_bytes_limit,
+            window_s=window_s,
+        ),
+        MemoryLeak(
+            unaccounted_growth_bytes,
+            window_s=window_s,
         ),
     ]

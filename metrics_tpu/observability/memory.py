@@ -1,0 +1,429 @@
+"""Device memory observatory: live HBM ledger + cache-plane inventory.
+
+``state_footprint()`` (core/metric.py) *predicts* bytes from shapes and
+dtypes; nothing asked the device what is actually resident. Meanwhile the
+runtime grew four invisible device-memory consumers — ReaderCache AOT
+executables, the fused-update compile cache, the retrieval layout LRU,
+and sketch scratch — plus the sliced per-slice value cache on the host.
+This module makes "where did my HBM go" answerable from telemetry:
+
+* :class:`MemoryLedger` walks live metric state pytrees and reports
+  *committed* bytes — dedup by buffer identity, so donated/aliased
+  fused-update buffers (deleted arrays count 0 via the ``_nbytes``
+  contract) and shared compute-group state are never double-counted —
+  with a per-device breakdown for slice-sharded state.
+* A **cache-plane registry**: every byte-holding cache registers a
+  ``nbytes()`` callback under a stable plane name
+  (``reader_cache | fused_compile | retrieval_layout | sketch_scratch |
+  sliced_value_cache | windowed_fold_memo``) into one global inventory.
+* :class:`MemoryObservatory` polls backend ``memory_stats()``
+  (bytes_in_use / peak_bytes_in_use where the backend provides them;
+  graceful host-RSS fallback on CPU, ``None`` when nothing reports) and
+  derives the **unaccounted-bytes** residue
+  ``in_use − ledger − cache planes`` — the leak signal the
+  ``memory_leak`` alarm (observability/health.py) watches for monotone
+  growth, while ``memory_budget`` watches the ledger's bytes/tenant.
+
+Everything here is read-path / poll-rate code: the metric hot paths only
+touch the recorder's one-bool-gated ``record_memory_boundary`` hook. The
+module never imports jax at import time (backend access is lazy), so the
+recorder's jax-free property is preserved for everything but the poller.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from metrics_tpu.observability.recorder import _DEFAULT_RECORDER, _nbytes
+
+__all__ = [
+    "MemoryLedger",
+    "MemoryObservatory",
+    "backend_memory_stats",
+    "cache_plane_inventory",
+    "cache_plane_total",
+    "executable_nbytes",
+    "host_rss_bytes",
+    "live_metrics",
+    "register_cache_plane",
+    "unregister_cache_plane",
+]
+
+
+# ---------------------------------------------------------------------------
+# live-metric registry (fed by Metric.__init__ via _track_metric)
+# ---------------------------------------------------------------------------
+
+_LIVE_METRICS: "weakref.WeakSet[Any]" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+
+def _track_metric(metric: Any) -> None:
+    """Register a live metric for default-ledger walks. Called from
+    ``Metric.__init__`` — one WeakSet add, and never allowed to fail a
+    metric's construction."""
+    try:
+        with _LIVE_LOCK:
+            _LIVE_METRICS.add(metric)
+    except Exception:  # noqa: BLE001 — unhashable/weakref-less foreign subclass
+        pass
+
+
+def live_metrics() -> List[Any]:
+    """Every live (not yet garbage-collected) metric instance in the
+    process — the default population a :class:`MemoryLedger` walks."""
+    with _LIVE_LOCK:
+        return list(_LIVE_METRICS)
+
+
+# ---------------------------------------------------------------------------
+# cache-plane registry
+# ---------------------------------------------------------------------------
+
+_PLANES: Dict[str, Callable[[], int]] = {}
+_PLANES_LOCK = threading.Lock()
+
+
+def register_cache_plane(name: str, nbytes_fn: Callable[[], int]) -> str:
+    """Register (or replace) a byte-holding cache's ``nbytes()`` callback
+    under ``name``. Owning modules register ONE plane per cache kind at
+    import (the callback fans out over a WeakSet of live instances), so
+    the inventory is a short, stable table, not per-instance churn."""
+    with _PLANES_LOCK:
+        _PLANES[name] = nbytes_fn
+    return name
+
+
+def unregister_cache_plane(name: str) -> bool:
+    with _PLANES_LOCK:
+        return _PLANES.pop(name, None) is not None
+
+
+def cache_plane_inventory() -> Dict[str, int]:
+    """Current bytes per registered plane. A callback that raises reports
+    0 — the inventory must never take down a poll."""
+    with _PLANES_LOCK:
+        planes = dict(_PLANES)
+    out: Dict[str, int] = {}
+    for name, fn in planes.items():
+        try:
+            out[name] = int(fn())
+        except Exception:  # noqa: BLE001
+            out[name] = 0
+    return out
+
+
+def cache_plane_total() -> int:
+    return sum(cache_plane_inventory().values())
+
+
+def executable_nbytes(compiled: Any) -> int:
+    """Best-effort footprint of one AOT-compiled executable via its
+    ``memory_analysis()`` (generated code + temp/argument/output
+    allocations). Backends without the analysis (CPU commonly) report 0 —
+    the plane then carries entry counts with honest zero bytes."""
+    ma = getattr(compiled, "memory_analysis", None)
+    if not callable(ma):
+        return 0
+    try:
+        analysis = ma()
+    except Exception:  # noqa: BLE001
+        return 0
+    if analysis is None:
+        return 0
+    if isinstance(analysis, dict):
+        return int(sum(v for v in analysis.values() if isinstance(v, (int, float)) and v > 0))
+    total = 0
+    for attr in (
+        "generated_code_size_in_bytes",
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "alias_size_in_bytes",
+    ):
+        v = getattr(analysis, attr, None)
+        if isinstance(v, (int, float)) and v > 0:
+            total += int(v)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+
+def _leaf_devices(value: Any) -> List[Any]:
+    """Devices a leaf is resident on (duck-typed; ``["host"]`` for numpy
+    and Python scalars). Sharded arrays report every addressable device."""
+    devs = getattr(value, "devices", None)
+    if callable(devs):
+        try:
+            ds = devs()
+            if ds:
+                return sorted(ds, key=str)
+        except Exception:  # noqa: BLE001
+            pass
+    dev = getattr(value, "device", None)
+    if dev is not None and not callable(dev):
+        return [dev]
+    return ["host"]
+
+
+def _per_device_bytes(value: Any, nbytes: int) -> Dict[str, int]:
+    """Per-device byte attribution of one leaf: exact via addressable
+    shards when the array exposes them (slice-sharded [S] state), else
+    split evenly across its devices."""
+    shards = getattr(value, "addressable_shards", None)
+    if shards:
+        try:
+            out: Dict[str, int] = {}
+            for shard in shards:
+                data = getattr(shard, "data", None)
+                nb = _nbytes(data) if data is not None else 0
+                key = str(getattr(shard, "device", "host"))
+                out[key] = out.get(key, 0) + nb
+            if out:
+                return out
+        except Exception:  # noqa: BLE001
+            pass
+    devices = _leaf_devices(value)
+    if not devices:
+        return {"host": nbytes}
+    share, rem = divmod(nbytes, len(devices))
+    out = {}
+    for i, d in enumerate(devices):
+        out[str(d)] = share + (1 if i < rem else 0)
+    return out
+
+
+def _iter_state_leaves(metric: Any):
+    """Yield every array-state leaf of a metric (list/'cat' states flatten;
+    children recurse — the buffer-identity dedup makes re-visits free)."""
+    defaults = getattr(metric, "_defaults", None)
+    if isinstance(defaults, dict):
+        for name in defaults:
+            val = getattr(metric, name, None)
+            if isinstance(val, list):
+                for item in val:
+                    yield item
+            elif val is not None and not isinstance(val, (int, float)):
+                yield val
+    children = getattr(metric, "_children", None)
+    if isinstance(children, dict):
+        kids = children.values()
+    elif isinstance(children, (list, tuple)):
+        kids = children
+    else:
+        kids = ()
+    for child in kids:
+        yield from _iter_state_leaves(child)
+
+
+class MemoryLedger:
+    """Walks metric state pytrees and reports *live committed* bytes.
+
+    Dedup is by buffer identity (``id`` of the array object): compute-group
+    members literally share the leader's arrays, and fused group
+    propagation installs the same objects into every member, so a naive
+    per-metric sum double-books them. Donated buffers mid-dispatch are
+    deleted arrays and count 0 (the ``_nbytes`` contract), matching the
+    async pipeline's separate in-flight accounting.
+
+    ``metrics=None`` (the default) walks every live metric in the process
+    — the population ``Metric.__init__`` registers. Passing an explicit
+    iterable scopes the ledger (e.g. one serving loop's collection)."""
+
+    def __init__(self, metrics: Optional[Iterable[Any]] = None) -> None:
+        self._metrics = None if metrics is None else list(metrics)
+
+    def metrics(self) -> List[Any]:
+        return live_metrics() if self._metrics is None else list(self._metrics)
+
+    def measure(self) -> Dict[str, Any]:
+        """One ledger walk. Host-only reads (shape × itemsize metadata; no
+        device sync). Returns totals, the per-device breakdown, per-metric
+        attribution (first-owner wins for shared buffers), and the sliced
+        bytes/tenant headline."""
+        seen: set = set()
+        total = 0
+        n_buffers = 0
+        n_shared = 0
+        n_donated = 0
+        per_device: Dict[str, int] = {}
+        per_metric: Dict[str, int] = {}
+        sliced_bytes = 0
+        num_tenants = 0
+        counted_metrics: set = set()
+        for metric in self.metrics():
+            if id(metric) in counted_metrics:
+                continue
+            counted_metrics.add(id(metric))
+            label = type(metric).__name__
+            metric_bytes = 0
+            try:
+                n_slices = getattr(metric, "num_slices", None)
+                for leaf in _iter_state_leaves(metric):
+                    key = id(leaf)
+                    if key in seen:
+                        n_shared += 1
+                        continue
+                    seen.add(key)
+                    nb = _nbytes(leaf)
+                    if nb == 0 and callable(getattr(leaf, "is_deleted", None)):
+                        try:
+                            if leaf.is_deleted():
+                                n_donated += 1
+                                continue
+                        except Exception:  # noqa: BLE001
+                            pass
+                    if nb <= 0:
+                        continue
+                    n_buffers += 1
+                    total += nb
+                    metric_bytes += nb
+                    for dev, db in _per_device_bytes(leaf, nb).items():
+                        per_device[dev] = per_device.get(dev, 0) + db
+                if isinstance(n_slices, int) and n_slices > 0:
+                    sliced_bytes += metric_bytes
+                    num_tenants += n_slices
+            except Exception:  # noqa: BLE001 — a mid-mutation metric must not kill the poll
+                continue
+            if metric_bytes:
+                per_metric[label] = per_metric.get(label, 0) + metric_bytes
+        return {
+            "total_bytes": total,
+            "per_device": per_device,
+            "per_metric": per_metric,
+            "sliced_bytes": sliced_bytes,
+            "num_tenants": num_tenants,
+            "bytes_per_tenant": (sliced_bytes / num_tenants) if num_tenants else 0.0,
+            "n_metrics": len(counted_metrics),
+            "n_buffers": n_buffers,
+            "n_shared": n_shared,
+            "n_donated": n_donated,
+        }
+
+    def total_bytes(self) -> int:
+        return int(self.measure()["total_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# backend poller + observatory
+# ---------------------------------------------------------------------------
+
+
+def backend_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device backend memory stats (``device.memory_stats()``):
+    ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit`` where the
+    backend provides them. TPU/GPU report; XLA:CPU typically returns
+    nothing — then the result is ``{}`` and callers fall back gracefully."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend is a valid observatory state
+        return {}
+    out: Dict[str, Dict[str, int]] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001
+            stats = None
+        if not stats:
+            continue
+        entry: Dict[str, int] = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            v = stats.get(key)
+            if isinstance(v, (int, float)):
+                entry[key] = int(v)
+        if entry:
+            out[str(d)] = entry
+    return out
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size of this process (``/proc/self/statm``;
+    ``None`` off Linux) — the in-use fallback when the backend reports no
+    memory stats, so the unaccounted-bytes leak signal still exists on a
+    CPU box. The absolute value includes the Python heap; the leak alarm
+    only cares about monotone *growth*, which survives the offset."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class MemoryObservatory:
+    """One poll surface over the ledger, the cache planes, and the
+    backend: ``observe()`` measures everything, derives the unaccounted
+    residue, feeds the recorder's ``mem_*`` series + one typed ``memory``
+    event (when telemetry is enabled), and returns the full report dict.
+
+    Serving loops call ``observe()`` at probe rate (alongside
+    ``rec.tick()``); benches call it between ingest phases. It is never
+    on a metric hot path."""
+
+    def __init__(
+        self,
+        recorder: Optional[Any] = None,
+        ledger: Optional[MemoryLedger] = None,
+        use_host_rss: bool = True,
+    ) -> None:
+        self.recorder = _DEFAULT_RECORDER if recorder is None else recorder
+        self.ledger = MemoryLedger() if ledger is None else ledger
+        #: whether to fall back to /proc RSS when the backend reports no
+        #: memory stats (CPU) — off for strict device-only accounting
+        self.use_host_rss = bool(use_host_rss)
+
+    def observe(self, **extra: Any) -> Dict[str, Any]:
+        report = self.ledger.measure()
+        planes = cache_plane_inventory()
+        plane_total = sum(planes.values())
+        backend = backend_memory_stats()
+        in_use: Optional[int] = None
+        peak: Optional[int] = None
+        source: Optional[str] = None
+        if backend:
+            in_use = sum(e.get("bytes_in_use", 0) for e in backend.values())
+            peaks = [e["peak_bytes_in_use"] for e in backend.values() if "peak_bytes_in_use" in e]
+            peak = sum(peaks) if peaks else None
+            source = "backend"
+        elif self.use_host_rss:
+            rss = host_rss_bytes()
+            if rss is not None:
+                in_use = rss
+                source = "host_rss"
+        unaccounted: Optional[int] = None
+        if in_use is not None:
+            unaccounted = int(in_use) - int(report["total_bytes"]) - int(plane_total)
+        out: Dict[str, Any] = dict(report)
+        out.update(
+            {
+                "cache_planes": planes,
+                "cache_plane_bytes": plane_total,
+                "backend": backend,
+                "device_bytes_in_use": in_use,
+                "device_peak_bytes": peak,
+                "unaccounted_bytes": unaccounted,
+                "source": source,
+            }
+        )
+        rec = self.recorder
+        if rec is not None and rec.enabled:
+            rec.record_memory_observation(
+                ledger_bytes=int(report["total_bytes"]),
+                cache_plane_bytes=int(plane_total),
+                device_bytes_in_use=in_use,
+                device_peak_bytes=peak,
+                unaccounted_bytes=unaccounted,
+                bytes_per_tenant=report["bytes_per_tenant"] or None,
+                per_device=report["per_device"] or None,
+                planes=planes or None,
+                source=source,
+                **extra,
+            )
+        return out

@@ -158,6 +158,22 @@ SERIES_READ_FANIN = "read_fanin"
 #: the ``freshness_slo`` alarm signal, fed from FreshnessStamp-carrying
 #: reads (see observability/freshness.py)
 SERIES_FRESHNESS_AGE_S = "freshness_age_s"
+#: memory plane (observability/memory.py): live committed state bytes the
+#: MemoryLedger attributes to metric state pytrees (dedup by buffer identity)
+SERIES_MEM_LEDGER_BYTES = "mem_ledger_bytes"
+#: memory plane: bytes held by registered cache planes (reader caches,
+#: fused compile cache, retrieval layout LRU, sketch scratch, sliced value
+#: cache) at an observation
+SERIES_MEM_CACHE_BYTES = "mem_cache_plane_bytes"
+#: memory plane: backend-reported bytes_in_use (host-RSS fallback on
+#: backends that report no memory stats — see the observation's ``source``)
+SERIES_MEM_DEVICE_BYTES = "mem_device_bytes_in_use"
+#: memory plane: device_in_use − ledger − cache planes — the leak signal
+#: the ``memory_leak`` alarm watches for monotone growth
+SERIES_MEM_UNACCOUNTED = "mem_unaccounted_bytes"
+#: memory plane: sliced state bytes per tenant (slice) — the
+#: ``memory_budget`` alarm signal, ROADMAP item 3's headline denominator
+SERIES_MEM_BYTES_PER_TENANT = "mem_bytes_per_tenant"
 
 #: the standard counter-kind series; every other standard series is a
 #: distribution (sketch-backed)
@@ -175,6 +191,37 @@ COUNTER_SERIES = (
 
 def _new_sliced_totals() -> Dict[str, int]:
     return {"scatter_events": 0, "rows": 0, "max_slices": 0}
+
+
+def _new_memory_totals() -> Dict[str, Any]:
+    """Zeroed memory-plane counters: boundary/observation/cache-plane event
+    counts and layout-cache eviction tallies (extensive — summed across
+    hosts) plus last-seen and high-water gauges for the ledger, the cache
+    planes, the backend in-use bytes, the unaccounted residue, and the
+    bytes/tenant headline (maxed across hosts). All host ints/floats —
+    TL-STATE-clean, never traced, never device-resident."""
+    return {
+        "events": 0,
+        "update_boundaries": 0,
+        "compute_boundaries": 0,
+        "reset_boundaries": 0,
+        "observations": 0,
+        "cache_plane_events": 0,
+        "plane_evictions": 0,
+        "plane_evicted_bytes": 0,
+        "ledger_bytes": 0,
+        "max_ledger_bytes": 0,
+        "cache_plane_bytes": 0,
+        "max_cache_plane_bytes": 0,
+        "device_bytes_in_use": 0,
+        "max_device_bytes_in_use": 0,
+        "unaccounted_bytes": 0,
+        "max_unaccounted_bytes": 0,
+        "boundary_live_bytes": 0,
+        "max_boundary_live_bytes": 0,
+        "bytes_per_tenant": 0.0,
+        "max_bytes_per_tenant": 0.0,
+    }
 
 
 def _new_read_totals() -> Dict[str, float]:
@@ -326,6 +373,9 @@ class MetricRecorder:
 
     DEFAULT_RECOMPILE_THRESHOLD = 8
     MAX_EVENTS = 200_000
+    #: minimum seconds between emitted ``memory`` event rows per boundary
+    #: kind — the boundary counters stay exact, only the stream is paced
+    MEMORY_EVENT_INTERVAL_S = 0.25
 
     def __init__(
         self,
@@ -368,6 +418,12 @@ class MetricRecorder:
         self._sketch = _new_sketch_totals()
         self._reads = _new_read_totals()
         self._freshness = _new_freshness_totals()
+        self._memory = _new_memory_totals()
+        #: per-boundary-kind wall clock of the last emitted ``memory`` event
+        #: — boundary COUNTERS are exact, boundary EVENT rows are throttled
+        #: to MEMORY_EVENT_INTERVAL_S so an eager update loop cannot flood
+        #: the ring buffer with byte snapshots
+        self._memory_last_event: Dict[str, float] = {}
         #: "source|stat" -> last observed drift score (gauges; fed by the
         #: health layer's DriftRule evaluations — see record_drift_score)
         self._drift: Dict[str, float] = {}
@@ -481,6 +537,8 @@ class MetricRecorder:
             self._sketch = _new_sketch_totals()
             self._reads = _new_read_totals()
             self._freshness = _new_freshness_totals()
+            self._memory = _new_memory_totals()
+            self._memory_last_event = {}
             self._drift = {}
             self._fleet = _new_fleet_totals()
             self._ops_dispatch = {}
@@ -609,6 +667,17 @@ class MetricRecorder:
         Merged across hosts via min/max identity like the gauge families."""
         with self._lock:
             return dict(self._freshness)
+
+    def memory_totals(self) -> Dict[str, Any]:
+        """Memory-plane counters: update/compute/reset boundary tallies,
+        observatory polls, cache-plane events and eviction totals
+        (extensive), plus last-seen and high-water gauges for the ledger
+        bytes, the cache-plane inventory, the backend in-use bytes, the
+        unaccounted residue, and bytes/tenant. Fed by
+        ``record_memory_boundary`` / ``record_memory_observation`` /
+        ``record_cache_plane`` — see observability/memory.py."""
+        with self._lock:
+            return dict(self._memory)
 
     def ops_dispatch_totals(self) -> Dict[str, int]:
         """Kernel-registry dispatches per ``"op|backend"`` key (backend in
@@ -1276,6 +1345,160 @@ class MetricRecorder:
             self._observe(SERIES_READ_FANIN, int(fanin))
         if staleness_s is not None:
             self._observe(SERIES_FRESHNESS_AGE_S, staleness_s)
+
+    def record_memory_boundary(
+        self,
+        kind: str,
+        metric: Any,
+        live_bytes: Any = None,
+        **extra: Any,
+    ) -> None:
+        """Record one metric-lifecycle memory boundary (``kind`` in
+        ``update | compute | reset``). The per-kind counter always bumps;
+        a typed ``memory`` event row (stamped with the metric's live
+        committed state bytes) is emitted at most once per
+        ``MEMORY_EVENT_INTERVAL_S`` per kind, so eager update loops pay a
+        counter bump, not an event allocation plus a state walk.
+
+        ``live_bytes`` may be an int or a zero-arg callable (e.g. the
+        metric's bound ``total_state_bytes``) — the callable is only
+        invoked when an event row is actually emitted."""
+        now = time.time()
+        with self._lock:
+            m = self._memory
+            key = kind + "_boundaries"
+            m[key] = m.get(key, 0) + 1
+            emit = now - self._memory_last_event.get(kind, 0.0) >= self.MEMORY_EVENT_INTERVAL_S
+            if emit:
+                self._memory_last_event[kind] = now
+        if not emit:
+            return
+        lb = int(live_bytes() if callable(live_bytes) else (live_bytes or 0))
+        with self._lock:
+            m = self._memory
+            m["events"] += 1
+            m["boundary_live_bytes"] = lb
+            m["max_boundary_live_bytes"] = max(m["max_boundary_live_bytes"], lb)
+            event: Dict[str, Any] = {
+                "type": "memory",
+                "kind": kind,
+                "metric": type(metric).__name__ if metric is not None else kind,
+                "live_bytes": lb,
+                "t": round(time.time() - self._t0, 6),
+            }
+            event.update(extra)
+            self._append(event)
+
+    def record_memory_observation(
+        self,
+        ledger_bytes: int,
+        cache_plane_bytes: int,
+        device_bytes_in_use: Optional[int] = None,
+        device_peak_bytes: Optional[int] = None,
+        unaccounted_bytes: Optional[int] = None,
+        bytes_per_tenant: Optional[float] = None,
+        per_device: Optional[Dict[str, int]] = None,
+        planes: Optional[Dict[str, int]] = None,
+        source: Optional[str] = None,
+        **extra: Any,
+    ) -> None:
+        """Record one full memory-observatory poll (``MemoryObservatory.
+        observe``): the ledger total, the cache-plane inventory total, the
+        backend's in-use/peak bytes where it reports them (``source`` says
+        what backed the in-use number — ``"backend"``, ``"host_rss"``, or
+        ``None`` when nothing could), and the derived unaccounted residue.
+        Updates last-seen + high-water gauges, appends one ``memory`` event
+        (kind ``observe``), and feeds the ``mem_*`` windowed series the
+        ``memory_leak`` / ``memory_budget`` alarms watch."""
+        with self._lock:
+            m = self._memory
+            m["observations"] += 1
+            m["events"] += 1
+            m["ledger_bytes"] = int(ledger_bytes)
+            m["max_ledger_bytes"] = max(m["max_ledger_bytes"], int(ledger_bytes))
+            m["cache_plane_bytes"] = int(cache_plane_bytes)
+            m["max_cache_plane_bytes"] = max(m["max_cache_plane_bytes"], int(cache_plane_bytes))
+            if device_bytes_in_use is not None:
+                m["device_bytes_in_use"] = int(device_bytes_in_use)
+                m["max_device_bytes_in_use"] = max(
+                    m["max_device_bytes_in_use"], int(device_bytes_in_use)
+                )
+            if unaccounted_bytes is not None:
+                m["unaccounted_bytes"] = int(unaccounted_bytes)
+                m["max_unaccounted_bytes"] = max(
+                    m["max_unaccounted_bytes"], int(unaccounted_bytes)
+                )
+            if bytes_per_tenant is not None:
+                m["bytes_per_tenant"] = float(bytes_per_tenant)
+                m["max_bytes_per_tenant"] = max(
+                    m["max_bytes_per_tenant"], float(bytes_per_tenant)
+                )
+            event: Dict[str, Any] = {
+                "type": "memory",
+                "kind": "observe",
+                "t": round(time.time() - self._t0, 6),
+                "ledger_bytes": int(ledger_bytes),
+                "cache_plane_bytes": int(cache_plane_bytes),
+            }
+            if device_bytes_in_use is not None:
+                event["device_bytes_in_use"] = int(device_bytes_in_use)
+            if device_peak_bytes is not None:
+                event["device_peak_bytes"] = int(device_peak_bytes)
+            if unaccounted_bytes is not None:
+                event["unaccounted_bytes"] = int(unaccounted_bytes)
+            if bytes_per_tenant is not None:
+                event["bytes_per_tenant"] = round(float(bytes_per_tenant), 4)
+            if per_device:
+                event["per_device"] = {str(k): int(v) for k, v in per_device.items()}
+            if planes:
+                event["planes"] = {str(k): int(v) for k, v in planes.items()}
+            if source is not None:
+                event["source"] = source
+            event.update(extra)
+            self._append(event)
+        # windowed feeds (outside the lock; no-ops when detached)
+        self._observe(SERIES_MEM_LEDGER_BYTES, int(ledger_bytes))
+        self._observe(SERIES_MEM_CACHE_BYTES, int(cache_plane_bytes))
+        if device_bytes_in_use is not None:
+            self._observe(SERIES_MEM_DEVICE_BYTES, int(device_bytes_in_use))
+        if unaccounted_bytes is not None:
+            self._observe(SERIES_MEM_UNACCOUNTED, int(unaccounted_bytes))
+        if bytes_per_tenant is not None:
+            self._observe(SERIES_MEM_BYTES_PER_TENANT, float(bytes_per_tenant))
+
+    def record_cache_plane(
+        self,
+        plane: str,
+        entries: int,
+        nbytes: int,
+        evictions: int = 0,
+        evicted_bytes: int = 0,
+        **extra: Any,
+    ) -> None:
+        """Record one cache-plane lifecycle event: a growth warning
+        (ReaderCache crossing its entry threshold) or an eviction (the
+        retrieval layout LRU dropping an entry). Carries the plane's entry
+        count and byte size as typed fields — what the fleet alarms on
+        instead of losing a ``warnings.warn`` to stderr — and sums
+        eviction count/bytes into the extensive memory totals."""
+        with self._lock:
+            m = self._memory
+            m["cache_plane_events"] += 1
+            m["plane_evictions"] += int(evictions)
+            m["plane_evicted_bytes"] += int(evicted_bytes)
+            event: Dict[str, Any] = {
+                "type": "cache_plane",
+                "plane": plane,
+                "entries": int(entries),
+                "nbytes": int(nbytes),
+                "t": round(time.time() - self._t0, 6),
+            }
+            if evictions:
+                event["evictions"] = int(evictions)
+            if evicted_bytes:
+                event["evicted_bytes"] = int(evicted_bytes)
+            event.update(extra)
+            self._append(event)
 
     def record_event(self, etype: str, **fields: Any) -> None:
         """Record a free-form auxiliary event (e.g. ``tracker_increment``)."""

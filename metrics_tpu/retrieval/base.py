@@ -59,6 +59,7 @@ from metrics_tpu.retrieval.table import (
     retrieval_table_layout_rows,
     retrieval_table_merge_fx,
 )
+from metrics_tpu.observability.memory import register_cache_plane
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
 from metrics_tpu.utils.checks import (
@@ -89,11 +90,69 @@ _LAYOUT_CACHE_MAX = 8
 #: LRU eviction, whichever first.
 _LAYOUT_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 
+#: lifetime eviction totals for the layout memo (process-wide, like the
+#: cache itself): count + bytes dropped, surfaced on the compute read event
+#: next to ``cache_hit`` and by :func:`layout_cache_totals`
+_LAYOUT_EVICTIONS = 0
+_LAYOUT_EVICTED_BYTES = 0
+
+
+def _layout_nbytes(layout: tuple) -> int:
+    """Bytes held by one memoized layout tuple (its padded unpack arrays)."""
+    return int(
+        sum(getattr(leaf, "nbytes", 0) or 0 for leaf in jax.tree_util.tree_leaves(layout))
+    )
+
+
+def _layout_cache_nbytes() -> int:
+    """Total bytes in the layout memo, deduped by layout identity — a
+    compute-group sibling's entry ALIASES the same layout tuple (same array
+    objects), so it must not count twice."""
+    seen: set = set()
+    total = 0
+    for _tid, layout, _fin in _LAYOUT_CACHE.values():
+        if id(layout) in seen:
+            continue
+        seen.add(id(layout))
+        total += _layout_nbytes(layout)
+    return total
+
+
+def layout_cache_totals() -> dict:
+    """The layout memo's current inventory and lifetime eviction totals:
+    ``{"entries", "nbytes", "evictions", "evicted_bytes"}``."""
+    return {
+        "entries": len(_LAYOUT_CACHE),
+        "nbytes": _layout_cache_nbytes(),
+        "evictions": _LAYOUT_EVICTIONS,
+        "evicted_bytes": _LAYOUT_EVICTED_BYTES,
+    }
+
 
 def _layout_cache_evict(key: tuple) -> None:
+    global _LAYOUT_EVICTIONS, _LAYOUT_EVICTED_BYTES
     entry = _LAYOUT_CACHE.pop(key, None)
-    if entry is not None and entry[2] is not None:
+    if entry is None:
+        return
+    if entry[2] is not None:
         entry[2].detach()
+    _LAYOUT_EVICTIONS += 1
+    dropped = _layout_nbytes(entry[1])
+    _LAYOUT_EVICTED_BYTES += dropped
+    if _TELEMETRY.enabled:
+        # runs from LRU overflow AND weakref finalizers (gc-time): the
+        # recorder hook is lock-safe and allocation-light, but never let a
+        # telemetry failure propagate out of a finalizer
+        try:
+            _TELEMETRY.record_cache_plane(
+                "retrieval_layout",
+                entries=len(_LAYOUT_CACHE),
+                nbytes=_layout_cache_nbytes(),
+                evictions=1,
+                evicted_bytes=dropped,
+            )
+        except Exception:
+            pass
 
 
 def _layout_cache_store(key: tuple, qtable: Array, layout: tuple) -> None:
@@ -131,6 +190,10 @@ def _table_layout_cached(qtable: Array, epoch_key: Optional[tuple] = None):
     layout = retrieval_table_layout(qtable)
     _layout_cache_store(epoch_key if epoch_key is not None else ("id", tid), qtable, layout)
     return layout, False
+
+
+# process-wide memory plane for the layout memo (one cache, one plane)
+register_cache_plane("retrieval_layout", _layout_cache_nbytes)
 
 
 class RetrievalMetric(Metric, ABC):
@@ -244,10 +307,16 @@ class RetrievalMetric(Metric, ABC):
         return self._compute_host_loop()
 
     def _read_extras(self) -> dict:
-        # surfaced on the typed ``read`` event emitted by Metric.compute
+        # surfaced on the typed ``read`` event emitted by Metric.compute;
+        # the layout-memo eviction totals ride alongside ``cache_hit`` so a
+        # thrashing memo (evictions climbing while hits hold) is visible on
+        # the same event stream that shows the hit rate
         return {
             "table_rows": self._last_table_rows,
             "cache_hit": self._last_layout_cache_hit,
+            "layout_entries": len(_LAYOUT_CACHE),
+            "layout_evictions": _LAYOUT_EVICTIONS,
+            "layout_evicted_bytes": _LAYOUT_EVICTED_BYTES,
         }
 
     def table_rows_layout(self, rows: Any):

@@ -40,6 +40,7 @@ counter tracks rows (not batches) per slice and powers top-k-by-count
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -48,6 +49,7 @@ import numpy as np
 
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric
 from metrics_tpu.core.readers import ReaderCache, pad_ids, round_up_bucket
+from metrics_tpu.observability.memory import register_cache_plane
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 
 # the single source of the prefix: the recorder owns it (it splits the
@@ -73,6 +75,33 @@ def _reducer_name(red: Any) -> str:
     if red is None:
         return "None"
     return _SLICEABLE.get(red) or getattr(red, "__name__", repr(red))
+
+
+#: every live SlicedMetric (weak); the ``sliced_value_cache`` memory plane
+#: sums the host-side per-slice value cache + dirty bitmap over this set —
+#: host bytes that scale with S and would otherwise be invisible to both
+#: the device ledger and ``state_footprint()``
+_LIVE_SLICED: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _svc_plane_nbytes() -> int:
+    total = 0
+    for m in list(_LIVE_SLICED):
+        dirty = getattr(m, "_dirty", None)
+        if dirty is not None:
+            total += int(dirty.nbytes)
+        svc = getattr(m, "_svc", None)
+        if svc is not None:
+            total += int(
+                sum(
+                    getattr(leaf, "nbytes", 0) or 0
+                    for leaf in jax.tree_util.tree_leaves(svc)
+                )
+            )
+    return total
+
+
+register_cache_plane("sliced_value_cache", _svc_plane_nbytes)
 
 
 class SlicedMetric(Metric):
@@ -143,6 +172,7 @@ class SlicedMetric(Metric):
         self._svc: Optional[Any] = None
         # pre-lowered subset-gather / top-k executables (core/readers.py)
         self._readers = ReaderCache()
+        _LIVE_SLICED.add(self)
 
     # ------------------------------------------------------------------
     # construction-time sliceability validation

@@ -43,6 +43,7 @@ docs/windowed_metrics.md.
 from __future__ import annotations
 
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -53,6 +54,7 @@ import numpy as np
 from metrics_tpu.core.metric import _AUTO_COUNT, Metric
 from metrics_tpu.core.readers import ReaderCache
 from metrics_tpu.observability.freshness import FreshnessStamp
+from metrics_tpu.observability.memory import register_cache_plane
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import WINDOWED_FOOTPRINT_PREFIX
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_max, dim_zero_min, dim_zero_sum
@@ -81,6 +83,30 @@ _MODES = ("ring", "decay")
 #: LRU bound on the per-instance fold memos — one entry per distinct
 #: (window, before) read pattern; serving loops use one or two
 _FOLD_MEMO_MAX = 8
+
+#: every live WindowedMetric (weak); the ``windowed_fold_memo`` memory
+#: plane sums both per-instance fold memos (prefix folds + merged window
+#: states — device arrays the state footprint does not cover) over this set
+_LIVE_WINDOWED: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _fold_memo_nbytes() -> int:
+    total = 0
+    for m in list(_LIVE_WINDOWED):
+        for memo in (getattr(m, "_fold_memo", None), getattr(m, "_wstate_memo", None)):
+            if not memo:
+                continue
+            for entry in list(memo.values()):
+                total += int(
+                    sum(
+                        getattr(leaf, "nbytes", 0) or 0
+                        for leaf in jax.tree_util.tree_leaves(entry)
+                    )
+                )
+    return total
+
+
+register_cache_plane("windowed_fold_memo", _fold_memo_nbytes)
 
 
 def _reducer_name(red: Any) -> str:
@@ -226,6 +252,7 @@ class WindowedMetric(Metric):
         self._last_fold_fanin = 0
         self._last_read_cache_hit = False
         self._readers = ReaderCache()
+        _LIVE_WINDOWED.add(self)
 
     # ------------------------------------------------------------------
     # construction-time validation

@@ -108,6 +108,14 @@ AUX_FIELDS: Dict[str, str] = {
     # edge over a whole-axis refold is the regression this PR exists to
     # prevent
     "incremental_vs_full": "higher",
+    # the memory-plane bench (``memory_plane_throughput``): armed-vs-disarmed
+    # S=100k async ingest throughput — the boundary hooks + observatory
+    # polls growing a per-update tax past the <=5% acceptance ceiling is a
+    # regression even when the absolute updates/sec still passes — and the
+    # ledger's per-tenant attribution, whose growth means sliced state the
+    # budget rule meters got silently heavier
+    "memory_plane_on_ratio": "higher",
+    "bytes_per_tenant": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -152,6 +160,15 @@ BOOL_FIELDS: Tuple[str, ...] = (
     # compute, and a fast-but-wrong cached read is data corruption however
     # large the speedup ratio
     "incremental_read_bit_exact",
+    # memory accounting honesty: the ledger must never claim more live
+    # state than the backend reports (unaccounted residue non-negative
+    # within allocator slack; vacuously true where the backend exposes no
+    # memory_stats), and the residue must return to its post-warmup
+    # baseline across update/compute/reset cycles — a growing residue is
+    # the leak signal the observatory exists to expose, and a lying ledger
+    # breaks every budget/leak alarm built on it
+    "ledger_matches_backend",
+    "unaccounted_non_growing",
 )
 
 

@@ -370,11 +370,11 @@ class TestWireAndCollector:
 # the alarm classes: freshness_slo + read_latency fire AND clear
 # ----------------------------------------------------------------------
 class TestFreshnessAlarms:
-    def test_default_rules_cover_eleven_classes(self):
+    def test_default_rules_cover_thirteen_classes(self):
         rules = default_rules()
         names = {r.name for r in rules}
         assert {"freshness_slo", "read_latency"} <= names
-        assert len(rules) == 13  # 11 classes; queue + freshness have companions
+        assert len(rules) == 15  # 13 classes; queue + freshness have companions
 
     def test_fire_and_clear(self):
         registry = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=60)

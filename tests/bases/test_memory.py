@@ -1,0 +1,441 @@
+"""Device-memory observatory tests (ISSUE 18 tentpole + satellites): the
+MemoryLedger's buffer-identity dedup (fused group state and donated
+buffers counted once, never twice), the reset-to-baseline leak
+regression for sliced/windowed/retrieval state, the cache-plane registry
+(register/unregister, raising callbacks, the repo's built-in planes, the
+retrieval layout eviction totals riding the compute read event), the
+``set_dtype`` footprint staleness fix (theoretical == live for
+fixed-shape metrics), the one-bool disabled hot path, the
+``memory_budget`` / ``memory_leak`` alarm classes firing and clearing,
+and the Prometheus memory families + fleet wire merge under the strict
+exposition parser."""
+import gc
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import MeanMetric
+from metrics_tpu.observability import (
+    HealthMonitor,
+    MemoryBudget,
+    MemoryLeak,
+    MemoryLedger,
+    MemoryObservatory,
+    cache_plane_inventory,
+    counter_payload,
+    default_rules,
+    get_recorder,
+    merge_payloads,
+    register_cache_plane,
+    render_prometheus,
+    unregister_cache_plane,
+)
+from metrics_tpu.observability.recorder import (
+    SERIES_MEM_BYTES_PER_TENANT,
+    SERIES_MEM_UNACCOUNTED,
+)
+from metrics_tpu.observability.timeseries import TimeSeriesRegistry
+from metrics_tpu.retrieval import RetrievalMAP
+from metrics_tpu.retrieval.base import layout_cache_totals
+from metrics_tpu.sliced import SlicedMetric
+from metrics_tpu.windowed import WindowedMetric
+
+from .test_freshness import parse_prometheus_strict
+
+T0 = 1_000_000.0
+
+
+@pytest.fixture
+def recorder():
+    """The default recorder, enabled for one test and ALWAYS disabled+reset
+    after — the session-level conftest asserts nothing leaks."""
+    rec = get_recorder()
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        rec.disable()
+        rec.detach_timeseries()
+        rec.reset()
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+    )
+
+
+# ----------------------------------------------------------------------
+# the ledger: identity dedup, donation, per-device attribution
+# ----------------------------------------------------------------------
+class TestLedger:
+    def test_fused_group_state_counted_once(self):
+        # satellite 4: after a fused update, group members receive the
+        # LEADER's new state arrays — two MSE twins alias the same buffers,
+        # and the sum of their individual footprints double-counts what the
+        # device actually holds
+        col = MetricCollection({"a": MeanSquaredError(), "b": MeanSquaredError()})
+        preds, target = _batch()
+        col.update(preds, target)  # discovery
+        col.compile_update()
+        col.update(preds, target)
+        rep = MemoryLedger(list(col.values())).measure()
+        naive = sum(m.total_state_bytes() for m in col.values())
+        assert rep["n_shared"] >= 1
+        assert rep["total_bytes"] < naive
+        assert rep["n_metrics"] == 2
+
+    def test_aliased_state_counted_once(self):
+        m1, m2 = MeanSquaredError(), MeanSquaredError()
+        preds, target = _batch()
+        m1.update(preds, target)
+        m2.update(preds, target)
+        independent = MemoryLedger([m1, m2]).measure()["total_bytes"]
+        m2.sum_squared_error = m1.sum_squared_error  # hand-aliased buffer
+        rep = MemoryLedger([m1, m2]).measure()
+        assert rep["n_shared"] >= 1
+        assert rep["total_bytes"] < independent
+
+    def test_donated_buffers_count_zero(self):
+        # the donated-buffer contract: a deleted (donated-away) array holds
+        # no committed device bytes, so the ledger must not bill it
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        base = MemoryLedger([m]).measure()["total_bytes"]
+        m.sum_squared_error.delete()
+        rep = MemoryLedger([m]).measure()
+        assert rep["n_donated"] >= 1
+        assert rep["total_bytes"] < base
+
+    def test_per_device_breakdown_sums_to_total(self):
+        m = SlicedMetric(MeanSquaredError(), num_slices=16)
+        preds, target = _batch()
+        m.update(jnp.asarray(np.arange(8) % 16), preds, target)
+        rep = MemoryLedger([m]).measure()
+        assert rep["total_bytes"] > 0
+        assert sum(rep["per_device"].values()) == rep["total_bytes"]
+        # sliced attribution: the whole state is per-tenant here
+        assert rep["sliced_bytes"] == rep["total_bytes"]
+        assert rep["num_tenants"] == 16
+        assert rep["bytes_per_tenant"] == pytest.approx(rep["total_bytes"] / 16)
+
+
+# ----------------------------------------------------------------------
+# satellite 4 (leak regression): reset returns the ledger to baseline
+# ----------------------------------------------------------------------
+class TestResetBaseline:
+    @pytest.mark.parametrize(
+        "factory,update",
+        [
+            (
+                lambda: SlicedMetric(MeanSquaredError(), num_slices=16),
+                lambda m, p, t: m.update(jnp.asarray(np.arange(8) % 16), p, t),
+            ),
+            (
+                lambda: WindowedMetric(MeanSquaredError(), window=4),
+                lambda m, p, t: m.update(p, t),
+            ),
+            (
+                lambda: RetrievalMAP(),
+                lambda m, p, t: m.update(
+                    p, jnp.asarray((np.arange(8) % 2).astype(np.int64)),
+                    indexes=jnp.asarray(np.arange(8) % 3),
+                ),
+            ),
+        ],
+        ids=["sliced", "windowed", "retrieval"],
+    )
+    def test_reset_returns_to_post_init_bytes(self, factory, update):
+        m = factory()
+        baseline = MemoryLedger([m]).measure()["total_bytes"]
+        preds, target = _batch()
+        for seed in range(3):
+            p, t = _batch(seed=seed)
+            update(m, p, t)
+        jnp.asarray(0.0).block_until_ready()
+        m.compute()
+        grown = MemoryLedger([m]).measure()["total_bytes"]
+        m.reset()
+        assert MemoryLedger([m]).measure()["total_bytes"] == baseline
+        # retrieval rides the fixed-capacity state table: updates must not
+        # grow committed bytes AT ALL, that is the whole point of the table
+        if isinstance(m, RetrievalMAP):
+            assert grown == baseline
+        else:
+            assert grown >= baseline
+
+
+# ----------------------------------------------------------------------
+# satellite 1: set_dtype footprint staleness fix
+# ----------------------------------------------------------------------
+class TestSetDtypeFootprint:
+    def test_footprint_event_stamps_theoretical_and_live(self, recorder):
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        m.set_dtype(jnp.float16)
+        evs = [e for e in recorder.events() if e.get("type") == "footprint"]
+        assert evs, "set_dtype must emit a footprint event"
+        last = evs[-1]
+        assert last["cast_to"] == "float16"
+        # fixed-shape metric: the defaults-predicted bytes and the live
+        # state walk must agree — the staleness this satellite fixes
+        assert last["theoretical_bytes"] == last["live_bytes"]
+        assert m.total_state_bytes() == m.theoretical_state_bytes()
+
+    def test_footprint_reflects_cast_sizes(self, recorder):
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        before = m.total_state_bytes()
+        m.set_dtype(jnp.float16)
+        # float states halve; count states keep their integer dtype — the
+        # footprint must reflect the cast immediately (the staleness bug)
+        assert m.total_state_bytes() < before
+        assert m.total_state_bytes() == m.theoretical_state_bytes()
+        # the cached computed value survives the cast at the new dtype
+        m2 = MeanSquaredError()
+        m2.update(preds, target)
+        float(m2.compute())
+        m2.set_dtype(jnp.float16)
+        assert m2._computed is not None
+        assert jnp.asarray(m2._computed).dtype == jnp.float16
+
+
+# ----------------------------------------------------------------------
+# boundary events + the one-bool disabled hot path
+# ----------------------------------------------------------------------
+class TestBoundaries:
+    def test_disabled_records_nothing(self):
+        rec = get_recorder()
+        assert not rec.enabled
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        float(m.compute())
+        m.reset()
+        totals = rec.memory_totals()
+        assert totals["events"] == 0 and totals["update_boundaries"] == 0
+        assert not [e for e in rec.events() if e.get("type") == "memory"]
+
+    def test_boundary_counters_and_throttled_events(self, recorder):
+        m = MeanSquaredError()
+        preds, target = _batch()
+        for _ in range(5):
+            m.update(preds, target)
+        float(m.compute())
+        m.reset()
+        totals = recorder.memory_totals()
+        assert totals["update_boundaries"] >= 5
+        assert totals["compute_boundaries"] >= 1
+        assert totals["reset_boundaries"] >= 1
+        evs = [e for e in recorder.events() if e.get("type") == "memory"]
+        # counters are exact, typed rows are throttled per kind: 5 eager
+        # updates inside one throttle interval emit ONE update row
+        update_rows = [e for e in evs if e.get("kind") == "update"]
+        assert len(update_rows) == 1
+        assert update_rows[0]["live_bytes"] == m.total_state_bytes() or (
+            update_rows[0]["live_bytes"] > 0
+        )
+
+
+# ----------------------------------------------------------------------
+# the cache-plane registry (tentpole) + satellites 2/3
+# ----------------------------------------------------------------------
+class TestCachePlanes:
+    def test_register_unregister_and_raising_callback(self):
+        register_cache_plane("test_plane", lambda: 123)
+        try:
+            assert cache_plane_inventory()["test_plane"] == 123
+        finally:
+            assert unregister_cache_plane("test_plane")
+        assert "test_plane" not in cache_plane_inventory()
+
+        def boom():
+            raise RuntimeError("dead cache")
+
+        register_cache_plane("test_boom", boom)
+        try:
+            # a dying callback reports 0, never poisons the inventory
+            assert cache_plane_inventory()["test_boom"] == 0
+        finally:
+            unregister_cache_plane("test_boom")
+
+    def test_builtin_planes_registered(self):
+        inv = cache_plane_inventory()
+        assert {
+            "reader_cache",
+            "fused_compile",
+            "retrieval_layout",
+            "sketch_scratch",
+            "sliced_value_cache",
+            "windowed_fold_memo",
+        } <= set(inv)
+        assert all(isinstance(v, int) and v >= 0 for v in inv.values())
+
+    def test_reader_cache_plane_tracks_compiles(self):
+        m = SlicedMetric(MeanSquaredError(), num_slices=8)
+        preds, target = _batch()
+        m.update(jnp.asarray(np.arange(8) % 8), preds, target)
+        m.compute()
+        # the instance's per-entry executable bytes feed the global plane
+        assert m._readers.nbytes() >= 0
+        assert len(m._readers._cache) >= 1
+        assert cache_plane_inventory()["reader_cache"] >= m._readers.nbytes()
+
+    def test_layout_eviction_totals_and_read_event(self, recorder):
+        # satellite 3: the compute read event carries the layout-cache
+        # totals alongside cache_hit, and a finalized metric's eviction
+        # shows up in the counters with the dropped bytes
+        rm = RetrievalMAP()
+        idx = jnp.asarray(np.repeat(np.arange(3), 5))
+        preds = jnp.asarray(np.linspace(0.0, 1.0, 15, dtype=np.float32))
+        target = jnp.asarray((np.arange(15) % 5 == 0).astype(np.int64))
+        rm.update(preds, target, indexes=idx)
+        float(rm.compute())
+        evs = [
+            e for e in recorder.events()
+            if e.get("type") == "read" and e.get("kind") == "compute"
+        ]
+        cold = [e for e in evs if e.get("cache_hit") is False]
+        assert cold and cold[-1]["layout_entries"] >= 1
+        assert "layout_evictions" in cold[-1] and "layout_evicted_bytes" in cold[-1]
+        before = layout_cache_totals()
+        del rm
+        gc.collect()
+        after = layout_cache_totals()
+        assert after["evictions"] > before["evictions"]
+        assert after["evicted_bytes"] > before["evicted_bytes"]
+        assert after["entries"] < before["entries"] or before["entries"] == 0
+
+
+# ----------------------------------------------------------------------
+# the two new alarm classes: fire AND clear
+# ----------------------------------------------------------------------
+class TestMemoryRules:
+    def test_default_rules_cover_memory_classes(self):
+        rules = default_rules(tenant_bytes_limit=1024, unaccounted_growth_bytes=1e6)
+        budget = next(r for r in rules if r.name == "memory_budget")
+        leak = next(r for r in rules if r.name == "memory_leak")
+        assert isinstance(budget, MemoryBudget) and isinstance(leak, MemoryLeak)
+        assert budget.threshold == 1024.0
+        # absent series: a monitor with no observatory polling stays clean
+        registry = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=60)
+        mon = HealthMonitor(rules, registry=registry)
+        snap = mon.evaluate(now=T0)
+        assert snap.status == "ok" and not snap.firing
+
+    def test_budget_fires_and_clears_on_threshold(self):
+        registry = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=60)
+        rule = MemoryBudget(100.0, window_s=5.0)
+        monitor = HealthMonitor([rule], registry=registry)
+        for i in range(4):
+            registry.observe(SERIES_MEM_BYTES_PER_TENANT, 500.0, t=T0 + i)
+        snap = monitor.evaluate(now=T0 + 4)
+        assert {a.name for a in snap.firing} == {"memory_budget"}
+        # the live-tunable threshold: ops restoring the ceiling clears the
+        # alarm on the very next evaluation, same samples
+        rule.threshold = 1000.0
+        snap = monitor.evaluate(now=T0 + 5)
+        assert snap.status == "ok"
+        assert "memory_budget" in monitor.fired_and_cleared()
+
+    def test_leak_fires_on_monotone_growth_only(self):
+        registry = TimeSeriesRegistry(bucket_seconds=1.0, n_buckets=120)
+        rule = MemoryLeak(growth_bytes=1000.0, window_s=8.0, min_count=4)
+        monitor = HealthMonitor([rule], registry=registry)
+        # noisy but FLAT residue: never fires
+        for i in range(8):
+            registry.observe(SERIES_MEM_UNACCOUNTED, 5000.0 + (i % 2) * 400, t=T0 + i)
+        snap = monitor.evaluate(now=T0 + 8)
+        assert snap.status == "ok"
+        # steady growth: every recent sample above every prior one by more
+        # than the bound
+        for i in range(8):
+            registry.observe(SERIES_MEM_UNACCOUNTED, 10_000.0 + i * 2000, t=T0 + 20 + i)
+        snap = monitor.evaluate(now=T0 + 28)
+        assert {a.name for a in snap.firing} == {"memory_leak"}
+        # recovery: the residue flattens, the window rolls past the growth
+        for i in range(10):
+            registry.observe(SERIES_MEM_UNACCOUNTED, 24_000.0, t=T0 + 29 + i)
+        snap = monitor.evaluate(now=T0 + 39)
+        assert snap.status == "ok"
+        assert "memory_leak" in monitor.fired_and_cleared()
+
+
+# ----------------------------------------------------------------------
+# the observatory poll + Prometheus families + fleet wire
+# ----------------------------------------------------------------------
+class TestObservatoryExposition:
+    def test_observe_derives_unaccounted(self, recorder):
+        recorder.attach_timeseries(bucket_seconds=1.0, n_buckets=60, sketch_capacity=64)
+        m = SlicedMetric(MeanSquaredError(), num_slices=8)
+        preds, target = _batch()
+        m.update(jnp.asarray(np.arange(8) % 8), preds, target)
+        obs = MemoryObservatory(recorder=recorder)
+        rep = obs.observe()
+        assert rep["total_bytes"] > 0
+        assert rep["cache_plane_bytes"] >= 0
+        # CPU boxes report via host RSS; a device backend reports directly —
+        # either way the residue must be derivable and positive (the process
+        # holds far more than metric state)
+        assert rep["source"] in ("backend", "host_rss")
+        assert rep["device_bytes_in_use"] > 0
+        assert rep["unaccounted_bytes"] == (
+            rep["device_bytes_in_use"] - rep["total_bytes"] - rep["cache_plane_bytes"]
+        )
+        totals = recorder.memory_totals()
+        assert totals["observations"] >= 1
+        assert totals["ledger_bytes"] == rep["total_bytes"]
+        assert totals["max_unaccounted_bytes"] >= rep["unaccounted_bytes"]
+
+    def test_prometheus_memory_families_strict(self, recorder):
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        MemoryObservatory(recorder=recorder).observe()
+        page = render_prometheus(recorder)
+        assert 'metrics_tpu_memory_boundaries_total{boundary="update"}' in page
+        assert "metrics_tpu_memory_observations_total" in page
+        assert 'metrics_tpu_memory_ledger_bytes{window="last"}' in page
+        assert 'metrics_tpu_memory_unaccounted_bytes{window="max"}' in page
+        assert "metrics_tpu_memory_plane_evictions_total" in page
+        parse_prometheus_strict(page)  # whole page must stay well-formed
+
+    def test_fleet_wire_merge_sums_counts_maxes_gauges(self, recorder):
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        MemoryObservatory(recorder=recorder).observe()
+        payload = counter_payload(recorder)
+        assert payload["memory"]["update_boundaries"] >= 1
+        other = dict(payload)
+        other["process"] = 1
+        merged = merge_payloads([payload, other])
+        mem = merged["memory"]
+        # host-summable counts add, point-in-time gauges take the fleet max
+        assert mem["update_boundaries"] == 2 * payload["memory"]["update_boundaries"]
+        assert mem["ledger_bytes"] == payload["memory"]["ledger_bytes"]
+        page = render_prometheus(recorder, aggregate=merged)
+        assert "metrics_tpu_memory_ledger_bytes" in page
+        parse_prometheus_strict(page)
+
+    def test_memory_events_ride_the_wire_payload(self, recorder):
+        # the FleetCollector stitches per-host payloads: memory totals must
+        # survive a JSON round-trip (no numpy scalars, no callables)
+        import json
+
+        m = MeanSquaredError()
+        preds, target = _batch()
+        m.update(preds, target)
+        MemoryObservatory(recorder=recorder).observe()
+        payload = counter_payload(recorder)
+        round_tripped = json.loads(json.dumps(payload))
+        assert round_tripped["memory"] == payload["memory"]
