@@ -13,15 +13,75 @@ the unweighted kernels.
 Only the sketch compute paths call these (the lossless window runs the
 exact unbounded kernels instead); they are shape-polymorphic jnp programs
 usable both eagerly on host-sliced rows and under jit on masked buffers.
+
+:func:`coco_precision_recall_grid` is the detection twin: the same
+sort-then-cumulate reduction, but integrated onto COCO's fixed recall
+grid with the reference's float64 / mergesort / zigzag-removal semantics
+(host numpy — detection AP parity is pinned bit-for-bit against the
+reference, which never leaves float64). ``detection/mean_ap.py`` folds
+every (class, area, max_det) cell through it instead of duplicating the
+cumsum logic.
 """
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.utils.data import stable_sort_with_payloads
 
 Array = jax.Array
+
+#: reference map.py:651 denominator epsilon (torch.finfo(torch.float64).eps)
+_COCO_EPS = float(np.finfo(np.float64).eps)
+
+
+def coco_precision_recall_grid(
+    scores: np.ndarray,
+    matches: np.ndarray,
+    ignore: np.ndarray,
+    npig: int,
+    rec_thrs: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """COCO PR integration for one (class, area, max_det) cell.
+
+    ``scores [nd]`` in unit-major arrival order, ``matches``/``ignore``
+    ``[T, nd]`` bool over the IoU-threshold axis, ``npig`` the number of
+    non-ignored ground truths, ``rec_thrs [R]`` the fixed recall grid.
+    Returns ``(precision [T, R], recall [T])`` float64 with the
+    reference's exact semantics: descending mergesort (Matlab-consistent
+    tie order, map.py:632-634), float64 cumulative TP/FP masses, the
+    right-to-left running max that is the fixed point of the iterative
+    zigzag removal (map.py:657-662), left ``searchsorted`` onto the
+    recall grid with first-out-of-bounds truncation (map.py:664-666).
+    """
+    T = matches.shape[0]
+    R = rec_thrs.shape[0]
+    nd = scores.shape[0]
+    precision = np.zeros((T, R))
+    recall = np.zeros((T,))
+    if nd == 0:
+        return precision, recall
+
+    inds = np.argsort(-scores, kind="mergesort")
+    matches = matches[:, inds]
+    ignore = ignore[:, inds]
+
+    tps = np.cumsum(matches & ~ignore, axis=1, dtype=np.float64)
+    fps = np.cumsum(~matches & ~ignore, axis=1, dtype=np.float64)
+
+    # all T thresholds at once: the per-t arithmetic and the zigzag
+    # fixed point vectorize over the leading axis; only searchsorted
+    # stays per-t (each row has its own sorted recall grid)
+    rc_all = tps / npig  # [T, nd]
+    pr_all = tps / (fps + tps + _COCO_EPS)
+    recall[:] = rc_all[:, -1]
+    pr_all = np.maximum.accumulate(pr_all[:, ::-1], axis=1)[:, ::-1]
+    for t in range(T):
+        r_inds = np.searchsorted(rc_all[t], rec_thrs, side="left")
+        num = int(r_inds.argmax()) if r_inds.max() >= nd else R
+        precision[t, :num] = pr_all[t, r_inds[:num]]
+    return precision, recall
 
 
 def _weighted_sorted_cumulants(

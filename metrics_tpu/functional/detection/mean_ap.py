@@ -36,7 +36,6 @@ from metrics_tpu.ops.box_iou_pallas import box_iou_dispatch
 
 Array = jax.Array
 
-_F64_EPS = float(np.finfo(np.float64).eps)  # reference map.py:651 (torch.finfo(torch.float64).eps)
 
 
 # ---------------------------------------------------------------------------
@@ -343,8 +342,15 @@ def _calculate_precision_recall(
     """Integrate matches into the COCO precision/recall tables.
 
     Returns ``precision [T, R, K, A, M]`` and ``recall [T, K, A, M]``
-    initialized to -1 (reference map.py:553-554).
+    initialized to -1 (reference map.py:553-554). The per-cell reduction
+    (sort, cumulate, zigzag, recall-grid projection) is the shared
+    :func:`~metrics_tpu.functional.classification.sketch_curve.coco_precision_recall_grid`;
+    this function only assembles each cell's scores/matches/ignore views.
     """
+    from metrics_tpu.functional.classification.sketch_curve import (
+        coco_precision_recall_grid,
+    )
+
     T = len(iou_thresholds)
     R = len(rec_thresholds)
     M = len(max_detection_thresholds)
@@ -377,39 +383,14 @@ def _calculate_precision_recall(
                 continue  # reference map.py:641-642
             for mi, max_det in enumerate(max_detection_thresholds):
                 live = live_masks[mi][sel]  # [S, D]
-                nd = int(live.sum())
                 scores = scores_k[live]  # [nd], unit-major order
                 matches = np.moveaxis(matches_k[:, a], 1, 0)[:, live]  # [T, nd]
                 ignore = (~matches) & area_out_k[:, a][live][None, :]
-
-                # mergesort for Matlab-consistent ordering (map.py:632-634)
-                inds = np.argsort(-scores, kind="mergesort")
-                scores_sorted = scores[inds]
-                matches = matches[:, inds]
-                ignore = ignore[:, inds]
-
-                tps = np.cumsum(matches & ~ignore, axis=1, dtype=np.float64)
-                fps = np.cumsum(~matches & ~ignore, axis=1, dtype=np.float64)
-
-                # all T thresholds at once: the per-t arithmetic and the
-                # right-to-left running max (== the reference's iterative
-                # zigzag removal, map.py:657-662, at its fixed point)
-                # vectorize over the leading axis; only searchsorted stays
-                # per-t (each row has its own sorted recall grid)
-                rc_all = tps / npig  # [T, nd]
-                pr_all = tps / (fps + tps + _F64_EPS)
-                recall[:, k, a, mi] = rc_all[:, -1] if nd else 0
-                pr_all = np.maximum.accumulate(pr_all[:, ::-1], axis=1)[:, ::-1]
-                for t in range(T):
-                    rc = rc_all[t]
-                    r_inds = np.searchsorted(rc, rec_thrs, side="left")
-                    # first-out-of-bounds truncation (map.py:664-666); when
-                    # nd == 0 all r_inds are 0 >= nd so num == 0 and the
-                    # precision row stays all-zero, exactly as the reference
-                    num = int(r_inds.argmax()) if r_inds.max() >= nd else R
-                    prec_row = np.zeros((R,))
-                    prec_row[:num] = pr_all[t, r_inds[:num]]
-                    precision[t, :, k, a, mi] = prec_row
+                prec_cell, rec_cell = coco_precision_recall_grid(
+                    scores, matches, ignore, npig, rec_thrs
+                )
+                precision[:, :, k, a, mi] = prec_cell
+                recall[:, k, a, mi] = rec_cell
     return precision, recall
 
 
