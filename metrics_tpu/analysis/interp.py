@@ -46,7 +46,7 @@ from __future__ import annotations
 import ast
 import pathlib
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
 
 from .engine import FileContext, PACKAGE_NAME, default_package_root
 
@@ -650,6 +650,12 @@ class _Scanner:
     #: from the default-mode verdict this scan produces
     exact_attr: Optional[str] = None
 
+    #: attribute names from __traced_callable_attrs__: `self.<attr>(...)`
+    #: is modeled as a traced-pure array program (the ctor installs a
+    #: traceable callable there by contract; a violating user install is
+    #: caught at runtime by the fused dispatcher's stale-manifest demotion)
+    traced_callable_attrs: FrozenSet[str] = frozenset()
+
     def _exact_branch_side(self, test: ast.AST) -> Optional[str]:
         """\"body\" when `if self.<exact_attr>:` selects the exact mode in
         its body, \"orelse\" for the negated spelling, None otherwise."""
@@ -1083,6 +1089,10 @@ class _Scanner:
                     return self._resolved_call(resolved, node, arg_values, kw_values, conditional, skip_self=True)
                 if member == "add_state":
                     return _Value(tainted=False, noneness=_NOT_NONE)
+                if member in self.traced_callable_attrs:
+                    # declared traced callable attribute (e.g. a Flax
+                    # feature extractor): a pure array → array program
+                    return _Value(tainted=True, noneness=_NOT_NONE)
                 if any_taint:
                     self._emit(
                         "unknown",
@@ -1406,6 +1416,7 @@ _SKETCH_INIT_CTORS = {
     "reservoir_init",
     "hist_init",
     "retrieval_table_init",
+    "detection_table_init",
 }
 
 _DTYPE_DEFAULTS = {"zeros": "float32", "ones": "float32", "empty": "float32", "full": None}
@@ -1600,6 +1611,12 @@ def _reducer_of(call: ast.Call) -> Optional[str]:
             return "ring"
         if name == "decay_sum_fx":
             return "decay"
+        # streaming-moment leaves (`moments_merge_fx()`): element-wise
+        # summable sufficient statistics whose cross-rank merge IS addition
+        # — checked BEFORE the merge_fx suffix so the write-contract rules
+        # (additive, not insert-transform) apply to them
+        if name == "moments_merge_fx":
+            return "moments"
         # the sketch modules' tagged merge reducers (`sketch_merge_fx()`,
         # `reservoir_merge_fx()`, `ranksketch_merge_fx()`): a self-merging
         # leaf, distinct from an arbitrary custom callable
@@ -1668,6 +1685,39 @@ class ClassFacts:
     chain: List[Tuple[FileContext, ast.ClassDef]]
     is_metric: bool
     exact_attr: Optional[str] = None  # __exact_mode_attr__ declaration
+    traced_callable_attrs: FrozenSet[str] = frozenset()  # __traced_callable_attrs__
+
+
+def _traced_callable_attrs(class_node: ast.ClassDef) -> FrozenSet[str]:
+    """The ``__traced_callable_attrs__ = ("<attr>", ...)`` declaration.
+
+    A metric whose constructor installs a *traceable* callable on an
+    instance attribute (e.g. a Flax feature extractor bound via
+    ``self.inception = build_fid_inception(...)``) declares those attribute
+    names here: ``self.<attr>(...)`` calls in the update are modeled as
+    traced-pure array programs instead of emitting the unresolved-method
+    "unknown" signal. The declaration is a CONTRACT on the default
+    configuration — a user who installs a host-only callable on such an
+    attribute is caught at runtime by the fused dispatcher's stale-manifest
+    safety net (the trace fails, the member is re-probed and demoted to the
+    eager path), so a wrong declaration degrades performance, never
+    correctness.
+    """
+    for stmt in class_node.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "__traced_callable_attrs__"
+            and isinstance(stmt.value, (ast.Tuple, ast.List))
+        ):
+            names = [
+                el.value
+                for el in stmt.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ]
+            return frozenset(names)
+    return frozenset()
 
 
 def _exact_mode_attr(class_node: ast.ClassDef) -> Optional[str]:
@@ -1804,6 +1854,9 @@ def class_facts(project: Project, ctx: FileContext, class_node: ast.ClassDef) ->
         exact_attr = _exact_mode_attr(cur_node)
         if exact_attr is not None:
             break
+    traced_attrs: FrozenSet[str] = frozenset()
+    for cur_ctx, cur_node in chain:
+        traced_attrs = traced_attrs | _traced_callable_attrs(cur_node)
     return ClassFacts(
         name=class_node.name,
         relpath=ctx.relpath,
@@ -1816,6 +1869,7 @@ def class_facts(project: Project, ctx: FileContext, class_node: ast.ClassDef) ->
         chain=chain,
         is_metric=is_metric,
         exact_attr=exact_attr,
+        traced_callable_attrs=traced_attrs,
     )
 
 
@@ -1832,6 +1886,25 @@ def _string_annotated_params(fn: ast.FunctionDef) -> Set[str]:
             ):
                 out.add(arg.arg)
                 break
+    return out
+
+
+def _static_annotated_params(fn: ast.FunctionDef) -> Set[str]:
+    """Update parameters annotated as BARE ``bool`` or ``int`` — declared
+    Python-static configuration knobs, not traced array inputs. Under the
+    fused dispatcher these are static (non-array leaves never become
+    tracers), so branching on them is shape selection, not a host sync.
+    Only the bare annotation qualifies: ``Optional[int]``, ``Tensor``-like
+    wrappers, and unions stay traced."""
+    out: Set[str] = set()
+    for arg in list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs):
+        ann = arg.annotation
+        if arg.arg == "self" or ann is None:
+            continue
+        if (isinstance(ann, ast.Name) and ann.id in ("bool", "int")) or (
+            isinstance(ann, ast.Constant) and ann.value in ("bool", "int")
+        ):
+            out.add(arg.arg)
     return out
 
 
@@ -1886,6 +1959,7 @@ def classify(project: Project, ctx: FileContext, class_node: ast.ClassDef) -> Tu
     scanner = _Scanner(project, up_ctx, _DEPTH_BUDGET)
     scanner._method_resolver = _method_resolver_for(project, facts)
     scanner.exact_attr = facts.exact_attr
+    scanner.traced_callable_attrs = facts.traced_callable_attrs
     params = {a.arg for a in list(up_fn.args.posonlyargs) + list(up_fn.args.args) if a.arg != "self"}
     params.update(a.arg for a in up_fn.args.kwonlyargs)
     if up_fn.args.vararg:
@@ -1893,7 +1967,7 @@ def classify(project: Project, ctx: FileContext, class_node: ast.ClassDef) -> Tu
     if up_fn.args.kwarg:
         params.add(up_fn.args.kwarg.arg)
     env = _Env(
-        traced=set(params),
+        traced=set(params) - _static_annotated_params(up_fn),
         noneness={p: _NOT_NONE for p in params},
         states={e.name for e in facts.entries if e.container != _CONTAINER_LIST},
         list_states=set(unknown_containers),

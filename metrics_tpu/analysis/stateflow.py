@@ -78,6 +78,7 @@ def _state_reducers(class_node: ast.ClassDef) -> Dict[str, str]:
             reducer = _reducer_of(node)
             if isinstance(reducer, str) and reducer in {
                 "sum", "mean", "max", "min", "cat", "merge", "ring", "decay",
+                "moments",
             }:
                 out[node.args[0].value] = reducer
     return out
@@ -283,14 +284,17 @@ def _check_update_writes(
                 for sub in ast.walk(expr)
             )
 
-        if reducer == "sum":
+        # streaming-moment leaves ("moments", `moments_merge_fx()`) are
+        # element-wise summable sufficient statistics: the cross-rank merge
+        # IS addition, so every "sum" write contract applies verbatim
+        if reducer in ("sum", "moments"):
             if kind == "assign":
                 scatter = _scatter_extremum_kind(rhs, attr) if rhs is not None else None
                 seg_add = _additive_segment_extremum(rhs) if rhs is not None else None
                 if seg_add is not None:
                     yield FlowFinding(
                         stmt,
-                        f"`\"sum\"`-reduced state `{attr}` accumulates a `{seg_add}` "
+                        f"`\"{reducer}\"`-reduced state `{attr}` accumulates a `{seg_add}` "
                         f"result in `{method.name}`; a scattered extremum summed into "
                         "the state is not additive across ranks — segment-SUM the "
                         "per-slice deltas, or declare the state "
@@ -301,7 +305,7 @@ def _check_update_writes(
                     spelled = f"`segment_{scatter}`" if seg else f"`.at[...].{scatter}(...)`"
                     yield FlowFinding(
                         stmt,
-                        f"`\"sum\"`-reduced state `{attr}` updated with a slice-axis "
+                        f"`\"{reducer}\"`-reduced state `{attr}` updated with a slice-axis "
                         f"scatter-extremum ({spelled}) in `{method.name}`; scattered "
                         "extrema are not additive across ranks — declare the state "
                         '`dist_reduce_fx="max"/"min"` or segment-SUM the per-slice '
@@ -310,7 +314,7 @@ def _check_update_writes(
                 elif rhs is not None and _is_extremum_rhs(rhs, attr):
                     yield FlowFinding(
                         stmt,
-                        f"`\"sum\"`-reduced state `{attr}` updated with an extremum "
+                        f"`\"{reducer}\"`-reduced state `{attr}` updated with an extremum "
                         f"(`{_last_call_name(rhs)}`) in `{method.name}`; per-rank values stop "
                         "being additive and the cross-rank sum double-counts — declare the "
                         'state `dist_reduce_fx="max"/"min"` or accumulate additively',
@@ -318,7 +322,7 @@ def _check_update_writes(
                 elif rhs is not None and not rhs_reads_prior(rhs):
                     yield FlowFinding(
                         stmt,
-                        f"`\"sum\"`-reduced state `{attr}` overwritten in `{method.name}` "
+                        f"`\"{reducer}\"`-reduced state `{attr}` overwritten in `{method.name}` "
                         "without reading its prior value; the overwrite discards earlier "
                         "batches on this rank — accumulate additively "
                         f"(`self.{attr} = self.{attr} + delta`)",
@@ -326,7 +330,7 @@ def _check_update_writes(
             elif kind not in ("Add", "Sub"):
                 yield FlowFinding(
                     stmt,
-                    f"`\"sum\"`-reduced state `{attr}` mutated with `{kind}` in "
+                    f"`\"{reducer}\"`-reduced state `{attr}` mutated with `{kind}` in "
                     f"`{method.name}`; only additive accumulation keeps per-rank values "
                     "summable across the mesh",
                 )

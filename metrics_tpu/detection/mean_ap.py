@@ -5,6 +5,29 @@ Behavior parity with /root/reference/torchmetrics/detection/map.py:133-735
 SURVEY §3.4).  The compute pipeline is re-architected TPU-first: the
 per-(image, class, area, threshold) Python matching loops become one jitted
 static-shape kernel (see metrics_tpu/functional/detection/mean_ap.py).
+
+State modes: by DEFAULT each image's detections and ground truths are
+packed into ONE fixed-width row of a reservoir table
+(``sketches/reservoir.py``) — ``det_slots`` capped detections,
+``gt_slots`` ground truths, plus the image's global arrival index, all
+flattened into ``[max_images, 1 + row_cols]`` float32.  Admission uses
+DETERMINISTIC hash-key priorities (:func:`reservoir_key` of the global
+image index, the retrieval table's ``_qid_key`` contract): the admitted
+image set is a pure function of the index set, so results are invariant
+to batch chunking, padding, and cross-rank merge order.  While
+``images_seen <= max_images`` the table holds every image in arrival
+order and ``compute()`` reproduces the unbounded list path bit-for-bit;
+past capacity it evaluates a uniform ~``max_images``-image subsample.
+``exact=True`` restores the reference's unbounded per-image lists (and
+its large-memory warning).
+
+Capacity caveats (see docs/image_detection_states.md): detections are
+capped PER IMAGE at ``det_slots`` (top scores, arrival order preserved),
+a stricter cut than the reference's per-(image, class) ``max_det`` cap —
+identical unless one image carries more than ``det_slots`` detections
+across ALL classes.  An image with more than ``gt_slots`` ground truths
+raises (raise ``gt_slots`` at construction).  Global image indices are
+stored as float32 — exact below 2**24 images.
 """
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -20,6 +43,15 @@ from metrics_tpu.functional.detection.mean_ap import (
     _summarize,
     _unpack_bool_bits,
 )
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
+from metrics_tpu.sketches.moments import moments_merge_fx
+from metrics_tpu.sketches.reservoir import (
+    detection_table_init,
+    reservoir_insert_keyed,
+    reservoir_key,
+    reservoir_merge_fx,
+)
+from metrics_tpu.utils.checks import _is_concrete
 
 Array = jax.Array
 
@@ -33,6 +65,8 @@ _BBOX_AREA_RANGES = {
     "medium": (32.0 ** 2, 96.0 ** 2),
     "large": (96.0 ** 2, 1e10),
 }
+
+_NEG_INF = -np.inf
 
 
 def _input_validator(preds: Sequence[dict], targets: Sequence[dict]) -> None:
@@ -102,12 +136,38 @@ def _to_xyxy_np(boxes: Any, box_format: str) -> np.ndarray:
     return np.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=1)  # cxcywh
 
 
+def _unique_classes(det_labels: List[np.ndarray], gt_labels: List[np.ndarray]) -> List[int]:
+    """Sorted unique class ids across detections and ground truths (map.py:329-333)."""
+    labels = list(det_labels) + list(gt_labels)
+    if not labels:
+        return []
+    cat = np.concatenate([np.asarray(l).reshape(-1) for l in labels])
+    return sorted(int(c) for c in np.unique(cat))
+
+
 class MeanAveragePrecision(Metric):
     """Computes COCO-style Mean Average Precision / Recall for object detection.
 
     Inputs are per-image dicts: predictions with ``boxes`` ``[n, 4]``,
     ``scores`` ``[n]``, ``labels`` ``[n]``; targets with ``boxes`` and
-    ``labels`` (reference map.py:271-313).
+    ``labels`` (reference map.py:271-313).  The fused/traced path instead
+    takes batched padded dicts — predictions with ``boxes [B, D, 4]``,
+    ``scores [B, D]``, ``labels [B, D]``, ``n [B]``; targets with
+    ``boxes [B, G, 4]``, ``labels [B, G]``, ``n [B]``.
+
+    Args:
+        box_format: input box layout — "xyxy", "xywh" or "cxcywh".
+        iou_thresholds / rec_thresholds / max_detection_thresholds /
+            class_metrics: the reference's evaluation grid (map.py:250-253).
+        max_images: streaming table capacity in IMAGES; lossless (bit-equal
+            to the list path) while the stream fits, a deterministic uniform
+            image subsample past it.
+        det_slots: per-image detection capacity (default: the largest
+            ``max_detection_thresholds`` entry); extra detections are
+            dropped lowest-score-first.
+        gt_slots: per-image ground-truth capacity (default ``det_slots``);
+            an image exceeding it raises.
+        exact: restore the reference's unbounded per-image list states.
 
     Example:
         >>> import jax.numpy as jnp
@@ -125,15 +185,10 @@ class MeanAveragePrecision(Metric):
         0.6000...
     """
 
-    __jit_unsafe__ = True  # ragged host-side inputs; compute() jit-dispatches internally
+    __exact_mode_attr__ = "_exact"
+    __fused_mask_valid__ = True
     is_differentiable = False
     higher_is_better = True
-
-    detection_boxes: List[Array]
-    detection_scores: List[Array]
-    detection_labels: List[Array]
-    groundtruth_boxes: List[Array]
-    groundtruth_labels: List[Array]
 
     def __init__(
         self,
@@ -142,6 +197,10 @@ class MeanAveragePrecision(Metric):
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        max_images: int = 4096,
+        det_slots: Optional[int] = None,
+        gt_slots: Optional[int] = None,
+        exact: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -164,13 +223,110 @@ class MeanAveragePrecision(Metric):
             raise ValueError("Expected argument `class_metrics` to be a boolean")
         self.class_metrics = class_metrics
 
-        self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
-        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
-        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        last_max_det = self.max_detection_thresholds[-1]
+        det_slots = last_max_det if det_slots is None else det_slots
+        gt_slots = det_slots if gt_slots is None else gt_slots
+        for name, val in (("max_images", max_images), ("det_slots", det_slots), ("gt_slots", gt_slots)):
+            if not (isinstance(val, int) and val > 0):
+                raise ValueError(f"Argument `{name}` expected to be a positive int, got {val}")
+        if det_slots < last_max_det:
+            raise ValueError(
+                f"Argument `det_slots` ({det_slots}) must cover the largest"
+                f" max_detection threshold ({last_max_det})"
+            )
+        self._det_slots = det_slots
+        self._gt_slots = gt_slots
+        self._max_images = max_images
+        # row: [global_idx, rank, n_det, n_gt, det boxes 4D, scores D,
+        #       labels D, gt boxes 4G, labels G]
+        self._row_cols = 4 + 6 * det_slots + 5 * gt_slots
 
-    def _update(self, preds: Sequence[dict], target: Sequence[dict]) -> None:
+        self._exact = bool(exact)
+        if self._exact:
+            register_exact_list_states(
+                self,
+                (
+                    "detection_boxes",
+                    "detection_scores",
+                    "detection_labels",
+                    "groundtruth_boxes",
+                    "groundtruth_labels",
+                ),
+                dist_reduce_fx=None,
+            )
+            warn_exact_buffer("MeanAveragePrecision", "detections and ground truths")
+        else:
+            self.add_state(
+                "table",
+                default=detection_table_init(max_images, self._row_cols),
+                dist_reduce_fx=reservoir_merge_fx(),
+            )
+            # moments reducer, not "sum": cross-rank reduction is the same
+            # element-wise addition, but the merge_like tag tells the fused
+            # bucketing path this leaf self-masks pad rows via n_valid — the
+            # generic k*delta pad correction would double-subtract them
+            self.add_state(
+                "images_seen", default=jnp.zeros((), jnp.int32), dist_reduce_fx=moments_merge_fx()
+            )
+
+    def _boxes_to_xyxy(self, boxes: Array) -> Array:
+        """Traced ``[..., 4]`` box-format conversion (the device counterpart
+        of :func:`_to_xyxy_np`; ``self.box_format`` is static)."""
+        if self.box_format == "xyxy":
+            return boxes
+        a, b, c, d = boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
+        if self.box_format == "xywh":
+            return jnp.stack([a, b, a + c, b + d], axis=-1)
+        return jnp.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=-1)  # cxcywh
+
+    def _pack_images_host(self, preds: Sequence[dict], target: Sequence[dict]):
+        """Canonicalize ragged list-of-dicts input into the padded batched
+        dict layout the traced tail consumes (boxes stay in ``box_format``;
+        the shared ``_boxes_to_xyxy`` converts both paths)."""
+        _input_validator(preds, target)
+        b = len(preds)
+        D, G = self._det_slots, self._gt_slots
+        d_boxes = np.zeros((b, D, 4), np.float32)
+        d_scores = np.zeros((b, D), np.float32)
+        d_labels = np.zeros((b, D), np.float32)
+        d_n = np.zeros((b,), np.int32)
+        g_boxes = np.zeros((b, G, 4), np.float32)
+        g_labels = np.zeros((b, G), np.float32)
+        g_n = np.zeros((b,), np.int32)
+        for i, (p, t) in enumerate(zip(preds, target)):
+            pb = np.asarray(p["boxes"], np.float32)
+            pb = pb.reshape(-1, 4) if pb.size else np.zeros((0, 4), np.float32)
+            ps = np.asarray(p["scores"], np.float32).reshape(-1)
+            pl = np.asarray(p["labels"], np.float32).reshape(-1)
+            nd = pb.shape[0]
+            if nd > D:
+                # keep the top-D by score, restored to arrival order (ties
+                # break low-index-first, matching the traced lax.top_k cap)
+                keep = np.sort(np.argsort(-ps, kind="stable")[:D])
+                pb, ps, pl = pb[keep], ps[keep], pl[keep]
+                nd = D
+            tb = np.asarray(t["boxes"], np.float32)
+            tb = tb.reshape(-1, 4) if tb.size else np.zeros((0, 4), np.float32)
+            tl = np.asarray(t["labels"], np.float32).reshape(-1)
+            ng = tb.shape[0]
+            if ng > G:
+                raise ValueError(
+                    f"Image {i} carries {ng} ground-truth boxes but the streaming table"
+                    f" holds {G} per image — raise `gt_slots` (or use `exact=True`)"
+                )
+            d_boxes[i, :nd] = pb
+            d_scores[i, :nd] = ps
+            d_labels[i, :nd] = pl
+            d_n[i] = nd
+            g_boxes[i, :ng] = tb
+            g_labels[i, :ng] = tl
+            g_n[i] = ng
+        return (
+            dict(boxes=d_boxes, scores=d_scores, labels=d_labels, n=d_n),
+            dict(boxes=g_boxes, labels=g_labels, n=g_n),
+        )
+
+    def _update_exact(self, preds: Sequence[dict], target: Sequence[dict]) -> None:
         _input_validator(preds, target)
 
         # states are host numpy: ragged per-image data never round-trips the
@@ -183,16 +339,144 @@ class MeanAveragePrecision(Metric):
             self.groundtruth_boxes.append(_to_xyxy_np(item["boxes"], self.box_format))
             self.groundtruth_labels.append(np.asarray(item["labels"]).reshape(-1).astype(np.int32))
 
-    def _get_classes(self) -> List[int]:
-        """Sorted unique class ids across detections and ground truths (map.py:329-333)."""
-        labels = self.detection_labels + self.groundtruth_labels
-        if not labels:
-            return []
-        cat = np.concatenate([np.asarray(l).reshape(-1) for l in labels]) if labels else np.zeros(0)
-        return sorted(int(c) for c in np.unique(cat))
+    def _update(
+        self,
+        preds: Any,
+        target: Any,
+        n_valid: Optional[Array] = None,
+    ) -> None:
+        if self._exact:
+            self._update_exact(preds, target)
+            return
+        if not isinstance(preds, dict) and _is_concrete(preds, target):
+            # ragged list-of-dicts API: validate (reference error messages)
+            # and canonicalize on host; batched padded dicts skip ahead
+            preds, target = self._pack_images_host(preds, target)
+
+        d_boxes = self._boxes_to_xyxy(jnp.asarray(preds["boxes"], jnp.float32))
+        d_scores = jnp.asarray(preds["scores"], jnp.float32)
+        d_labels = jnp.asarray(preds["labels"], jnp.float32)
+        d_n = jnp.asarray(preds["n"], jnp.int32)
+        g_boxes = self._boxes_to_xyxy(jnp.asarray(target["boxes"], jnp.float32))
+        g_labels = jnp.asarray(target["labels"], jnp.float32)
+        g_n = jnp.asarray(target["n"], jnp.int32)
+
+        b, d_in = d_scores.shape
+        if b == 0:
+            return
+        g_in = g_labels.shape[1]
+        if g_in > self._gt_slots:
+            raise ValueError(
+                f"got {g_in} ground-truth slots but the streaming table holds"
+                f" {self._gt_slots} per image — raise `gt_slots`"
+            )
+        if d_in > self._det_slots:
+            # per-image cap: keep the top-det_slots valid scores, restored
+            # to arrival order (sorted kept indices)
+            slot = jnp.arange(d_in, dtype=jnp.int32)
+            masked = jnp.where(slot[None, :] < d_n[:, None], d_scores, -jnp.inf)
+            _, idx = jax.lax.top_k(masked, self._det_slots)
+            idx = jnp.sort(idx, axis=1)
+            d_boxes = jnp.take_along_axis(d_boxes, idx[:, :, None], axis=1)
+            d_scores = jnp.take_along_axis(d_scores, idx, axis=1)
+            d_labels = jnp.take_along_axis(d_labels, idx, axis=1)
+            d_n = jnp.minimum(d_n, self._det_slots)
+            d_in = self._det_slots
+
+        # zero dead slots so admitted rows are bit-deterministic, then pad
+        # the slot axes up to the table's static capacity
+        d_live = jnp.arange(d_in, dtype=jnp.int32)[None, :] < d_n[:, None]
+        d_boxes = jnp.where(d_live[:, :, None], d_boxes, 0.0)
+        d_scores = jnp.where(d_live, d_scores, 0.0)
+        d_labels = jnp.where(d_live, d_labels, 0.0)
+        g_live = jnp.arange(g_in, dtype=jnp.int32)[None, :] < g_n[:, None]
+        g_boxes = jnp.where(g_live[:, :, None], g_boxes, 0.0)
+        g_labels = jnp.where(g_live, g_labels, 0.0)
+        dpad = self._det_slots - d_in
+        gpad = self._gt_slots - g_in
+        if dpad:
+            d_boxes = jnp.pad(d_boxes, ((0, 0), (0, dpad), (0, 0)))
+            d_scores = jnp.pad(d_scores, ((0, 0), (0, dpad)))
+            d_labels = jnp.pad(d_labels, ((0, 0), (0, dpad)))
+        if gpad:
+            g_boxes = jnp.pad(g_boxes, ((0, 0), (0, gpad), (0, 0)))
+            g_labels = jnp.pad(g_labels, ((0, 0), (0, gpad)))
+
+        # hash-key admission over global image indices: pad rows (masked by
+        # n_valid) advance neither the index cursor nor the table. The
+        # process index joins the hash input (KID's seed-folding idiom) so
+        # ranks holding the same local indices draw decorrelated priorities.
+        valid = jnp.arange(b) < n_valid if n_valid is not None else jnp.ones((b,), bool)
+        global_idx = self.images_seen + jnp.cumsum(valid.astype(jnp.int32)) - 1
+        rank = jax.process_index()
+        keys = reservoir_key(jnp.asarray(global_idx, jnp.uint32) + jnp.uint32(rank) * jnp.uint32(1 << 24))
+        rows = jnp.concatenate(
+            [
+                global_idx.astype(jnp.float32)[:, None],
+                jnp.full((b, 1), rank, jnp.float32),
+                d_n.astype(jnp.float32)[:, None],
+                g_n.astype(jnp.float32)[:, None],
+                d_boxes.reshape(b, -1),
+                d_scores,
+                d_labels,
+                g_boxes.reshape(b, -1),
+                g_labels,
+            ],
+            axis=1,
+        )
+        self.table = reservoir_insert_keyed(self.table, rows, keys, n_valid=n_valid)
+        self.images_seen = self.images_seen + jnp.sum(valid.astype(jnp.int32))
 
     def _compute(self) -> Dict[str, Array]:
-        classes = self._get_classes()
+        if self._exact:
+            return self._compute_from_lists(
+                self.detection_boxes,
+                self.detection_scores,
+                self.detection_labels,
+                self.groundtruth_boxes,
+                self.groundtruth_labels,
+            )
+
+        # unpack admitted table rows back into per-image host lists, in
+        # rank-major arrival order — the reference's DDP gather order —
+        # (bit-equal to the list path while lossless)
+        leaf = np.asarray(self.table)  # tracelint: disable=TL-TRACE — compute() IS the host COCO pipeline; only _update runs under the fused trace
+        rows = leaf[leaf[:, 0] > _NEG_INF, 1:]
+        rows = rows[np.lexsort((rows[:, 0], rows[:, 1]))]
+        D, G = self._det_slots, self._gt_slots
+        n = rows.shape[0]
+        nd = rows[:, 2].astype(np.int32)
+        ng = rows[:, 3].astype(np.int32)
+        # whole-matrix slices + casts (one pass over the leaf), then cheap
+        # per-image views — a per-row python unpack would dominate compute()
+        # at serving scale
+        off = 4
+        db = rows[:, off : off + 4 * D].astype(np.float32).reshape(n, D, 4)
+        off += 4 * D
+        ds = rows[:, off : off + D].astype(np.float32)
+        off += D
+        dl = rows[:, off : off + D].astype(np.int32)
+        off += D
+        gb = rows[:, off : off + 4 * G].astype(np.float32).reshape(n, G, 4)
+        off += 4 * G
+        gl = rows[:, off : off + G].astype(np.int32)
+        return self._compute_from_lists(
+            [db[i, : nd[i]] for i in range(n)],
+            [ds[i, : nd[i]] for i in range(n)],
+            [dl[i, : nd[i]] for i in range(n)],
+            [gb[i, : ng[i]] for i in range(n)],
+            [gl[i, : ng[i]] for i in range(n)],
+        )
+
+    def _compute_from_lists(
+        self,
+        det_boxes: List[np.ndarray],
+        det_scores: List[np.ndarray],
+        det_labels: List[np.ndarray],
+        gt_boxes: List[np.ndarray],
+        gt_labels: List[np.ndarray],
+    ) -> Dict[str, Array]:
+        classes = _unique_classes(det_labels, gt_labels)
         num_classes = len(classes)
         area_ranges = list(self.bbox_area_ranges.values())
         num_areas = len(area_ranges)
@@ -202,11 +486,11 @@ class MeanAveragePrecision(Metric):
         last_max_det = self.max_detection_thresholds[-1]
 
         packed = _pack_units(
-            [np.asarray(b) for b in self.detection_boxes],
-            [np.asarray(s, np.float64) for s in self.detection_scores],
-            [np.asarray(l) for l in self.detection_labels],
-            [np.asarray(b) for b in self.groundtruth_boxes],
-            [np.asarray(l) for l in self.groundtruth_labels],
+            [np.asarray(b) for b in det_boxes],
+            [np.asarray(s, np.float64) for s in det_scores],
+            [np.asarray(l) for l in det_labels],
+            [np.asarray(b) for b in gt_boxes],
+            [np.asarray(l) for l in gt_labels],
             classes,
             last_max_det,
         )

@@ -3,24 +3,60 @@
 Behavior parity with /root/reference/torchmetrics/image/inception.py:28-171.
 ``feature`` accepts any callable ``imgs -> [N, num_classes]`` logits
 extractor or 'logits_unbiased'/int for the bundled Flax InceptionV3.
+
+State modes: by DEFAULT the metric streams exact per-split sufficient
+statistics — softmax-probability sums ``[splits, C]``, per-sample
+``Σ_c p log p`` sums ``[splits]``, and per-split counts — because each
+split's KL term depends on its samples only through those moments:
+
+    ``kl_k = plogp_sum_k / n_k − Σ_c m_c log m_c``,  ``m = prob_sum_k / n_k``
+
+Samples land in splits ROUND-ROBIN by arrival index (deterministic,
+chunking-invariant) instead of the reference's host-RNG shuffle-then-
+contiguous-split; with i.i.d. streams the split populations are
+exchangeable either way, but per-value parity requires ``exact=True``,
+which restores the reference's unbounded feature list and shuffle
+bit-for-bit (see docs/differences.md). Moment leaves are
+``moments_merge_fx()``-reduced: element-wise summable, so cross-rank
+merge is addition and the fused bucketing path masks pad rows via
+``n_valid`` (``__fused_mask_valid__``) — pad rows never touch the
+round-robin cursor, keeping the assignment identical to the unpadded
+stream.
 """
-from typing import Any, Callable, Tuple, Union
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.sketches.compat import register_exact_list_states, warn_exact_buffer
+from metrics_tpu.sketches.moments import moments_merge_fx
 from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
 
 class InceptionScore(Metric):
-    """Computes the Inception Score (mean and std over splits)."""
+    """Computes the Inception Score (mean and std over splits).
 
-    __jit_unsafe__ = True
+    Args:
+        feature: 'logits_unbiased' / int depth for the bundled Flax
+            InceptionV3, or any callable ``imgs -> [N, num_classes]``.
+        splits: number of KL splits (reference default 10).
+        seed: host RNG seed for the ``exact=True`` shuffle (unused by the
+            streaming default, whose round-robin assignment is
+            deterministic).
+        num_classes: logits width ``C`` for callable extractors (ignored
+            otherwise; 'logits_unbiased' emits 1008, an int depth emits
+            itself); default 1008.
+        exact: restore the reference's unbounded feature list and
+            shuffle-then-split behavior (bit-for-bit legacy path).
+    """
+
+    __exact_mode_attr__ = "_exact"
+    __traced_callable_attrs__ = ("inception",)
+    __fused_mask_valid__ = True
     is_differentiable = False
     higher_is_better = True
 
@@ -30,15 +66,11 @@ class InceptionScore(Metric):
         splits: int = 10,
         seed: int = None,
         feature_extractor_weights_path: str = None,
+        num_classes: Optional[int] = None,
+        exact: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-
-        rank_zero_warn(
-            "Metric `InceptionScore` will save all extracted features in buffer."
-            " For large datasets this may lead to large memory footprint.",
-            UserWarning,
-        )
 
         if isinstance(feature, (str, int)):
             valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
@@ -49,21 +81,77 @@ class InceptionScore(Metric):
             from metrics_tpu.models.inception import build_fid_inception
 
             self.inception = build_fid_inception(feature, feature_extractor_weights_path)
+            num_classes = 1008 if feature == "logits_unbiased" else feature
         elif callable(feature):
             self.inception = feature
+            num_classes = 1008 if num_classes is None else num_classes
         else:
             raise TypeError("Got unknown input to argument `feature`")
+        if not (isinstance(num_classes, int) and num_classes > 0):
+            raise ValueError(f"Argument `num_classes` expected to be a positive int, got {num_classes}")
+        self._num_classes = num_classes
 
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError(f"Argument `splits` expected to be a positive int, got {splits}")
         self.splits = splits
         self._rng = np.random.RandomState(seed)
-        self.add_state("features", [], dist_reduce_fx=None)
 
-    def _update(self, imgs: Array) -> None:
+        self._exact = bool(exact)
+        if self._exact:
+            register_exact_list_states(self, ("features",), dist_reduce_fx=None)
+            warn_exact_buffer("InceptionScore", "extracted features")
+        else:
+            self.add_state(
+                "prob_sum",
+                default=jnp.zeros((splits, num_classes), jnp.float32),
+                dist_reduce_fx=moments_merge_fx(),
+            )
+            self.add_state(
+                "plogp_sum",
+                default=jnp.zeros((splits,), jnp.float32),
+                dist_reduce_fx=moments_merge_fx(),
+            )
+            self.add_state(
+                "split_count",
+                default=jnp.zeros((splits,), jnp.float32),
+                dist_reduce_fx=moments_merge_fx(),
+            )
+
+    def _update(self, imgs: Array, n_valid: Optional[Array] = None) -> None:
         features = self.inception(imgs)
-        self.features.append(features)
+        if self._exact:
+            self.features.append(features)
+            return
+        logits = jnp.asarray(features, jnp.float32)
+        if logits.shape[-1] != self._num_classes:
+            raise ValueError(
+                f"Extractor emitted logits of width {logits.shape[-1]} but the streaming"
+                f" split state was sized for num_classes={self._num_classes} — pass the"
+                " extractor's true width via `num_classes` (or use `exact=True`)."
+            )
+        prob = jax.nn.softmax(logits, axis=1)
+        log_prob = jax.nn.log_softmax(logits, axis=1)
+        plogp = jnp.sum(prob * log_prob, axis=1)  # [B]
 
-    def _compute(self) -> Tuple[Array, Array]:
-        getattr(self.inception, "finalize", lambda: None)()  # flush async range check of the last batch
+        b = logits.shape[0]
+        row = jnp.arange(b, dtype=jnp.int32)
+        valid = row < n_valid if n_valid is not None else jnp.ones((b,), bool)
+        # round-robin split assignment by global arrival index; pad rows
+        # (masked by n_valid) neither land anywhere nor advance the cursor
+        cursor = jnp.sum(self.split_count).astype(jnp.int32)
+        arrival = cursor + jnp.cumsum(valid.astype(jnp.int32)) - 1
+        assign = jnp.where(valid, arrival % self.splits, self.splits)
+        onehot = (assign[:, None] == jnp.arange(self.splits)[None, :]).astype(jnp.float32)
+
+        self.prob_sum = self.prob_sum + jnp.matmul(
+            onehot.T, prob, precision=jax.lax.Precision.HIGHEST
+        )
+        self.plogp_sum = self.plogp_sum + jnp.matmul(
+            onehot.T, plogp, precision=jax.lax.Precision.HIGHEST
+        )
+        self.split_count = self.split_count + jnp.sum(onehot, axis=0)
+
+    def _compute_exact(self) -> Tuple[Array, Array]:
         features = dim_zero_cat(self.features)
         idx = self._rng.permutation(features.shape[0])
         features = features[idx]
@@ -80,4 +168,15 @@ class InceptionScore(Metric):
             kl = p * (log_p - jnp.log(m_p))
             kl_.append(jnp.exp(jnp.mean(jnp.sum(kl, axis=1))))
         kl = jnp.stack(kl_)
+        return jnp.mean(kl), jnp.std(kl, ddof=1)
+
+    def _compute(self) -> Tuple[Array, Array]:
+        getattr(self.inception, "finalize", lambda: None)()  # flush async range check of the last batch
+        if self._exact:
+            return self._compute_exact()
+
+        n = jnp.maximum(self.split_count, 1.0)  # [S]
+        marginal = self.prob_sum / n[:, None]  # [S, C]
+        cross = jnp.sum(marginal * jnp.log(jnp.clip(marginal, 1e-38, None)), axis=1)
+        kl = jnp.exp(self.plogp_sum / n - cross)  # [S]
         return jnp.mean(kl), jnp.std(kl, ddof=1)

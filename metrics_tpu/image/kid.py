@@ -68,10 +68,13 @@ def poly_mmd(
 class KernelInceptionDistance(Metric):
     """Computes KID (mean and std of polynomial MMD over random subsets)."""
 
-    #: the feature extractor is an arbitrary host callable (Flax model or
-    #: user function) — the update cannot be traced whatever the state mode
+    #: stays eager even though the bundled extractor is traced-pure (the
+    #: declaration below): the reservoir width is discovered lazily from
+    #: the first feature batch (`_init_reservoirs`) and compute() draws
+    #: its MMD subsets with host RNG — see docs/differences.md
     __jit_unsafe__ = True
     __exact_mode_attr__ = "_exact"
+    __traced_callable_attrs__ = ("inception",)
     is_differentiable = False
     higher_is_better = False
 

@@ -11,9 +11,11 @@ Registered ops: ``box_iou`` (tiled pairwise/batched IoU), ``bincount`` /
 ``segment_sum`` (the tiled one-hot MXU scatter serving confusion-matrix
 metrics and the ``SlicedMetric`` slice axis), ``segment_max`` /
 ``segment_min`` (the masked-select extremum scatter), ``qsketch_compact``
-(the fused sort->bucket->segment-merge t-digest compaction), and
+(the fused sort->bucket->segment-merge t-digest compaction),
 ``row_topk`` (the fused per-row top-k + payload gather behind the
-retrieval table's compaction and merge). See docs/ops_kernels.md.
+retrieval table's compaction and merge), and ``trace_sqrtm`` (the
+jnp-only Newton–Schulz ``tr((Σ₁Σ₂)^{1/2})`` behind streaming FID's
+device-side compute). See docs/ops_kernels.md.
 """
 from metrics_tpu.ops.dispatch import (  # noqa: F401
     NO_PALLAS_ENV,
@@ -43,3 +45,7 @@ from metrics_tpu.ops.topk_pallas import (  # noqa: F401
     row_topk_tiled,
 )
 from metrics_tpu.ops.box_iou_pallas import box_iou_dispatch, box_iou_tiled  # noqa: F401
+from metrics_tpu.ops.sqrtm import (  # noqa: F401
+    NEWTON_SCHULZ_ITERS,
+    trace_sqrtm_dispatch,
+)

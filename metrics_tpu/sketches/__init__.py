@@ -13,12 +13,17 @@ Three families:
 * :mod:`.quantile` — mergeable weighted quantile/stream sketch (packed
   ``[capacity, 2+P]`` leaf, pair-collapse compaction). Powers the sketched
   threshold curves (AUROC / ROC / PRC / AveragePrecision).
-* :mod:`.reservoir` — Gumbel-key weighted reservoir (``[k, 1+P]`` leaf,
-  top-k replacement). Powers KID subset selection.
+* :mod:`.reservoir` — weighted reservoir (``[k, 1+P]`` leaf, top-k
+  replacement) with counter-seeded Gumbel or deterministic hash-key
+  priorities. Powers KID subset selection and the detection mAP
+  per-image matching table.
 * :mod:`.histogram` — static-edge weighted histogram (exact sufficient
   statistics for binned metrics). Powers CalibrationError.
 * :mod:`.rank` — (pred, target) quantile sketch + weighted midrank
   Spearman, for streaming SpearmanCorrCoef.
+* :mod:`.moments` — exact streaming sum / outer-product-sum / count
+  leaves (element-wise summable; cross-rank merge is addition). Powers
+  streaming FID and InceptionScore.
 
 See ``docs/sketch_states.md`` for the accuracy contract, the lossless
 window, capacity tuning, and the mergeability story.
@@ -47,10 +52,19 @@ from .rank import (
     ranksketch_merge_fx,
     ranksketch_spearman,
 )
+from .moments import (
+    mean_cov_from_moments,
+    moments_init,
+    moments_merge_fx,
+    moments_update,
+)
 from .reservoir import (
+    detection_table_init,
     reservoir_fill,
     reservoir_init,
     reservoir_insert,
+    reservoir_insert_keyed,
+    reservoir_key,
     reservoir_merge,
     reservoir_merge_fx,
     reservoir_rows,
@@ -59,10 +73,15 @@ from .compat import register_exact_list_states, warn_exact_buffer
 
 __all__ = [
     "QSKETCH_RANK_EPS",
+    "detection_table_init",
     "hist_bin_index",
     "hist_init",
     "hist_insert",
     "hist_merge",
+    "mean_cov_from_moments",
+    "moments_init",
+    "moments_merge_fx",
+    "moments_update",
     "qsketch_absorb_rows",
     "qsketch_cdf",
     "qsketch_fill",
@@ -84,6 +103,8 @@ __all__ = [
     "reservoir_fill",
     "reservoir_init",
     "reservoir_insert",
+    "reservoir_insert_keyed",
+    "reservoir_key",
     "reservoir_merge",
     "reservoir_merge_fx",
     "reservoir_rows",

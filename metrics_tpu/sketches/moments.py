@@ -1,0 +1,91 @@
+"""Exact streaming moments: sum / outer-product-sum / count leaves.
+
+The FID trick (and the general one behind every "cat-state that only
+feeds a mean + covariance"): the Gaussian fit in ``compute()`` depends on
+the features ONLY through
+
+    ``feat_sum  = Σ x_i``             ``[d]``
+    ``outer_sum = Σ x_i x_iᵀ``        ``[d, d]``
+    ``count     = N``                 scalar
+
+so a fixed-capacity state of those three leaves is EXACT forever — no
+window, no admission policy, no accuracy knob. Unlike the packed sketch
+leaves (quantile/reservoir), moment leaves are element-wise summable:
+the cross-rank merge IS addition, batches commute, and the fused
+bucketing path needs no pad correction beyond masking pad rows out of
+the per-batch delta.
+
+``moments_merge_fx()`` tags such leaves for the merge plumbing
+(``merge_like`` so ``merge_states`` folds stacked per-rank leaves through
+the reducer, ``sketch_kind = "moments"`` so occupancy telemetry knows
+there is no fill ratio to report) while the tracelint ``moments``
+reducer teaching holds them to the full additive write contract.
+
+Numerics: accumulate in float32 on device. ``Σ x x ᵀ`` loses precision to
+cancellation when ``‖μ‖ ≫ σ`` — for InceptionV3 pool features (entries
+``O(1)``, N ≤ 1e6) the covariance identity stays well within float32 for
+FID purposes; the ``exact=True`` hatch keeps the float64 host path for
+certification runs. See ``docs/image_detection_states.md``.
+"""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def moments_init(dim: int) -> tuple:
+    """Fresh ``(feat_sum [dim], outer_sum [dim, dim], count)`` leaves."""
+    if not (isinstance(dim, int) and dim > 0):
+        raise ValueError(f"feature dim must be a positive int, got {dim}")
+    return (
+        jnp.zeros((dim,), jnp.float32),
+        jnp.zeros((dim, dim), jnp.float32),
+        jnp.zeros((), jnp.float32),
+    )
+
+
+def moments_update(
+    feat_sum: Array, outer_sum: Array, count: Array, feats: Array
+) -> tuple:
+    """Fold a ``[B, d]`` feature batch into the three moment leaves."""
+    feats = jnp.asarray(feats, jnp.float32)
+    return (
+        feat_sum + jnp.sum(feats, axis=0),
+        outer_sum + feats.T @ feats,
+        count + feats.shape[0],
+    )
+
+
+def mean_cov_from_moments(
+    feat_sum: Array, outer_sum: Array, count: Array
+) -> tuple:
+    """``(mean [d], unbiased covariance [d, d])`` via the covariance
+    identity ``cov = (Σxxᵀ − N μμᵀ) / (N − 1)`` — the same estimator the
+    cat-state path computes from raw features."""
+    n = jnp.maximum(count, 1.0)
+    mean = feat_sum / n
+    cov = (outer_sum - n * jnp.outer(mean, mean)) / jnp.maximum(n - 1.0, 1.0)
+    return mean, cov
+
+
+class _MomentsReduce:
+    """``dist_reduce_fx`` summing stacked per-rank moment leaves
+    ``[world, ...] -> [...]`` — tagged ``merge_like`` so the merge
+    plumbing routes it like the sketch reducers, but the merge itself is
+    plain addition (moment leaves are element-wise summable)."""
+
+    merge_like = True
+    sketch_kind = "moments"
+    __name__ = "moments_reduce"
+
+    def __call__(self, stacked: Array) -> Array:
+        return jnp.sum(jnp.asarray(stacked), axis=0)
+
+
+_MOMENTS_REDUCE = _MomentsReduce()
+
+
+def moments_merge_fx() -> _MomentsReduce:
+    """The shared streaming-moment ``dist_reduce_fx`` (see
+    :class:`_MomentsReduce`)."""
+    return _MOMENTS_REDUCE

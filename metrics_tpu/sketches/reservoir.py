@@ -110,6 +110,62 @@ def reservoir_insert(
     return out
 
 
+def reservoir_key(ids: Array) -> Array:
+    """Deterministic hash priority in ``(0, 1]`` from integer ids.
+
+    The same avalanche mix as the retrieval table's ``_qid_key``
+    (retrieval/table.py): the priority is a PURE FUNCTION of the global
+    id, so admission decisions are invariant to batch chunking, padding,
+    and cross-rank merge order — the surviving id set under any
+    partitioning of the stream is exactly the top-``k`` ids by hash.
+    Compare :func:`reservoir_insert`'s counter-seeded Gumbel draw, whose
+    priorities depend on how the stream was batched.
+    """
+    x = jnp.asarray(ids, jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    # top 24 bits -> (0, 1]: exactly representable in f32, never -inf/0
+    return ((x >> 8).astype(jnp.float32) + 1.0) / float(1 << 24)
+
+
+def reservoir_insert_keyed(
+    reservoir: Array,
+    payload: Array,
+    keys: Array,
+    n_valid: Optional[Array] = None,
+) -> Array:
+    """Insert ``[B, payload_cols]`` rows with CALLER-SUPPLIED priorities.
+
+    The deterministic-key counterpart of :func:`reservoir_insert`: the
+    caller derives each row's priority from a stable identity (e.g.
+    :func:`reservoir_key` of a global arrival index), making the admitted
+    set independent of batching. ``n_valid`` masks trailing pad rows to
+    ``-inf`` priority (the fused pad-and-mask contract).
+    """
+    payload = jnp.asarray(payload, jnp.float32)
+    payload = payload.reshape(payload.shape[0], -1)
+    b = payload.shape[0]
+    if payload.shape[1] != reservoir.shape[1] - 1:
+        raise ValueError(
+            f"payload has {payload.shape[1]} column(s) but the reservoir was initialized"
+            f" with {reservoir.shape[1] - 1}"
+        )
+    if b == 0:
+        return reservoir
+    pri = jnp.asarray(keys, jnp.float32).reshape(-1)
+    if pri.shape[0] != b:
+        raise ValueError(f"got {pri.shape[0]} key(s) for {b} payload row(s)")
+    if n_valid is not None:
+        pri = jnp.where(jnp.arange(b) < n_valid, pri, _EMPTY)
+    rows = jnp.concatenate([pri[:, None], payload], axis=1)
+    k = reservoir.shape[0]
+    out = reservoir
+    for lo in range(0, b, k):
+        out = _select(jnp.concatenate([out, rows[lo : lo + k]], axis=0), k)
+    return out
+
+
 def reservoir_merge(a: Array, b: Array) -> Array:
     """Merge two reservoirs (top-``k`` of the union by priority); the
     ``dist_reduce_fx`` operation. Exact (no row lost) while the combined
@@ -149,6 +205,20 @@ _RESERVOIR_REDUCE = _ReservoirReduce()
 def reservoir_merge_fx() -> _ReservoirReduce:
     """The shared reservoir ``dist_reduce_fx`` (see :class:`_ReservoirReduce`)."""
     return _RESERVOIR_REDUCE
+
+
+def detection_table_init(max_images: int, row_cols: int) -> Array:
+    """Detection matching table: a reservoir of PER-IMAGE packed rows.
+
+    ``detection/mean_ap.py`` flattens each image's capped detection and
+    ground-truth slots into one ``[row_cols]`` payload row and admits
+    images through the standard reservoir contract: lossless (arrival
+    order preserved) while ``images_seen <= max_images``, deterministic
+    counter-seeded uniform subsampling past that. Same leaf layout as
+    :func:`reservoir_init` — the alias exists so the state registration
+    (and the interp ctor teaching) names the capacity model it implements.
+    """
+    return reservoir_init(max_images, row_cols)
 
 
 def reservoir_fill(reservoir: Array) -> Array:

@@ -116,6 +116,17 @@ AUX_FIELDS: Dict[str, str] = {
     # budget rule meters got silently heavier
     "memory_plane_on_ratio": "higher",
     "bytes_per_tenant": "lower",
+    # the image/detection state bench (``image_detection_throughput``,
+    # ISSUE 19): the end-to-end fused-table-over-eager-list mAP wall ratio
+    # (acceptance floor 5x — the anchor is set so the 10% tolerance lands
+    # the gate there), the streaming-FID-over-cat-state footprint fraction
+    # at a 1e5-feature stream (acceptance ceiling 0.05 — the moment state
+    # is O(d^2) forever, growth means a state leaf regressed to O(N)), and
+    # the device Newton-Schulz trace-sqrtm's absolute error vs the host
+    # f64 eigh oracle (a broken iteration errs at O(1), not O(1e-3))
+    "map_fused_vs_eager": "higher",
+    "fid_state_bytes_frac": "lower",
+    "newton_schulz_abs_err": "lower",
 }
 
 #: boolean invariants gated whenever the CURRENT record carries them — a
@@ -169,6 +180,15 @@ BOOL_FIELDS: Tuple[str, ...] = (
     # breaks every budget/leak alarm built on it
     "ledger_matches_backend",
     "unaccounted_non_growing",
+    # image/detection streaming-state parity (ISSUE 19): streaming mAP
+    # compute() must equal the exact=True list path on every result key
+    # inside the capacity window, and the streaming FID moment leaves must
+    # be bit-identical to f64 oracle sums cast to f32 on dyadic features
+    # (every sum exactly representable — a false bit is an update-path
+    # bug, not float noise); fused-vs-eager state equality rides the
+    # existing states_bit_identical field
+    "map_window_bit_exact",
+    "fid_identity_bit_exact",
 )
 
 

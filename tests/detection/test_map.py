@@ -305,7 +305,16 @@ def test_empty_metric():
 
 
 def test_reset_clears_state():
+    # streaming default: the table empties and the index cursor rewinds
     metric = MeanAveragePrecision()
+    metric.update([_as_jnp(p) for p in _PREDS[0]], [_as_jnp(t) for t in _TARGET[0]])
+    metric.reset()
+    assert int(metric.images_seen) == 0
+    assert not bool(jnp.any(metric.table[:, 0] > -jnp.inf))
+    assert float(metric.compute()["map"]) == -1.0
+
+    # exact mode: the reference's list states empty
+    metric = MeanAveragePrecision(exact=True)
     metric.update([_as_jnp(p) for p in _PREDS[0]], [_as_jnp(t) for t in _TARGET[0]])
     metric.reset()
     assert metric.detection_boxes == []
@@ -369,7 +378,7 @@ def test_box_ops():
 
 
 # ---------------------------------------------------------------------------
-# distributed sync over the five list states (VERDICT r2 weak #6)
+# distributed sync over the five list states (exact mode; VERDICT r2 weak #6)
 # ---------------------------------------------------------------------------
 
 
@@ -402,13 +411,13 @@ def test_map_ddp_two_rank_union():
     preds_r1 = [_random_sample(rng) for _ in range(n_per_rank)]
     target_r1 = [_random_sample(rng, with_scores=False) for _ in range(n_per_rank)]
 
-    rank1 = MeanAveragePrecision()
+    rank1 = MeanAveragePrecision(exact=True)
     rank1.update(preds_r1, target_r1)
 
-    rank0 = MeanAveragePrecision(dist_sync_fn=_elementwise_gather_from(rank1))
+    rank0 = MeanAveragePrecision(exact=True, dist_sync_fn=_elementwise_gather_from(rank1))
     rank0.update(preds_r0, target_r0)
 
-    union = MeanAveragePrecision()
+    union = MeanAveragePrecision(exact=True)
     union.update(preds_r0 + preds_r1, target_r0 + target_r1)
 
     synced = rank0.compute()
@@ -420,7 +429,7 @@ def test_map_ddp_two_rank_union():
 
     # local (pre-sync) state must be restored after compute's sync context
     assert len(rank0.detection_boxes) == n_per_rank
-    r0_local = MeanAveragePrecision()
+    r0_local = MeanAveragePrecision(exact=True)
     r0_local.update(preds_r0, target_r0)
     local_after = rank0._compute()
     local_expected = r0_local.compute()
@@ -437,10 +446,10 @@ def test_map_sync_unsync_state_machine():
     preds = [_random_sample(rng) for _ in range(3)]
     target = [_random_sample(rng, with_scores=False) for _ in range(3)]
 
-    other = MeanAveragePrecision()
+    other = MeanAveragePrecision(exact=True)
     other.update(preds, target)
 
-    m = MeanAveragePrecision()
+    m = MeanAveragePrecision(exact=True)
     m.update(preds, target)
     m.sync(dist_sync_fn=_elementwise_gather_from(other), distributed_available=lambda: True)
     assert len(m.detection_boxes) == 6  # 3 local + 3 gathered

@@ -1,0 +1,338 @@
+"""Fixed-capacity per-image detection table (detection/mean_ap.py) vs the
+``exact=True`` list-state path — the detection mirror of
+tests/retrieval/test_retrieval_table.py.
+
+The contract under test (docs/image_detection_states.md):
+
+* **In-window parity** — every image fits its ``det_slots``/``gt_slots``
+  and the stream fits ``max_images``: compute() is bit-identical to the
+  exact path on every result key (the table stores the full payload, and
+  unpacking replays arrival order).
+* **Reservoir determinism** — the admitted image set past ``max_images``
+  is a pure function of the global image indices (deterministic hash
+  keys): batch chunking never moves it, and admitted rows hold the
+  COMPLETE per-image payload, so compute() equals the exact metric run
+  over exactly the admitted images.
+* **Capacity policy** — detections above ``det_slots`` truncate to the
+  score top-k (ties to the lower index, matching `lax.top_k`); ground
+  truths above ``gt_slots`` raise (silent GT truncation would bias
+  recall).
+* **Composition** — fused single-dispatch, ragged-shape bucketing (one
+  compile), async ingest, and the 8-device mesh merge round all produce
+  the same states as eager updates.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.detection import MeanAveragePrecision
+
+# ---------------------------------------------------------------------------
+# data helpers
+# ---------------------------------------------------------------------------
+
+_NEG_INF = -np.inf
+
+
+def _rand_images(rng, n_images, max_det=4, max_gt=4, n_cls=3, grid=6.0):
+    """Images whose boxes sit on a coarse grid with jitter, so detections
+    genuinely overlap ground truths and the PR grids are non-trivial."""
+    out = []
+    for _ in range(n_images):
+        nd = int(rng.randint(0, max_det + 1))
+        ng = int(rng.randint(1, max_gt + 1))
+
+        def boxes(k):
+            xy = rng.randint(0, 4, (k, 2)).astype(np.float64) * grid + rng.rand(k, 2)
+            wh = 4.0 + rng.rand(k, 2) * 4.0
+            return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+        out.append(
+            (
+                dict(
+                    boxes=boxes(nd),
+                    scores=rng.rand(nd).astype(np.float32),
+                    labels=rng.randint(0, n_cls, nd).astype(np.int32),
+                ),
+                dict(boxes=boxes(ng), labels=rng.randint(0, n_cls, ng).astype(np.int32)),
+            )
+        )
+    return out
+
+
+def _as_lists(images):
+    preds = [{k: jnp.asarray(v) for k, v in p.items()} for p, _ in images]
+    target = [{k: jnp.asarray(v) for k, v in t.items()} for _, t in images]
+    return preds, target
+
+
+def _as_padded(images, det_slots, gt_slots):
+    """The padded dict batch a fused/jitted pipeline feeds directly."""
+    n = len(images)
+    pb = np.zeros((n, det_slots, 4), np.float32)
+    ps = np.zeros((n, det_slots), np.float32)
+    pl = np.zeros((n, det_slots), np.int32)
+    pn = np.zeros((n,), np.int32)
+    gb = np.zeros((n, gt_slots, 4), np.float32)
+    gl = np.zeros((n, gt_slots), np.int32)
+    gn = np.zeros((n,), np.int32)
+    for i, (p, t) in enumerate(images):
+        nd, ng = len(p["scores"]), len(t["labels"])
+        pb[i, :nd], ps[i, :nd], pl[i, :nd], pn[i] = p["boxes"], p["scores"], p["labels"], nd
+        gb[i, :ng], gl[i, :ng], gn[i] = t["boxes"], t["labels"], ng
+    preds = dict(boxes=jnp.asarray(pb), scores=jnp.asarray(ps), labels=jnp.asarray(pl), n=jnp.asarray(pn))
+    target = dict(boxes=jnp.asarray(gb), labels=jnp.asarray(gl), n=jnp.asarray(gn))
+    return preds, target
+
+
+def _exact_map(**kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return MeanAveragePrecision(exact=True, **kw)
+
+
+def _results_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[k]).ravel(), np.asarray(b[k]).ravel(), err_msg=k
+        )
+
+
+def _admitted(table):
+    """(global_idx, n_det, n_gt) for the live rows, arrival-sorted."""
+    leaf = np.asarray(table)
+    rows = leaf[leaf[:, 0] > _NEG_INF]
+    rows = rows[np.lexsort((rows[:, 1], rows[:, 2]))]
+    return rows[:, 1].astype(int), rows[:, 3].astype(int), rows[:, 4].astype(int)
+
+
+# ---------------------------------------------------------------------------
+# in-window parity
+# ---------------------------------------------------------------------------
+
+
+def test_in_window_bit_parity_with_exact():
+    rng = np.random.RandomState(0)
+    images = _rand_images(rng, 18)
+    streaming = MeanAveragePrecision()
+    exact = _exact_map()
+    for lo in (0, 6, 12):
+        p, t = _as_lists(images[lo : lo + 6])
+        streaming.update(p, t)
+        exact.update(p, t)
+    _results_equal(streaming.compute(), exact.compute())
+
+
+def test_xywh_format_in_window_parity():
+    rng = np.random.RandomState(1)
+    images = _rand_images(rng, 8)
+    # re-express the xyxy helper boxes as xywh
+    for p, t in images:
+        for d in (p, t):
+            d["boxes"] = np.concatenate(
+                [d["boxes"][:, :2], d["boxes"][:, 2:] - d["boxes"][:, :2]], axis=1
+            )
+    streaming = MeanAveragePrecision(box_format="xywh")
+    exact = _exact_map(box_format="xywh")
+    p, t = _as_lists(images)
+    streaming.update(p, t)
+    exact.update(p, t)
+    _results_equal(streaming.compute(), exact.compute())
+
+
+def test_chunking_invariance_is_bitwise():
+    """Identical stream, different batch splits: the table leaf itself is
+    bit-identical (hash keys depend only on the global image index)."""
+    rng = np.random.RandomState(2)
+    images = _rand_images(rng, 24)
+
+    def run(*cuts):
+        m = MeanAveragePrecision(max_images=16)  # past capacity: 24 > 16
+        lo = 0
+        for hi in (*cuts, len(images)):
+            p, t = _as_lists(images[lo:hi])
+            m.update(p, t)
+            lo = hi
+        return m
+
+    a, b, c = run(12), run(5, 9, 17), run(1, 2, 3, 23)
+    assert jnp.array_equal(a.table, b.table)
+    assert jnp.array_equal(a.table, c.table)
+    assert int(a.images_seen) == int(b.images_seen) == 24
+    _results_equal(a.compute(), b.compute())
+
+
+def test_admitted_images_are_complete_past_capacity():
+    """An admitted image's row carries its FULL payload (admission happens
+    at first sight, whole-image), so compute() equals the exact metric run
+    over exactly the admitted subset."""
+    rng = np.random.RandomState(3)
+    images = _rand_images(rng, 30)
+    small = MeanAveragePrecision(max_images=8)
+    p, t = _as_lists(images)
+    small.update(p, t)
+
+    idx, nd, ng = _admitted(small.table)
+    assert len(idx) == 8 and int(small.images_seen) == 30
+    for i, d, g in zip(idx, nd, ng):
+        assert d == len(images[i][0]["scores"])
+        assert g == len(images[i][1]["labels"])
+
+    exact = _exact_map()
+    p_sub, t_sub = _as_lists([images[i] for i in idx])
+    exact.update(p_sub, t_sub)
+    _results_equal(small.compute(), exact.compute())
+
+
+# ---------------------------------------------------------------------------
+# capacity policy
+# ---------------------------------------------------------------------------
+
+
+def test_det_overflow_truncates_to_score_topk():
+    """150 detections into det_slots=100 (the default cap): the stored rows
+    are the score top-100, bit-matching an exact metric fed the same
+    host-side top-100 (stable argsort, ties to the lower index)."""
+    rng = np.random.RandomState(4)
+    nd = 150
+    boxes = np.concatenate([rng.rand(nd, 2) * 20, 20 + rng.rand(nd, 2) * 20 + 5], 1).astype(np.float32)
+    scores = rng.rand(nd).astype(np.float32)
+    labels = rng.randint(0, 2, nd).astype(np.int32)
+    gt = dict(boxes=boxes[:6] + 1.0, labels=labels[:6])
+
+    m = MeanAveragePrecision()
+    m.update(
+        [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(scores), labels=jnp.asarray(labels))],
+        [{k: jnp.asarray(v) for k, v in gt.items()}],
+    )
+    keep = np.sort(np.argsort(-scores, kind="stable")[:100])
+    exact = _exact_map()
+    exact.update(
+        [dict(boxes=jnp.asarray(boxes[keep]), scores=jnp.asarray(scores[keep]), labels=jnp.asarray(labels[keep]))],
+        [{k: jnp.asarray(v) for k, v in gt.items()}],
+    )
+    _results_equal(m.compute(), exact.compute())
+
+
+def test_gt_overflow_raises_with_remedy():
+    m = MeanAveragePrecision(max_detection_thresholds=[1, 4], det_slots=4, gt_slots=4)
+    boxes = jnp.asarray(np.tile([[0.0, 0.0, 5.0, 5.0]], (6, 1)))
+    with pytest.raises(ValueError, match="gt_slots"):
+        m.update(
+            [dict(boxes=boxes[:1], scores=jnp.asarray([0.5]), labels=jnp.asarray([0]))],
+            [dict(boxes=boxes, labels=jnp.zeros((6,), jnp.int32))],
+        )
+
+
+def test_exact_mode_is_jit_unsafe_table_is_not():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert MeanAveragePrecision(exact=True).__jit_unsafe__ is True
+    m = MeanAveragePrecision()
+    assert not getattr(m, "__jit_unsafe__", False)
+    entry = MeanAveragePrecision.static_fusibility()
+    assert entry is not None and entry["verdict"] == "fusible"
+    assert entry["states"]["table"]["dist_reduce_fx"] == "merge"
+
+
+# ---------------------------------------------------------------------------
+# merge / distributed
+# ---------------------------------------------------------------------------
+
+
+def test_merge_states_equals_single_stream():
+    rng = np.random.RandomState(5)
+    images = _rand_images(rng, 16)
+    kw = dict(max_images=64)
+    m1, m2 = MeanAveragePrecision(**kw), MeanAveragePrecision(**kw)
+    p1, t1 = _as_lists(images[:9])
+    p2, t2 = _as_lists(images[9:])
+    m1.update(p1, t1)
+    m2.update(p2, t2)
+    merged = m1.merge_states(
+        {k: getattr(m1, k) for k in m1._defaults}, {k: getattr(m2, k) for k in m2._defaults}
+    )
+    full = MeanAveragePrecision(**kw)
+    p, t = _as_lists(images)
+    full.update(p, t)
+    assert int(merged["images_seen"]) == 16
+    _results_equal(full.compute_state(merged), full.compute())
+
+
+def test_mesh_merge_round_equals_host_fold():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+    from metrics_tpu.utils.compat import shard_map
+
+    kw = dict(max_images=64, det_slots=4, gt_slots=4, max_detection_thresholds=[1, 4])
+    rng = np.random.RandomState(6)
+    states, streams = [], []
+    for r in range(8):
+        m = MeanAveragePrecision(**kw)
+        images = _rand_images(rng, 4)
+        m.update(*_as_padded(images, 4, 4))
+        states.append({k: jnp.asarray(getattr(m, k)) for k in m._defaults})
+        streams.append(images)
+    template = MeanAveragePrecision(**kw)
+    reductions = template.state_reductions()
+    stacked = {k: jnp.stack([s[k] for s in states]) for k in states[0]}
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rank",))
+
+    def body(st):
+        return sync_pytree_in_mesh({k: v[0] for k, v in st.items()}, reductions, "rank")
+
+    synced = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("rank"),), out_specs=P()))(stacked)
+    for k in synced:
+        assert jnp.array_equal(synced[k], reductions[k](stacked[k])), k
+    # in-window: the synced table holds every rank's images -> fold equals
+    # one metric over the union stream
+    union = MeanAveragePrecision(**kw)
+    for images in streams:
+        union.update(*_as_padded(images, 4, 4))
+    assert int(synced["images_seen"]) == 32
+    _results_equal(union.compute_state(synced), union.compute())
+
+
+# ---------------------------------------------------------------------------
+# fused / bucketed / async composition
+# ---------------------------------------------------------------------------
+
+
+def _ragged_padded_batches(seed=7):
+    rng = np.random.RandomState(seed)
+    return [_as_padded(_rand_images(rng, n), 4, 4) for n in (3, 5, 7)]
+
+
+_FUSED_KW = dict(max_images=64, det_slots=4, gt_slots=4, max_detection_thresholds=[1, 4])
+
+
+def test_fused_bucketed_single_compile_bit_parity():
+    fused = MetricCollection([MeanAveragePrecision(**_FUSED_KW)])
+    eager = MetricCollection([MeanAveragePrecision(**_FUSED_KW)])
+    handle = fused.compile_update(buckets=[8])
+    for p, t in _ragged_padded_batches():
+        fused.update(p, t)
+        eager.update(p, t)
+    assert len(handle._cache) == 1  # ONE compile across 3 ragged shapes
+    assert not handle._eager_names  # nobody fell back eagerly
+    _results_equal(fused.compute(), eager.compute())
+    fm, em = fused["MeanAveragePrecision"], eager["MeanAveragePrecision"]
+    assert jnp.array_equal(fm.table, em.table)
+    assert jnp.array_equal(fm.images_seen, em.images_seen)
+
+
+def test_async_ingest_bit_parity():
+    a = MetricCollection([MeanAveragePrecision(**_FUSED_KW)])
+    b = MetricCollection([MeanAveragePrecision(**_FUSED_KW)])
+    a.compile_update_async(buckets=[8])
+    for p, t in _ragged_padded_batches(8):
+        a.update_async(p, t)
+        b.update(p, t)
+    _results_equal(a.compute(), b.compute())

@@ -280,39 +280,49 @@ def _scipy_fid(real: np.ndarray, fake: np.ndarray) -> float:
     return float(diff @ diff + np.trace(cov1) + np.trace(cov2) - 2 * np.trace(covmean))
 
 
-def test_fid_matches_scipy_oracle():
+@pytest.mark.parametrize("mode_kwargs,rtol", [(dict(exact=True), 1e-4), (dict(feature_dim=16), 1e-3)])
+def test_fid_matches_scipy_oracle(mode_kwargs, rtol):
+    """exact mode reproduces the f64 scipy formula tightly; the streaming
+    default (f32 moments + Newton–Schulz trace-sqrtm) tracks it to its
+    documented device tolerance."""
     rng = np.random.RandomState(0)
     real = (rng.randn(200, 16) + 0.5).astype(np.float64)
     fake = (rng.randn(180, 16) * 1.3 - 0.2).astype(np.float64)
 
-    metric = FrechetInceptionDistance(feature=_identity_extractor)
+    metric = FrechetInceptionDistance(feature=_identity_extractor, **mode_kwargs)
     metric.update(jnp.asarray(real), real=True)
     metric.update(jnp.asarray(fake), real=False)
     got = float(metric.compute())
 
     expected = _scipy_fid(real, fake)
-    np.testing.assert_allclose(got, expected, rtol=1e-4)
+    np.testing.assert_allclose(got, expected, rtol=rtol)
 
 
 def test_fid_same_distribution_near_zero():
     rng = np.random.RandomState(1)
     feats = rng.randn(300, 8).astype(np.float64)
-    metric = FrechetInceptionDistance(feature=_identity_extractor)
+    metric = FrechetInceptionDistance(feature=_identity_extractor, exact=True)
     metric.update(jnp.asarray(feats), real=True)
     metric.update(jnp.asarray(feats), real=False)
     assert abs(float(metric.compute())) < 1e-6
+
+    streaming = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=8)
+    streaming.update(jnp.asarray(feats), real=True)
+    streaming.update(jnp.asarray(feats), real=False)
+    # identical moments -> the only residue is the Newton–Schulz tolerance
+    assert abs(float(streaming.compute())) < 1e-2
 
 
 def test_fid_batched_updates_equal_single():
     rng = np.random.RandomState(2)
     real = rng.randn(120, 8)
     fake = rng.randn(120, 8) + 1.0
-    m1 = FrechetInceptionDistance(feature=_identity_extractor)
+    m1 = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=8)
     for chunk in np.array_split(real, 4):
         m1.update(jnp.asarray(chunk), real=True)
     for chunk in np.array_split(fake, 3):
         m1.update(jnp.asarray(chunk), real=False)
-    m2 = FrechetInceptionDistance(feature=_identity_extractor)
+    m2 = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=8)
     m2.update(jnp.asarray(real), real=True)
     m2.update(jnp.asarray(fake), real=False)
     np.testing.assert_allclose(float(m1.compute()), float(m2.compute()), rtol=1e-6)
@@ -367,7 +377,9 @@ def test_inception_score_matches_numpy_oracle():
     logits = rng.randn(100, 10).astype(np.float64) * 2.0
     splits, seed = 4, 11
 
-    metric = InceptionScore(feature=_identity_extractor, splits=splits, seed=seed)
+    # exact=True: the oracle replicates the reference's seeded shuffle; the
+    # streaming default assigns splits round-robin (own parity tests)
+    metric = InceptionScore(feature=_identity_extractor, splits=splits, seed=seed, exact=True)
     metric.update(jnp.asarray(logits))
     got_mean, got_std = (float(v) for v in metric.compute())
 
@@ -410,3 +422,234 @@ def test_extractor_finalize_validates_last_batch(converted_pair, tmp_path):
         extractor.finalize()
     # flushed: a second finalize is a no-op
     extractor.finalize()
+
+
+# ---------------------------------------------------------------------------
+# streaming-state parity / composition (docs/image_detection_states.md)
+# ---------------------------------------------------------------------------
+
+
+def test_fid_streaming_state_is_exact_sufficient_statistics():
+    """The covariance-identity contract: on dyadic features with a
+    power-of-two count every moment leaf is BITWISE equal to the float64
+    cat-state moments (sums of multiples of 1/4 stay exactly representable
+    in float32), and the derived mean/cov match numpy's float64 estimators
+    to float32 ulp of the moment scale — the streaming state loses nothing,
+    the only approximation in the FID pipeline is compute()'s trace-sqrtm."""
+    from metrics_tpu.sketches.moments import mean_cov_from_moments
+
+    rng = np.random.RandomState(21)
+    n, d = 64, 8  # n = 2^6: mean division is exact
+    feats = rng.randint(0, 16, (n, d)).astype(np.float64) / 2.0  # dyadic
+
+    m = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=d)
+    for chunk in np.array_split(feats, 5):
+        m.update(jnp.asarray(chunk.astype(np.float32)), real=True)
+
+    np.testing.assert_array_equal(np.asarray(m.real_feat_sum), feats.sum(0).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(m.real_outer_sum), (feats.T @ feats).astype(np.float32))
+    assert float(m.real_count) == n
+
+    mean, cov = mean_cov_from_moments(m.real_feat_sum, m.real_outer_sum, m.real_count)
+    np.testing.assert_array_equal(np.asarray(mean), feats.mean(0).astype(np.float32))
+    # the identity's subtraction cancels two exact O(n·μ²) terms: its error
+    # is a few ulp AT THAT SCALE, asserted explicitly
+    scale = np.float32(np.abs(feats.T @ feats).max() / (n - 1))
+    np.testing.assert_allclose(
+        np.asarray(cov), np.cov(feats, rowvar=False), atol=8 * np.spacing(scale)
+    )
+
+
+def test_fid_is_width_mismatch_raises():
+    m = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=8)
+    with pytest.raises(ValueError, match="feature_dim"):
+        m.update(jnp.zeros((4, 16)), real=True)
+    s = InceptionScore(feature=_identity_extractor, num_classes=8)
+    with pytest.raises(ValueError, match="num_classes"):
+        s.update(jnp.zeros((4, 16)))
+
+
+def test_is_streaming_matches_round_robin_oracle():
+    """The streaming default equals a float64 re-derivation that assigns
+    samples to splits round-robin by arrival index, and the state is
+    chunking-invariant (split_count exactly; the float sums to 1e-6, the
+    per-batch partial-sum re-association)."""
+    rng = np.random.RandomState(22)
+    logits = rng.randn(60, 6).astype(np.float64)
+    splits = 3
+
+    def run(batch):
+        m = InceptionScore(feature=_identity_extractor, num_classes=6, splits=splits)
+        for lo in range(0, 60, batch):
+            m.update(jnp.asarray(logits[lo : lo + batch].astype(np.float32)))
+        return m
+
+    m1, m2 = run(60), run(7)
+    np.testing.assert_array_equal(np.asarray(m1.split_count), np.asarray(m2.split_count))
+    np.testing.assert_allclose(np.asarray(m1.prob_sum), np.asarray(m2.prob_sum), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m1.plogp_sum), np.asarray(m2.plogp_sum), atol=1e-6)
+    got_mean, got_std = (float(v) for v in m1.compute())
+
+    expm = np.exp(logits - logits.max(axis=1, keepdims=True))
+    prob = expm / expm.sum(axis=1, keepdims=True)
+    kls = []
+    for k in range(splits):
+        p = prob[k::splits]  # round-robin by arrival index
+        marginal = p.mean(axis=0, keepdims=True)
+        kls.append(np.exp((p * (np.log(p) - np.log(marginal))).sum(axis=1).mean()))
+    np.testing.assert_allclose(got_mean, np.mean(kls), rtol=1e-5)
+    np.testing.assert_allclose(got_std, np.std(kls, ddof=1), rtol=1e-4)
+
+
+def test_kid_reservoir_draws_match_exact_in_window():
+    """Satellite pin: inside the lossless window the KID reservoir holds
+    the exact features in arrival order, so the host-RNG subset draws — and
+    therefore compute() — are bit-identical to the ``exact=True`` cat-state
+    path. The FID/IS streaming refactor must not move this."""
+    rng = np.random.RandomState(23)
+    kw = dict(feature=_identity_extractor, subsets=4, subset_size=10, seed=123)
+    a = KernelInceptionDistance(**kw)
+    with pytest.warns(UserWarning, match="memory"):
+        b = KernelInceptionDistance(exact=True, **kw)
+    for _ in range(3):
+        real = rng.randn(15, 6).astype(np.float32)
+        fake = rng.randn(12, 6).astype(np.float32)
+        for m in (a, b):
+            m.update(jnp.asarray(real), real=True)
+            m.update(jnp.asarray(fake), real=False)
+    am, astd = a.compute()
+    bm, bstd = b.compute()
+    assert float(am) == float(bm)
+    assert float(astd) == float(bstd)
+
+
+def _int_feature_batches(rng, sizes, d):
+    """Integer-valued float32 features: every sum in the moment leaves is
+    exactly representable, so fused-vs-eager parity is bitwise."""
+    return [jnp.asarray(rng.randint(0, 8, (n, d)).astype(np.float32)) for n in sizes]
+
+
+def test_fid_is_fused_bucketed_single_compile_bit_parity():
+    from metrics_tpu import MetricCollection
+
+    d = 8
+    mk = lambda: MetricCollection(
+        [
+            FrechetInceptionDistance(feature=_identity_extractor, feature_dim=d),
+            InceptionScore(feature=_identity_extractor, num_classes=d, splits=3),
+        ]
+    )
+    fused, eager = mk(), mk()
+    handle = fused.compile_update(buckets=[8])
+    rng = np.random.RandomState(24)
+    for x in _int_feature_batches(rng, (3, 5, 7), d):
+        fused.update(x, real=True)
+        eager.update(x, real=True)
+    for x in _int_feature_batches(rng, (4, 6, 2), d):
+        fused.update(x, real=False)
+        eager.update(x, real=False)
+    # ONE compile per static `real` flag across 3 ragged shapes each
+    assert len(handle._cache) == 2
+    assert not handle._eager_names  # nobody fell back eagerly
+    rf = {k: np.asarray(v) for k, v in fused.compute().items()}
+    re_ = {k: np.asarray(v) for k, v in eager.compute().items()}
+    for k in re_:
+        np.testing.assert_array_equal(rf[k], re_[k])
+    for s in ("real_feat_sum", "real_outer_sum", "real_count", "fake_feat_sum", "fake_outer_sum", "fake_count"):
+        assert jnp.array_equal(
+            getattr(fused["FrechetInceptionDistance"], s), getattr(eager["FrechetInceptionDistance"], s)
+        ), s
+    for s in ("prob_sum", "plogp_sum", "split_count"):
+        assert jnp.array_equal(getattr(fused["InceptionScore"], s), getattr(eager["InceptionScore"], s)), s
+
+
+def test_fid_is_async_ingest_bit_parity():
+    from metrics_tpu import MetricCollection
+
+    d = 8
+    mk = lambda: MetricCollection(
+        [
+            FrechetInceptionDistance(feature=_identity_extractor, feature_dim=d),
+            InceptionScore(feature=_identity_extractor, num_classes=d, splits=3),
+        ]
+    )
+    a, b = mk(), mk()
+    a.compile_update_async(buckets=[8])
+    rng = np.random.RandomState(25)
+    for x in _int_feature_batches(rng, (3, 5, 7), d):
+        a.update_async(x, real=True)
+        b.update(x, real=True)
+    for x in _int_feature_batches(rng, (4, 6, 2), d):
+        a.update_async(x, real=False)
+        b.update(x, real=False)
+    ra = {k: np.asarray(v) for k, v in a.compute().items()}
+    rb = {k: np.asarray(v) for k, v in b.compute().items()}
+    for k in rb:
+        np.testing.assert_array_equal(ra[k], rb[k])
+
+
+def test_fid_mesh_merge_round_equals_host_fold():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+    from metrics_tpu.utils.compat import shard_map
+
+    d = 6
+    rng = np.random.RandomState(26)
+    states, streams = [], []
+    for r in range(8):
+        m = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=d)
+        real = rng.randint(0, 8, (5, d)).astype(np.float32)
+        fake = rng.randint(0, 8, (4, d)).astype(np.float32)
+        m.update(jnp.asarray(real), real=True)
+        m.update(jnp.asarray(fake), real=False)
+        states.append({k: jnp.asarray(getattr(m, k)) for k in m._defaults})
+        streams.append((real, fake))
+    template = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=d)
+    reductions = template.state_reductions()
+    stacked = {k: jnp.stack([s[k] for s in states]) for k in states[0]}
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rank",))
+
+    def body(st):
+        return sync_pytree_in_mesh({k: v[0] for k, v in st.items()}, reductions, "rank")
+
+    synced = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("rank"),), out_specs=P()))(stacked)
+    # integer features: the cross-rank sums are exact, so the mesh round
+    # reproduces the single-stream union metric BITWISE, leaf for leaf
+    union = FrechetInceptionDistance(feature=_identity_extractor, feature_dim=d)
+    for real, fake in streams:
+        union.update(jnp.asarray(real), real=True)
+        union.update(jnp.asarray(fake), real=False)
+    for k in synced:
+        assert jnp.array_equal(synced[k], getattr(union, k)), k
+    assert float(union.compute_state(synced)) == float(union.compute())
+
+
+def test_is_mesh_merge_round_equals_host_fold():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.distributed import sync_pytree_in_mesh
+    from metrics_tpu.utils.compat import shard_map
+
+    d = 6
+    rng = np.random.RandomState(27)
+    states = []
+    for r in range(8):
+        m = InceptionScore(feature=_identity_extractor, num_classes=d, splits=3)
+        m.update(jnp.asarray(rng.randint(0, 6, (5, d)).astype(np.float32)))
+        states.append({k: jnp.asarray(getattr(m, k)) for k in m._defaults})
+    template = InceptionScore(feature=_identity_extractor, num_classes=d, splits=3)
+    reductions = template.state_reductions()
+    stacked = {k: jnp.stack([s[k] for s in states]) for k in states[0]}
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rank",))
+
+    def body(st):
+        return sync_pytree_in_mesh({k: v[0] for k, v in st.items()}, reductions, "rank")
+
+    synced = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("rank"),), out_specs=P()))(stacked)
+    for k in synced:
+        assert jnp.array_equal(synced[k], reductions[k](stacked[k])), k
+    mean, std = template.compute_state(synced)
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
