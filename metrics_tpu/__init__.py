@@ -9,7 +9,7 @@ _logger = logging.getLogger("metrics_tpu")
 _logger.addHandler(logging.StreamHandler())
 _logger.setLevel(logging.INFO)
 
-__version__ = "0.19.0"
+__version__ = "0.20.0"
 
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402

@@ -33,6 +33,23 @@ Rule catalog (see ``docs/static_analysis.md`` for rationale + fix recipes):
 * **TL-FLOW** — state-lifecycle dataflow (``stateflow.py``): a ``"sum"``-
   reduced leaf mutated by anything other than additive assignment, an
   overriding ``reset`` that misses a leaf, a registered-but-dead leaf.
+* **TL-SHARD** — partition-rule coverage and spec/reducer agreement
+  (``layout_rules.py``): a committed rule set that leaves state-leaf
+  paths unmatched, a named-axis rule or spec on a leaf every registering
+  class needs a cross-rank reduction for (the silently-skipped-reduction
+  bug class), an unconditional sharded claim over every state leaf.
+* **TL-MERGE** — fold-algebra soundness for ``merge_like``-tagged
+  reducers: statically non-commutative fold steps, host-state reads, and
+  ring folds that mix time-bucket slots, all of which break the
+  collector's arrival-order-independence contract.
+* **TL-WIRE** — checkpoint/wire coverage: every ``add_state`` leaf needs
+  a wire-serializable dtype/shape/reducer triple — untagged callable
+  reducers, statically wire-opaque defaults, and mixed device/cat-list
+  classes without the ``__exact_mode_attr__`` escape hatch flag.
+* **TL-LOCK** — guarded-by lock discipline for ``core/pipeline.py`` and
+  ``observability/collector.py``: accesses of registered fields outside
+  their lock's ``with`` scope (registry in ``layout_rules.GUARDED_FIELDS``;
+  ``__init__`` and ``*_locked`` methods exempt).
 
 v2 adds the **interprocedural abstract interpreter** (``interp.py``): calls
 from metric updates resolve into ``metrics_tpu/functional/`` and ``utils/``,
@@ -42,6 +59,19 @@ a taint/None-ness/bool-ness lattice classifies every metric as ``fusible`` /
 shape/dtype/reduction abstractions to ``scripts/fusibility_manifest.json``
 (``manifest.py``) — which ``core/fused.py`` consults at runtime to skip the
 ``eval_shape`` fusibility probe for ``fusible``-verdict metrics.
+
+v3 adds the **layout/collective soundness pass**: the TL-SHARD / TL-MERGE /
+TL-WIRE / TL-LOCK families above, and — from the same interp walk — the
+schema-v1 **layout manifest** (``layout.py`` →
+``scripts/layout_manifest.json``): per class, per leaf, the reducer class,
+shard axis (``[S]`` slice / ``[R]`` ring / replicated), partition-spec
+template, and reshard recipe (``fold`` for merge/sum leaves, ``reshape``
+for slice axes). ``sliced/sharding.py`` answers partition specs from it
+without probing live arrays (probe-skip counter observable,
+``METRICS_TPU_VERIFY_MANIFEST=1`` cross-checks, stale manifests fall back
+safely) and ``parallel/distributed.py`` audits sharded-claimed sync leaves
+against it under the same flag. ``--manifest`` regenerates BOTH manifests;
+``--manifest --check`` freshness-gates both in CI.
 
 Run ``python scripts/tracelint.py`` (stdlib-only, no jax import) or
 ``python -m metrics_tpu.analysis``.
@@ -61,8 +91,18 @@ from .engine import (  # noqa: F401
     suppressed_rules,
 )
 from .baseline import load_baseline, save_baseline, split_by_baseline  # noqa: F401
-from .reporters import render_json, render_text  # noqa: F401
+from .reporters import render_github, render_json, render_text  # noqa: F401
 from .rules import RULE_REGISTRY, Rule, all_rules, get_rules, register_rule  # noqa: F401
+from .layout import (  # noqa: F401
+    build_layout_manifest,
+    layout_for_class,
+    leaf_may_shard,
+    leaf_shard_axes,
+    load_layout_manifest,
+    render_layout_manifest,
+    runtime_layout,
+    shard_path_universe,
+)
 from .interp import (  # noqa: F401
     Project,
     Signal,
@@ -98,6 +138,7 @@ __all__ = [
     "analyze_paths",
     "analyze_source",
     "analyze_state_flows",
+    "build_layout_manifest",
     "build_manifest",
     "class_facts",
     "class_key",
@@ -105,16 +146,24 @@ __all__ = [
     "default_package_root",
     "file_suppressed_rules",
     "get_rules",
+    "layout_for_class",
+    "leaf_may_shard",
+    "leaf_shard_axes",
     "load_baseline",
+    "load_layout_manifest",
     "load_manifest",
     "lookup_class",
     "manifest_verdict",
     "package_relpath",
     "register_rule",
+    "render_github",
     "render_json",
+    "render_layout_manifest",
     "render_manifest",
     "render_text",
+    "runtime_layout",
     "runtime_manifest",
+    "shard_path_universe",
     "save_baseline",
     "split_by_baseline",
     "suppressed_rules",

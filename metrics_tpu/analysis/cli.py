@@ -17,8 +17,9 @@ from typing import List, Optional
 
 from .baseline import load_baseline, save_baseline, split_by_baseline
 from .engine import Violation, analyze_paths, default_package_root
+from .layout import DEFAULT_LAYOUT_MANIFEST
 from .manifest import DEFAULT_MANIFEST
-from .reporters import render_json, render_text
+from .reporters import render_github, render_json, render_text
 from .rules import all_rules, get_rules
 
 #: repo-root-relative default; lives next to the other check scripts
@@ -71,20 +72,38 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="CI mode: additionally fail (exit 1) on stale baseline entries",
     )
-    parser.add_argument("--json", action="store_true", help="emit the JSON report")
+    parser.add_argument(
+        "--format",
+        choices=("text", "json", "github"),
+        default=None,
+        help="report format: text (default), json (schema v2), or github "
+        "(GitHub Actions ::error annotations for inline PR diffs)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="alias for --format=json (kept for script compatibility)",
+    )
     parser.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
     parser.add_argument(
         "--manifest",
         action="store_true",
-        help="fusibility-manifest mode: write the abstract interpreter's per-metric "
-        "verdicts (always full-package); with --check, fail instead if the "
-        "committed manifest is stale",
+        help="manifest mode: write BOTH committed analyzer manifests — the "
+        "fusibility manifest (per-metric verdicts) and the layout manifest "
+        "(per-leaf reducer/shard-axis/reshard recipes) — always full-package; "
+        "with --check, fail instead if either committed file is stale",
     )
     parser.add_argument(
         "--manifest-path",
         type=pathlib.Path,
         default=None,
-        help=f"manifest file (default: <repo>/{DEFAULT_MANIFEST})",
+        help=f"fusibility manifest file (default: <repo>/{DEFAULT_MANIFEST})",
+    )
+    parser.add_argument(
+        "--layout-manifest-path",
+        type=pathlib.Path,
+        default=None,
+        help=f"layout manifest file (default: <repo>/{DEFAULT_LAYOUT_MANIFEST})",
     )
     return parser
 
@@ -142,7 +161,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         new, grandfathered, stale = list(result.violations), [], {}
 
     stale_count = sum(stale.values()) if stale else 0
-    if args.json:
+    fmt = args.format or ("json" if args.json else "text")
+    if fmt == "json":
         sys.stdout.write(
             render_json(
                 new,
@@ -153,6 +173,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 stale_count=stale_count,
             )
         )
+    elif fmt == "github":
+        sys.stdout.write(render_github(new, grandfathered))
     else:
         sys.stdout.write(
             render_text(
@@ -172,24 +194,37 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _manifest_mode(args) -> int:
-    """``--manifest``: regenerate the fusibility manifest; ``--manifest
-    --check``: CI freshness gate (byte-compare against the committed file)."""
+    """``--manifest``: regenerate BOTH committed manifests (fusibility +
+    layout) from one interp walk; ``--manifest --check``: CI freshness gate
+    (byte-compare each against its committed file — no jax import)."""
+    from .interp import Project
+    from .layout import build_layout_manifest, render_layout_manifest
     from .manifest import build_manifest, render_manifest
 
-    path = args.manifest_path or (_repo_root() / DEFAULT_MANIFEST)
-    rendered = render_manifest(build_manifest())
-    n = rendered.count('"verdict"')
+    project = Project()
+    fus_path = args.manifest_path or (_repo_root() / DEFAULT_MANIFEST)
+    lay_path = args.layout_manifest_path or (_repo_root() / DEFAULT_LAYOUT_MANIFEST)
+    fus = render_manifest(build_manifest(project))
+    lay = render_layout_manifest(build_layout_manifest(project))
+    targets = (
+        ("fusibility", fus_path, fus, fus.count('"verdict"'), "metrics"),
+        ("layout", lay_path, lay, lay.count('"reducer"'), "leaves"),
+    )
     if args.check:
-        committed = path.read_text() if path.is_file() else None
-        if committed != rendered:
-            sys.stderr.write(
-                f"tracelint: fusibility manifest {path} is "
-                f"{'missing' if committed is None else 'STALE'} — regenerate with "
-                "`python scripts/tracelint.py --manifest` and commit the result\n"
-            )
-            return 1
-        sys.stdout.write(f"tracelint: fusibility manifest {path} is fresh ({n} metrics)\n")
-        return 0
-    path.write_text(rendered)
-    sys.stdout.write(f"tracelint: fusibility manifest written to {path} ({n} metrics)\n")
+        stale = False
+        for kind, path, rendered, n, unit in targets:
+            committed = path.read_text() if path.is_file() else None
+            if committed != rendered:
+                stale = True
+                sys.stderr.write(
+                    f"tracelint: {kind} manifest {path} is "
+                    f"{'missing' if committed is None else 'STALE'} — regenerate with "
+                    "`python scripts/tracelint.py --manifest` and commit the result\n"
+                )
+            else:
+                sys.stdout.write(f"tracelint: {kind} manifest {path} is fresh ({n} {unit})\n")
+        return 1 if stale else 0
+    for kind, path, rendered, n, unit in targets:
+        path.write_text(rendered)
+        sys.stdout.write(f"tracelint: {kind} manifest written to {path} ({n} {unit})\n")
     return 0

@@ -1,25 +1,42 @@
-"""tracelint reporters: human text and machine JSON.
+"""tracelint reporters: human text, machine JSON, GitHub annotations.
 
 The JSON schema is stable (version-tagged) so CI annotators and editors can
 consume it:
 
 ```json
 {
-  "version": 1,
+  "version": 2,
   "tool": "tracelint",
   "violations": [
-    {"rule": "TL-TRACE", "path": "a.py", "line": 3, "col": 4,
-     "message": "...", "snippet": "...", "baselined": false}
+    {"rule": "TL-TRACE", "path": "a.py", "file": "metrics_tpu/a.py",
+     "line": 3, "col": 4, "message": "...", "snippet": "...",
+     "baselined": false}
   ],
   "summary": {"files": 10, "new": 1, "baselined": 0, "suppressed": 0,
+              "stale_baseline_entries": 0,
               "rules": ["TL-COLLECTIVE", "..."],
               "by_rule": {"TL-TRACE": 1}}
 }
 ```
 
+Schema history:
+
+- **v2** — every violation gains ``file``, the REPO-relative path
+  (``metrics_tpu/<path>``) matching what ``--format=github`` annotates and
+  what CI diff views key on; ``path`` stays the package-relative form the
+  baseline and pragma machinery use. No fields were removed, so v1
+  consumers that ignore unknown keys keep working; consumers that pin
+  ``version == 1`` must accept 2.
+- **v1** — initial schema.
+
 ``by_rule`` counts NEW violations per rule id (omitting zero-count rules),
 so CI annotators can tell WHICH invariant regressed without walking the
 violation list.
+
+``render_github`` emits GitHub Actions workflow commands (``::error
+file=...,line=...,col=...``) so lint failures land inline on the PR diff;
+baselined violations surface as ``::warning`` (visible but non-blocking,
+matching their exit-status semantics).
 """
 from __future__ import annotations
 
@@ -27,9 +44,15 @@ import json
 from collections import Counter
 from typing import List, Sequence
 
-from .engine import Violation
+from .engine import PACKAGE_NAME, Violation
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
+
+
+def _repo_relative(path: str) -> str:
+    """Violation paths are package-relative; CI annotations and the v2
+    ``file`` field need the repo-relative form."""
+    return f"{PACKAGE_NAME}/{path}"
 
 
 def render_text(
@@ -73,9 +96,11 @@ def render_json(
         "version": JSON_SCHEMA_VERSION,
         "tool": "tracelint",
         "violations": [
-            {**v.to_dict(), "baselined": False} for v in new
+            {**v.to_dict(), "file": _repo_relative(v.path), "baselined": False}
+            for v in new
         ] + [
-            {**v.to_dict(), "baselined": True} for v in baselined
+            {**v.to_dict(), "file": _repo_relative(v.path), "baselined": True}
+            for v in baselined
         ],
         "summary": {
             "files": n_files,
@@ -88,3 +113,31 @@ def render_json(
         },
     }
     return json.dumps(payload, indent=2) + "\n"
+
+
+def _gh_escape(value: str, *, property_value: bool = False) -> str:
+    """GitHub workflow-command escaping: ``%``/newlines always; ``:`` and
+    ``,`` additionally inside property values (file=..., title=...)."""
+    out = value.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if property_value:
+        out = out.replace(":", "%3A").replace(",", "%2C")
+    return out
+
+
+def render_github(
+    new: Sequence[Violation],
+    baselined: Sequence[Violation] = (),
+) -> str:
+    """GitHub Actions annotation report: one ``::error`` workflow command
+    per new violation (``::warning`` per baselined one), each anchored to
+    the repo-relative file/line/col so it lands inline on the PR diff."""
+    out: List[str] = []
+    for level, violations in (("error", new), ("warning", baselined)):
+        for v in violations:
+            props = (
+                f"file={_gh_escape(_repo_relative(v.path), property_value=True)},"
+                f"line={v.line},col={v.col},"
+                f"title={_gh_escape('tracelint ' + v.rule, property_value=True)}"
+            )
+            out.append(f"::{level} {props}::{_gh_escape(v.message)}")
+    return "\n".join(out) + "\n" if out else ""

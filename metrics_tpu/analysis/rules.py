@@ -1159,3 +1159,9 @@ class FlowRule(Rule):
                 continue
             for finding in stateflow.analyze_class(ctx, info.node):
                 yield self.violation(ctx, finding.node, finding.message)
+
+
+# Layout/collective soundness rules (TL-SHARD, TL-MERGE, TL-WIRE, TL-LOCK)
+# live in their own module but register into the same registry; imported
+# last so they can reuse this module's helpers without circularity.
+from . import layout_rules  # noqa: E402,F401

@@ -214,14 +214,16 @@ _MANIFEST_FP_CACHE: Optional[str] = None
 
 
 def manifest_fingerprint() -> str:
-    """Short sha256 fingerprint of the committed fusibility manifest —
-    the repo's machine description of every metric's state layout and
-    reducers, so two builds with the same fingerprint serialize the same
-    state schemas. ``""`` when no manifest is present (installed package
-    without the scripts/ tree); collectors treat empty as "unknown, fold
-    anyway" and a *mismatching* non-empty pair as skew. Cached for the
-    process lifetime: the collector consults it per ingested snapshot,
-    and re-hashing the manifest file at thousands of snapshots/s would
+    """Short sha256 fingerprint of the committed analyzer manifests — the
+    fusibility manifest (every metric's state layout and reducers) plus,
+    when present, the layout manifest (per-leaf shard axis and reshard
+    recipe) — so two builds with the same fingerprint serialize the same
+    state schemas AND agree on how each leaf reshards. ``""`` when no
+    fusibility manifest is present (installed package without the
+    scripts/ tree); collectors treat empty as "unknown, fold anyway" and
+    a *mismatching* non-empty pair as skew. Cached for the process
+    lifetime: the collector consults it per ingested snapshot, and
+    re-hashing the manifest files at thousands of snapshots/s would
     dominate the fold."""
     global _MANIFEST_FP_CACHE
     if _MANIFEST_FP_CACHE is not None:
@@ -230,7 +232,13 @@ def manifest_fingerprint() -> str:
         from metrics_tpu.analysis.manifest import default_manifest_path
 
         data = default_manifest_path().read_bytes()
-        _MANIFEST_FP_CACHE = hashlib.sha256(data).hexdigest()[:16]
+        try:
+            from metrics_tpu.analysis.layout import default_layout_manifest_path
+
+            layout = default_layout_manifest_path().read_bytes()
+        except Exception:  # noqa: BLE001 — pre-layout checkouts stay readable
+            layout = b""
+        _MANIFEST_FP_CACHE = hashlib.sha256(data + b"\x00" + layout).hexdigest()[:16]
     except Exception:  # noqa: BLE001 — absent manifest is a legal deployment
         _MANIFEST_FP_CACHE = ""
     return _MANIFEST_FP_CACHE

@@ -22,6 +22,7 @@ groups. Here the same contract is provided two ways, both XLA-native:
 ``process_group`` in the reference maps to a *mesh axis name* (or a subset
 axis) here.
 """
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 from metrics_tpu.observability.recorder import _DEFAULT_RECORDER as _TELEMETRY
 from metrics_tpu.observability.recorder import _nbytes
 from metrics_tpu.observability.trace import span as _span
+from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -291,6 +293,52 @@ _FUSED_REDUCERS = {
 }
 
 
+#: layout-manifest plausibility counters for sharded-claimed sync leaves
+#: (populated only under METRICS_TPU_VERIFY_MANIFEST)
+_LAYOUT_VERIFY_COUNTERS = {"claims_checked": 0, "implausible_claims": 0}
+
+
+def layout_verify_counters() -> Dict[str, int]:
+    """Snapshot of the sync path's layout-manifest cross-check counters:
+    ``claims_checked`` (sharded-claimed leaves inspected under
+    ``METRICS_TPU_VERIFY_MANIFEST``) and ``implausible_claims`` (claims the
+    committed layout manifest says belong to replicated-only leaves — the
+    silently-skipped-reduction bug class; behavior is unchanged, the claim
+    is honored with a warning)."""
+    return dict(_LAYOUT_VERIFY_COUNTERS)
+
+
+def reset_layout_verify_counters() -> None:
+    for key in _LAYOUT_VERIFY_COUNTERS:
+        _LAYOUT_VERIFY_COUNTERS[key] = 0
+
+
+def _verify_sharded_claims(sharded: List[tuple]) -> None:
+    """Under ``METRICS_TPU_VERIFY_MANIFEST``, check every sharded-claimed
+    (passthrough) leaf against the layout manifest's shard-axis index and
+    warn on claims the manifest refutes. Pure host-side string work at
+    trace time — never changes sync behavior (the spec stays authoritative;
+    the warning names the leaf so the claim can be audited)."""
+    try:
+        from metrics_tpu.analysis.layout import leaf_may_shard
+        from metrics_tpu.analysis.manifest import ENV_VERIFY_MANIFEST
+    except Exception:  # pragma: no cover - analysis package always ships
+        return
+    if os.environ.get(ENV_VERIFY_MANIFEST, "").strip().lower() in ("", "0", "false", "no", "off"):
+        return
+    for path in sharded:
+        _LAYOUT_VERIFY_COUNTERS["claims_checked"] += 1
+        if leaf_may_shard("/".join(path)) is False:
+            _LAYOUT_VERIFY_COUNTERS["implausible_claims"] += 1
+            rank_zero_warn(
+                f"partition spec claims state leaf {'/'.join(path)!r} sharded, but the "
+                "layout manifest knows it only as replicated — the sync is passing it "
+                "through WITHOUT its cross-rank reduction. Audit the spec (or "
+                "regenerate the manifest with `python scripts/tracelint.py --manifest`).",
+                UserWarning,
+            )
+
+
 def _spec_shards_axis(spec: Any, axis_name: str) -> bool:
     """True when a ``PartitionSpec`` (or spec-like tuple) places
     ``axis_name`` on some array dimension — the leaf's rows are then owned
@@ -363,6 +411,9 @@ def sync_pytree_in_mesh(
             merge_groups.setdefault(jnp.asarray(value).dtype, []).append(path)
         else:
             fallback.append(path)
+
+    if sharded:
+        _verify_sharded_claims(sharded)
 
     record = _TELEMETRY.enabled
     if record:

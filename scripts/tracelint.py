@@ -10,10 +10,12 @@ run starts instantly and works on machines with no accelerator stack.
     python scripts/tracelint.py                  # lint the package vs baseline
     python scripts/tracelint.py --check          # CI mode (stale baseline fails)
     python scripts/tracelint.py --baseline-update
-    python scripts/tracelint.py --json path/to/file.py
+    python scripts/tracelint.py --format=json path/to/file.py
+    python scripts/tracelint.py --format=github  # ::error annotations for PR diffs
     python scripts/tracelint.py --list-rules
-    python scripts/tracelint.py --manifest           # regenerate fusibility manifest
-    python scripts/tracelint.py --manifest --check   # CI freshness gate
+    python scripts/tracelint.py --manifest           # regenerate BOTH manifests
+                                                     # (fusibility + layout)
+    python scripts/tracelint.py --manifest --check   # CI freshness gate (both)
 """
 import importlib.util
 import pathlib

@@ -1,0 +1,399 @@
+"""Layout manifest (ISSUE 20 tentpole): freshness, schema, the path
+universe, and the runtime consultation fast paths.
+
+The committed ``scripts/layout_manifest.json`` is a build artifact of
+``python scripts/tracelint.py --manifest`` (same walk, same freshness gate
+as the fusibility manifest) that TWO runtime consumers trust:
+
+* ``sliced/sharding.py`` answers partition specs / shardings from it with
+  no per-leaf array probe — so the fast path must be BIT-identical to the
+  probe on a real multi-device mesh, observable (probe-skip counter), and
+  must fall back to the probe whenever the manifest cannot vouch for the
+  live object (stale file, statically invisible registrations);
+* ``parallel/distributed.py`` audits sharded-claimed sync leaves against
+  the manifest's shard-axis index under ``METRICS_TPU_VERIFY_MANIFEST``.
+"""
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import metrics_tpu  # noqa: F401
+from metrics_tpu import MeanSquaredError
+from metrics_tpu.analysis import (
+    build_layout_manifest,
+    layout_for_class,
+    leaf_may_shard,
+    leaf_shard_axes,
+    load_layout_manifest,
+    render_layout_manifest,
+    shard_path_universe,
+)
+from metrics_tpu.analysis import layout as layout_mod
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.parallel.distributed import (
+    layout_verify_counters,
+    reset_layout_verify_counters,
+    sync_pytree_in_mesh,
+)
+from metrics_tpu.sliced import SlicedMetric, shard_sliced_states, sliced_partition_specs
+from metrics_tpu.sliced.sharding import (
+    manifest_consultation_counters,
+    reset_manifest_consultation_counters,
+    slice_partition_rules,
+)
+from metrics_tpu.utils.compat import shard_map
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+MANIFEST_PATH = REPO_ROOT / layout_mod.DEFAULT_LAYOUT_MANIFEST
+
+AXES = {layout_mod.AXIS_SLICE, layout_mod.AXIS_RING, layout_mod.AXIS_REPLICATED}
+RESHARDS = {
+    layout_mod.RESHARD_RESHAPE,
+    layout_mod.RESHARD_FOLD,
+    layout_mod.RESHARD_GATHER,
+    layout_mod.RESHARD_OPAQUE,
+}
+LEAF_FIELDS = (
+    "reducer",
+    "shard_axis",
+    "partition_spec",
+    "reshard",
+    "container",
+    "dtype",
+    "shape",
+    "wire",
+)
+
+
+@pytest.fixture(scope="module")
+def committed():
+    data = load_layout_manifest(MANIFEST_PATH)
+    assert data is not None, f"missing/invalid committed layout manifest at {MANIFEST_PATH}"
+    return data
+
+
+@pytest.fixture(autouse=True)
+def _clean_consultation_state():
+    """Counters and manifest caches are process-global; tests that doctor
+    the manifest path or env flags must not leak into each other."""
+    layout_mod.invalidate_layout_cache()
+    reset_manifest_consultation_counters()
+    reset_layout_verify_counters()
+    yield
+    layout_mod.invalidate_layout_cache()
+    reset_manifest_consultation_counters()
+    reset_layout_verify_counters()
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:8]), ("slices",))
+
+
+# ---------------------------------------------------------------------------
+# freshness + determinism (the byte-level CI gate)
+# ---------------------------------------------------------------------------
+
+class TestFreshness:
+    def test_committed_manifest_is_byte_fresh(self):
+        """Byte-for-byte: the committed file equals a fresh full-package
+        build — exactly what CI's `tracelint --manifest --check` enforces
+        (for BOTH manifests, this one included)."""
+        assert render_layout_manifest(build_layout_manifest()) == MANIFEST_PATH.read_text()
+
+    def test_build_is_deterministic(self):
+        assert render_layout_manifest(build_layout_manifest()) == render_layout_manifest(
+            build_layout_manifest()
+        )
+
+
+# ---------------------------------------------------------------------------
+# schema
+# ---------------------------------------------------------------------------
+
+class TestSchema:
+    def test_header(self, committed):
+        assert committed["version"] == layout_mod.LAYOUT_VERSION == 1
+        assert committed["tool"] == "tracelint"
+        assert committed["classes"]
+
+    def test_leaf_records(self, committed):
+        for key, entry in committed["classes"].items():
+            assert isinstance(entry.get("sliceable"), bool), key
+            for name, rec in entry["leaves"].items():
+                for field in LEAF_FIELDS:
+                    assert field in rec, (key, name, field)
+                assert rec["shard_axis"] in AXES, (key, name)
+                assert rec["reshard"] in RESHARDS, (key, name)
+                assert isinstance(rec["partition_spec"], list), (key, name)
+                # the reshard recipe is a function of axis + reducer:
+                # slice axes re-split, fold-reducible leaves re-fold,
+                # cat lists gather, opaque reducers stay opaque
+                if rec["shard_axis"] == layout_mod.AXIS_SLICE:
+                    assert rec["reshard"] == layout_mod.RESHARD_RESHAPE, (key, name)
+                    assert rec["partition_spec"] == [layout_mod.SLICE_AXIS_NAME], (key, name)
+                elif rec["reducer"] in layout_mod.FOLD_REDUCERS:
+                    assert rec["reshard"] == layout_mod.RESHARD_FOLD, (key, name)
+                    assert rec["partition_spec"] == [], (key, name)
+
+    def test_synthetic_sliced_metric_entry(self, committed):
+        entry = committed["classes"][layout_mod.SLICED_METRIC_KEY]
+        assert entry["dynamic_leaves"] == "template-broadcast"
+        rows = entry["leaves"][layout_mod.SLICE_ROWS]
+        assert rows["shard_axis"] == layout_mod.AXIS_SLICE
+        assert rows["dtype"] == "int32"
+
+    def test_prefix_constants_agree_with_runtime(self):
+        """layout.py mirrors the runtime footprint/axis constants instead
+        of importing them (stdlib-only contract) — pin the mirror."""
+        from metrics_tpu.observability.recorder import (
+            SKETCH_FOOTPRINT_PREFIX,
+            SLICED_FOOTPRINT_PREFIX,
+            WINDOWED_FOOTPRINT_PREFIX,
+        )
+        from metrics_tpu.sliced.metric import SLICE_ROWS
+        from metrics_tpu.sliced.sharding import SLICE_AXIS
+
+        assert layout_mod.SLICED_PREFIX == SLICED_FOOTPRINT_PREFIX
+        assert layout_mod.SKETCH_PREFIX == SKETCH_FOOTPRINT_PREFIX
+        assert layout_mod.WINDOWED_PREFIX == WINDOWED_FOOTPRINT_PREFIX
+        assert layout_mod.SLICE_ROWS == SLICE_ROWS
+        assert layout_mod.SLICE_AXIS_NAME == SLICE_AXIS
+
+    def test_runtime_class_lookup(self, committed):
+        entry = layout_for_class(MeanSquaredError)
+        assert entry is not None and entry["sliceable"] is True
+        assert set(entry["leaves"]) == {"sum_squared_error", "total"}
+        # loop-registered states (StatScores' `for s in ...: add_state(s)`)
+        # are statically invisible — Accuracy's entry must NOT pretend to
+        # cover them (the runtime consultation falls back on the mismatch)
+        acc = layout_for_class(Accuracy)
+        if acc is not None:
+            assert "tp" not in acc["leaves"]
+
+
+# ---------------------------------------------------------------------------
+# path universe + shard-axis verdicts
+# ---------------------------------------------------------------------------
+
+class TestPathUniverse:
+    def test_sliced_prefix_carries_slice_axis(self, committed):
+        universe = shard_path_universe(committed)
+        assert layout_mod.AXIS_SLICE in universe["sliced/sum_squared_error"]
+        # a BARE name belongs to an unwrapped metric whose leading axis
+        # must still reduce — named-axis specs on it are the PR 8 bug
+        assert universe["sum_squared_error"] == set()
+        assert universe["total"] == set()
+        assert universe[layout_mod.SLICE_ROWS] == {layout_mod.AXIS_SLICE}
+
+    def test_leaf_may_shard_verdicts(self):
+        assert leaf_may_shard(layout_mod.SLICE_ROWS) is True
+        assert leaf_may_shard("sliced/total") is True
+        # bare [S] names: legitimate in name-keyed spec dicts — no verdict
+        assert leaf_may_shard("total") is None
+        # never-registered names: no verdict either way
+        assert leaf_may_shard("no_such_leaf_anywhere") is None
+        # ring rows shard per-slot in either spelling
+        assert leaf_may_shard("_ring_rows") is True
+
+    def test_known_replicated_leaf_is_refutable(self, committed):
+        name = next(
+            name
+            for entry in committed["classes"].values()
+            for name, rec in entry["leaves"].items()
+            if rec["shard_axis"] == layout_mod.AXIS_REPLICATED
+            and not leaf_shard_axes(name)
+        )
+        assert leaf_may_shard(name) is False
+
+    def test_no_manifest_env_disables_verdicts(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_NO_MANIFEST", "1")
+        layout_mod.invalidate_layout_cache()
+        assert leaf_may_shard(layout_mod.SLICE_ROWS) is None
+        assert leaf_shard_axes("total") == set()
+
+
+# ---------------------------------------------------------------------------
+# runtime consultation: bit parity with the probe on an 8-device mesh
+# ---------------------------------------------------------------------------
+
+class TestConsultation:
+    def _probe_specs(self, monkeypatch, m, mesh):
+        """The probe's answer with consultation disabled entirely."""
+        with monkeypatch.context() as mp:
+            mp.setenv("METRICS_TPU_NO_MANIFEST", "1")
+            layout_mod.invalidate_layout_cache()
+            specs = sliced_partition_specs(m, mesh)
+        layout_mod.invalidate_layout_cache()
+        return specs
+
+    def test_sliced_specs_bit_identical_and_probe_skipped(self, monkeypatch):
+        mesh = _mesh()
+        m = SlicedMetric(MeanSquaredError(), num_slices=64)
+        reset_manifest_consultation_counters()
+        fast = sliced_partition_specs(m, mesh)
+        counters = manifest_consultation_counters()
+        assert counters["probe_skips"] == 1 and counters["stale_fallbacks"] == 0
+        assert fast == self._probe_specs(monkeypatch, m, mesh)
+        assert all(s == P("slices") for s in fast.values())
+        assert layout_mod.SLICE_ROWS in fast
+
+    def test_nondivisible_num_slices_replicates(self, monkeypatch):
+        mesh = _mesh()
+        m = SlicedMetric(MeanSquaredError(), num_slices=13)  # 13 % 8 != 0
+        fast = sliced_partition_specs(m, mesh)
+        assert all(s == P() for s in fast.values())
+        assert fast == self._probe_specs(monkeypatch, m, mesh)
+        assert manifest_consultation_counters()["probe_skips"] >= 1
+
+    def test_plain_metric_replicates_from_manifest(self, monkeypatch):
+        mesh = _mesh()
+        m = MeanSquaredError()
+        fast = sliced_partition_specs(m, mesh)
+        assert all(s == P() for s in fast.values())
+        assert fast == self._probe_specs(monkeypatch, m, mesh)
+        assert manifest_consultation_counters()["probe_skips"] >= 1
+
+    def test_statically_invisible_class_falls_back(self):
+        """StatScores registers its leaves through a loop variable, so
+        Accuracy's manifest entry cannot cover the live state dict — the
+        consultation must refuse to vouch and count a stale fallback."""
+        mesh = _mesh()
+        m = Accuracy(num_classes=3)
+        reset_manifest_consultation_counters()
+        specs = sliced_partition_specs(m, mesh)
+        counters = manifest_consultation_counters()
+        assert counters["stale_fallbacks"] == 1 and counters["probe_skips"] == 0
+        assert all(s == P() for s in specs.values())
+
+    def test_shard_sliced_states_fast_path_parity(self, monkeypatch):
+        mesh = _mesh()
+        m_fast = SlicedMetric(MeanSquaredError(), num_slices=64)
+        reset_manifest_consultation_counters()
+        fast = shard_sliced_states(m_fast, mesh)
+        assert manifest_consultation_counters()["probe_skips"] == 1
+        with monkeypatch.context() as mp:
+            mp.setenv("METRICS_TPU_NO_MANIFEST", "1")
+            layout_mod.invalidate_layout_cache()
+            m_probe = SlicedMetric(MeanSquaredError(), num_slices=64)
+            probed = shard_sliced_states(m_probe, mesh)
+        layout_mod.invalidate_layout_cache()
+        assert fast == probed  # NamedSharding equality: same mesh, same spec
+        assert all(s == NamedSharding(mesh, P("slices")) for s in fast.values())
+        # and the placed metrics stay bit-identical through an update
+        ids = jnp.arange(64)
+        preds = jnp.arange(64, dtype=jnp.float32)
+        target = jnp.zeros(64)
+        m_fast.update(ids, preds, target)
+        m_probe.update(ids, preds, target)
+        assert bool(jnp.array_equal(m_fast.sum_squared_error, m_probe.sum_squared_error))
+        assert m_fast.sum_squared_error.sharding.spec == P("slices")
+
+    def test_custom_rules_always_probe(self):
+        mesh = _mesh()
+        m = SlicedMetric(MeanSquaredError(), num_slices=64)
+        reset_manifest_consultation_counters()
+        shard_sliced_states(m, mesh, rules=slice_partition_rules())
+        assert manifest_consultation_counters()["probe_skips"] == 0
+
+    def test_verify_mode_cross_checks_and_agrees(self, monkeypatch):
+        mesh = _mesh()
+        m = SlicedMetric(MeanSquaredError(), num_slices=64)
+        monkeypatch.setenv("METRICS_TPU_VERIFY_MANIFEST", "1")
+        reset_manifest_consultation_counters()
+        specs = sliced_partition_specs(m, mesh)
+        counters = manifest_consultation_counters()
+        # verify mode runs the probe and compares: no skip, no mismatch
+        assert counters["verify_mismatches"] == 0
+        assert counters["probe_skips"] == 0
+        assert all(s == P("slices") for s in specs.values())
+
+    def test_verify_mode_catches_divergence_and_trusts_probe(self, monkeypatch):
+        """Force fast-path/probe disagreement (doctored num_slices: the
+        manifest math sees 13, the live arrays still have 64 rows) — the
+        cross-check must warn, count, and return the PROBE's answer."""
+        mesh = _mesh()
+        m = SlicedMetric(MeanSquaredError(), num_slices=64)
+        m.num_slices = 13
+        monkeypatch.setenv("METRICS_TPU_VERIFY_MANIFEST", "1")
+        reset_manifest_consultation_counters()
+        with pytest.warns(UserWarning, match="disagree with the probe"):
+            specs = sliced_partition_specs(m, mesh)
+        assert manifest_consultation_counters()["verify_mismatches"] == 1
+        assert all(s == P("slices") for s in specs.values())  # the probe's verdict
+
+    def test_stale_manifest_file_falls_back(self, monkeypatch, tmp_path):
+        """A manifest whose MSE entry lost a leaf cannot vouch for the
+        live object: the consultation counts a stale fallback and the
+        probe still answers correctly."""
+        doctored = json.loads(MANIFEST_PATH.read_text())
+        del doctored["classes"]["regression/mse.py::MeanSquaredError"]["leaves"]["total"]
+        stale = tmp_path / "layout_manifest.json"
+        stale.write_text(json.dumps(doctored))
+        monkeypatch.setenv(layout_mod.ENV_LAYOUT_MANIFEST_PATH, str(stale))
+        layout_mod.invalidate_layout_cache()
+        mesh = _mesh()
+        m = SlicedMetric(MeanSquaredError(), num_slices=64)
+        reset_manifest_consultation_counters()
+        specs = sliced_partition_specs(m, mesh)
+        counters = manifest_consultation_counters()
+        assert counters["stale_fallbacks"] == 1 and counters["probe_skips"] == 0
+        assert all(s == P("slices") for s in specs.values())
+
+
+# ---------------------------------------------------------------------------
+# sync-path plausibility audit (parallel/distributed.py)
+# ---------------------------------------------------------------------------
+
+class TestSyncVerify:
+    def _sync(self, leaf_name):
+        mesh = _mesh()
+        leaf = jnp.arange(16, dtype=jnp.float32)
+
+        def body(x):
+            out = sync_pytree_in_mesh(
+                {"m": {leaf_name: x}},
+                {"m": {leaf_name: "sum"}},
+                "slices",
+                partition_specs={"m": {leaf_name: P("slices")}},
+            )
+            return out["m"][leaf_name]
+
+        return jax.jit(
+            shard_map(body, mesh=mesh, in_specs=(P("slices"),), out_specs=P("slices"))
+        )(leaf)
+
+    def test_audit_off_by_default(self):
+        reset_layout_verify_counters()
+        out = self._sync("data_leaf_unknown")
+        assert layout_verify_counters() == {"claims_checked": 0, "implausible_claims": 0}
+        assert bool(jnp.array_equal(out, jnp.arange(16, dtype=jnp.float32)))
+
+    def test_plausible_claim_passes_audit(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_VERIFY_MANIFEST", "1")
+        reset_layout_verify_counters()
+        self._sync(layout_mod.SLICE_ROWS)
+        counters = layout_verify_counters()
+        assert counters["claims_checked"] >= 1
+        assert counters["implausible_claims"] == 0
+
+    def test_implausible_claim_warns_but_behavior_unchanged(self, monkeypatch, committed):
+        replicated_name = next(
+            name
+            for entry in committed["classes"].values()
+            for name, rec in entry["leaves"].items()
+            if rec["shard_axis"] == layout_mod.AXIS_REPLICATED
+            and not leaf_shard_axes(name)
+        )
+        monkeypatch.setenv("METRICS_TPU_VERIFY_MANIFEST", "1")
+        reset_layout_verify_counters()
+        with pytest.warns(UserWarning, match="knows it only as replicated"):
+            out = self._sync(replicated_name)
+        assert layout_verify_counters()["implausible_claims"] >= 1
+        # the spec stays authoritative: passthrough identity, no reduction
+        assert bool(jnp.array_equal(out, jnp.arange(16, dtype=jnp.float32)))

@@ -4,6 +4,7 @@ tier-1 package gate (the whole of ``metrics_tpu/`` must be clean against
 the checked-in baseline).
 """
 import json
+import os
 import pathlib
 import subprocess
 import sys
@@ -18,6 +19,7 @@ from metrics_tpu.analysis import (
     default_package_root,
     get_rules,
     load_baseline,
+    render_github,
     render_json,
     save_baseline,
     split_by_baseline,
@@ -825,12 +827,15 @@ def f():
         payload = json.loads(
             render_json(kept, [], suppressed_count=len(suppressed), n_files=1, rules=["TL-PRINT"])
         )
-        assert payload["version"] == 1
+        assert payload["version"] == 2
         assert payload["tool"] == "tracelint"
         assert isinstance(payload["violations"], list) and payload["violations"]
         entry = payload["violations"][0]
-        for field in ("rule", "path", "line", "col", "message", "snippet", "baselined"):
+        # v2 adds the repo-relative "file" key; every v1 field survives so
+        # consumers keyed on path/line/rule are unaffected
+        for field in ("rule", "path", "file", "line", "col", "message", "snippet", "baselined"):
             assert field in entry
+        assert entry["file"] == "metrics_tpu/" + entry["path"]
         assert entry["baselined"] is False
         summary = payload["summary"]
         for field in ("files", "new", "baselined", "suppressed", "rules"):
@@ -917,6 +922,10 @@ class TestPackageGate:
             "TL-DECL",
             "TL-FLOW",
             "TL-BLOCK",
+            "TL-SHARD",
+            "TL-MERGE",
+            "TL-WIRE",
+            "TL-LOCK",
         }
 
     def test_cli_script_exits_zero_on_package(self):
@@ -926,6 +935,37 @@ class TestPackageGate:
             text=True,
         )
         assert result.returncode == 0, result.stdout + result.stderr
+
+    def test_manifest_check_covers_both_manifests_without_jax(self, tmp_path):
+        """The CI freshness gate (`--manifest --check`) must regenerate and
+        verify BOTH manifests on a machine with no accelerator stack: run
+        it in a subprocess where importing jax is a hard error."""
+        blocker = tmp_path / "sitecustomize.py"
+        blocker.write_text(
+            "import sys\n"
+            "class _Block:\n"
+            "    def find_spec(self, name, path=None, target=None):\n"
+            "        if name == 'jax' or name.startswith('jax.'):\n"
+            "            raise ImportError('jax import blocked by test')\n"
+            "        return None\n"
+            "sys.meta_path.insert(0, _Block())\n"
+        )
+        env = dict(os.environ, PYTHONPATH=str(tmp_path))
+        result = subprocess.run(
+            [
+                sys.executable,
+                str(REPO_ROOT / "scripts" / "tracelint.py"),
+                "--manifest",
+                "--check",
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=str(REPO_ROOT),
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        out = result.stdout
+        assert "fusibility" in out and "layout" in out, out
 
 
 # ---------------------------------------------------------------------------
@@ -2386,3 +2426,441 @@ class M(Metric):
         assert kid["verdict"] == "unknown" and kid["declared_jit_unsafe"] is True
         fusible_count = sum(1 for v in metrics.values() if v["verdict"] == "fusible")
         assert fusible_count >= 35, fusible_count
+
+
+# ---------------------------------------------------------------------------
+# GitHub reporter (--format=github workflow commands)
+# ---------------------------------------------------------------------------
+
+class TestGithubReporter:
+    def test_error_annotation_shape(self):
+        kept, _ = _check(
+            """
+def f():
+    print("a")
+"""
+        )
+        out = render_github(kept, [])
+        line = out.splitlines()[0]
+        assert line.startswith("::error file=metrics_tpu/classification/fixture.py,line=")
+        assert ",col=" in line and ",title=tracelint TL-PRINT::" in line
+
+    def test_baselined_become_warnings_and_newlines_escape(self):
+        kept, _ = _check(
+            """
+def f():
+    print("a")
+"""
+        )
+        out = render_github([], kept)
+        assert out.splitlines()[0].startswith("::warning file=")
+        # messages must be %0A-escaped, never raw newlines after `::`
+        assert "\n" not in out.splitlines()[0]
+
+    def test_empty_renders_empty(self):
+        assert render_github([], []) == ""
+
+    def test_cli_format_github(self, tmp_path, capsys):
+        src = tmp_path / "mod.py"
+        src.write_text("print('x')\n")
+        rc = cli_main([str(src), "--format=github", "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "::error file=" in out
+
+
+# ---------------------------------------------------------------------------
+# TL-SHARD
+# ---------------------------------------------------------------------------
+
+_SPEC_PREAMBLE = """
+from jax.sharding import NamedSharding, PartitionSpec
+"""
+
+
+def _shard_check(source, relpath="sliced/fixture.py"):
+    kept, suppressed = analyze_source(
+        _SPEC_PREAMBLE + source, relpath, rules=get_rules(["TL-SHARD"])
+    )
+    return kept, suppressed
+
+
+class TestShardRule:
+    def test_unconditional_dictcomp_over_defaults_flags(self):
+        """The PR 8 mutant: every leaf claimed sharded with no divisibility
+        guard — the leaves the fallback leaves replicated would silently
+        skip their required reduction."""
+        kept, _ = _shard_check(
+            """
+def sliced_partition_specs(m, axis_name):
+    return {name: PartitionSpec(axis_name) for name in m._defaults}
+"""
+        )
+        assert _rules_of(kept) == {"TL-SHARD"}
+        assert "unconditionally" in kept[0].message
+
+    def test_guarded_dictcomp_passes(self):
+        kept, _ = _shard_check(
+            """
+def sliced_partition_specs(m, axis_name, shardable):
+    return {
+        name: (PartitionSpec(axis_name) if shardable(name) else PartitionSpec())
+        for name in m._defaults
+    }
+"""
+        )
+        assert not kept
+
+    def test_helper_routed_dictcomp_passes(self):
+        """Routing through a helper call keeps the divisibility authority
+        with the helper — no static claim to audit."""
+        kept, _ = _shard_check(
+            """
+def shard_sliced_states(m, mesh):
+    return {name: get_naive_slice_sharding(v, mesh) for name, v in m._defaults.items()}
+"""
+        )
+        assert not kept
+
+    def test_spec_dict_claiming_replicated_leaf_flags(self):
+        kept, _ = _shard_check(
+            """
+SPECS = {"total": PartitionSpec("slices")}
+"""
+        )
+        assert _rules_of(kept) == {"TL-SHARD"}
+        assert "`total`" in kept[0].message
+
+    def test_spec_dict_on_slice_rows_passes(self):
+        kept, _ = _shard_check(
+            """
+SPECS = {"_slice_rows": PartitionSpec("slices"), "total": PartitionSpec()}
+"""
+        )
+        assert not kept
+
+    def test_rule_set_missing_catchall_flags(self):
+        kept, _ = _shard_check(
+            """
+import re
+RULES = (
+    (f"{re.escape(SLICE_ROWS)}$", PartitionSpec("slices")),
+)
+"""
+        )
+        assert _rules_of(kept) == {"TL-SHARD"}
+        assert "unmatched" in kept[0].message
+
+    def test_named_axis_catchall_flags_replicated_first_match(self):
+        kept, _ = _shard_check(
+            """
+RULES = (
+    (".*", PartitionSpec("slices")),
+)
+"""
+        )
+        assert any("cross-rank reduction" in v.message for v in kept)
+
+    def test_scoped_rule_set_with_replicate_catchall_passes(self):
+        kept, _ = _shard_check(
+            """
+import re
+RULES = (
+    (f"{re.escape(SLICE_ROWS)}$", PartitionSpec("slices")),
+    (".*", PartitionSpec()),
+)
+"""
+        )
+        assert not kept
+
+
+# ---------------------------------------------------------------------------
+# TL-MERGE
+# ---------------------------------------------------------------------------
+
+def _merge_check(source, relpath="windowed/fixture.py"):
+    kept, suppressed = analyze_source(
+        _METRIC_PREAMBLE + source, relpath, rules=get_rules(["TL-MERGE"])
+    )
+    return kept, suppressed
+
+
+class TestMergeRuleStatic:
+    def test_noncommutative_fold_step_flags(self):
+        kept, _ = _merge_check(
+            """
+class TopKMerge:
+    merge_like = True
+    def __call__(self, stacked):
+        out = stacked[0]
+        for i in range(1, 4):
+            out = out - stacked[i]
+        return out
+"""
+        )
+        assert _rules_of(kept) == {"TL-MERGE"}
+        assert "non-commutative" in kept[0].message
+
+    def test_commutative_fold_passes(self):
+        kept, _ = _merge_check(
+            """
+class SumMerge:
+    merge_like = True
+    def __call__(self, stacked):
+        out = stacked[0]
+        for i in range(1, 4):
+            out = out + stacked[i]
+        return out
+"""
+        )
+        assert not kept
+
+    def test_untagged_class_is_out_of_scope(self):
+        """Plain callables (not merge_like-tagged) may do whatever they
+        like — the collector never folds through them."""
+        kept, _ = _merge_check(
+            """
+class PlainDelta:
+    def __call__(self, stacked):
+        return stacked[0] - stacked[1]
+"""
+        )
+        assert not kept
+
+    def test_host_state_and_instance_mutation_flag(self):
+        kept, _ = _merge_check(
+            """
+import time
+class StampMerge:
+    merge_like = True
+    def __call__(self, stacked):
+        self.last = time.time()
+        return jnp.sum(stacked, axis=0)
+"""
+        )
+        assert len(kept) == 2 and _rules_of(kept) == {"TL-MERGE"}
+        messages = " ".join(v.message for v in kept)
+        assert "host state" in messages and "mutates" in messages
+
+    def test_ring_full_reduce_flags(self):
+        kept, _ = _merge_check(
+            """
+class RingMerge:
+    merge_like = True
+    windowed_kind = "ring"
+    def __call__(self, stacked):
+        return jnp.sum(stacked)
+"""
+        )
+        assert _rules_of(kept) == {"TL-MERGE"}
+        assert "slot-aligned" in kept[0].message
+
+    def test_ring_slot_aligned_reduce_passes(self):
+        kept, _ = _merge_check(
+            """
+class RingMerge:
+    merge_like = True
+    windowed_kind = "ring"
+    def __call__(self, stacked):
+        return jnp.sum(stacked, axis=0)
+"""
+        )
+        assert not kept
+
+    def test_ring_flatten_flags(self):
+        kept, _ = _merge_check(
+            """
+class RingMerge:
+    merge_like = True
+    windowed_kind = "ring"
+    def __call__(self, stacked):
+        rows = stacked.ravel()
+        return jnp.sort(rows)
+"""
+        )
+        assert any("time-bucket" in v.message for v in kept)
+
+    def test_shipped_merge_reducers_are_clean(self):
+        """Every merge_like reducer actually shipped must satisfy its own
+        rule — the arrival-order contract is pinned dynamically in
+        test_fleet_collector, statically here."""
+        root = default_package_root()
+        for rel in (
+            "sketches/reservoir.py",
+            "sketches/quantile.py",
+            "windowed/reducers.py",
+            "retrieval/table.py",
+        ):
+            kept, _ = analyze_source(
+                (root / rel).read_text(), rel, rules=get_rules(["TL-MERGE"])
+            )
+            assert not kept, (rel, [v.message for v in kept])
+
+
+# ---------------------------------------------------------------------------
+# TL-WIRE
+# ---------------------------------------------------------------------------
+
+def _wire_check(source, relpath="classification/fixture.py"):
+    kept, suppressed = analyze_source(
+        _METRIC_PREAMBLE + source, relpath, rules=get_rules(["TL-WIRE"])
+    )
+    return kept, suppressed
+
+
+class TestWireRule:
+    def test_untagged_callable_reducer_flags(self):
+        kept, _ = _wire_check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", jnp.zeros(()), lambda a, b: a + b)
+"""
+        )
+        assert _rules_of(kept) == {"TL-WIRE"}
+        assert "untagged callable reducer" in kept[0].message
+
+    def test_constructor_parameterized_reducer_passes(self):
+        """BaseAggregator's pattern: the caller picks the fold, add_state
+        validates it at registration — runtime keeps authority."""
+        kept, _ = _wire_check(
+            """
+class M(Metric):
+    def __init__(self, fn):
+        super().__init__()
+        self.add_state("acc", jnp.zeros(()), fn)
+"""
+        )
+        assert not kept
+
+    def test_string_reducer_passes(self):
+        kept, _ = _wire_check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", jnp.zeros(()), "sum")
+"""
+        )
+        assert not kept
+
+    def test_wire_opaque_default_flags(self):
+        kept, _ = _wire_check(
+            """
+OPAQUE = object()
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("blob", OPAQUE, "sum")
+"""
+        )
+        assert _rules_of(kept) == {"TL-WIRE"}
+        assert "wire-opaque" in kept[0].message
+
+    def test_locally_derived_default_passes(self):
+        kept, _ = _wire_check(
+            """
+class M(Metric):
+    def __init__(self, exact):
+        super().__init__()
+        default = jnp.zeros((4,)) if exact else jnp.zeros((2,))
+        self.add_state("v", default, "sum")
+"""
+        )
+        assert not kept
+
+    def test_mixed_modes_without_escape_hatch_flag(self):
+        kept, _ = _wire_check(
+            """
+class M(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("count", jnp.zeros(()), "sum")
+        self.add_state("items", [], "cat")
+"""
+        )
+        assert any("__exact_mode_attr__" in v.message for v in kept)
+
+    def test_mixed_modes_with_exact_attr_pass(self):
+        kept, _ = _wire_check(
+            """
+class M(Metric):
+    __exact_mode_attr__ = "exact"
+    def __init__(self, exact=False):
+        super().__init__()
+        self.exact = exact
+        self.add_state("count", jnp.zeros(()), "sum")
+        if exact:
+            self.add_state("items", [], "cat")
+"""
+        )
+        assert not any("__exact_mode_attr__" in v.message for v in kept)
+
+
+# ---------------------------------------------------------------------------
+# TL-LOCK
+# ---------------------------------------------------------------------------
+
+def _lock_check(source, relpath="core/pipeline.py"):
+    kept, suppressed = analyze_source(source, relpath, rules=get_rules(["TL-LOCK"]))
+    return kept, suppressed
+
+
+class TestLockRule:
+    BAD = """
+class AsyncUpdateHandle:
+    def stats(self):
+        return self._pending
+"""
+
+    def test_unlocked_read_flags(self):
+        kept, _ = _lock_check(self.BAD)
+        assert _rules_of(kept) == {"TL-LOCK"}
+        assert "_pending" in kept[0].message and "_cond" in kept[0].message
+
+    def test_locked_read_and_exempt_contexts_pass(self):
+        kept, _ = _lock_check(
+            """
+class AsyncUpdateHandle:
+    def __init__(self):
+        self._pending = 0
+    def stats(self):
+        with self._cond:
+            return self._pending
+    def _drain_locked(self):
+        return self._pending
+"""
+        )
+        assert not kept
+
+    def test_closure_inherits_lexical_lock_scope(self):
+        kept, _ = _lock_check(
+            """
+class AsyncUpdateHandle:
+    def stats(self):
+        with self._cond:
+            def read():
+                return self._pending
+            return read()
+"""
+        )
+        assert not kept
+
+    def test_registry_is_path_scoped(self):
+        """The same access pattern outside the registered files is not the
+        rule's business — unregistered classes own their own discipline."""
+        kept, _ = _lock_check(self.BAD, relpath="classification/fixture.py")
+        assert not kept
+
+    def test_collector_registry_fields(self):
+        kept, _ = _lock_check(
+            """
+class FleetCollector:
+    def errors(self):
+        return self.fold_errors
+""",
+            relpath="observability/collector.py",
+        )
+        assert _rules_of(kept) == {"TL-LOCK"}
+        assert "_lock" in kept[0].message
